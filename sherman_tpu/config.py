@@ -1,0 +1,141 @@
+"""Configuration for the TPU DSM + B+Tree stack.
+
+Mirrors the reference's compile-time constant surface (``include/Common.h``,
+``include/Config.h``) as runtime dataclasses, so one build serves tests
+(8 virtual CPU devices) and real TPU meshes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# ---------------------------------------------------------------------------
+# Word / page geometry.
+#
+# The reference uses 1 KB pages (Common.h:119-121).  We keep 1 KB pages but
+# express everything in 32-bit words: TPUs have no native int64 lanes, so
+# 64-bit keys/values/pointers are stored as pairs of int32 words (bit-pattern
+# of the uint64 hi/lo halves).
+# ---------------------------------------------------------------------------
+
+PAGE_BYTES = 1024
+PAGE_WORDS = PAGE_BYTES // 4  # 256 int32 words per page
+
+# Packed 32-bit global page address {node:8, page:24} — the TPU analogue of
+# the reference's 64-bit GlobalAddress {nodeID:16, offset:48}
+# (GlobalAddress.h:10-16).  addr==0 is NULL; page 0 of node 0 is reserved
+# (it holds the root pointer + cluster meta words, cf. the reference's fixed
+# root-pointer slot at node 0, kChunkSize/2 — Tree.cpp:90-97, Common.h:82-84).
+ADDR_NODE_BITS = 8
+ADDR_PAGE_BITS = 24
+ADDR_PAGE_MASK = (1 << ADDR_PAGE_BITS) - 1
+MAX_MACHINE = 1 << ADDR_NODE_BITS
+
+# Meta words inside the reserved page 0 of node 0.
+META_ROOT_ADDR_W = 0   # packed addr of the current root page
+META_ROOT_LEVEL_W = 1  # level of the root page
+
+
+@dataclasses.dataclass(frozen=True)
+class DSMConfig:
+    """Cluster + memory-pool shape (reference ``Config.h:13-22``).
+
+    ``machine_nr`` plays the role of DSMConfig::machineNR; the per-node pool
+    is ``pages_per_node`` 1 KB pages of HBM instead of ``dsmSize`` GB of
+    hugepages (DSM.cpp:40).
+    """
+
+    machine_nr: int = 1
+    pages_per_node: int = 4096
+    # Global lock table shard per node; the analogue of the 16K on-NIC
+    # device-memory locks (kLockChipMemSize = 128 KB -> 16K 64-bit words,
+    # Common.h:86-93).  Ours are 32-bit words.
+    locks_per_node: int = 16384
+    # Per-(source, destination) request capacity of one DSM step's
+    # all_to_all exchange.  Requests over capacity are dropped with ok=0 and
+    # retried by the caller (cf. RDMA send-queue depth).
+    step_capacity: int = 512
+    # Chunk size of the memory-node global allocator, in pages
+    # (kChunkSize = 32 MB -> 32768 pages, Common.h:80).  Scaled down by
+    # default so small test pools still have multiple chunks.
+    chunk_pages: int = 256
+
+    def __post_init__(self):
+        assert 1 <= self.machine_nr <= MAX_MACHINE
+        assert self.pages_per_node <= (1 << ADDR_PAGE_BITS)
+
+
+# ---------------------------------------------------------------------------
+# B+Tree page layout (word offsets inside a 256-word page).
+#
+# Mirrors the reference Header/InternalEntry/LeafEntry layouts
+# (Tree.h:130-187) with TPU-friendly word granularity:
+#   word 0:   front_version        (Tree.h:199-210 front/rear page versions)
+#   word 1:   leftmost_ptr         (internal pages; Header.leftmost_ptr)
+#   word 2:   sibling_ptr          (B-link; Header.sibling_ptr)
+#   word 3:   level                (0 = leaf)
+#   word 4:   nkeys                (Header.last_index + 1)
+#   word 5-6: lowest key (hi, lo)  (fence keys, Header.lowest/highest)
+#   word 7-8: highest key (hi, lo)
+#   word 9..254: entries
+#   word 255: rear_version
+#
+# Internal entry  = [key_hi, key_lo, child_addr]            -> 3 words, 81 max
+# Leaf entry      = [fver, key_hi, key_lo, val_hi, val_lo, rver] -> 6 words,
+#                   41 max; fver/rver are the per-entry two-level versions
+#                   (LeafEntry f_version/r_version, Tree.h:174-187): an entry
+#                   is consistent iff fver == rver != 0; 0 marks a free slot.
+# ---------------------------------------------------------------------------
+
+W_FRONT_VER = 0
+W_LEFTMOST = 1
+W_SIBLING = 2
+W_LEVEL = 3
+W_NKEYS = 4
+W_LOW_HI = 5
+W_LOW_LO = 6
+W_HIGH_HI = 7
+W_HIGH_LO = 8
+W_ENTRIES = 9
+W_REAR_VER = PAGE_WORDS - 1
+
+ENTRY_WORDS_AVAIL = W_REAR_VER - W_ENTRIES  # 246
+
+INTERNAL_ENTRY_WORDS = 3
+LEAF_ENTRY_WORDS = 6
+
+# Leaf entry word offsets (relative to entry start).
+LE_FVER = 0
+LE_KEY_HI = 1
+LE_KEY_LO = 2
+LE_VAL_HI = 3
+LE_VAL_LO = 4
+LE_RVER = 5
+
+INTERNAL_CAP = ENTRY_WORDS_AVAIL // INTERNAL_ENTRY_WORDS  # 82 -> reference 61
+LEAF_CAP = ENTRY_WORDS_AVAIL // LEAF_ENTRY_WORDS          # 41 -> reference 54
+
+# 64-bit key sentinels (stored as hi/lo uint32 pairs).  User keys must lie in
+# [KEY_MIN, KEY_MAX]; the fences use NEG_INF/POS_INF (cf. kKeyMin/kKeyMax in
+# the reference tests).
+KEY_NEG_INF = 0
+KEY_POS_INF = (1 << 64) - 1
+KEY_MIN = 1
+KEY_MAX = KEY_POS_INF - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeConfig:
+    """Tree-level knobs (reference ``Common.h:73-104`` namespace define)."""
+
+    # Max tree height the batched device kernels unroll/loop over.
+    max_level: int = 8
+    # Extra descent iterations budgeted for B-link sibling chases per op.
+    sibling_chase_budget: int = 4
+    # Rounds of the device-side insert retry loop before falling back to the
+    # host slow path (lock conflicts / splits).
+    insert_rounds: int = 8
+    # Bulk-load leaf fill fraction (cf. kWarmRatio=0.8, benchmark.cpp:19).
+    bulk_fill: float = 0.75
+    # Local lock table size for the hierarchical lock (kNumOfLock parity).
+    hand_over_limit: int = 8  # kMaxHandOverTime, Common.h:101

@@ -1,0 +1,136 @@
+"""Bit-level primitives: 64-bit keys as int32 pairs, packed global addresses,
+unsigned comparisons, and the lock-index hash.
+
+TPUs have no native 64-bit integer lanes, so all 64-bit quantities (keys,
+values — reference ``Key``/``Value`` uint64) travel as (hi, lo) pairs of
+int32 words holding the uint32 bit patterns.  Comparisons flip the sign bit
+to reuse signed int32 compares as unsigned ones.
+
+Global addresses are packed int32 {node:8, page:24} — the TPU analogue of the
+reference's 64-bit ``GlobalAddress`` {nodeID:16, offset:48}
+(``GlobalAddress.h:10-16``); word-granular sub-addressing uses a separate
+word-offset field instead of byte offsets.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from sherman_tpu.config import ADDR_PAGE_BITS, ADDR_PAGE_MASK
+
+_SIGN = np.int32(np.uint32(0x80000000).view(np.int32))
+_U32_MASK = (1 << 32) - 1
+
+
+# -- host-side scalar helpers -------------------------------------------------
+
+def key_to_pair(k: int) -> tuple[int, int]:
+    """Split a Python uint64 key into (hi, lo) int32 bit patterns."""
+    k = int(k) & ((1 << 64) - 1)
+    hi = np.uint32(k >> 32).view(np.int32).item()
+    lo = np.uint32(k & _U32_MASK).view(np.int32).item()
+    return hi, lo
+
+
+def pair_to_key(hi, lo) -> int:
+    """Rebuild the Python uint64 key from (hi, lo) int32 bit patterns."""
+    hi_u = int(np.int64(int(hi)) & _U32_MASK)
+    lo_u = int(np.int64(int(lo)) & _U32_MASK)
+    return (hi_u << 32) | lo_u
+
+
+def keys_to_pairs(ks) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized host conversion: uint64 array -> (hi, lo) int32 arrays."""
+    ks = np.asarray(ks, dtype=np.uint64)
+    hi = (ks >> np.uint64(32)).astype(np.uint32).view(np.int32)
+    lo = (ks & np.uint64(_U32_MASK)).astype(np.uint32).view(np.int32)
+    return hi, lo
+
+
+def pairs_to_keys(hi, lo) -> np.ndarray:
+    hi = np.asarray(hi).view(np.uint32).astype(np.uint64)
+    lo = np.asarray(lo).view(np.uint32).astype(np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+# -- device-side (jnp) unsigned compare on (hi, lo) pairs ---------------------
+
+def _ux(x):
+    return jnp.bitwise_xor(x, _SIGN)
+
+
+def u32_lt(a, b):
+    return _ux(a) < _ux(b)
+
+
+def u32_le(a, b):
+    return _ux(a) <= _ux(b)
+
+
+def key_lt(ahi, alo, bhi, blo):
+    """(ahi,alo) < (bhi,blo) as uint64."""
+    return u32_lt(ahi, bhi) | ((ahi == bhi) & u32_lt(alo, blo))
+
+
+def key_le(ahi, alo, bhi, blo):
+    return u32_lt(ahi, bhi) | ((ahi == bhi) & u32_le(alo, blo))
+
+
+def key_eq(ahi, alo, bhi, blo):
+    return (ahi == bhi) & (alo == blo)
+
+
+# -- packed global page addresses --------------------------------------------
+
+def make_addr(node, page):
+    """Pack (node, page) into an int32 address; works for ints and arrays."""
+    if isinstance(node, (int, np.integer)) and isinstance(page, (int, np.integer)):
+        v = (int(node) << ADDR_PAGE_BITS) | (int(page) & ADDR_PAGE_MASK)
+        return np.uint32(v).view(np.int32).item()
+    return jnp.bitwise_or(
+        jnp.left_shift(jnp.asarray(node, jnp.int32), ADDR_PAGE_BITS),
+        jnp.bitwise_and(jnp.asarray(page, jnp.int32), ADDR_PAGE_MASK),
+    )
+
+
+def addr_node(addr):
+    if isinstance(addr, (int, np.integer)):
+        return (int(np.int64(int(addr)) & _U32_MASK)) >> ADDR_PAGE_BITS
+    a = jnp.asarray(addr, jnp.int32).astype(jnp.uint32)
+    return jnp.right_shift(a, ADDR_PAGE_BITS).astype(jnp.int32)
+
+
+def addr_page(addr):
+    if isinstance(addr, (int, np.integer)):
+        return int(addr) & ADDR_PAGE_MASK
+    return jnp.bitwise_and(jnp.asarray(addr, jnp.int32), ADDR_PAGE_MASK)
+
+
+NULL_ADDR = 0
+
+
+def addr_is_null(addr):
+    if isinstance(addr, (int, np.integer)):
+        return int(addr) == 0
+    return addr == 0
+
+
+# -- lock hash ---------------------------------------------------------------
+# The reference hashes page addresses onto the on-chip lock table with
+# CityHash64 % kNumOfLock (Tree.cpp:702-707,832-842).  We use a 32-bit
+# Murmur3 finalizer — cheap on the VPU and well-mixing for packed addresses.
+
+def hash32(x):
+    x = jnp.asarray(x, jnp.int32).astype(jnp.uint32)
+    x = jnp.bitwise_xor(x, jnp.right_shift(x, 16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = jnp.bitwise_xor(x, jnp.right_shift(x, 13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = jnp.bitwise_xor(x, jnp.right_shift(x, 16))
+    return x
+
+
+def lock_index(addr, locks_per_node: int):
+    """Lock word index for a page address (on the page's owner node)."""
+    return (hash32(addr) % jnp.uint32(locks_per_node)).astype(jnp.int32)

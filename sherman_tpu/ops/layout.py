@@ -1,0 +1,230 @@
+"""B+Tree page layout: vectorized pack/unpack over [..., 256]-word pages.
+
+Mirrors the reference page structures (``Tree.h:130-210``):
+``Header{leftmost_ptr, sibling_ptr, level, last_index, lowest, highest}``,
+sorted ``InternalEntry{key, ptr}`` arrays, and unsorted ``LeafEntry`` slots
+with the two-level (per-entry f/r) versions that enable single-entry
+write-back (``Tree.cpp:914-921``) — but expressed as word offsets into a
+256-word int32 page so that whole batches of pages can be searched with
+vectorized compares on the VPU instead of per-entry scalar loops.
+
+All functions accept pages of shape [..., PAGE_WORDS] and broadcast.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu.ops import bits
+
+
+# -- header accessors ---------------------------------------------------------
+
+def h_front_ver(page):
+    return page[..., C.W_FRONT_VER]
+
+
+def h_rear_ver(page):
+    return page[..., C.W_REAR_VER]
+
+
+def h_leftmost(page):
+    return page[..., C.W_LEFTMOST]
+
+
+def h_sibling(page):
+    return page[..., C.W_SIBLING]
+
+
+def h_level(page):
+    return page[..., C.W_LEVEL]
+
+
+def h_nkeys(page):
+    return page[..., C.W_NKEYS]
+
+
+def h_lowest(page):
+    return page[..., C.W_LOW_HI], page[..., C.W_LOW_LO]
+
+
+def h_highest(page):
+    return page[..., C.W_HIGH_HI], page[..., C.W_HIGH_LO]
+
+
+def page_consistent(page):
+    """Front/rear version match (torn-page check, ``Tree.cpp:600-618``)."""
+    return h_front_ver(page) == h_rear_ver(page)
+
+
+# -- internal pages -----------------------------------------------------------
+
+def internal_entry_words(slot):
+    """Word offset of internal entry `slot` (static int or array)."""
+    return C.W_ENTRIES + slot * C.INTERNAL_ENTRY_WORDS
+
+
+_I_SLOTS = np.arange(C.INTERNAL_CAP)
+_I_KHI = C.W_ENTRIES + _I_SLOTS * C.INTERNAL_ENTRY_WORDS
+_I_KLO = _I_KHI + 1
+_I_PTR = _I_KHI + 2
+
+
+def internal_keys(page):
+    """-> (khi, klo) arrays of shape [..., INTERNAL_CAP]."""
+    return page[..., _I_KHI], page[..., _I_KLO]
+
+
+def internal_ptrs(page):
+    return page[..., _I_PTR]
+
+
+def internal_pick_child(page, khi, klo):
+    """Vectorized child pick (``internal_page_search``, Tree.cpp:665-685).
+
+    Sorted entries e_0..e_{n-1}; keys < e_0.key go to leftmost_ptr; else the
+    child of the last entry with entry.key <= k.  Returns packed child addr.
+    ``khi/klo`` broadcast against page batch dims.
+    """
+    ekhi, eklo = internal_keys(page)
+    n = h_nkeys(page)[..., None]
+    valid = _I_SLOTS < n
+    le = bits.key_le(ekhi, eklo, khi[..., None], klo[..., None]) & valid
+    # index of last entry with key <= k; -1 -> leftmost
+    idx = jnp.sum(le.astype(jnp.int32), axis=-1) - 1
+    ptrs = internal_ptrs(page)
+    child = jnp.take_along_axis(ptrs, jnp.maximum(idx, 0)[..., None], axis=-1)[..., 0]
+    return jnp.where(idx < 0, h_leftmost(page), child)
+
+
+# -- leaf pages ---------------------------------------------------------------
+
+_L_SLOTS = np.arange(C.LEAF_CAP)
+_L_BASE = C.W_ENTRIES + _L_SLOTS * C.LEAF_ENTRY_WORDS
+_L_FVER = _L_BASE + C.LE_FVER
+_L_KHI = _L_BASE + C.LE_KEY_HI
+_L_KLO = _L_BASE + C.LE_KEY_LO
+_L_VHI = _L_BASE + C.LE_VAL_HI
+_L_VLO = _L_BASE + C.LE_VAL_LO
+_L_RVER = _L_BASE + C.LE_RVER
+
+
+def leaf_entry_base(slot):
+    return C.W_ENTRIES + slot * C.LEAF_ENTRY_WORDS
+
+
+def leaf_slots_view(page):
+    """-> dict of [..., LEAF_CAP] arrays: fver, khi, klo, vhi, vlo, rver."""
+    return {
+        "fver": page[..., _L_FVER],
+        "khi": page[..., _L_KHI],
+        "klo": page[..., _L_KLO],
+        "vhi": page[..., _L_VHI],
+        "vlo": page[..., _L_VLO],
+        "rver": page[..., _L_RVER],
+    }
+
+
+def leaf_slot_used(page):
+    """A slot is live iff fver == rver != 0 (two-level version rule)."""
+    fv, rv = page[..., _L_FVER], page[..., _L_RVER]
+    return (fv == rv) & (fv != 0)
+
+
+def leaf_find_key(page, khi, klo):
+    """Vectorized ``leaf_page_search`` (Tree.cpp:687-697): scan all slots.
+
+    Returns (found, vhi, vlo, slot).  slot = -1 when absent.
+    """
+    used = leaf_slot_used(page)
+    ekhi, eklo = page[..., _L_KHI], page[..., _L_KLO]
+    hit = used & bits.key_eq(ekhi, eklo, khi[..., None], klo[..., None])
+    slot = jnp.argmax(hit, axis=-1)
+    found = jnp.any(hit, axis=-1)
+    take = lambda a: jnp.take_along_axis(a, slot[..., None], axis=-1)[..., 0]
+    vhi = jnp.where(found, take(page[..., _L_VHI]), 0)
+    vlo = jnp.where(found, take(page[..., _L_VLO]), 0)
+    return found, vhi, vlo, jnp.where(found, slot, -1)
+
+
+def leaf_find_free_slot(page):
+    """First free slot index, or -1 if the leaf is full."""
+    free = ~leaf_slot_used(page)
+    slot = jnp.argmax(free, axis=-1)
+    any_free = jnp.any(free, axis=-1)
+    return jnp.where(any_free, slot, -1)
+
+
+def in_fence(page, khi, klo):
+    """lowest <= k < highest (fence check, ``Tree.cpp:859-872``)."""
+    lhi, llo = h_lowest(page)
+    hhi, hlo = h_highest(page)
+    return bits.key_le(lhi, llo, khi, klo) & bits.key_lt(khi, klo, hhi, hlo)
+
+
+def needs_sibling_chase(page, khi, klo):
+    """k >= highest -> follow B-link sibling (``Tree.cpp:626-629``)."""
+    hhi, hlo = h_highest(page)
+    return ~bits.key_lt(khi, klo, hhi, hlo)
+
+
+# -- host-side page construction (numpy) -------------------------------------
+
+def np_empty_page(level: int, lowest: int, highest: int,
+                  sibling: int = 0, leftmost: int = 0,
+                  version: int = 1) -> np.ndarray:
+    """Build a fresh page as a host numpy word array."""
+    pg = np.zeros(C.PAGE_WORDS, dtype=np.int32)
+    pg[C.W_FRONT_VER] = version
+    pg[C.W_REAR_VER] = version
+    pg[C.W_LEFTMOST] = leftmost
+    pg[C.W_SIBLING] = sibling
+    pg[C.W_LEVEL] = level
+    pg[C.W_NKEYS] = 0
+    pg[C.W_LOW_HI], pg[C.W_LOW_LO] = bits.key_to_pair(lowest)
+    pg[C.W_HIGH_HI], pg[C.W_HIGH_LO] = bits.key_to_pair(highest)
+    return pg
+
+
+def np_leaf_set_entry(pg: np.ndarray, slot: int, key: int, value: int,
+                      ver: int = 1) -> None:
+    base = leaf_entry_base(slot)
+    pg[base + C.LE_FVER] = ver
+    pg[base + C.LE_KEY_HI], pg[base + C.LE_KEY_LO] = bits.key_to_pair(key)
+    pg[base + C.LE_VAL_HI], pg[base + C.LE_VAL_LO] = bits.key_to_pair(value)
+    pg[base + C.LE_RVER] = ver
+
+
+def np_leaf_clear_entry(pg: np.ndarray, slot: int) -> None:
+    base = leaf_entry_base(slot)
+    pg[base:base + C.LEAF_ENTRY_WORDS] = 0
+
+
+def np_internal_set_entry(pg: np.ndarray, slot: int, key: int, child: int) -> None:
+    base = internal_entry_words(slot)
+    pg[base], pg[base + 1] = bits.key_to_pair(key)
+    pg[base + 2] = child
+
+
+def np_leaf_entries(pg: np.ndarray) -> list[tuple[int, int, int]]:
+    """-> list of (key, value, slot) of live entries (host debugging/tests)."""
+    out = []
+    for s in range(C.LEAF_CAP):
+        base = leaf_entry_base(s)
+        fv, rv = pg[base + C.LE_FVER], pg[base + C.LE_RVER]
+        if fv == rv and fv != 0:
+            k = bits.pair_to_key(pg[base + C.LE_KEY_HI], pg[base + C.LE_KEY_LO])
+            v = bits.pair_to_key(pg[base + C.LE_VAL_HI], pg[base + C.LE_VAL_LO])
+            out.append((k, v, s))
+    return out
+
+
+def np_internal_entries(pg: np.ndarray) -> list[tuple[int, int]]:
+    out = []
+    for s in range(int(pg[C.W_NKEYS])):
+        base = internal_entry_words(s)
+        k = bits.pair_to_key(pg[base], pg[base + 1])
+        out.append((k, int(pg[base + 2])))
+    return out

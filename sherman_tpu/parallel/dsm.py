@@ -1,0 +1,414 @@
+"""DSM — the one-sided remote-memory runtime, TPU-native.
+
+This is the analogue of the reference's ``DSM`` class (``include/DSM.h``,
+``src/DSM.cpp``): a cluster-wide word/page-addressable memory pool with
+one-sided READ / WRITE / CAS / FAA, plus the separate small lock-word space
+standing in for NIC on-chip device memory (the ``_dm`` op variants,
+``DSM.cpp:395-523``).
+
+Design (TPU-first, not a port):
+
+- The pool is one global jax array ``[machine_nr * pages_per_node, 256]``
+  int32, sharded over the 1-D ``node`` mesh axis — each chip's HBM shard is
+  that node's DSM partition (reference: hugepage pool per node, DSM.cpp:40).
+- One *step* executes a whole batch of requests from every node as one SPMD
+  program: bucket-route requests by owner (``transport.py``), owners apply
+  them to their local shard, replies route back.  A step is the unit of
+  visibility: reads snapshot the pre-step pool; conflicting atomics within a
+  step are linearized deterministically (CAS: at most one winner per word per
+  step; FAA: serial prefix semantics).  Cross-step concurrency is governed by
+  the same lock/version protocol as the reference.
+- Async latency hiding (coroutines yielding per verb, reference
+  ``Tree.cpp:1059-1122``; doorbell batching, ``Operation.cpp:351-481``) is
+  subsumed by batching: dependent op pairs (write+unlock, cas+read) are
+  simply issued in consecutive steps or fused into one step where ordering
+  permits (writes in a step become visible together, which IS the
+  write+unlock coalescing guarantee).
+
+Apply-order within a step: READ (snapshot) < CAS < FAA < WRITE_WORD < WRITE.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sherman_tpu import config as CFG
+from sherman_tpu.config import DSMConfig, PAGE_WORDS
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import transport
+from sherman_tpu.parallel.mesh import AXIS, make_mesh, node_sharding
+
+# Request opcodes (cf. verb set in Rdma.h:89-143).
+OP_NOP = 0
+OP_READ = 1        # read one page; reply in data[:, :256]
+OP_WRITE = 2       # write nw words starting at woff of page addr (payload)
+OP_WRITE_WORD = 3  # write single word arg1 at (addr, woff) / lock word
+OP_CAS = 4         # compare-and-swap word: expected=arg0, desired=arg1
+OP_FAA = 5         # fetch-and-add word: delta=arg0
+OP_READ_WORD = 6   # read single word; reply in old
+
+# Address spaces: pool pages vs the lock table ("on-chip device memory",
+# reference DirectoryConnection.cpp:24-30, DSM::fill_keys_dest DSM.cpp:169).
+SPACE_POOL = 0
+SPACE_LOCK = 1
+
+REQ_FIELDS = ("op", "addr", "woff", "nw", "space", "arg0", "arg1")
+
+# Counter slots (reference op counters, DSM.cpp:17-21).
+CNT_READ_OPS = 0
+CNT_READ_PAGES = 1
+CNT_WRITE_OPS = 2
+CNT_WRITE_WORDS = 3
+CNT_CAS_OPS = 4
+CNT_FAA_OPS = 5
+CNT_WW_OPS = 6
+N_COUNTERS = 8
+
+
+def empty_requests(n: int) -> dict[str, np.ndarray]:
+    """Host-side all-NOP request batch of n slots."""
+    reqs = {f: np.zeros(n, np.int32) for f in REQ_FIELDS}
+    reqs["payload"] = np.zeros((n, PAGE_WORDS), np.int32)
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# Owner-side apply (runs on each node's local shard).
+# ---------------------------------------------------------------------------
+
+def _word_apply(flat, m_cas, m_faa, m_ww, m_rw, widx, arg0, arg1):
+    """Linearized word ops on a flat word array.
+
+    Returns (new_flat, old[M], ok[M]) where old is: pre-step value for
+    CAS/READ_WORD; serial pre-value for FAA.  ok is the CAS winner flag
+    (True for everything else).
+    """
+    M = widx.shape[0]
+    W = flat.shape[0]
+    prio = jnp.arange(M, dtype=jnp.int32)
+    any_word = m_cas | m_faa | m_ww | m_rw
+    gidx = jnp.where(any_word, widx, 0)
+    gidx = jnp.clip(gidx, 0, W - 1)
+    cur = flat[gidx]
+
+    # CAS: at most one winner per word per step — the lowest-priority
+    # request whose expected value matches (linearization point = step start).
+    eligible = m_cas & (cur == arg0)
+    key_w = jnp.where(m_cas, widx, W)
+    perm = jnp.lexsort((prio, ~eligible, key_w))
+    sw = key_w[perm]
+    head = jnp.concatenate([jnp.ones(1, bool), sw[1:] != sw[:-1]])
+    winner_s = head & eligible[perm] & (sw < W)
+    winner = jnp.zeros(M, bool).at[perm].set(winner_s)
+    flat = flat.at[jnp.where(winner, widx, W)].set(arg1, mode="drop")
+
+    # FAA: all succeed; each sees the serial prefix value (post-CAS state).
+    cur2 = flat[gidx]
+    key_f = jnp.where(m_faa, widx, W)
+    permf = jnp.lexsort((prio, key_f))
+    sf = key_f[permf]
+    d = jnp.where(m_faa, arg0, 0)[permf]
+    csum = jnp.cumsum(d)
+    excl = csum - d
+    startsf = jnp.searchsorted(sf, sf, side="left")
+    in_seg_excl = excl - excl[startsf]
+    old_faa_s = cur2[permf] + in_seg_excl
+    old_faa = jnp.zeros(M, flat.dtype).at[permf].set(old_faa_s)
+    flat = flat.at[jnp.where(m_faa, widx, W)].add(arg0, mode="drop")
+
+    # WRITE_WORD: plain store, wins over same-step CAS/FAA results.
+    flat = flat.at[jnp.where(m_ww, widx, W)].set(arg1, mode="drop")
+
+    old = jnp.where(m_faa, old_faa, cur)
+    ok = jnp.where(m_cas, winner, True)
+    return flat, old, ok
+
+
+def _apply(pool, locks, counters, req):
+    """Apply incoming requests [M] to this node's shard."""
+    P, PW = pool.shape
+    page = bits.addr_page(req["addr"])
+    op = req["op"]
+    m_pool = req["space"] == SPACE_POOL
+    m_lock = req["space"] == SPACE_LOCK
+
+    # In-shard bounds checks: the page field must index a real pool page (or
+    # a real lock word for the lock space), word ops must stay inside their
+    # page, and multi-word writes must not spill into the next page.
+    # Out-of-range or unroutable (op, space) requests fail with ok=0 rather
+    # than silently clamping or corrupting neighbors.
+    woff, nw = req["woff"], req["nw"]
+    page_ok = jnp.where(m_lock, page < locks.shape[0], page < P) & (page >= 0)
+    word_ok = m_lock | ((woff >= 0) & (woff < PW))
+    write_ok = (woff >= 0) & (nw >= 0) & (woff + nw <= PW)
+    wordspace = m_pool | m_lock
+
+    is_read = (op == OP_READ) & m_pool & page_ok
+    m_cas = (op == OP_CAS) & wordspace & page_ok & word_ok
+    m_faa = (op == OP_FAA) & wordspace & page_ok & word_ok
+    m_ww = (op == OP_WRITE_WORD) & wordspace & page_ok & word_ok
+    m_rw = (op == OP_READ_WORD) & wordspace & page_ok & word_ok
+    is_write = (op == OP_WRITE) & m_pool & page_ok & write_ok
+
+    # READ: snapshot gather of whole pages before any mutation.
+    rpage = pool[jnp.clip(page, 0, P - 1)]
+    data = jnp.where(is_read[:, None], rpage, 0)
+
+    # Word-granular ops on the pool space...
+    flatpool = pool.reshape(-1)
+    widx_pool = page * PW + woff
+    flatpool, old_p, ok_p = _word_apply(
+        flatpool, m_cas & m_pool, m_faa & m_pool, m_ww & m_pool, m_rw & m_pool,
+        widx_pool, req["arg0"], req["arg1"])
+    # ...and on the lock space (lock index rides the addr page field).
+    locks, old_l, ok_l = _word_apply(
+        locks, m_cas & m_lock, m_faa & m_lock, m_ww & m_lock, m_rw & m_lock,
+        page, req["arg0"], req["arg1"])
+
+    # Page WRITE: word-masked scatter (single-entry write-back support —
+    # the reference's write-amplification optimization, Tree.cpp:914-921).
+    cols = jnp.arange(PW, dtype=jnp.int32)
+    idx = widx_pool[:, None] + cols[None, :]
+    wmask = is_write[:, None] & (cols[None, :] < nw[:, None])
+    idx = jnp.where(wmask, idx, P * PW)
+    flatpool = flatpool.at[idx.reshape(-1)].set(
+        req["payload"].reshape(-1), mode="drop")
+    pool = flatpool.reshape(P, PW)
+
+    handled = is_read | is_write | m_cas | m_faa | m_ww | m_rw
+    old = jnp.where(m_lock, old_l, old_p)
+    ok = jnp.where(m_lock, ok_l, ok_p) & handled
+
+    u32 = lambda m: jnp.sum(m.astype(jnp.uint32))
+    counters = counters.at[CNT_READ_OPS].add(u32(is_read))
+    counters = counters.at[CNT_READ_PAGES].add(u32(is_read))
+    counters = counters.at[CNT_WRITE_OPS].add(u32(is_write))
+    counters = counters.at[CNT_WRITE_WORDS].add(
+        jnp.sum(jnp.where(is_write, req["nw"], 0)).astype(jnp.uint32))
+    counters = counters.at[CNT_CAS_OPS].add(u32(m_cas))
+    counters = counters.at[CNT_FAA_OPS].add(u32(m_faa))
+    counters = counters.at[CNT_WW_OPS].add(u32(m_ww))
+    return pool, locks, counters, data, old, ok
+
+
+# ---------------------------------------------------------------------------
+# The SPMD step (composable inside shard_map).
+# ---------------------------------------------------------------------------
+
+def dsm_step_spmd(pool, locks, counters, reqs, *, cfg: DSMConfig,
+                  axis_name: str = AXIS):
+    """One DSM step on per-node shards; call inside shard_map.
+
+    reqs: dict of [R] arrays (+ payload [R, 256]).
+    Returns (pool, locks, counters, replies) with replies =
+    {"data": [R,256], "old": [R], "ok": [R] bool}.
+    """
+    N, C = cfg.machine_nr, cfg.step_capacity
+    active = reqs["op"] != OP_NOP
+    dest = bits.addr_node(reqs["addr"])
+    bucket_idx, routed = transport.bucketize(dest, active, N, C)
+
+    out = {k: transport.scatter_to_buckets(v, bucket_idx, N * C)
+           for k, v in reqs.items()}
+    inc = transport.exchange(out, axis_name)
+
+    pool, locks, counters, data, old, ok = _apply(pool, locks, counters, inc)
+
+    rep = transport.exchange({"data": data, "old": old, "ok": ok}, axis_name)
+    safe_b = jnp.where(routed, bucket_idx, 0)
+    replies = {
+        "data": jnp.where((active & routed)[:, None], rep["data"][safe_b], 0),
+        "old": jnp.where(active & routed, rep["old"][safe_b], 0),
+        "ok": jnp.where(active, routed & rep["ok"][safe_b], True),
+    }
+    return pool, locks, counters, replies
+
+
+def read_pages_spmd(pool, addrs, *, cfg: DSMConfig, axis_name: str = AXIS,
+                    active=None):
+    """Lightweight read-only exchange: fetch pages for a batch of addrs.
+
+    The hot-loop primitive for batched tree descent — avoids shipping write
+    payloads: requests are 1 word each; only replies carry pages.
+    Returns (pages [R, 256], ok [R]).
+    """
+    N, C = cfg.machine_nr, cfg.step_capacity
+    P = pool.shape[0]
+    if active is None:
+        active = jnp.ones(addrs.shape, bool)
+    dest = bits.addr_node(addrs)
+    bucket_idx, routed = transport.bucketize(dest, active, N, C)
+    out = transport.scatter_to_buckets(bits.addr_page(addrs), bucket_idx, N * C)
+    inc = transport.exchange(out, axis_name)
+    data = pool[jnp.clip(inc, 0, P - 1)]
+    rep = transport.exchange(
+        {"data": data, "okb": (inc >= 0) & (inc < P)}, axis_name)
+    safe_b = jnp.where(routed, bucket_idx, 0)
+    served = active & routed & rep["okb"][safe_b]
+    pages = jnp.where(served[:, None], rep["data"][safe_b], 0)
+    return pages, served
+
+
+# ---------------------------------------------------------------------------
+# Host-facing runtime.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Replies:
+    data: np.ndarray
+    old: np.ndarray
+    ok: np.ndarray
+
+
+class DSM:
+    """Host handle to the cluster: owns the sharded pool/locks/counters and a
+    jitted step.  The analogue of ``DSM::getInstance`` (DSM.cpp:23-35).
+
+    Single-process SPMD: one Python process drives all nodes (the mesh).
+    Multi-host meshes use the same code path via jax.distributed — the mesh
+    simply spans processes.
+    """
+
+    def __init__(self, cfg: DSMConfig, mesh: jax.sharding.Mesh | None = None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.machine_nr)
+        if self.mesh.devices.size != cfg.machine_nr:
+            raise ValueError("mesh size must equal cfg.machine_nr")
+        self.shard = node_sharding(self.mesh)
+        N, P, L = cfg.machine_nr, cfg.pages_per_node, cfg.locks_per_node
+        self.pool = jax.device_put(
+            jnp.zeros((N * P, PAGE_WORDS), jnp.int32), self.shard)
+        self.locks = jax.device_put(jnp.zeros(N * L, jnp.int32), self.shard)
+        self.counters = jax.device_put(
+            jnp.zeros(N * N_COUNTERS, jnp.uint32), self.shard)
+
+        spec = jax.sharding.PartitionSpec(AXIS)
+        in_specs = (spec, spec, spec,
+                    {k: spec for k in (*REQ_FIELDS, "payload")})
+        out_specs = (spec, spec, spec, {k: spec for k in ("data", "old", "ok")})
+        step = jax.shard_map(
+            functools.partial(dsm_step_spmd, cfg=cfg),
+            mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False)
+        self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+        # Per-step request slots available to the *host* API; device kernels
+        # compose dsm_step_spmd directly and have their own batches.
+        self.host_slots = N * cfg.step_capacity
+
+    # -- raw step ------------------------------------------------------------
+
+    def step(self, reqs: dict[str, np.ndarray]) -> Replies:
+        """Run one DSM step over host-built global request arrays [N*R]."""
+        reqs = {k: jax.device_put(jnp.asarray(v), self.shard)
+                for k, v in reqs.items()}
+        self.pool, self.locks, self.counters, rep = self._step(
+            self.pool, self.locks, self.counters, reqs)
+        return Replies(data=np.asarray(rep["data"]), old=np.asarray(rep["old"]),
+                       ok=np.asarray(rep["ok"]))
+
+    # -- host convenience ops (control plane / slow paths / tests) -----------
+    # Each builds a small batch and steps once; requests are spread over
+    # source nodes round-robin so per-(src,dst) capacity is not the limit.
+
+    def _batch(self, rows: list[dict]) -> Replies:
+        n = self.cfg.machine_nr * self.cfg.step_capacity
+        if len(rows) > n:
+            # split oversized host batches into multiple steps
+            out = [self._batch(rows[i:i + n]) for i in range(0, len(rows), n)]
+            return Replies(
+                data=np.concatenate([r.data for r in out]),
+                old=np.concatenate([r.old for r in out]),
+                ok=np.concatenate([r.ok for r in out]))
+        reqs = empty_requests(n)
+        R = self.cfg.step_capacity
+        slots = []
+        # round-robin rows over source nodes: slot = src*R + idx_within_src
+        per_src = [0] * self.cfg.machine_nr
+        for i, row in enumerate(rows):
+            src = i % self.cfg.machine_nr
+            slot = src * R + per_src[src]
+            per_src[src] += 1
+            slots.append(slot)
+            for k, v in row.items():
+                if k == "payload":
+                    v = np.asarray(v, np.int32)
+                    reqs["payload"][slot, :v.shape[0]] = v
+                else:
+                    reqs[k][slot] = v
+        rep = self.step(reqs)
+        sl = np.array(slots, np.int64)
+        return Replies(data=rep.data[sl], old=rep.old[sl], ok=rep.ok[sl])
+
+    def read_page(self, addr: int) -> np.ndarray:
+        r = self._batch([{"op": OP_READ, "addr": addr}])
+        assert r.ok[0]
+        return r.data[0]
+
+    def read_pages(self, addrs) -> np.ndarray:
+        rows = [{"op": OP_READ, "addr": int(a)} for a in addrs]
+        r = self._batch(rows)
+        assert r.ok.all(), "read overflow: raise step_capacity"
+        return r.data
+
+    def write_page(self, addr: int, words: np.ndarray):
+        r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": 0,
+                          "nw": PAGE_WORDS, "payload": words}])
+        assert r.ok[0]
+
+    def write_words(self, addr: int, woff: int, words: np.ndarray):
+        words = np.asarray(words, np.int32)
+        r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": woff,
+                          "nw": words.shape[0], "payload": words}])
+        assert r.ok[0]
+
+    def write_rows(self, rows: list[dict]):
+        """Batched writes in ONE step — the write_batch/doorbell analogue
+        (Operation.cpp:351-380): all writes in a step become visible
+        atomically at the step boundary."""
+        r = self._batch(rows)
+        assert r.ok.all()
+
+    def cas(self, addr: int, woff: int, expected: int, desired: int,
+            space: int = SPACE_POOL) -> tuple[int, bool]:
+        r = self._batch([{"op": OP_CAS, "addr": addr, "woff": woff,
+                          "arg0": expected, "arg1": desired, "space": space}])
+        return int(r.old[0]), bool(r.ok[0])
+
+    def faa(self, addr: int, woff: int, delta: int,
+            space: int = SPACE_POOL) -> int:
+        r = self._batch([{"op": OP_FAA, "addr": addr, "woff": woff,
+                          "arg0": delta, "space": space}])
+        assert r.ok[0], "faa failed (bad address?)"
+        return int(r.old[0])
+
+    def read_word(self, addr: int, woff: int, space: int = SPACE_POOL) -> int:
+        r = self._batch([{"op": OP_READ_WORD, "addr": addr, "woff": woff,
+                          "space": space}])
+        assert r.ok[0], "read_word failed (bad address?)"
+        return int(r.old[0])
+
+    def write_word(self, addr: int, woff: int, value: int,
+                   space: int = SPACE_POOL):
+        r = self._batch([{"op": OP_WRITE_WORD, "addr": addr, "woff": woff,
+                          "arg1": value, "space": space}])
+        assert r.ok[0]
+
+    # -- observability (write_test.cpp:72-76 parity) -------------------------
+
+    def counter_snapshot(self) -> dict[str, int]:
+        c = np.asarray(self.counters).reshape(self.cfg.machine_nr, N_COUNTERS)
+        tot = c.sum(axis=0, dtype=np.uint64)
+        return {
+            "read_ops": int(tot[CNT_READ_OPS]),
+            "read_bytes": int(tot[CNT_READ_PAGES]) * CFG.PAGE_BYTES,
+            "write_ops": int(tot[CNT_WRITE_OPS]),
+            "write_bytes": int(tot[CNT_WRITE_WORDS]) * 4,
+            "cas_ops": int(tot[CNT_CAS_OPS]),
+            "faa_ops": int(tot[CNT_FAA_OPS]),
+            "write_word_ops": int(tot[CNT_WW_OPS]),
+        }

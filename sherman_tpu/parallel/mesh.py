@@ -1,0 +1,27 @@
+"""Device mesh helpers.
+
+The cluster axis is a 1-D ``jax.sharding.Mesh`` named ``"node"``: each device
+plays the role of one symmetric Sherman node (compute node + memory node,
+reference ``README.md:60-61``).  Tests run this on 8 virtual CPU devices via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+AXIS = "node"
+
+
+def make_mesh(n_nodes: int | None = None) -> jax.sharding.Mesh:
+    devs = jax.devices()
+    n = n_nodes if n_nodes is not None else len(devs)
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return jax.sharding.Mesh(np.array(devs[:n]), (AXIS,))
+
+
+def node_sharding(mesh: jax.sharding.Mesh) -> jax.sharding.NamedSharding:
+    """Shard dim 0 across nodes."""
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(AXIS))

@@ -1,0 +1,56 @@
+"""Request routing over the ICI mesh: the RDMA-fabric analogue.
+
+Where the reference posts verbs on per-destination RC queue pairs
+(``ThreadConnection.cpp:21-27``, ``src/rdma/Operation.cpp``), we route a
+fixed-capacity batch of requests per step with one ``all_to_all`` exchange:
+each node scatters its requests into per-destination buckets of capacity
+``C``; one tiled all_to_all delivers every bucket to its owner; replies ride
+the reverse exchange.  Requests beyond a bucket's capacity are dropped with
+``ok=0`` and retried by the caller — the moral equivalent of a full RDMA
+send queue.
+
+All helpers run *inside* ``shard_map`` on per-node shards.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bucketize(dest, active, n_nodes: int, capacity: int):
+    """Assign each request a slot in its destination bucket.
+
+    Args:
+      dest: [R] int32 destination node per request.
+      active: [R] bool; inactive requests are never routed.
+      n_nodes, capacity: static bucket geometry.
+
+    Returns:
+      (bucket_idx[R] int32 in [0, n_nodes*capacity) or -1,
+       routed[R] bool).
+    """
+    R = dest.shape[0]
+    d = jnp.where(active, dest, n_nodes).astype(jnp.int32)
+    perm = jnp.argsort(d, stable=True)
+    sd = d[perm]
+    starts = jnp.searchsorted(sd, sd, side="left")
+    rank = jnp.arange(R, dtype=jnp.int32) - starts.astype(jnp.int32)
+    ok = (sd < n_nodes) & (rank < capacity)
+    bidx = jnp.where(ok, sd * capacity + rank, -1).astype(jnp.int32)
+    bucket_idx = jnp.zeros(R, jnp.int32).at[perm].set(bidx)
+    return bucket_idx, bucket_idx >= 0
+
+
+def scatter_to_buckets(field, bucket_idx, n_slots: int):
+    """Place request fields [R, ...] into bucket slots [n_slots, ...]."""
+    safe = jnp.where(bucket_idx >= 0, bucket_idx, n_slots)
+    out = jnp.zeros((n_slots,) + field.shape[1:], field.dtype)
+    return out.at[safe].set(field, mode="drop")
+
+
+def exchange(tree, axis_name: str):
+    """Tiled all_to_all of every array in the pytree along dim 0."""
+    return jax.tree.map(
+        lambda x: jax.lax.all_to_all(x, axis_name, 0, 0, tiled=True), tree
+    )

@@ -1,0 +1,30 @@
+"""Test harness: run everything on 8 virtual CPU devices.
+
+The multi-chip code path (shard_map over the 'node' mesh) is exercised
+without TPU hardware, per the reference's missing-fake-transport lesson
+(SURVEY.md §4): the DSM is fully testable in-process.
+"""
+
+import os
+
+# jax may already be pre-imported by the interpreter environment, so setting
+# JAX_PLATFORMS via os.environ can be too late — update the live config
+# instead (the backend is only initialized on first use).
+os.environ["JAX_PLATFORMS"] = "cpu"  # override e.g. JAX_PLATFORMS=axon (TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,59 @@
+import jax.numpy as jnp
+import numpy as np
+
+from sherman_tpu.ops import bits
+
+
+def test_key_pair_roundtrip():
+    for k in [0, 1, 2**31, 2**32 - 1, 2**32, 2**63, 2**64 - 1,
+              0xDEADBEEFCAFEBABE]:
+        hi, lo = bits.key_to_pair(k)
+        assert bits.pair_to_key(hi, lo) == k
+
+
+def test_keys_to_pairs_vectorized():
+    ks = np.array([0, 1, 2**32 + 7, 2**64 - 1], dtype=np.uint64)
+    hi, lo = bits.keys_to_pairs(ks)
+    back = bits.pairs_to_keys(hi, lo)
+    assert (back == ks).all()
+
+
+def test_key_compare_unsigned():
+    pairs = [0, 1, 2**31 - 1, 2**31, 2**32 - 1, 2**32, 2**63, 2**64 - 1]
+    his, los = bits.keys_to_pairs(np.array(pairs, dtype=np.uint64))
+    his, los = jnp.asarray(his), jnp.asarray(los)
+    for i, a in enumerate(pairs):
+        for j, b in enumerate(pairs):
+            lt = bool(bits.key_lt(his[i], los[i], his[j], los[j]))
+            le = bool(bits.key_le(his[i], los[i], his[j], los[j]))
+            eq = bool(bits.key_eq(his[i], los[i], his[j], los[j]))
+            assert lt == (a < b), (a, b)
+            assert le == (a <= b)
+            assert eq == (a == b)
+
+
+def test_addr_pack_unpack():
+    for node, page in [(0, 0), (0, 1), (3, 12345), (7, (1 << 24) - 1),
+                       (255, 42)]:
+        a = bits.make_addr(node, page)
+        assert bits.addr_node(a) == node
+        assert bits.addr_page(a) == page
+    # array path
+    nodes = jnp.array([0, 3, 7, 255], jnp.int32)
+    pages = jnp.array([0, 12345, (1 << 24) - 1, 42], jnp.int32)
+    a = bits.make_addr(nodes, pages)
+    assert (np.asarray(bits.addr_node(a)) == np.asarray(nodes)).all()
+    assert (np.asarray(bits.addr_page(a)) == np.asarray(pages)).all()
+
+
+def test_null_addr():
+    assert bits.addr_is_null(0)
+    assert not bits.addr_is_null(bits.make_addr(0, 1))
+
+
+def test_lock_index_range():
+    addrs = jnp.arange(1000, dtype=jnp.int32)
+    li = np.asarray(bits.lock_index(addrs, 16384))
+    assert (li >= 0).all() and (li < 16384).all()
+    # decently spread
+    assert len(np.unique(li)) > 900

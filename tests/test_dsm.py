@@ -1,0 +1,190 @@
+import numpy as np
+import pytest
+
+from sherman_tpu.config import DSMConfig, PAGE_WORDS
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import dsm as D
+
+
+@pytest.fixture(scope="module")
+def cluster(eight_devices):
+    cfg = DSMConfig(machine_nr=4, pages_per_node=64, locks_per_node=128,
+                    step_capacity=32)
+    return D.DSM(cfg)
+
+
+def test_write_read_page(cluster):
+    addr = bits.make_addr(2, 5)
+    words = np.arange(PAGE_WORDS, dtype=np.int32)
+    cluster.write_page(addr, words)
+    got = cluster.read_page(addr)
+    assert (got == words).all()
+    # other pages untouched
+    assert (cluster.read_page(bits.make_addr(2, 6)) == 0).all()
+
+
+def test_partial_word_write(cluster):
+    addr = bits.make_addr(1, 3)
+    cluster.write_page(addr, np.zeros(PAGE_WORDS, np.int32))
+    cluster.write_words(addr, 10, np.array([7, 8, 9], np.int32))
+    got = cluster.read_page(addr)
+    assert got[9] == 0 and got[13] == 0
+    assert got[10:13].tolist() == [7, 8, 9]
+
+
+def test_cross_node_ops(cluster):
+    # every node's pages are reachable from the host batch path
+    for n in range(cluster.cfg.machine_nr):
+        a = bits.make_addr(n, 7)
+        cluster.write_words(a, 0, np.array([100 + n], np.int32))
+    for n in range(cluster.cfg.machine_nr):
+        a = bits.make_addr(n, 7)
+        assert cluster.read_word(a, 0) == 100 + n
+
+
+def test_cas_basics(cluster):
+    a = bits.make_addr(3, 9)
+    cluster.write_word(a, 4, 0)
+    old, ok = cluster.cas(a, 4, 0, 42)
+    assert ok and old == 0
+    old, ok = cluster.cas(a, 4, 0, 43)
+    assert not ok and old == 42
+    old, ok = cluster.cas(a, 4, 42, 44)
+    assert ok and old == 42
+    assert cluster.read_word(a, 4) == 44
+
+
+def test_cas_single_winner_per_step(cluster):
+    """Conflicting CAS in one step: exactly one winner (lock semantics)."""
+    a = bits.make_addr(0, 11)
+    cluster.write_word(a, 0, 0)
+    rows = [{"op": D.OP_CAS, "addr": a, "woff": 0, "arg0": 0,
+             "arg1": i + 1} for i in range(8)]
+    r = cluster._batch(rows)
+    assert r.ok.sum() == 1
+    winner = int(np.nonzero(r.ok)[0][0])
+    assert cluster.read_word(a, 0) == winner + 1
+    assert (r.old == 0).all()
+
+
+def test_faa_serial_prefix(cluster):
+    a = bits.make_addr(1, 12)
+    cluster.write_word(a, 0, 100)
+    rows = [{"op": D.OP_FAA, "addr": a, "woff": 0, "arg0": 10}
+            for _ in range(5)]
+    r = cluster._batch(rows)
+    assert cluster.read_word(a, 0) == 150
+    assert sorted(r.old.tolist()) == [100, 110, 120, 130, 140]
+
+
+def test_lock_space_independent(cluster):
+    a = bits.make_addr(2, 17)  # page field = lock index 17 on node 2
+    assert cluster.read_word(a, 0, space=D.SPACE_LOCK) == 0
+    old, ok = cluster.cas(a, 0, 0, 99, space=D.SPACE_LOCK)
+    assert ok
+    assert cluster.read_word(a, 0, space=D.SPACE_LOCK) == 99
+    # pool page 17 on node 2 unaffected
+    assert cluster.read_word(bits.make_addr(2, 17), 0) == 0
+    cluster.write_word(a, 0, 0, space=D.SPACE_LOCK)
+    assert cluster.read_word(a, 0, space=D.SPACE_LOCK) == 0
+
+
+def test_write_plus_unlock_same_step(cluster):
+    """The coalesced write+unlock pattern (Operation.cpp:351-380): a data
+    write and a lock-release write issued in ONE step are visible together."""
+    data_a = bits.make_addr(3, 20)
+    lock_a = bits.make_addr(3, 55)
+    _, ok = cluster.cas(lock_a, 0, 0, 7, space=D.SPACE_LOCK)
+    assert ok
+    cluster.write_rows([
+        {"op": D.OP_WRITE, "addr": data_a, "woff": 0, "nw": 4,
+         "payload": np.array([1, 2, 3, 4], np.int32)},
+        {"op": D.OP_WRITE_WORD, "addr": lock_a, "woff": 0, "arg1": 0,
+         "space": D.SPACE_LOCK},
+    ])
+    assert cluster.read_word(lock_a, 0, space=D.SPACE_LOCK) == 0
+    assert cluster.read_page(data_a)[:4].tolist() == [1, 2, 3, 4]
+
+
+def test_reads_snapshot_before_writes(cluster):
+    a = bits.make_addr(0, 21)
+    cluster.write_word(a, 0, 1)
+    rows = [
+        {"op": D.OP_READ, "addr": a},
+        {"op": D.OP_WRITE_WORD, "addr": a, "woff": 0, "arg1": 2},
+    ]
+    r = cluster._batch(rows)
+    assert r.data[0][0] == 1  # read saw pre-step value
+    assert cluster.read_word(a, 0) == 2
+
+
+def test_overflow_drops_with_retry_flag(cluster):
+    # all requests to one destination node from one source exceed capacity
+    cfg = cluster.cfg
+    n = cfg.machine_nr * cfg.step_capacity
+    reqs = D.empty_requests(n)
+    target = bits.make_addr(0, 1)
+    # put 2*capacity requests on source node 1's slots
+    base = 1 * cfg.step_capacity
+    count = cfg.step_capacity  # source 1 has only `capacity` slots anyway
+    for i in range(count):
+        reqs["op"][base + i] = D.OP_READ
+        reqs["addr"][base + i] = target
+    rep = cluster.step(reqs)
+    oks = rep.ok[base:base + count]
+    assert oks.all()  # exactly at capacity: all served
+    # per-source overflow: a per-node request batch larger than capacity,
+    # all aimed at one destination -> excess dropped with ok=0
+    small = D.DSM(DSMConfig(machine_nr=2, pages_per_node=16,
+                            locks_per_node=16, step_capacity=4))
+    n2 = 2 * 8  # R'=8 per node > capacity 4
+    reqs2 = D.empty_requests(n2)
+    for i in range(8):  # slots 0..7 all belong to source node 0
+        reqs2["op"][i] = D.OP_READ
+        reqs2["addr"][i] = bits.make_addr(1, 2)
+    rep2 = small.step(reqs2)
+    assert rep2.ok[:8].sum() == 4  # capacity served, the rest dropped
+
+
+def test_counters(cluster):
+    snap0 = cluster.counter_snapshot()
+    cluster.read_page(bits.make_addr(0, 1))
+    cluster.write_page(bits.make_addr(0, 2), np.zeros(PAGE_WORDS, np.int32))
+    snap1 = cluster.counter_snapshot()
+    assert snap1["read_ops"] == snap0["read_ops"] + 1
+    assert snap1["read_bytes"] == snap0["read_bytes"] + 1024
+    assert snap1["write_ops"] == snap0["write_ops"] + 1
+    assert snap1["write_bytes"] == snap0["write_bytes"] + 1024
+
+
+def test_out_of_range_page_fails(cluster):
+    r = cluster._batch([{"op": D.OP_READ,
+                         "addr": bits.make_addr(1, 9999)}])
+    assert not r.ok[0]
+    old, ok = cluster.cas(bits.make_addr(1, 9999), 0, 0, 1)
+    assert not ok
+    # lock space bounds too (locks_per_node=128)
+    old, ok = cluster.cas(bits.make_addr(1, 500), 0, 0, 1,
+                          space=D.SPACE_LOCK)
+    assert not ok
+
+
+def test_woff_bounds_and_bad_space(cluster):
+    a = bits.make_addr(1, 5)
+    cluster.write_word(bits.make_addr(1, 6), 3, 111)
+    # CAS with woff spilling into the next page must fail, not corrupt it
+    old, ok = cluster.cas(a, 259, 111, 777)
+    assert not ok
+    assert cluster.read_word(bits.make_addr(1, 6), 3) == 111
+    # multi-word write crossing the page boundary must fail
+    r = cluster._batch([{"op": D.OP_WRITE, "addr": a, "woff": 254, "nw": 4,
+                         "payload": np.full(4, -1, np.int32)}])
+    assert not r.ok[0]
+    assert cluster.read_word(bits.make_addr(1, 6), 0) == 0
+    # negative woff must fail
+    old, ok = cluster.cas(bits.make_addr(1, 6), -3, 0, 5)
+    assert not ok
+    # unknown address space: CAS reports failure and is a no-op
+    r = cluster._batch([{"op": D.OP_CAS, "addr": a, "woff": 0, "arg0": 0,
+                         "arg1": 42, "space": 7}])
+    assert not r.ok[0]

@@ -1,0 +1,103 @@
+import jax.numpy as jnp
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu.ops import bits, layout
+
+
+def test_empty_page_header():
+    pg = layout.np_empty_page(level=2, lowest=10, highest=1000, sibling=77,
+                              leftmost=55)
+    j = jnp.asarray(pg)
+    assert int(layout.h_level(j)) == 2
+    assert int(layout.h_sibling(j)) == 77
+    assert int(layout.h_leftmost(j)) == 55
+    assert int(layout.h_nkeys(j)) == 0
+    lo = bits.pair_to_key(*[int(x) for x in layout.h_lowest(j)])
+    hi = bits.pair_to_key(*[int(x) for x in layout.h_highest(j)])
+    assert (lo, hi) == (10, 1000)
+    assert bool(layout.page_consistent(j))
+
+
+def test_leaf_entry_roundtrip_and_find():
+    pg = layout.np_empty_page(0, C.KEY_NEG_INF, C.KEY_POS_INF)
+    layout.np_leaf_set_entry(pg, 0, key=42, value=4242)
+    layout.np_leaf_set_entry(pg, 5, key=2**40 + 3, value=99)
+    j = jnp.asarray(pg)
+
+    khi, klo = bits.key_to_pair(42)
+    found, vhi, vlo, slot = layout.leaf_find_key(
+        j, jnp.int32(khi), jnp.int32(klo))
+    assert bool(found) and int(slot) == 0
+    assert bits.pair_to_key(int(vhi), int(vlo)) == 4242
+
+    khi, klo = bits.key_to_pair(2**40 + 3)
+    found, vhi, vlo, slot = layout.leaf_find_key(
+        j, jnp.int32(khi), jnp.int32(klo))
+    assert bool(found) and int(slot) == 5
+    assert bits.pair_to_key(int(vhi), int(vlo)) == 99
+
+    khi, klo = bits.key_to_pair(43)
+    found, _, _, slot = layout.leaf_find_key(j, jnp.int32(khi), jnp.int32(klo))
+    assert not bool(found) and int(slot) == -1
+
+    assert int(layout.leaf_find_free_slot(j)) == 1
+    ents = layout.np_leaf_entries(pg)
+    assert ents == [(42, 4242, 0), (2**40 + 3, 99, 5)]
+
+
+def test_leaf_clear_entry():
+    pg = layout.np_empty_page(0, C.KEY_NEG_INF, C.KEY_POS_INF)
+    layout.np_leaf_set_entry(pg, 0, 7, 70)
+    layout.np_leaf_clear_entry(pg, 0)
+    j = jnp.asarray(pg)
+    khi, klo = bits.key_to_pair(7)
+    found, *_ = layout.leaf_find_key(j, jnp.int32(khi), jnp.int32(klo))
+    assert not bool(found)
+    assert int(layout.leaf_find_free_slot(j)) == 0
+
+
+def test_internal_pick_child():
+    # children: leftmost for k<10, c0 for [10,20), c1 for [20,30), c2 for >=30
+    pg = layout.np_empty_page(1, C.KEY_NEG_INF, C.KEY_POS_INF, leftmost=111)
+    layout.np_internal_set_entry(pg, 0, 10, 222)
+    layout.np_internal_set_entry(pg, 1, 20, 333)
+    layout.np_internal_set_entry(pg, 2, 30, 444)
+    pg[C.W_NKEYS] = 3
+    j = jnp.asarray(pg)
+
+    for k, want in [(5, 111), (10, 222), (15, 222), (20, 333), (29, 333),
+                    (30, 444), (10**9, 444)]:
+        khi, klo = bits.key_to_pair(k)
+        child = layout.internal_pick_child(j, jnp.int32(khi), jnp.int32(klo))
+        assert int(child) == want, k
+
+
+def test_internal_pick_child_batched():
+    pg = layout.np_empty_page(1, C.KEY_NEG_INF, C.KEY_POS_INF, leftmost=1)
+    layout.np_internal_set_entry(pg, 0, 100, 2)
+    pg[C.W_NKEYS] = 1
+    pages = jnp.asarray(np.stack([pg, pg]))
+    khi, klo = bits.keys_to_pairs(np.array([5, 200], dtype=np.uint64))
+    child = layout.internal_pick_child(pages, jnp.asarray(khi),
+                                       jnp.asarray(klo))
+    assert np.asarray(child).tolist() == [1, 2]
+
+
+def test_fence_checks():
+    pg = layout.np_empty_page(0, 100, 200)
+    j = jnp.asarray(pg)
+    for k, inside in [(99, False), (100, True), (150, True), (199, True),
+                      (200, False)]:
+        khi, klo = bits.key_to_pair(k)
+        assert bool(layout.in_fence(j, jnp.int32(khi), jnp.int32(klo))) == inside
+        assert bool(layout.needs_sibling_chase(
+            j, jnp.int32(khi), jnp.int32(klo))) == (k >= 200)
+
+
+def test_capacities():
+    assert C.INTERNAL_CAP == 82
+    assert C.LEAF_CAP == 41
+    # last entry words must fit before rear version word
+    assert C.W_ENTRIES + C.INTERNAL_CAP * C.INTERNAL_ENTRY_WORDS <= C.W_REAR_VER
+    assert C.W_ENTRIES + C.LEAF_CAP * C.LEAF_ENTRY_WORDS <= C.W_REAR_VER
