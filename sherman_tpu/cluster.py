@@ -1,0 +1,59 @@
+"""Cluster — the top-level runtime handle (``DSM::getInstance`` analogue).
+
+Bundles the sharded-memory transport (:class:`~sherman_tpu.parallel.dsm.DSM`),
+the bootstrap Keeper, and one Directory per node, and hands out per-client
+contexts the way ``DSM::registerThread`` does (``DSM.cpp:68-92``).
+
+Construction order mirrors the reference init path (SURVEY.md §3.1):
+pool allocation -> fabric (the mesh itself) -> keeper enter -> directories
+-> cluster barrier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.parallel.alloc import Directory, LocalAllocator
+from sherman_tpu.parallel.bootstrap import Keeper
+from sherman_tpu.parallel.dsm import DSM
+
+
+@dataclass
+class ClientContext:
+    """Per-client state (the registerThread product): a client id and a
+    private page allocator with per-node chunk leases."""
+
+    client_id: int
+    alloc: LocalAllocator
+
+    @property
+    def tag(self) -> int:
+        """Lock-holder tag; must be nonzero (thread_tag, DSM.cpp:76)."""
+        return self.client_id + 1
+
+
+class Cluster:
+    def __init__(self, cfg: DSMConfig, mesh: jax.sharding.Mesh | None = None):
+        self.cfg = cfg
+        self.dsm = DSM(cfg, mesh)
+        self.keeper = Keeper(cfg.machine_nr)
+        # every process slot enters like a symmetric CN+MN node
+        self.node_ids = [self.keeper.server_enter()
+                         for _ in range(cfg.machine_nr)]
+        self.directories = [Directory(n, cfg) for n in self.node_ids]
+        self._next_client = 0
+        self.keeper.barrier("DSM-init")
+
+    def register_client(self) -> ClientContext:
+        cid = self._next_client
+        self._next_client += 1
+        return ClientContext(client_id=cid,
+                             alloc=LocalAllocator(self.directories))
+
+    # NEW_ROOT broadcast (Tree.cpp:116-124): update every directory's hint.
+    def broadcast_new_root(self, addr: int, level: int) -> None:
+        for d in self.directories:
+            d.new_root(addr, level)

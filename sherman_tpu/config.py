@@ -31,9 +31,10 @@ ADDR_PAGE_BITS = 24
 ADDR_PAGE_MASK = (1 << ADDR_PAGE_BITS) - 1
 MAX_MACHINE = 1 << ADDR_NODE_BITS
 
-# Meta words inside the reserved page 0 of node 0.
+# Meta words inside the reserved page 0 of node 0.  The root's level is NOT
+# mirrored here: it is read from the root page's own W_LEVEL word, so the
+# root install stays a single atomic CAS on this one word.
 META_ROOT_ADDR_W = 0   # packed addr of the current root page
-META_ROOT_LEVEL_W = 1  # level of the root page
 
 
 @dataclasses.dataclass(frozen=True)

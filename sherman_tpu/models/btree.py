@@ -1,0 +1,379 @@
+"""The distributed B+Tree — client logic over the one-sided DSM.
+
+Mirrors the reference index (``include/Tree.h``, ``src/Tree.cpp``): a B-link
+tree of 1 KB pages living in the cluster-wide pool, accessed purely with
+one-sided reads, lock CAS, and coalesced write+unlock steps.  The memory
+nodes' CPUs never run index code (only chunk MALLOC / NEW_ROOT, served by
+:class:`~sherman_tpu.parallel.alloc.Directory`).
+
+This module is the *host orchestration* path: correct for every operation
+(including splits and deletes), used for control-plane work, slow paths and
+as the executable spec for the batched device kernels
+(:mod:`sherman_tpu.models.batched`).  Protocol parity notes:
+
+- Locking: global lock word = CAS on the owner node's lock table at
+  ``hash(page_addr) % locks_per_node`` (``Tree.cpp:702-707,832-842``), spin
+  with a deadlock reporter (``Tree.cpp:219-227``).
+- Write-back: a no-split insert writes ONE leaf entry + the unlock word in a
+  single DSM step — the single-entry write-back + write+unlock doorbell
+  coalescing (``Tree.cpp:914-921``, ``Operation.cpp:351-380``).  A split
+  writes sibling page + old page + unlock in one step, which makes the split
+  *atomically visible* (stronger than the reference's ordered writes).
+- B-link: every page carries a sibling pointer and a [lowest, highest)
+  fence; readers chase siblings on overshoot (``Tree.cpp:626-629,648-651``),
+  so stale roots/parents never break correctness, only add hops.
+- Root: packed addr + level in the reserved meta page (node 0, page 0),
+  installed by CAS (``Tree.cpp:55``, root slot parity ``Tree.cpp:90-97``),
+  broadcast via NEW_ROOT (``Tree.cpp:116-124``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu.cluster import ClientContext, Cluster
+from sherman_tpu.ops import bits, layout
+from sherman_tpu.parallel import dsm as D
+
+META_ADDR = bits.make_addr(0, 0)
+LOCK_SPIN_LIMIT = 1_000_000  # deadlock reporter threshold (Tree.cpp:219-227)
+
+
+class Tree:
+    def __init__(self, cluster: Cluster, ctx: ClientContext | None = None):
+        self.cluster = cluster
+        self.dsm = cluster.dsm
+        self.cfg = cluster.cfg
+        self.ctx = ctx if ctx is not None else cluster.register_client()
+
+        # Construct an empty root leaf and try to install it (one winner
+        # across the cluster, Tree.cpp:48-55).
+        root = self.ctx.alloc.alloc()
+        pg = layout.np_empty_page(level=0, lowest=C.KEY_NEG_INF,
+                                  highest=C.KEY_POS_INF)
+        self.dsm.write_page(root, pg)
+        old, ok = self.dsm.cas(META_ADDR, C.META_ROOT_ADDR_W, 0, root)
+        if ok:
+            self.cluster.broadcast_new_root(root, 0)
+            self._root_addr, self._root_level = root, 0
+        else:
+            self._root_addr = old
+            self._root_level = int(self.dsm.read_page(old)[C.W_LEVEL])
+
+    # -- root helpers --------------------------------------------------------
+    # The root's level is read from the root page itself (W_LEVEL), so the
+    # root install is a SINGLE atomic CAS on the meta addr word — a separate
+    # meta level word could be observed stale by a concurrent root-grow and
+    # let it install a second root that orphans the tree.
+
+    def _refresh_root(self) -> None:
+        self._root_addr = self.dsm.read_word(META_ADDR, C.META_ROOT_ADDR_W)
+        self._root_level = int(
+            self.dsm.read_page(self._root_addr)[C.W_LEVEL])
+
+    # -- locking (global lock table; hierarchical local tier lives in the
+    #    batched path where real intra-step contention exists) ---------------
+
+    def _lock_word_addr(self, page_addr: int) -> int:
+        node = bits.addr_node(page_addr)
+        idx = int(np.asarray(bits.lock_index(
+            np.int32(np.uint32(page_addr & 0xFFFFFFFF).view(np.int32)),
+            self.cfg.locks_per_node)))
+        return bits.make_addr(node, idx)
+
+    def _lock(self, page_addr: int) -> int:
+        la = self._lock_word_addr(page_addr)
+        spins = 0
+        while True:
+            old, ok = self.dsm.cas(la, 0, 0, self.ctx.tag,
+                                   space=D.SPACE_LOCK)
+            if ok:
+                return la
+            spins += 1
+            if spins > LOCK_SPIN_LIMIT:
+                raise RuntimeError(
+                    f"possible deadlock on lock {la:#x}: holder tag {old}")
+
+    def _unlock_row(self, lock_addr: int) -> dict:
+        """Unlock as a request row, to coalesce with payload writes."""
+        return {"op": D.OP_WRITE_WORD, "addr": lock_addr, "woff": 0,
+                "arg1": 0, "space": D.SPACE_LOCK}
+
+    def _unlock(self, lock_addr: int) -> None:
+        self.dsm.write_word(lock_addr, 0, 0, space=D.SPACE_LOCK)
+
+    # -- descent -------------------------------------------------------------
+
+    def _descend(self, key: int, stop_level: int = 0):
+        """Walk root -> stop_level; -> (addr, page, path{level: addr}).
+
+        The hot read loop (Tree.cpp:429-458): one one-sided page read per
+        level, B-link sibling chase on overshoot.
+        """
+        addr = self._root_addr
+        path: dict[int, int] = {}
+        hops = 0
+        while True:
+            pg = self.dsm.read_page(addr)
+            lvl = int(pg[C.W_LEVEL])
+            if key >= layout.np_highest(pg):
+                sib = int(pg[C.W_SIBLING])
+                if bits.addr_is_null(sib):
+                    # stale root cache (concurrent new root): refresh
+                    self._refresh_root()
+                    addr = self._root_addr
+                else:
+                    addr = sib
+                hops += 1
+                assert hops < 1000, "sibling chase runaway"
+                continue
+            path[lvl] = addr
+            if lvl == stop_level:
+                return addr, pg, path
+            addr = layout.np_pick_child(pg, key)
+
+    # -- public API (Tree.h:45-63 surface) -----------------------------------
+
+    def search(self, key: int) -> int | None:
+        assert C.KEY_MIN <= key <= C.KEY_MAX
+        _, pg, _ = self._descend(key, 0)
+        _, val = layout.np_leaf_find(pg, key)
+        return val
+
+    def insert(self, key: int, value: int) -> None:
+        assert C.KEY_MIN <= key <= C.KEY_MAX
+        while True:
+            addr, _, path = self._descend(key, 0)
+            if self._leaf_store(addr, key, value, path):
+                return
+
+    def delete(self, key: int) -> bool:
+        assert C.KEY_MIN <= key <= C.KEY_MAX
+        while True:
+            addr, _, _ = self._descend(key, 0)
+            la = self._lock(addr)
+            pg = self.dsm.read_page(addr)
+            if not (layout.np_lowest(pg) <= key < layout.np_highest(pg)):
+                self._unlock(la)
+                continue  # concurrent split: re-descend
+            slot, _ = layout.np_leaf_find(pg, key)
+            if slot < 0:
+                self._unlock(la)
+                return False
+            base = layout.leaf_entry_base(slot)
+            self.dsm.write_rows([
+                {"op": D.OP_WRITE, "addr": addr, "woff": base,
+                 "nw": C.LEAF_ENTRY_WORDS,
+                 "payload": np.zeros(C.LEAF_ENTRY_WORDS, np.int32)},
+                self._unlock_row(la),
+            ])
+            return True
+
+    def range_query(self, lo: int, hi: int) -> dict[int, int]:
+        """All (k, v) with lo <= k < hi (Tree.cpp:461-522)."""
+        out: dict[int, int] = {}
+        addr, pg, _ = self._descend(lo, 0)
+        while True:
+            for k, v, _ in layout.np_leaf_entries(pg):
+                if lo <= k < hi:
+                    out[k] = v
+            if layout.np_highest(pg) >= hi:
+                return out
+            sib = int(pg[C.W_SIBLING])
+            if bits.addr_is_null(sib):
+                return out
+            pg = self.dsm.read_page(sib)
+
+    # -- write path ----------------------------------------------------------
+
+    def _leaf_store(self, addr: int, key: int, value: int,
+                    path: dict[int, int]) -> bool:
+        """leaf_page_store (Tree.cpp:828-987).  True on success, False to
+        re-descend (fence moved under us)."""
+        la = self._lock(addr)
+        pg = self.dsm.read_page(addr)  # fresh read under lock
+        if not (layout.np_lowest(pg) <= key < layout.np_highest(pg)):
+            self._unlock(la)
+            return False
+
+        slot, _ = layout.np_leaf_find(pg, key)
+        if slot < 0:
+            slot = layout.np_leaf_free_slot(pg)
+        if slot >= 0:
+            # in-place update / free-slot insert: write ONE entry + unlock
+            # in one step (single-entry write-back, Tree.cpp:914-921).
+            base = layout.leaf_entry_base(slot)
+            ver = (int(pg[base + C.LE_FVER]) + 1) & 0x7FFFFFFF or 1
+            ent = np.zeros(C.LEAF_ENTRY_WORDS, np.int32)
+            ent[C.LE_FVER] = ver
+            ent[C.LE_KEY_HI], ent[C.LE_KEY_LO] = bits.key_to_pair(key)
+            ent[C.LE_VAL_HI], ent[C.LE_VAL_LO] = bits.key_to_pair(value)
+            ent[C.LE_RVER] = ver
+            self.dsm.write_rows([
+                {"op": D.OP_WRITE, "addr": addr, "woff": base,
+                 "nw": C.LEAF_ENTRY_WORDS, "payload": ent},
+                self._unlock_row(la),
+            ])
+            return True
+
+        # Leaf full: split (Tree.cpp:922-963).
+        ents = [(k, v) for k, v, _ in layout.np_leaf_entries(pg)]
+        ents.append((key, value))
+        ents.sort()
+        m = len(ents) // 2
+        split_key = ents[m][0]
+        sib_addr = self.ctx.alloc.alloc()
+        old_high = layout.np_highest(pg)
+        old_sib = int(pg[C.W_SIBLING])
+        ver = int(pg[C.W_FRONT_VER]) + 1
+
+        right = layout.np_empty_page(0, split_key, old_high, sibling=old_sib,
+                                     version=1)
+        for i, (k, v) in enumerate(ents[m:]):
+            layout.np_leaf_set_entry(right, i, k, v)
+        left = layout.np_empty_page(0, layout.np_lowest(pg), split_key,
+                                    sibling=sib_addr, version=ver)
+        for i, (k, v) in enumerate(ents[:m]):
+            layout.np_leaf_set_entry(left, i, k, v)
+
+        # sibling + rebuilt page + unlock all in ONE step: atomic split.
+        self.dsm.write_rows([
+            {"op": D.OP_WRITE, "addr": sib_addr, "woff": 0,
+             "nw": C.PAGE_WORDS, "payload": right},
+            {"op": D.OP_WRITE, "addr": addr, "woff": 0,
+             "nw": C.PAGE_WORDS, "payload": left},
+            self._unlock_row(la),
+        ])
+        self._insert_parent(split_key, sib_addr, 1, path, child_left=addr)
+        return True
+
+    def _insert_parent(self, key: int, child: int, level: int,
+                       path: dict[int, int], child_left: int) -> None:
+        """internal_page_store + root growth (Tree.cpp:980-987,116-124)."""
+        if self._root_level < level:
+            self._refresh_root()
+        if self._root_level < level:
+            # Grow the tree: new root with leftmost = left half.
+            new_root = self.ctx.alloc.alloc()
+            pg = layout.np_empty_page(level, C.KEY_NEG_INF, C.KEY_POS_INF,
+                                      leftmost=child_left)
+            layout.np_internal_set_entry(pg, 0, key, child)
+            pg[C.W_NKEYS] = 1
+            self.dsm.write_page(new_root, pg)
+            old, ok = self.dsm.cas(META_ADDR, C.META_ROOT_ADDR_W,
+                                   self._root_addr, new_root)
+            if ok:
+                self.cluster.broadcast_new_root(new_root, level)
+                self._root_addr, self._root_level = new_root, level
+                return
+            # lost the race: fall through and insert into the real tree
+            self._refresh_root()
+
+        addr = path.get(level)
+        if addr is None:
+            addr, _, _ = self._descend(key, level)
+        while True:
+            la = self._lock(addr)
+            pg = self.dsm.read_page(addr)
+            if key >= layout.np_highest(pg):
+                self._unlock(la)
+                sib = int(pg[C.W_SIBLING])
+                if bits.addr_is_null(sib):
+                    addr, _, _ = self._descend(key, level)
+                else:
+                    addr = sib
+                continue
+            break
+
+        ents = layout.np_internal_entries(pg)
+        ents.append((key, child))
+        ents.sort()
+        if len(ents) <= C.INTERNAL_CAP:
+            ver = int(pg[C.W_FRONT_VER]) + 1
+            newpg = layout.np_empty_page(
+                level, layout.np_lowest(pg), layout.np_highest(pg),
+                sibling=int(pg[C.W_SIBLING]), leftmost=int(pg[C.W_LEFTMOST]),
+                version=ver)
+            for i, (k, c) in enumerate(ents):
+                layout.np_internal_set_entry(newpg, i, k, c)
+            newpg[C.W_NKEYS] = len(ents)
+            self.dsm.write_rows([
+                {"op": D.OP_WRITE, "addr": addr, "woff": 0,
+                 "nw": C.PAGE_WORDS, "payload": newpg},
+                self._unlock_row(la),
+            ])
+            return
+
+        # Internal split: middle key moves up.
+        m = len(ents) // 2
+        up_key, up_child = ents[m]
+        sib_addr = self.ctx.alloc.alloc()
+        old_high = layout.np_highest(pg)
+        old_sib = int(pg[C.W_SIBLING])
+        ver = int(pg[C.W_FRONT_VER]) + 1
+
+        right = layout.np_empty_page(level, up_key, old_high, sibling=old_sib,
+                                     leftmost=up_child)
+        for i, (k, c) in enumerate(ents[m + 1:]):
+            layout.np_internal_set_entry(right, i, k, c)
+        right[C.W_NKEYS] = len(ents) - m - 1
+        left = layout.np_empty_page(level, layout.np_lowest(pg), up_key,
+                                    sibling=sib_addr,
+                                    leftmost=int(pg[C.W_LEFTMOST]),
+                                    version=ver)
+        for i, (k, c) in enumerate(ents[:m]):
+            layout.np_internal_set_entry(left, i, k, c)
+        left[C.W_NKEYS] = m
+
+        self.dsm.write_rows([
+            {"op": D.OP_WRITE, "addr": sib_addr, "woff": 0,
+             "nw": C.PAGE_WORDS, "payload": right},
+            {"op": D.OP_WRITE, "addr": addr, "woff": 0,
+             "nw": C.PAGE_WORDS, "payload": left},
+            self._unlock_row(la),
+        ])
+        self._insert_parent(up_key, sib_addr, level + 1, path,
+                            child_left=addr)
+
+    # -- diagnostics (print_and_check_tree parity, Tree.cpp:151-203) ---------
+
+    def check_structure(self) -> dict:
+        """Walk the leftmost spine + leaf sibling chain; validate fences and
+        key order.  Returns stats; raises on invariant violations."""
+        self._refresh_root()
+        stats = {"levels": self._root_level + 1, "leaves": 0, "keys": 0,
+                 "internal_pages": 0}
+        # walk down the leftmost spine
+        addr = self._root_addr
+        for lvl in range(self._root_level, 0, -1):
+            pg = self.dsm.read_page(addr)
+            assert int(pg[C.W_LEVEL]) == lvl, "level mismatch on spine"
+            # count pages across this level via sibling chain
+            a, n = addr, 0
+            while not bits.addr_is_null(a):
+                p = self.dsm.read_page(a)
+                n += 1
+                ents = layout.np_internal_entries(p)
+                keys = [k for k, _ in ents]
+                assert keys == sorted(keys), "unsorted internal page"
+                a = int(p[C.W_SIBLING])
+            stats["internal_pages"] += n
+            addr = int(pg[C.W_LEFTMOST])
+        # leaf chain
+        a = addr
+        last_high = None
+        while not bits.addr_is_null(a):
+            p = self.dsm.read_page(a)
+            assert int(p[C.W_LEVEL]) == 0
+            lo, hi = layout.np_lowest(p), layout.np_highest(p)
+            if last_high is not None:
+                assert lo == last_high, "leaf fence gap"
+            for k, _, _ in layout.np_leaf_entries(p):
+                assert lo <= k < hi, "leaf key outside fence"
+                stats["keys"] += 1
+            stats["leaves"] += 1
+            last_high = hi
+            a = int(p[C.W_SIBLING])
+        assert last_high == C.KEY_POS_INF
+        return stats

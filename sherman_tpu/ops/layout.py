@@ -208,13 +208,21 @@ def np_internal_set_entry(pg: np.ndarray, slot: int, key: int, child: int) -> No
     pg[base + 2] = child
 
 
+def np_slot_live(pg: np.ndarray, slot: int) -> bool:
+    """Host-side two-level version liveness rule: fver == rver != 0.
+    (Single source of truth for host code; `leaf_slot_used` is the
+    vectorized device twin.)"""
+    base = leaf_entry_base(slot)
+    fv, rv = pg[base + C.LE_FVER], pg[base + C.LE_RVER]
+    return bool(fv == rv and fv != 0)
+
+
 def np_leaf_entries(pg: np.ndarray) -> list[tuple[int, int, int]]:
     """-> list of (key, value, slot) of live entries (host debugging/tests)."""
     out = []
     for s in range(C.LEAF_CAP):
-        base = leaf_entry_base(s)
-        fv, rv = pg[base + C.LE_FVER], pg[base + C.LE_RVER]
-        if fv == rv and fv != 0:
+        if np_slot_live(pg, s):
+            base = leaf_entry_base(s)
             k = bits.pair_to_key(pg[base + C.LE_KEY_HI], pg[base + C.LE_KEY_LO])
             v = bits.pair_to_key(pg[base + C.LE_VAL_HI], pg[base + C.LE_VAL_LO])
             out.append((k, v, s))
@@ -228,3 +236,39 @@ def np_internal_entries(pg: np.ndarray) -> list[tuple[int, int]]:
         k = bits.pair_to_key(pg[base], pg[base + 1])
         out.append((k, int(pg[base + 2])))
     return out
+
+
+# -- host-side page queries (used by the slow/control paths) ------------------
+
+def np_lowest(pg: np.ndarray) -> int:
+    return bits.pair_to_key(pg[C.W_LOW_HI], pg[C.W_LOW_LO])
+
+
+def np_highest(pg: np.ndarray) -> int:
+    return bits.pair_to_key(pg[C.W_HIGH_HI], pg[C.W_HIGH_LO])
+
+
+def np_pick_child(pg: np.ndarray, key: int) -> int:
+    """Host ``internal_page_search`` (Tree.cpp:665-685)."""
+    child = int(pg[C.W_LEFTMOST])
+    for k, ptr in np_internal_entries(pg):
+        if k <= key:
+            child = ptr
+        else:
+            break
+    return child
+
+
+def np_leaf_find(pg: np.ndarray, key: int) -> tuple[int, int | None]:
+    """Host leaf scan: -> (slot, value) or (-1, None)."""
+    for k, v, s in np_leaf_entries(pg):
+        if k == key:
+            return s, v
+    return -1, None
+
+
+def np_leaf_free_slot(pg: np.ndarray) -> int:
+    for s in range(C.LEAF_CAP):
+        if not np_slot_live(pg, s):
+            return s
+    return -1

@@ -1,0 +1,108 @@
+"""Remote-memory allocation: chunk-grain directory service + client bumps.
+
+Three cooperating pieces, mirroring the reference:
+
+- :class:`GlobalAllocator` — per memory node, owned by its directory: hands
+  out fixed-size chunks of the node's pool partition, bump-scan, no reuse
+  (``GlobalAllocator.h:31-50``; 32 MB chunks, ``Common.h:80``).
+- :class:`Directory` — the per-node memory-node agent serving MALLOC / FREE
+  / NEW_ROOT (``Directory.cpp:60-92``).  In single-process SPMD the "RPC"
+  is a method call; the interface is kept RPC-shaped (explicit request
+  types) so a multi-host build can put a real host service behind it.
+- :class:`LocalAllocator` — per client thread: bump-allocates pages inside
+  leased chunks, round-robining target nodes per allocation the way
+  ``DSM::alloc`` round-robins its chunk leases (``DSM.h:200-221``,
+  ``LocalAllocator.h:21-43``).  ``free`` is a no-op, faithful to the
+  reference (``DSM.h:226``).
+
+Page 0 of every node is reserved (page 0 of node 0 carries the root-pointer
+meta words; addr 0 doubles as NULL).
+"""
+
+from __future__ import annotations
+
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.ops import bits
+
+RESERVED_PAGES = 1
+
+
+class GlobalAllocator:
+    """Bump chunk allocator over one node's pool partition."""
+
+    def __init__(self, node_id: int, pages_per_node: int, chunk_pages: int,
+                 reserved: int = RESERVED_PAGES):
+        self.node_id = node_id
+        self.chunk_pages = chunk_pages
+        self._next = reserved
+        self._limit = pages_per_node
+
+    def alloc_chunk(self) -> int:
+        """-> first page index of a fresh chunk; raises when exhausted."""
+        if self._next + self.chunk_pages > self._limit:
+            raise MemoryError(
+                f"node {self.node_id}: DSM partition exhausted "
+                f"({self._limit} pages)")
+        start = self._next
+        self._next += self.chunk_pages
+        return start
+
+    @property
+    def pages_used(self) -> int:
+        return self._next
+
+
+class Directory:
+    """Memory-node agent: chunk MALLOC + NEW_ROOT bookkeeping.
+
+    The reference spawns one directory thread per node polling UD messages
+    (``Directory.cpp:23-58``); here requests arrive as calls.  NEW_ROOT
+    updates the node-local root hint exactly like ``Directory.cpp:75-86``.
+    """
+
+    def __init__(self, node_id: int, cfg: DSMConfig):
+        self.node_id = node_id
+        self.allocator = GlobalAllocator(
+            node_id, cfg.pages_per_node, cfg.chunk_pages)
+        self.root_ptr = 0      # g_root_ptr analogue
+        self.root_level = -1   # g_root_level analogue
+
+    def malloc_chunk(self) -> tuple[int, int]:
+        """MALLOC RPC: -> (chunk base addr, chunk_pages)."""
+        start = self.allocator.alloc_chunk()
+        return bits.make_addr(self.node_id, start), self.allocator.chunk_pages
+
+    def new_root(self, addr: int, level: int) -> None:
+        """NEW_ROOT RPC (broadcast target, ``Tree.cpp:116-124``)."""
+        self.root_ptr = addr
+        self.root_level = level
+
+
+class LocalAllocator:
+    """Per-client page allocator over leased chunks, one lease per node."""
+
+    def __init__(self, directories: list[Directory]):
+        self._dirs = directories
+        self._cur: dict[int, tuple[int, int]] = {}  # node -> (next_page, end)
+        self._rr = 0
+
+    def alloc(self, npages: int = 1, node: int | None = None) -> int:
+        """Allocate npages *contiguous* pages; -> packed addr of the first.
+
+        Target node round-robins per call unless pinned (DSM.h:200-203).
+        """
+        if node is None:
+            node = self._rr % len(self._dirs)
+            self._rr += 1
+        nxt, end = self._cur.get(node, (0, 0))
+        if nxt + npages > end:
+            base_addr, chunk_pages = self._dirs[node].malloc_chunk()
+            assert npages <= chunk_pages
+            nxt = bits.addr_page(base_addr)
+            end = nxt + chunk_pages
+        self._cur[node] = (nxt + npages, end)
+        return bits.make_addr(node, nxt)
+
+    def free(self, addr: int, npages: int = 1) -> None:
+        """No-op, like the reference (``DSM.h:226``, LocalAllocator.h:45-47).
+        Page reclamation is future work in both systems."""

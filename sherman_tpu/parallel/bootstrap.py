@@ -1,0 +1,71 @@
+"""Cluster bootstrap + control-plane collectives — the Keeper role.
+
+The reference coordinates out-of-band through memcached: node-ID assignment
+(``Keeper.cpp:67-85``), all-pairs QP handshake (``DSMKeeper.cpp:36-134``),
+named barriers via fetch-add + spin (``DSMKeeper.cpp:148-161``) and ``sum``
+all-reduce via per-node keys (``DSMKeeper.cpp:163-176``).
+
+On TPU the fabric needs no QP handshake — the mesh IS the connection table —
+so the Keeper reduces to a small KV + collectives surface.  Single-process
+SPMD (one Python process driving the whole mesh) implements it in-memory;
+a multi-host deployment would back the same interface with
+``jax.distributed`` 's KV store and process-group barriers, which
+``jax.distributed.initialize`` already provides.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class Keeper:
+    """In-process KV / barrier / sum with DSMKeeper's interface."""
+
+    def __init__(self, machine_nr: int):
+        self.machine_nr = machine_nr
+        self._kv: dict[str, bytes] = {}
+        self._counters: dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+        self._server_num = 0
+
+    # -- membership (Keeper::serverEnter, Keeper.cpp:67-85) ------------------
+
+    def server_enter(self) -> int:
+        with self._lock:
+            node_id = self._server_num
+            self._server_num += 1
+            assert node_id < self.machine_nr, "cluster full"
+            return node_id
+
+    # -- KV (Keeper::memSet/memGet/memFetchAndAdd, Keeper.cpp:115-160) -------
+
+    def mem_set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def mem_get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self._kv.get(key)
+
+    def mem_fetch_and_add(self, key: str, delta: int = 1) -> int:
+        with self._lock:
+            old = self._counters[key]
+            self._counters[key] = old + delta
+            return old
+
+    # -- collectives (DSMKeeper.cpp:148-176) ---------------------------------
+
+    def barrier(self, name: str) -> None:
+        """Named cluster barrier.  In single-process SPMD every node's work
+        is already serialized through one driver, so arrival==completion;
+        the fetch-add bookkeeping is kept for interface parity."""
+        self.mem_fetch_and_add("barrier:" + name, 1)
+
+    def sum(self, name: str, value: int) -> int:
+        """All-reduce sum of one contribution per call (cluster throughput
+        aggregation in the benchmark driver, test/benchmark.cpp:336-346)."""
+        with self._lock:
+            k = "sum:" + name
+            self._counters[k] += int(value)
+            return self._counters[k]

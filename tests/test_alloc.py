@@ -1,0 +1,69 @@
+import pytest
+
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel.alloc import Directory, GlobalAllocator, LocalAllocator
+
+
+def _dirs(machine_nr=4, pages=64, chunk=8):
+    cfg = DSMConfig(machine_nr=machine_nr, pages_per_node=pages,
+                    chunk_pages=chunk, step_capacity=8)
+    return [Directory(n, cfg) for n in range(machine_nr)]
+
+
+def test_chunk_alloc_skips_reserved_page():
+    ga = GlobalAllocator(0, pages_per_node=64, chunk_pages=8)
+    assert ga.alloc_chunk() == 1  # page 0 reserved
+    assert ga.alloc_chunk() == 9
+
+
+def test_chunk_exhaustion():
+    ga = GlobalAllocator(0, pages_per_node=20, chunk_pages=8)
+    ga.alloc_chunk()
+    ga.alloc_chunk()
+    with pytest.raises(MemoryError):
+        ga.alloc_chunk()
+
+
+def test_local_alloc_round_robin_nodes():
+    la = LocalAllocator(_dirs())
+    nodes = [bits.addr_node(la.alloc()) for _ in range(8)]
+    assert nodes == [0, 1, 2, 3, 0, 1, 2, 3]
+
+
+def test_local_alloc_unique_addrs():
+    la = LocalAllocator(_dirs())
+    addrs = [la.alloc() for _ in range(100)]
+    assert len(set(addrs)) == 100
+    assert all(not bits.addr_is_null(a) for a in addrs)
+
+
+def test_local_alloc_chunk_refill_and_pinned_node():
+    la = LocalAllocator(_dirs(machine_nr=2, pages=64, chunk=4))
+    addrs = [la.alloc(node=1) for _ in range(10)]  # spans 3 chunks
+    assert all(bits.addr_node(a) == 1 for a in addrs)
+    pages = [bits.addr_page(a) for a in addrs]
+    assert len(set(pages)) == 10
+
+
+def test_two_clients_disjoint_pages():
+    dirs = _dirs()
+    a = LocalAllocator(dirs)
+    b = LocalAllocator(dirs)
+    got_a = {a.alloc() for _ in range(20)}
+    got_b = {b.alloc() for _ in range(20)}
+    assert not (got_a & got_b)
+
+
+def test_contiguous_multi_page_alloc():
+    la = LocalAllocator(_dirs(chunk=16))
+    addr = la.alloc(npages=4, node=2)
+    nxt = la.alloc(node=2)
+    assert bits.addr_page(nxt) == bits.addr_page(addr) + 4
+
+
+def test_directory_new_root():
+    d = _dirs()[0]
+    d.new_root(bits.make_addr(1, 5), 3)
+    assert d.root_ptr == bits.make_addr(1, 5)
+    assert d.root_level == 3

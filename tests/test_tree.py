@@ -1,0 +1,110 @@
+"""Tree functional tests — the tree_test.cpp parity suite (SURVEY.md §4):
+insert -> overwrite -> search-assert -> delete -> re-insert -> re-verify,
+plus split coverage and structural invariant checks."""
+
+import numpy as np
+import pytest
+
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.models.btree import Tree
+
+
+@pytest.fixture(scope="module")
+def cluster(eight_devices):
+    cfg = DSMConfig(machine_nr=4, pages_per_node=1024, locks_per_node=1024,
+                    step_capacity=32, chunk_pages=32)
+    return Cluster(cfg)
+
+
+@pytest.fixture(scope="module")
+def tree(cluster):
+    return Tree(cluster)
+
+
+def test_insert_search_single_leaf(tree):
+    for k in [5, 3, 9, 1]:
+        tree.insert(k, k * 10)
+    for k in [5, 3, 9, 1]:
+        assert tree.search(k) == k * 10
+    assert tree.search(4) is None
+
+
+def test_overwrite(tree):
+    tree.insert(5, 555)
+    assert tree.search(5) == 555
+
+
+def test_delete_and_reinsert(tree):
+    assert tree.delete(3)
+    assert tree.search(3) is None
+    assert not tree.delete(3)
+    tree.insert(3, 33)
+    assert tree.search(3) == 33
+
+
+def test_leaf_split_and_multi_level(tree):
+    # enough keys to force leaf splits and an internal root
+    keys = list(range(100, 100 + 300))
+    rng = np.random.default_rng(0)
+    rng.shuffle(keys)
+    for k in keys:
+        tree.insert(k, k + 7)
+    for k in keys:
+        assert tree.search(k) == k + 7, k
+    stats = tree.check_structure()
+    assert stats["leaves"] > 1
+    assert stats["levels"] >= 2
+
+
+def test_range_query(tree):
+    got = tree.range_query(150, 160)
+    assert got == {k: k + 7 for k in range(150, 160)}
+    # range spanning deleted + missing keys
+    got = tree.range_query(1, 20)
+    assert got[1] == 10 and got[3] == 33
+    assert 4 not in got
+
+
+def test_big_keys_64bit(tree):
+    big = [2**40 + 1, 2**63 - 5, 2**32, 2**33 + 17]
+    for k in big:
+        tree.insert(k, k % 1000)
+    for k in big:
+        assert tree.search(k) == k % 1000
+
+
+def test_tree_test_parity(cluster):
+    """Scaled tree_test.cpp loop (insert, overwrite x2, verify v==i*3,
+    delete evens, verify, re-insert, verify; test/tree_test.cpp:30-70)."""
+    t = Tree(cluster)  # second client on the same cluster/index
+    n = 400
+    keys = list(range(10_000, 10_000 + n))
+    rng = np.random.default_rng(1)
+    rng.shuffle(keys)
+    for k in keys:
+        t.insert(k, k)
+    for k in keys:
+        t.insert(k, k * 3)
+    for k in keys:
+        assert t.search(k) == k * 3
+    for k in keys[::2]:
+        assert t.delete(k)
+    for k in keys[::2]:
+        assert t.search(k) is None
+    for k in keys[1::2]:
+        assert t.search(k) == k * 3
+    for k in keys[::2]:
+        t.insert(k, k * 3)
+    for k in keys:
+        assert t.search(k) == k * 3
+    stats = t.check_structure()
+    assert stats["keys"] >= n  # earlier tests' keys also live in this index
+
+
+def test_two_clients_share_index(cluster, tree):
+    """Second Tree handle adopts the existing root (CAS loser path)."""
+    t2 = Tree(cluster)
+    assert t2.search(5) == 555
+    t2.insert(77777, 1)
+    assert tree.search(77777) == 1
