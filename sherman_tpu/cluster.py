@@ -11,7 +11,7 @@ pool allocation -> fabric (the mesh itself) -> keeper enter -> directories
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 

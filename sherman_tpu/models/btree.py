@@ -47,8 +47,15 @@ class Tree:
         self.cfg = cluster.cfg
         self.ctx = ctx if ctx is not None else cluster.register_client()
 
-        # Construct an empty root leaf and try to install it (one winner
-        # across the cluster, Tree.cpp:48-55).
+        # Adopt an existing root if one is installed; otherwise construct an
+        # empty root leaf and CAS-install it (one winner across the cluster,
+        # Tree.cpp:48-55).  The pre-read avoids leaking a page per client
+        # handle (free() is a no-op, faithful to the reference).
+        existing = self.dsm.read_word(META_ADDR, C.META_ROOT_ADDR_W)
+        if existing != 0:
+            self._root_addr = existing
+            self._root_level = int(self.dsm.read_page(existing)[C.W_LEVEL])
+            return
         root = self.ctx.alloc.alloc()
         pg = layout.np_empty_page(level=0, lowest=C.KEY_NEG_INF,
                                   highest=C.KEY_POS_INF)

@@ -1,0 +1,164 @@
+"""Batched device-kernel tests: bulk_load + batched search/insert vs a
+python dict model, on the 8-virtual-device CPU mesh (SURVEY.md §4 lesson:
+everything testable in-process)."""
+
+import numpy as np
+import pytest
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+
+
+def make(nr=4, pages=4096, cap=256, B=128):
+    cfg = DSMConfig(machine_nr=nr, pages_per_node=pages, step_capacity=cap,
+                    chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B)
+    return tree, eng
+
+
+def test_bulk_load_and_search(eight_devices):
+    tree, eng = make()
+    rng = np.random.default_rng(0)
+    keys = np.unique(rng.integers(1, 1 << 40, 3000, dtype=np.uint64))
+    vals = keys * np.uint64(7)
+    stats = batched.bulk_load(tree, keys, vals)
+    assert stats["root_level"] >= 1
+    tree.check_structure()
+
+    got, found = eng.search(keys)
+    assert found.all()
+    np.testing.assert_array_equal(got, vals)
+
+    # misses
+    miss_keys = np.array([2, 4, (1 << 41) + 1], np.uint64)
+    miss_keys = np.setdiff1d(miss_keys, keys)
+    _, found = eng.search(miss_keys)
+    assert not found.any()
+
+
+def test_search_matches_host_tree(eight_devices):
+    tree, eng = make()
+    rng = np.random.default_rng(1)
+    keys = np.unique(rng.integers(1, 10_000, 500, dtype=np.uint64))
+    batched.bulk_load(tree, keys, keys + np.uint64(1))
+    for k in keys[:20]:
+        assert tree.search(int(k)) == int(k) + 1
+    got, found = eng.search(keys[:20])
+    assert found.all()
+    np.testing.assert_array_equal(got, keys[:20] + np.uint64(1))
+
+
+def test_batched_insert_fast_path(eight_devices):
+    tree, eng = make()
+    base = np.unique(
+        np.random.default_rng(2).integers(1, 1 << 30, 2000, dtype=np.uint64))
+    batched.bulk_load(tree, base, base, fill=0.5)
+
+    # updates of existing keys: pure fast path, no splits
+    upd = base[::3]
+    stats = eng.insert(upd, upd * np.uint64(3))
+    assert stats["applied"] == upd.shape[0]
+    assert stats["host_path"] == 0
+
+    got, found = eng.search(base)
+    assert found.all()
+    expect = base.copy()
+    expect[::3] *= np.uint64(3)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_batched_insert_new_keys_and_splits(eight_devices):
+    tree, eng = make()
+    rng = np.random.default_rng(3)
+    base = np.unique(rng.integers(1, 1 << 30, 1000, dtype=np.uint64))
+    batched.bulk_load(tree, base, base, fill=0.9)
+
+    extra = np.unique(rng.integers(1 << 30, 1 << 31, 1500, dtype=np.uint64))
+    eng.insert(extra, extra + np.uint64(9))
+    tree.check_structure()
+
+    got, found = eng.search(extra)
+    assert found.all()
+    np.testing.assert_array_equal(got, extra + np.uint64(9))
+    got, found = eng.search(base)
+    assert found.all()
+    np.testing.assert_array_equal(got, base)
+
+
+def test_duplicate_keys_in_one_batch(eight_devices):
+    tree, eng = make()
+    base = np.arange(1, 200, dtype=np.uint64)
+    batched.bulk_load(tree, base, base)
+
+    keys = np.array([50, 50, 50, 60], np.uint64)
+    vals = np.array([111, 222, 333, 444], np.uint64)
+    stats = eng.insert(keys, vals)
+    assert stats["applied"] + stats["superseded"] + stats["host_path"] == 4
+
+    got, found = eng.search(np.array([50, 60], np.uint64))
+    assert found.all()
+    assert got[0] in (111, 222, 333)  # deterministic winner, one of the batch
+    assert got[1] == 444
+
+
+def test_insert_into_empty_tree_via_engine(eight_devices):
+    tree, eng = make()
+    keys = np.unique(np.random.default_rng(5).integers(
+        1, 1 << 20, 300, dtype=np.uint64))
+    eng.insert(keys, keys * np.uint64(2))
+    tree.check_structure()
+    got, found = eng.search(keys)
+    assert found.all()
+    np.testing.assert_array_equal(got, keys * np.uint64(2))
+
+
+def test_mixed_engine_and_host_ops(eight_devices):
+    tree, eng = make()
+    keys = np.arange(1, 500, dtype=np.uint64)
+    batched.bulk_load(tree, keys, keys)
+    # host-path delete then batched search must miss
+    assert tree.delete(100)
+    _, found = eng.search(np.array([100], np.uint64))
+    assert not found.any()
+    # host-path insert visible to engine
+    tree.insert(100, 777)
+    got, found = eng.search(np.array([100], np.uint64))
+    assert found.all() and got[0] == 777
+
+
+def test_stale_root_handle_recovers_after_bulk_load(eight_devices):
+    """A Tree handle created before bulk_load must chase into the new tree
+    (the old root is poisoned, not orphaned)."""
+    tree, eng = make()
+    t2 = Tree(tree.cluster)  # stale handle, cached empty root
+    keys = np.arange(1, 400, dtype=np.uint64)
+    batched.bulk_load(tree, keys, keys * np.uint64(2))
+    assert t2.search(100) == 200
+    t2.insert(100, 999)
+    assert tree.search(100) == 999
+    got, found = eng.search(np.array([100], np.uint64))
+    assert found.all() and got[0] == 999
+
+
+def test_bulk_load_refuses_nonempty_tree(eight_devices):
+    tree, _ = make()
+    tree.insert(5, 5)
+    with pytest.raises(ValueError):
+        batched.bulk_load(tree, np.array([1, 2, 3], np.uint64),
+                          np.array([1, 2, 3], np.uint64))
+
+
+def test_counters_move(eight_devices):
+    tree, eng = make()
+    keys = np.arange(1, 300, dtype=np.uint64)
+    batched.bulk_load(tree, keys, keys)
+    before = tree.dsm.counter_snapshot()
+    eng.search(keys[:64])
+    eng.insert(keys[:32], keys[:32])
+    after = tree.dsm.counter_snapshot()
+    assert after["read_ops"] > before["read_ops"]
+    assert after["write_ops"] >= before["write_ops"] + 32
