@@ -56,6 +56,11 @@ class DSMConfig:
     # all_to_all exchange.  Requests over capacity are dropped with ok=0 and
     # retried by the caller (cf. RDMA send-queue depth).
     step_capacity: int = 512
+    # Capacity of the HOST control-plane step (DSM.step/_batch): kept small
+    # and independent of step_capacity because every host call materializes
+    # [machine_nr * capacity, PAGE_WORDS] request payloads — sizing it like
+    # the device batch would ship hundreds of MB per control-plane op.
+    host_step_capacity: int = 64
     # Chunk size of the memory-node global allocator, in pages
     # (kChunkSize = 32 MB -> 32768 pages, Common.h:80).  Scaled down by
     # default so small test pools still have multiple chunks.
@@ -69,8 +74,15 @@ class DSMConfig:
 # ---------------------------------------------------------------------------
 # B+Tree page layout (word offsets inside a 256-word page).
 #
-# Mirrors the reference Header/InternalEntry/LeafEntry layouts
-# (Tree.h:130-187) with TPU-friendly word granularity:
+# Mirrors the reference Header/InternalEntry/LeafEntry *content*
+# (Tree.h:130-187) but NOT its array-of-structs layout: entries are stored
+# struct-of-arrays WITHIN the page — each field is a contiguous word block —
+# because TPU vector units have no per-lane gather: a strided field access
+# (AoS) lowers to a slow minor-axis gather, while an SoA field is a static
+# contiguous slice the VPU streams at full rate.  This is the single most
+# important TPU-first layout decision in the framework (measured ~5x on the
+# batched descent hot loop).
+#
 #   word 0:   front_version        (Tree.h:199-210 front/rear page versions)
 #   word 1:   leftmost_ptr         (internal pages; Header.leftmost_ptr)
 #   word 2:   sibling_ptr          (B-link; Header.sibling_ptr)
@@ -78,14 +90,15 @@ class DSMConfig:
 #   word 4:   nkeys                (Header.last_index + 1)
 #   word 5-6: lowest key (hi, lo)  (fence keys, Header.lowest/highest)
 #   word 7-8: highest key (hi, lo)
-#   word 9..254: entries
+#   word 9..254: entry field blocks (SoA, see below)
 #   word 255: rear_version
 #
-# Internal entry  = [key_hi, key_lo, child_addr]            -> 3 words, 81 max
-# Leaf entry      = [fver, key_hi, key_lo, val_hi, val_lo, rver] -> 6 words,
-#                   41 max; fver/rver are the per-entry two-level versions
-#                   (LeafEntry f_version/r_version, Tree.h:174-187): an entry
-#                   is consistent iff fver == rver != 0; 0 marks a free slot.
+# Internal (82 entries): khi[82] | klo[82] | child[82]
+# Leaf     (41 slots):   fver[41] | khi[41] | klo[41] | vhi[41] | vlo[41]
+#                        | rver[41]
+# fver/rver are the per-entry two-level versions (LeafEntry
+# f_version/r_version, Tree.h:174-187): a slot is live iff
+# fver == rver != 0; fver == 0 marks a free slot.
 # ---------------------------------------------------------------------------
 
 W_FRONT_VER = 0
@@ -102,19 +115,24 @@ W_REAR_VER = PAGE_WORDS - 1
 
 ENTRY_WORDS_AVAIL = W_REAR_VER - W_ENTRIES  # 246
 
-INTERNAL_ENTRY_WORDS = 3
-LEAF_ENTRY_WORDS = 6
-
-# Leaf entry word offsets (relative to entry start).
-LE_FVER = 0
-LE_KEY_HI = 1
-LE_KEY_LO = 2
-LE_VAL_HI = 3
-LE_VAL_LO = 4
-LE_RVER = 5
+INTERNAL_ENTRY_WORDS = 3  # words per internal entry (summed over blocks)
+LEAF_ENTRY_WORDS = 6      # words per leaf slot (summed over blocks)
 
 INTERNAL_CAP = ENTRY_WORDS_AVAIL // INTERNAL_ENTRY_WORDS  # 82 -> reference 61
 LEAF_CAP = ENTRY_WORDS_AVAIL // LEAF_ENTRY_WORDS          # 41 -> reference 54
+
+# Internal field block starts.
+I_KHI_W = W_ENTRIES
+I_KLO_W = I_KHI_W + INTERNAL_CAP
+I_PTR_W = I_KLO_W + INTERNAL_CAP
+
+# Leaf field block starts.
+L_FVER_W = W_ENTRIES
+L_KHI_W = L_FVER_W + LEAF_CAP
+L_KLO_W = L_KHI_W + LEAF_CAP
+L_VHI_W = L_KLO_W + LEAF_CAP
+L_VLO_W = L_VHI_W + LEAF_CAP
+L_RVER_W = L_VLO_W + LEAF_CAP
 
 # 64-bit key sentinels (stored as hi/lo uint32 pairs).  User keys must lie in
 # [KEY_MIN, KEY_MAX]; the fences use NEG_INF/POS_INF (cf. kKeyMin/kKeyMax in

@@ -60,27 +60,31 @@ _PW = C.PAGE_WORDS
 # ---------------------------------------------------------------------------
 
 def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
-                 iters: int, axis_name: str = AXIS):
+                 iters: int, axis_name: str = AXIS, start=None):
     """Walk each active key from ``root`` to its leaf (level 0, in fence).
 
     Runs inside shard_map; khi/klo are this node's [B] key shard.  ``iters``
-    is a static trip count (tree height + sibling-chase budget).
+    is a static trip count (tree height + sibling-chase budget).  ``start``
+    optionally seeds per-key start addresses (the index-cache fast path);
+    keys then only need the sibling-chase/leaf hops from there.
 
     Returns (counters, addr [B], page [B, PW], done [B]).  done=False keys
     exhausted the budget (capacity overflow or deep chase): retry.
+
+    Perf note: the loop carries ONLY (addr, done) — the leaf page is
+    re-gathered once after the loop.  Carrying the [B, PAGE_WORDS] page
+    through the loop costs a full-batch select per iteration, which
+    dominates step time at large B.
     """
     B = khi.shape[0]
-    addr = jnp.broadcast_to(jnp.asarray(root, jnp.int32), (B,))
+    if start is None:
+        start = jnp.broadcast_to(jnp.asarray(root, jnp.int32), (B,))
+    addr = start
     done = ~active
-    page = jnp.zeros((B, _PW), jnp.int32)
 
-    def body(_, st):
-        addr, done, page, counters = st
+    def advance(addr, done):
         pages, ok = D.read_pages_spmd(pool, addr, cfg=cfg,
                                       axis_name=axis_name, active=~done)
-        served = jnp.sum((ok & ~done).astype(jnp.uint32))
-        counters = counters.at[D.CNT_READ_OPS].add(served)
-        counters = counters.at[D.CNT_READ_PAGES].add(served)
         lvl = layout.h_level(pages)
         chase = layout.needs_sibling_chase(pages, khi, klo)
         at_leaf = (lvl == 0) & ~chase
@@ -88,24 +92,144 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
                         layout.internal_pick_child(pages, khi, klo))
         step_ok = ok & ~done
         new_addr = jnp.where(step_ok & ~at_leaf, nxt, addr)
-        new_page = jnp.where((step_ok & at_leaf)[:, None], pages, page)
         new_done = done | (step_ok & at_leaf)
-        return new_addr, new_done, new_page, counters
+        return new_addr, new_done
 
-    addr, done, page, counters = lax.fori_loop(
-        0, iters, body, (addr, done, page, counters))
-    return counters, addr, page, done & active
+    if cfg.machine_nr == 1:
+        # Dynamic early exit: no collectives in the body, so a data-dependent
+        # while_loop is legal; a fresh index-cache start exits after ~1 hop.
+        def cond(st):
+            it, _, done = st
+            return (it < iters) & jnp.any(~done)
+
+        def bodyw(st):
+            it, addr, done = st
+            addr, done = advance(addr, done)
+            return it + 1, addr, done
+
+        _, addr, done = lax.while_loop(cond, bodyw, (0, addr, done))
+    else:
+        # SPMD: every node must run the same trip count (the body carries
+        # all_to_all exchanges), so the budget is static.
+        def body(_, st):
+            addr, done = st
+            return advance(*st)
+
+        addr, done = lax.fori_loop(0, iters, body, (addr, done))
+
+    # one final gather yields the leaf pages for the done keys
+    page, ok_f = D.read_pages_spmd(pool, addr, cfg=cfg, axis_name=axis_name,
+                                   active=done & active)
+    done = done & active & ok_f
+    # read accounting: every key costs its descent depth; we charge the
+    # static budget (iters + 1 gathers issued per active key)
+    counters = counters.at[D.CNT_READ_OPS].add(
+        jnp.sum(active.astype(jnp.uint32)) * jnp.uint32(iters + 1))
+    counters = counters.at[D.CNT_READ_PAGES].add(
+        jnp.sum(active.astype(jnp.uint32)) * jnp.uint32(iters + 1))
+    return counters, addr, page, done
 
 
-def search_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
-                iters: int, axis_name: str = AXIS):
+def _router_start(table, khi, lb: int):
+    """Seed addresses from the replicated index-cache table (router.py)."""
+    uhi = jnp.asarray(khi, jnp.int32).astype(jnp.uint32)
+    bucket = jnp.right_shift(uhi, jnp.uint32(32 - lb)).astype(jnp.int32)
+    return table[bucket]
+
+
+def search_routed_spmd(pool, counters, khi, klo, root, active, table, *,
+                       cfg: DSMConfig, iters: int, lb: int,
+                       axis_name: str = AXIS):
+    """Single-node cache-hit search: one full-batch leaf read, then a
+    COMPACTED straggler loop.
+
+    With a warm index cache ~95% of keys finish in round 1 (their bucket's
+    page IS their leaf).  The few stragglers (bucket-boundary sibling
+    chases, stale entries) are compacted into a small fixed buffer so later
+    rounds gather S rows instead of B — full-batch rounds are what make a
+    naive descent loop pay the whole batch's bandwidth per level.
+
+    Single-node only (no routing exchange); the generic ``search_spmd``
+    remains the multi-node / no-cache path.
+    """
+    assert cfg.machine_nr == 1
+    B = khi.shape[0]
+    P = pool.shape[0]
+    S = max(min(256, B), B // 4)
+    max_rounds = iters * 4
+
+    def read(addrs):
+        page = bits.addr_page(addrs)
+        ok = (page >= 0) & (page < P)
+        return pool[jnp.clip(page, 0, P - 1)], ok
+
+    def advance(pg, ok, kh, kl):
+        lvl = layout.h_level(pg)
+        chase = layout.needs_sibling_chase(pg, kh, kl)
+        at_leaf = ok & (lvl == 0) & ~chase
+        nxt = jnp.where(chase, layout.h_sibling(pg),
+                        layout.internal_pick_child(pg, kh, kl))
+        f, vh, vl, _ = layout.leaf_find_key(pg, kh, kl)
+        return at_leaf, nxt, f, vh, vl
+
+    # round 1: full batch from the cache-seeded start
+    start = _router_start(table, khi, lb)
+    pg, ok = read(start)
+    at_leaf, nxt, f, vh, vl = advance(pg, ok, khi, klo)
+    hit = active & at_leaf
+    done = ~active | at_leaf
+    found = hit & f
+    vhi = jnp.where(found, vh, 0)
+    vlo = jnp.where(found, vl, 0)
+    addr = jnp.where(ok, nxt, start)
+
+    def cond(st):
+        it, done = st[0], st[1]
+        return (it < max_rounds) & jnp.any(~done)
+
+    def body(st):
+        it, done, addr, found, vhi, vlo = st
+        sidx = jnp.nonzero(~done, size=S, fill_value=B)[0].astype(jnp.int32)
+        valid = sidx < B
+        ci = jnp.clip(sidx, 0, B - 1)
+        sa, skh, skl = addr[ci], khi[ci], klo[ci]
+        pg, ok = read(sa)
+        ok = ok & valid
+        at_leaf, nxt, f, vh, vl = advance(pg, ok, skh, skl)
+        fin = ok & at_leaf
+        tgt = jnp.where(fin, sidx, B)
+        done = done.at[tgt].set(True, mode="drop")
+        found = found.at[tgt].set(f & fin, mode="drop")
+        vhi = vhi.at[tgt].set(jnp.where(f & fin, vh, 0), mode="drop")
+        vlo = vlo.at[tgt].set(jnp.where(f & fin, vl, 0), mode="drop")
+        adv = jnp.where(ok & ~at_leaf, sidx, B)
+        addr = addr.at[adv].set(nxt, mode="drop")
+        return it + 1, done, addr, found, vhi, vlo
+
+    _, done, addr, found, vhi, vlo = lax.while_loop(
+        cond, body, (1, done, addr, found, vhi, vlo))
+
+    counters = counters.at[D.CNT_READ_OPS].add(
+        jnp.sum(active.astype(jnp.uint32)))
+    counters = counters.at[D.CNT_READ_PAGES].add(
+        jnp.sum(active.astype(jnp.uint32)))
+    done = done & active
+    return counters, done, found & done, vhi, vlo
+
+
+def search_spmd(pool, counters, khi, klo, root, active, table=None, *,
+                cfg: DSMConfig, iters: int, lb: int | None = None,
+                axis_name: str = AXIS):
     """Batched ``Tree::search`` (Tree.cpp:405-458): pure one-sided reads.
 
+    With ``table`` (the index cache), descent starts at the bucket's page —
+    normally the leaf itself (cache-hit path, Tree.cpp:415-427).
     Returns (done, found, vhi, vlo) per key.
     """
+    start = _router_start(table, khi, lb) if table is not None else None
     counters, _, page, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
-        axis_name=axis_name)
+        axis_name=axis_name, start=start)
     found, vhi, vlo, _ = layout.leaf_find_key(page, khi, klo)
     return counters, done, found & done, vhi, vlo
 
@@ -213,16 +337,18 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     slot = jnp.where(found, fslot, islot)
 
     # --- single-entry write-back scatter -----------------------------------
-    ent_off = C.W_ENTRIES + slot * C.LEAF_ENTRY_WORDS
-    old_fv = jnp.take_along_axis(pg, ent_off[:, None], axis=-1)[:, 0]
+    # one-hot extract of the slot's old fver (take_along_axis is slow on TPU)
+    fver_blk = pg[:, C.L_FVER_W:C.L_FVER_W + C.LEAF_CAP]
+    slot_oh = jnp.arange(C.LEAF_CAP)[None, :] == slot[:, None]
+    old_fv = jnp.sum(jnp.where(slot_oh, fver_blk, 0), axis=-1)
     new_ver = (old_fv + 1) & 0x7FFFFFFF
     new_ver = jnp.where(new_ver == 0, 1, new_ver)
 
     ent = jnp.stack([new_ver, khi, klo, inc["vhi"], inc["vlo"], new_ver],
                     axis=-1)                               # [M, 6]
-    base = safe_page * _PW + ent_off
-    cols = jnp.arange(C.LEAF_ENTRY_WORDS, dtype=jnp.int32)
-    idx = base[:, None] + cols[None, :]
+    field_w = jnp.asarray([C.L_FVER_W, C.L_KHI_W, C.L_KLO_W, C.L_VHI_W,
+                           C.L_VLO_W, C.L_RVER_W], jnp.int32)
+    idx = (safe_page * _PW)[:, None] + field_w[None, :] + slot[:, None]
     idx = jnp.where(applied[:, None], idx, P * _PW)
     flat = pool.reshape(-1)
     flat = flat.at[idx.reshape(-1)].set(ent.reshape(-1), mode="drop")
@@ -254,16 +380,28 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
 
 
 def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
-                     *, cfg: DSMConfig, iters: int, axis_name: str = AXIS):
+                     table=None, *, cfg: DSMConfig, iters: int,
+                     lb: int | None = None, axis_name: str = AXIS):
     """One batched insert step: descend + route to owners + leaf apply.
 
     Returns (pool, counters, status [B]) per this node's key shard.
     """
     B = khi.shape[0]
     N, cap = cfg.machine_nr, cfg.step_capacity
+    start = _router_start(table, khi, lb) if table is not None else None
     counters, addr, _, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
-        axis_name=axis_name)
+        axis_name=axis_name, start=start)
+
+    if N == 1:
+        # Single-node fast path: requests are already local — no routing.
+        prio = jnp.arange(B, dtype=jnp.int32)
+        inc = {"active": done, "addr": addr, "khi": khi, "klo": klo,
+               "vhi": vhi, "vlo": vlo, "prio": prio}
+        pool, counters, st = leaf_apply_spmd(pool, locks, counters, inc,
+                                             cfg=cfg)
+        status = jnp.where(active, jnp.where(done, st, ST_RETRY), ST_INVALID)
+        return pool, counters, status
 
     dest = bits.addr_node(addr)
     bucket_idx, routed = transport.bucketize(dest, done, N, cap)
@@ -304,8 +442,9 @@ class BatchedEngine:
         self.cfg = tree.cfg
         self.tcfg = tcfg if tcfg is not None else TreeConfig()
         self.B = batch_per_node
-        self._search_cache: dict[int, callable] = {}
-        self._insert_cache: dict[int, callable] = {}
+        self.router = None
+        self._search_cache: dict = {}
+        self._insert_cache: dict = {}
         spec = jax.sharding.PartitionSpec(AXIS)
         self._spec = spec
         self._rep = jax.sharding.PartitionSpec()
@@ -314,34 +453,64 @@ class BatchedEngine:
         # static descent budget: height + chase slack
         return self.tree._root_level + 1 + self.tcfg.sibling_chase_budget
 
-    def _get_search(self, iters: int):
-        fn = self._search_cache.get(iters)
+    def attach_router(self, log2_buckets: int | None = None):
+        """Create + seed the device index cache (see router.py).  Uses the
+        bulk-load leaf directory when available; otherwise starts cold at
+        the root and is refined by split notifications."""
+        from sherman_tpu.models.router import LeafRouter, default_log2_buckets
+        leaf_dir = getattr(self.tree, "_bulk_leaf_dir", None)
+        if log2_buckets is None:
+            n_leaves = len(leaf_dir[0]) if leaf_dir else 1024
+            log2_buckets = default_log2_buckets(n_leaves)
+        r = LeafRouter(self.tree, log2_buckets)
+        if leaf_dir is not None:
+            r.seed_from_leaves(*leaf_dir)
+        self.router = r
+        return r
+
+    def _get_search(self, iters: int, with_router: bool):
+        lb = self.router.lb if with_router else None
+        key = (iters, lb)
+        fn = self._search_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
+            in_specs = [spec, spec, spec, spec, rep, spec]
+            if with_router:
+                in_specs.append(rep)
+            if with_router and self.cfg.machine_nr == 1:
+                kernel = functools.partial(search_routed_spmd, cfg=self.cfg,
+                                           iters=iters, lb=lb)
+            else:
+                kernel = functools.partial(search_spmd, cfg=self.cfg,
+                                           iters=iters, lb=lb)
             sm = jax.shard_map(
-                functools.partial(search_spmd, cfg=self.cfg, iters=iters),
+                kernel,
                 mesh=self.dsm.mesh,
-                in_specs=(spec, spec, spec, spec, rep, spec),
+                in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec, spec, spec),
                 check_vma=False)
             fn = jax.jit(sm, donate_argnums=(1,))
-            self._search_cache[iters] = fn
+            self._search_cache[key] = fn
         return fn
 
-    def _get_insert(self, iters: int):
-        fn = self._insert_cache.get(iters)
+    def _get_insert(self, iters: int, with_router: bool):
+        lb = self.router.lb if with_router else None
+        key = (iters, lb)
+        fn = self._insert_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
+            in_specs = [spec, spec, spec, spec, spec, spec, spec, rep, spec]
+            if with_router:
+                in_specs.append(rep)
             sm = jax.shard_map(
                 functools.partial(insert_step_spmd, cfg=self.cfg,
-                                  iters=iters),
+                                  iters=iters, lb=lb),
                 mesh=self.dsm.mesh,
-                in_specs=(spec, spec, spec, spec, spec, spec, spec, rep,
-                          spec),
+                in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec),
                 check_vma=False)
             fn = jax.jit(sm, donate_argnums=(0, 2))
-            self._insert_cache[iters] = fn
+            self._insert_cache[key] = fn
         return fn
 
     # -- helpers -------------------------------------------------------------
@@ -360,7 +529,7 @@ class BatchedEngine:
 
     # -- public ops ----------------------------------------------------------
 
-    def search(self, keys) -> tuple[np.ndarray, np.ndarray]:
+    def search(self, keys, _depth: int = 0) -> tuple[np.ndarray, np.ndarray]:
         """Batched lookup.  keys: uint64 array [n] (n <= N*B per call is
         chunked automatically).  Returns (values uint64 [n], found bool [n]).
         """
@@ -378,20 +547,26 @@ class BatchedEngine:
         khi, klo = bits.keys_to_pairs(keys)
         (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
         active, _ = self._pad(np.ones(n, bool))
-        fn = self._get_search(self._iters())
-        self.dsm.counters, done, found, vhi, vlo = fn(
-            self.dsm.pool, self.dsm.counters,
-            self._shard(khi), self._shard(klo),
-            np.int32(self.tree._root_addr), self._shard(active))
+        # retries (depth > 0) bypass the index cache and descend from root
+        use_router = self.router is not None and _depth == 0
+        fn = self._get_search(self._iters(), use_router)
+        args = [self.dsm.pool, self.dsm.counters,
+                self._shard(khi), self._shard(klo),
+                np.int32(self.tree._root_addr), self._shard(active)]
+        if use_router:
+            args.append(self.router.table)
+        self.dsm.counters, done, found, vhi, vlo = fn(*args)
         done = np.asarray(done)[:n]
         if not done.all():
-            # height grew / capacity overflow: refresh root, retry stragglers
+            assert _depth < 8, "search stragglers not converging"
+            # stale cache / height growth / capacity overflow: refresh root,
+            # full descent for the stragglers
             self.tree._refresh_root()
             vals = np.array(bits.pairs_to_keys(
                 np.asarray(vhi)[:n], np.asarray(vlo)[:n]))
             fnd = np.array(found[:n])
             miss = ~done
-            v2, f2 = self.search(keys[miss])
+            v2, f2 = self.search(keys[miss], _depth=_depth + 1)
             vals[miss], fnd[miss] = v2, f2
             return vals, fnd
         return (bits.pairs_to_keys(np.asarray(vhi)[:n], np.asarray(vlo)[:n]),
@@ -419,7 +594,7 @@ class BatchedEngine:
     def _insert_chunk(self, keys, values, max_rounds, stats):
         n = keys.shape[0]
         pending = np.ones(n, bool)
-        for _ in range(max_rounds):
+        for round_i in range(max_rounds):
             if not pending.any():
                 return
             stats["rounds"] += 1
@@ -429,12 +604,15 @@ class BatchedEngine:
             (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
             (vhi, _), (vlo, _) = self._pad(vhi), self._pad(vlo)
             active, _ = self._pad(np.ones(idx.shape[0], bool))
-            fn = self._get_insert(self._iters())
-            self.dsm.pool, self.dsm.counters, status = fn(
-                self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                self._shard(khi), self._shard(klo),
-                self._shard(vhi), self._shard(vlo),
-                np.int32(self.tree._root_addr), self._shard(active))
+            use_router = self.router is not None and round_i == 0
+            fn = self._get_insert(self._iters(), use_router)
+            args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
+                    self._shard(khi), self._shard(klo),
+                    self._shard(vhi), self._shard(vlo),
+                    np.int32(self.tree._root_addr), self._shard(active)]
+            if use_router:
+                args.append(self.router.table)
+            self.dsm.pool, self.dsm.counters, status = fn(*args)
             status = np.asarray(status)[:idx.shape[0]]
 
             stats["applied"] += int((status == ST_APPLIED).sum())
@@ -460,6 +638,10 @@ class BatchedEngine:
 # ---------------------------------------------------------------------------
 # Bulk load: bottom-up tree construction (benchmark warmup path).
 # ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _install_pages(pool, rows, pages):
+    return pool.at[rows].set(pages)
 
 def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     """Build the tree bottom-up from unique sorted keys and install it.
@@ -491,8 +673,7 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
 
     # --- leaf level ---------------------------------------------------------
     alloc = tree.ctx.alloc
-    leaf_addrs = np.array([alloc.alloc() for _ in range(n_leaves)],
-                          dtype=np.int64)
+    leaf_addrs = alloc.alloc_many(n_leaves)
     pages = np.zeros((n_leaves, _PW), np.int32)
     pages[:, C.W_FRONT_VER] = 1
     pages[:, C.W_REAR_VER] = 1
@@ -502,13 +683,12 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     slot_of = np.arange(n) % per_leaf
     khi, klo = bits.keys_to_pairs(keys)
     vhi, vlo = bits.keys_to_pairs(values)
-    base = C.W_ENTRIES + slot_of * C.LEAF_ENTRY_WORDS
-    pages[leaf_of, base + C.LE_FVER] = 1
-    pages[leaf_of, base + C.LE_KEY_HI] = khi
-    pages[leaf_of, base + C.LE_KEY_LO] = klo
-    pages[leaf_of, base + C.LE_VAL_HI] = vhi
-    pages[leaf_of, base + C.LE_VAL_LO] = vlo
-    pages[leaf_of, base + C.LE_RVER] = 1
+    pages[leaf_of, C.L_FVER_W + slot_of] = 1
+    pages[leaf_of, C.L_KHI_W + slot_of] = khi
+    pages[leaf_of, C.L_KLO_W + slot_of] = klo
+    pages[leaf_of, C.L_VHI_W + slot_of] = vhi
+    pages[leaf_of, C.L_VLO_W + slot_of] = vlo
+    pages[leaf_of, C.L_RVER_W + slot_of] = 1
 
     # fences: lowest = first key of leaf (leaf 0: -inf); highest = next
     # leaf's first key (last: +inf); sibling links left->right
@@ -538,8 +718,7 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
         fan = C.INTERNAL_CAP  # children per internal page (incl leftmost)
         m = len(child_addrs)
         n_pages = -(-m // fan)
-        addrs = np.array([alloc.alloc() for _ in range(n_pages)],
-                         dtype=np.int64)
+        addrs = alloc.alloc_many(n_pages)
         ipages = np.zeros((n_pages, _PW), np.int32)
         ipages[:, C.W_FRONT_VER] = 1
         ipages[:, C.W_REAR_VER] = 1
@@ -554,11 +733,11 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
             child_addrs[is_first].astype(np.int32)
         ent = pos - 1
         ei = ~is_first
-        ebase = C.W_ENTRIES + ent[ei] * C.INTERNAL_ENTRY_WORDS
+        eslot = ent[ei]
         ckhi, cklo = bits.keys_to_pairs(child_lows[ei])
-        ipages[pg_of[ei], ebase] = ckhi
-        ipages[pg_of[ei], ebase + 1] = cklo
-        ipages[pg_of[ei], ebase + 2] = child_addrs[ei].astype(np.int32)
+        ipages[pg_of[ei], C.I_KHI_W + eslot] = ckhi
+        ipages[pg_of[ei], C.I_KLO_W + eslot] = cklo
+        ipages[pg_of[ei], C.I_PTR_W + eslot] = child_addrs[ei].astype(np.int32)
         counts = np.bincount(pg_of, minlength=n_pages) - 1
         ipages[:, C.W_NKEYS] = counts.astype(np.int32)
 
@@ -584,16 +763,15 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     root_addr = int(child_addrs[0])
     root_level = level
 
-    # --- install: one scatter into the pool via host write batches ---------
-    N, P = cfg.machine_nr, cfg.pages_per_node
-    pool_np = np.asarray(tree.dsm.pool).copy()
+    # --- install: one device-side scatter (no pool round-trip) -------------
+    P = cfg.pages_per_node
     flat_addrs = np.concatenate(all_addrs)
     flat_pages = np.concatenate(all_pages, axis=0)
     nodes = (flat_addrs.astype(np.uint64) & 0xFFFFFFFF) >> C.ADDR_PAGE_BITS
     pgs = flat_addrs.astype(np.uint64) & C.ADDR_PAGE_MASK
-    rows = (nodes * np.uint64(P) + pgs).astype(np.int64)
-    pool_np[rows] = flat_pages
-    tree.dsm.pool = jax.device_put(jnp.asarray(pool_np), tree.dsm.shard)
+    rows = (nodes * np.uint64(P) + pgs).astype(np.int32)
+    tree.dsm.pool = _install_pages(tree.dsm.pool, jnp.asarray(rows),
+                                   jnp.asarray(flat_pages))
 
     # Install root (bulk load is cluster-quiescent) and POISON the old root:
     # clients holding a stale root handle recover through the B-link chase
@@ -613,4 +791,9 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     tree.cluster.broadcast_new_root(root_addr, root_level)
     tree._root_addr, tree._root_level = root_addr, root_level
     stats["root_level"] = root_level
+
+    # leaf directory for index-cache seeding (router.seed_from_leaves)
+    tree._bulk_leaf_dir = (leaf_addrs.copy(), lows.copy())
+    if tree.router is not None:
+        tree.router.seed_from_leaves(leaf_addrs, lows)
     return stats

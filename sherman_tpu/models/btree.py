@@ -41,6 +41,10 @@ LOCK_SPIN_LIMIT = 1_000_000  # deadlock reporter threshold (Tree.cpp:219-227)
 
 
 class Tree:
+    # device index cache handle (models/router.py); attached by the
+    # batched engine, notified on leaf splits
+    router = None
+
     def __init__(self, cluster: Cluster, ctx: ClientContext | None = None):
         self.cluster = cluster
         self.dsm = cluster.dsm
@@ -168,11 +172,16 @@ class Tree:
             if slot < 0:
                 self._unlock(la)
                 return False
-            base = layout.leaf_entry_base(slot)
+            # clear the slot's version words: fver==rver==0 marks it free
+            # (SoA layout: the six fields live in separate blocks, but only
+            # the version pair decides liveness)
+            wf, _, _, _, _, wr = layout.leaf_slot_words(slot)
+            zero = np.zeros(1, np.int32)
             self.dsm.write_rows([
-                {"op": D.OP_WRITE, "addr": addr, "woff": base,
-                 "nw": C.LEAF_ENTRY_WORDS,
-                 "payload": np.zeros(C.LEAF_ENTRY_WORDS, np.int32)},
+                {"op": D.OP_WRITE, "addr": addr, "woff": wf, "nw": 1,
+                 "payload": zero},
+                {"op": D.OP_WRITE, "addr": addr, "woff": wr, "nw": 1,
+                 "payload": zero},
                 self._unlock_row(la),
             ])
             return True
@@ -210,18 +219,18 @@ class Tree:
         if slot >= 0:
             # in-place update / free-slot insert: write ONE entry + unlock
             # in one step (single-entry write-back, Tree.cpp:914-921).
-            base = layout.leaf_entry_base(slot)
-            ver = (int(pg[base + C.LE_FVER]) + 1) & 0x7FFFFFFF or 1
-            ent = np.zeros(C.LEAF_ENTRY_WORDS, np.int32)
-            ent[C.LE_FVER] = ver
-            ent[C.LE_KEY_HI], ent[C.LE_KEY_LO] = bits.key_to_pair(key)
-            ent[C.LE_VAL_HI], ent[C.LE_VAL_LO] = bits.key_to_pair(value)
-            ent[C.LE_RVER] = ver
-            self.dsm.write_rows([
-                {"op": D.OP_WRITE, "addr": addr, "woff": base,
-                 "nw": C.LEAF_ENTRY_WORDS, "payload": ent},
-                self._unlock_row(la),
-            ])
+            words = layout.leaf_slot_words(slot)
+            ver = (int(pg[words[0]]) + 1) & 0x7FFFFFFF or 1
+            khi_, klo_ = bits.key_to_pair(key)
+            vhi_, vlo_ = bits.key_to_pair(value)
+            vals = (ver, khi_, klo_, vhi_, vlo_, ver)
+            rows = [
+                {"op": D.OP_WRITE, "addr": addr, "woff": w, "nw": 1,
+                 "payload": np.array([v], np.int32)}
+                for w, v in zip(words, vals)
+            ]
+            rows.append(self._unlock_row(la))
+            self.dsm.write_rows(rows)
             return True
 
         # Leaf full: split (Tree.cpp:922-963).
@@ -252,6 +261,8 @@ class Tree:
              "nw": C.PAGE_WORDS, "payload": left},
             self._unlock_row(la),
         ])
+        if self.router is not None:
+            self.router.note_split(split_key, sib_addr, old_high)
         self._insert_parent(split_key, sib_addr, 1, path, child_left=addr)
         return True
 

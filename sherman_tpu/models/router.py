@@ -1,0 +1,97 @@
+"""LeafRouter — the device-resident index cache.
+
+The reference's IndexCache (``IndexCache.h:102-259``) keeps level-1 internal
+pages on the compute node so a cache hit jumps straight to the leaf address,
+skipping every internal level (``Tree.cpp:415-427``).  The TPU-native
+equivalent is a *replicated device array*: ``table[bucket] -> page addr``,
+where buckets partition the uint64 key space by its top bits.  A lookup
+seeds the batched descent at ``table[key >> shift]`` — one word gather —
+and normally needs a single leaf-page read.
+
+Correctness never depends on the table: a stale entry still points to a
+page whose ``lowest`` fence is <= every key of the bucket (fences only ever
+shrink from the right on splits, and pages are never freed), so the B-link
+sibling chase (``Tree.cpp:626-629``) self-heals, exactly like the
+reference's stale-cache re-descend (``Tree.cpp:430-443``).  Maintenance:
+
+- ``seed_from_leaves`` — vectorized rebuild from a bulk load's leaf
+  directory (addrs + lowest fences).
+- ``note_split``    — on a leaf split, point every bucket whose start lies
+  in [split_key, old_high) at the new right sibling (the invalidate +
+  re-fill of ``IndexCache.h:209-225``, minus the epoch delay-free: entries
+  are values in an immutable functional array, so there is nothing to
+  race with).
+- ``reset``         — point everything back at the root (cold cache).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sherman_tpu import config as C
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=())
+def _range_set(table, lo, hi, value):
+    i = jnp.arange(table.shape[0], dtype=jnp.int32)
+    return jnp.where((i >= lo) & (i < hi), value, table)
+
+
+class LeafRouter:
+    def __init__(self, tree, log2_buckets: int):
+        assert 1 <= log2_buckets <= 32
+        self.tree = tree
+        self.lb = log2_buckets
+        self.nb = 1 << log2_buckets
+        self.shift = 64 - log2_buckets
+        self.table = jnp.full(self.nb, jnp.int32(tree._root_addr))
+        self.splits_noted = 0
+        tree.router = self
+
+    # -- maintenance ---------------------------------------------------------
+
+    def reset(self) -> None:
+        self.tree._refresh_root()
+        self.table = jnp.full(self.nb, jnp.int32(self.tree._root_addr))
+
+    def seed_from_leaves(self, leaf_addrs: np.ndarray,
+                         leaf_lows: np.ndarray) -> None:
+        """Vectorized rebuild: leaf_lows must be sorted ascending with
+        leaf_lows[0] == KEY_NEG_INF (a bulk load's leaf directory)."""
+        starts = (np.arange(self.nb, dtype=np.uint64)
+                  << np.uint64(self.shift))
+        idx = np.searchsorted(leaf_lows, starts, side="right") - 1
+        self.table = jnp.asarray(
+            leaf_addrs[np.clip(idx, 0, len(leaf_addrs) - 1)].astype(np.int32))
+
+    def note_split(self, split_key: int, new_addr: int,
+                   old_high: int) -> None:
+        """Leaf [.., old_high) split at split_key; right half -> new_addr."""
+        b_lo = (split_key + (1 << self.shift) - 1) >> self.shift
+        if old_high >= C.KEY_POS_INF:
+            b_hi = self.nb
+        else:
+            b_hi = min(self.nb,
+                       (old_high + (1 << self.shift) - 1) >> self.shift)
+        if b_lo < b_hi:
+            self.table = _range_set(self.table, jnp.int32(b_lo),
+                                    jnp.int32(b_hi), jnp.int32(new_addr))
+        self.splits_noted += 1
+
+    # -- device-side lookup (inside the search/insert step) ------------------
+
+    def bucket_of(self, khi):
+        """Bucket index from the key's high word (shift >= 32 always)."""
+        uhi = jnp.asarray(khi, jnp.int32).astype(jnp.uint32)
+        s = self.shift - 32
+        return jnp.right_shift(uhi, jnp.uint32(s)).astype(jnp.int32)
+
+
+def default_log2_buckets(n_leaves: int) -> int:
+    """~4 buckets per leaf, capped to keep the replicated table small."""
+    lb = max(8, int(np.ceil(np.log2(max(1, n_leaves) * 4))))
+    return min(lb, 24)

@@ -1,0 +1,94 @@
+"""Pallas page-gather kernel: the hot op of the DSM read path.
+
+Fetching a batch of 1 KB pages at data-dependent addresses is the innermost
+loop of every tree operation (one gather per level per step — the analogue
+of the NIC servicing ``rdmaRead`` requests, ``Operation.cpp:170``).  XLA
+lowers a generic row gather poorly on TPU (serialized dynamic-slices), so
+this kernel does what the NIC does: stream row DMAs HBM -> VMEM with many
+copies in flight, scalar-prefetching the page indices so DMA targets are
+known before the body runs.
+
+Grid: one program per block of rows; each program pipelines its rows with
+``N_INFLIGHT`` outstanding DMAs (double-buffering generalized).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sherman_tpu.config import PAGE_WORDS
+
+try:  # pallas is TPU-only at runtime; import lazily-tolerant
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+BLOCK = 256       # rows per grid program
+N_INFLIGHT = 16   # outstanding row DMAs per program
+
+
+def _gather_kernel(idx_ref, pool_ref, out_ref, sems):
+    i = pl.program_id(0)
+    base = i * BLOCK
+
+    def row_dma(j, slot):
+        return pltpu.make_async_copy(
+            pool_ref.at[idx_ref[base + j]],
+            out_ref.at[j],
+            sems.at[slot],
+        )
+
+    # warm-up: fill the pipeline
+    for j in range(N_INFLIGHT):
+        row_dma(j, j).start()
+
+    def body(j, _):
+        row_dma(j, j % N_INFLIGHT).wait()
+
+        @pl.when(j + N_INFLIGHT < BLOCK)
+        def _():
+            row_dma(j + N_INFLIGHT, (j + N_INFLIGHT) % N_INFLIGHT).start()
+
+        return 0
+
+    lax.fori_loop(0, BLOCK, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gather_tpu(pool, idx, interpret: bool = False):
+    B = idx.shape[0]
+    assert B % BLOCK == 0
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B // BLOCK,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((BLOCK, PAGE_WORDS), lambda i, *_: (i, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((N_INFLIGHT,))],
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        out_shape=jax.ShapeDtypeStruct((B, PAGE_WORDS), pool.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(idx, pool)
+
+
+def gather_pages(pool, idx):
+    """pool [P, PAGE_WORDS], idx [B] int32 (must be pre-clipped to [0, P)).
+
+    Returns pool[idx] — Pallas row-DMA kernel on TPU, jnp gather elsewhere.
+    """
+    if not (_HAVE_PALLAS and jax.default_backend() == "tpu"):
+        return pool[idx]
+    B = idx.shape[0]
+    pad = (-B) % BLOCK
+    if pad:
+        idx = jnp.pad(idx, (0, pad))
+    out = _gather_tpu(pool, idx)
+    return out[:B] if pad else out

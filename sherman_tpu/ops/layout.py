@@ -60,25 +60,20 @@ def page_consistent(page):
 
 
 # -- internal pages -----------------------------------------------------------
-
-def internal_entry_words(slot):
-    """Word offset of internal entry `slot` (static int or array)."""
-    return C.W_ENTRIES + slot * C.INTERNAL_ENTRY_WORDS
-
+# SoA field blocks: every accessor is a static contiguous slice (fast on
+# the VPU); see the layout rationale in config.py.
 
 _I_SLOTS = np.arange(C.INTERNAL_CAP)
-_I_KHI = C.W_ENTRIES + _I_SLOTS * C.INTERNAL_ENTRY_WORDS
-_I_KLO = _I_KHI + 1
-_I_PTR = _I_KHI + 2
 
 
 def internal_keys(page):
     """-> (khi, klo) arrays of shape [..., INTERNAL_CAP]."""
-    return page[..., _I_KHI], page[..., _I_KLO]
+    return (page[..., C.I_KHI_W:C.I_KHI_W + C.INTERNAL_CAP],
+            page[..., C.I_KLO_W:C.I_KLO_W + C.INTERNAL_CAP])
 
 
 def internal_ptrs(page):
-    return page[..., _I_PTR]
+    return page[..., C.I_PTR_W:C.I_PTR_W + C.INTERNAL_CAP]
 
 
 def internal_pick_child(page, khi, klo):
@@ -87,65 +82,68 @@ def internal_pick_child(page, khi, klo):
     Sorted entries e_0..e_{n-1}; keys < e_0.key go to leftmost_ptr; else the
     child of the last entry with entry.key <= k.  Returns packed child addr.
     ``khi/klo`` broadcast against page batch dims.
+
+    Implementation note: no ``take_along_axis`` — per-row dynamic indexing
+    lowers terribly on the TPU VPU (no per-lane gather).  The last
+    entry.key <= k slot is a prefix-mask boundary, so a one-hot masked sum
+    extracts the child pointer in pure elementwise + reduce ops.
     """
     ekhi, eklo = internal_keys(page)
     n = h_nkeys(page)[..., None]
     valid = _I_SLOTS < n
     le = bits.key_le(ekhi, eklo, khi[..., None], klo[..., None]) & valid
-    # index of last entry with key <= k; -1 -> leftmost
-    idx = jnp.sum(le.astype(jnp.int32), axis=-1) - 1
+    # boundary one-hot: the last slot with key <= k (le is a prefix mask
+    # over the sorted valid entries)
+    le_next = jnp.concatenate(
+        [le[..., 1:], jnp.zeros_like(le[..., :1])], axis=-1)
+    edge = le & ~le_next
     ptrs = internal_ptrs(page)
-    child = jnp.take_along_axis(ptrs, jnp.maximum(idx, 0)[..., None], axis=-1)[..., 0]
-    return jnp.where(idx < 0, h_leftmost(page), child)
+    child = jnp.sum(jnp.where(edge, ptrs, 0), axis=-1)
+    any_le = jnp.any(le, axis=-1)
+    return jnp.where(any_le, child, h_leftmost(page))
 
 
 # -- leaf pages ---------------------------------------------------------------
 
 _L_SLOTS = np.arange(C.LEAF_CAP)
-_L_BASE = C.W_ENTRIES + _L_SLOTS * C.LEAF_ENTRY_WORDS
-_L_FVER = _L_BASE + C.LE_FVER
-_L_KHI = _L_BASE + C.LE_KEY_HI
-_L_KLO = _L_BASE + C.LE_KEY_LO
-_L_VHI = _L_BASE + C.LE_VAL_HI
-_L_VLO = _L_BASE + C.LE_VAL_LO
-_L_RVER = _L_BASE + C.LE_RVER
 
 
-def leaf_entry_base(slot):
-    return C.W_ENTRIES + slot * C.LEAF_ENTRY_WORDS
+def _lf(page, start):
+    return page[..., start:start + C.LEAF_CAP]
 
 
 def leaf_slots_view(page):
     """-> dict of [..., LEAF_CAP] arrays: fver, khi, klo, vhi, vlo, rver."""
     return {
-        "fver": page[..., _L_FVER],
-        "khi": page[..., _L_KHI],
-        "klo": page[..., _L_KLO],
-        "vhi": page[..., _L_VHI],
-        "vlo": page[..., _L_VLO],
-        "rver": page[..., _L_RVER],
+        "fver": _lf(page, C.L_FVER_W),
+        "khi": _lf(page, C.L_KHI_W),
+        "klo": _lf(page, C.L_KLO_W),
+        "vhi": _lf(page, C.L_VHI_W),
+        "vlo": _lf(page, C.L_VLO_W),
+        "rver": _lf(page, C.L_RVER_W),
     }
 
 
 def leaf_slot_used(page):
     """A slot is live iff fver == rver != 0 (two-level version rule)."""
-    fv, rv = page[..., _L_FVER], page[..., _L_RVER]
+    fv, rv = _lf(page, C.L_FVER_W), _lf(page, C.L_RVER_W)
     return (fv == rv) & (fv != 0)
 
 
 def leaf_find_key(page, khi, klo):
     """Vectorized ``leaf_page_search`` (Tree.cpp:687-697): scan all slots.
 
-    Returns (found, vhi, vlo, slot).  slot = -1 when absent.
+    Returns (found, vhi, vlo, slot).  slot = -1 when absent.  Live keys are
+    unique per leaf, so ``hit`` is one-hot and masked sums extract the value
+    without per-row dynamic indexing (slow on TPU).
     """
     used = leaf_slot_used(page)
-    ekhi, eklo = page[..., _L_KHI], page[..., _L_KLO]
+    ekhi, eklo = _lf(page, C.L_KHI_W), _lf(page, C.L_KLO_W)
     hit = used & bits.key_eq(ekhi, eklo, khi[..., None], klo[..., None])
-    slot = jnp.argmax(hit, axis=-1)
     found = jnp.any(hit, axis=-1)
-    take = lambda a: jnp.take_along_axis(a, slot[..., None], axis=-1)[..., 0]
-    vhi = jnp.where(found, take(page[..., _L_VHI]), 0)
-    vlo = jnp.where(found, take(page[..., _L_VLO]), 0)
+    vhi = jnp.sum(jnp.where(hit, _lf(page, C.L_VHI_W), 0), axis=-1)
+    vlo = jnp.sum(jnp.where(hit, _lf(page, C.L_VLO_W), 0), axis=-1)
+    slot = jnp.sum(jnp.where(hit, _L_SLOTS, 0), axis=-1)
     return found, vhi, vlo, jnp.where(found, slot, -1)
 
 
@@ -188,32 +186,37 @@ def np_empty_page(level: int, lowest: int, highest: int,
     return pg
 
 
+def leaf_slot_words(slot):
+    """Word offsets of one leaf slot's six fields (SoA blocks):
+    (fver, khi, klo, vhi, vlo, rver)."""
+    return (C.L_FVER_W + slot, C.L_KHI_W + slot, C.L_KLO_W + slot,
+            C.L_VHI_W + slot, C.L_VLO_W + slot, C.L_RVER_W + slot)
+
+
 def np_leaf_set_entry(pg: np.ndarray, slot: int, key: int, value: int,
                       ver: int = 1) -> None:
-    base = leaf_entry_base(slot)
-    pg[base + C.LE_FVER] = ver
-    pg[base + C.LE_KEY_HI], pg[base + C.LE_KEY_LO] = bits.key_to_pair(key)
-    pg[base + C.LE_VAL_HI], pg[base + C.LE_VAL_LO] = bits.key_to_pair(value)
-    pg[base + C.LE_RVER] = ver
+    wf, wkh, wkl, wvh, wvl, wr = leaf_slot_words(slot)
+    pg[wf] = ver
+    pg[wkh], pg[wkl] = bits.key_to_pair(key)
+    pg[wvh], pg[wvl] = bits.key_to_pair(value)
+    pg[wr] = ver
 
 
 def np_leaf_clear_entry(pg: np.ndarray, slot: int) -> None:
-    base = leaf_entry_base(slot)
-    pg[base:base + C.LEAF_ENTRY_WORDS] = 0
+    for w in leaf_slot_words(slot):
+        pg[w] = 0
 
 
 def np_internal_set_entry(pg: np.ndarray, slot: int, key: int, child: int) -> None:
-    base = internal_entry_words(slot)
-    pg[base], pg[base + 1] = bits.key_to_pair(key)
-    pg[base + 2] = child
+    pg[C.I_KHI_W + slot], pg[C.I_KLO_W + slot] = bits.key_to_pair(key)
+    pg[C.I_PTR_W + slot] = child
 
 
 def np_slot_live(pg: np.ndarray, slot: int) -> bool:
     """Host-side two-level version liveness rule: fver == rver != 0.
     (Single source of truth for host code; `leaf_slot_used` is the
     vectorized device twin.)"""
-    base = leaf_entry_base(slot)
-    fv, rv = pg[base + C.LE_FVER], pg[base + C.LE_RVER]
+    fv, rv = pg[C.L_FVER_W + slot], pg[C.L_RVER_W + slot]
     return bool(fv == rv and fv != 0)
 
 
@@ -222,9 +225,8 @@ def np_leaf_entries(pg: np.ndarray) -> list[tuple[int, int, int]]:
     out = []
     for s in range(C.LEAF_CAP):
         if np_slot_live(pg, s):
-            base = leaf_entry_base(s)
-            k = bits.pair_to_key(pg[base + C.LE_KEY_HI], pg[base + C.LE_KEY_LO])
-            v = bits.pair_to_key(pg[base + C.LE_VAL_HI], pg[base + C.LE_VAL_LO])
+            k = bits.pair_to_key(pg[C.L_KHI_W + s], pg[C.L_KLO_W + s])
+            v = bits.pair_to_key(pg[C.L_VHI_W + s], pg[C.L_VLO_W + s])
             out.append((k, v, s))
     return out
 
@@ -232,9 +234,8 @@ def np_leaf_entries(pg: np.ndarray) -> list[tuple[int, int, int]]:
 def np_internal_entries(pg: np.ndarray) -> list[tuple[int, int]]:
     out = []
     for s in range(int(pg[C.W_NKEYS])):
-        base = internal_entry_words(s)
-        k = bits.pair_to_key(pg[base], pg[base + 1])
-        out.append((k, int(pg[base + 2])))
+        k = bits.pair_to_key(pg[C.I_KHI_W + s], pg[C.I_KLO_W + s])
+        out.append((k, int(pg[C.I_PTR_W + s])))
     return out
 
 

@@ -21,7 +21,9 @@ meta words; addr 0 doubles as NULL).
 
 from __future__ import annotations
 
-from sherman_tpu.config import DSMConfig
+import numpy as np
+
+from sherman_tpu.config import ADDR_PAGE_BITS, DSMConfig
 from sherman_tpu.ops import bits
 
 RESERVED_PAGES = 1
@@ -102,6 +104,31 @@ class LocalAllocator:
             end = nxt + chunk_pages
         self._cur[node] = (nxt + npages, end)
         return bits.make_addr(node, nxt)
+
+    def alloc_many(self, count: int) -> np.ndarray:
+        """Vectorized allocation of ``count`` single pages (bulk-load path).
+
+        Leases whole chunks round-robin across nodes and fills them; any
+        partial last chunk stays leased for future alloc() calls.  Returns
+        an int64 array of packed addresses.
+        """
+        out = np.empty(count, np.int64)
+        filled = 0
+        while filled < count:
+            node = self._rr % len(self._dirs)
+            self._rr += 1
+            nxt, end = self._cur.pop(node, (0, 0))
+            if nxt >= end:
+                base_addr, chunk_pages = self._dirs[node].malloc_chunk()
+                nxt = bits.addr_page(base_addr)
+                end = nxt + chunk_pages
+            take = min(end - nxt, count - filled)
+            out[filled:filled + take] = (
+                (node << ADDR_PAGE_BITS) | np.arange(nxt, nxt + take))
+            filled += take
+            if nxt + take < end:
+                self._cur[node] = (nxt + take, end)
+        return out
 
     def free(self, addr: int, npages: int = 1) -> None:
         """No-op, like the reference (``DSM.h:226``, LocalAllocator.h:45-47).

@@ -241,6 +241,12 @@ def read_pages_spmd(pool, addrs, *, cfg: DSMConfig, axis_name: str = AXIS,
     P = pool.shape[0]
     if active is None:
         active = jnp.ones(addrs.shape, bool)
+    if N == 1:
+        # Single-node fast path: no routing, direct local gather.
+        page = bits.addr_page(addrs)
+        ok = active & (page >= 0) & (page < P)
+        pages = pool[jnp.clip(page, 0, P - 1)]
+        return jnp.where(ok[:, None], pages, 0), ok
     dest = bits.addr_node(addrs)
     bucket_idx, routed = transport.bucketize(dest, active, N, C)
     out = transport.scatter_to_buckets(bits.addr_page(addrs), bucket_idx, N * C)
@@ -291,14 +297,20 @@ class DSM:
         in_specs = (spec, spec, spec,
                     {k: spec for k in (*REQ_FIELDS, "payload")})
         out_specs = (spec, spec, spec, {k: spec for k in ("data", "old", "ok")})
+        # The host control-plane step uses its own small routing capacity —
+        # see DSMConfig.host_step_capacity.
+        import dataclasses as _dc
+        self._host_cfg = _dc.replace(
+            cfg, step_capacity=min(cfg.step_capacity,
+                                   cfg.host_step_capacity))
         step = jax.shard_map(
-            functools.partial(dsm_step_spmd, cfg=cfg),
+            functools.partial(dsm_step_spmd, cfg=self._host_cfg),
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False)
         self._step = jax.jit(step, donate_argnums=(0, 1, 2))
         # Per-step request slots available to the *host* API; device kernels
         # compose dsm_step_spmd directly and have their own batches.
-        self.host_slots = N * cfg.step_capacity
+        self.host_slots = N * self._host_cfg.step_capacity
 
     # -- raw step ------------------------------------------------------------
 
@@ -316,16 +328,19 @@ class DSM:
     # source nodes round-robin so per-(src,dst) capacity is not the limit.
 
     def _batch(self, rows: list[dict]) -> Replies:
-        n = self.cfg.machine_nr * self.cfg.step_capacity
-        if len(rows) > n:
-            # split oversized host batches into multiple steps
-            out = [self._batch(rows[i:i + n]) for i in range(0, len(rows), n)]
+        # Cap one host step at host_step_capacity TOTAL rows so that no
+        # destination bucket can overflow regardless of the rows' targets.
+        cap = self._host_cfg.step_capacity
+        n = self.cfg.machine_nr * cap
+        if len(rows) > cap:
+            out = [self._batch(rows[i:i + cap])
+                   for i in range(0, len(rows), cap)]
             return Replies(
                 data=np.concatenate([r.data for r in out]),
                 old=np.concatenate([r.old for r in out]),
                 ok=np.concatenate([r.ok for r in out]))
         reqs = empty_requests(n)
-        R = self.cfg.step_capacity
+        R = cap
         slots = []
         # round-robin rows over source nodes: slot = src*R + idx_within_src
         per_src = [0] * self.cfg.machine_nr

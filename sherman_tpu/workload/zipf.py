@@ -1,0 +1,63 @@
+"""Zipfian workload generator — YCSB-style skewed key sampling.
+
+Plays the role of the reference's MICA-derived sampler (``test/zipf.h``,
+``mehcached_zipf_init/next``): ranks follow a Zipf(theta) distribution over
+[0, n).  Implemented from the standard Gray et al. formulation ("Quickly
+Generating Billion-Record Synthetic Databases", SIGMOD '94) with fully
+vectorized numpy sampling — one call yields millions of samples, matching
+the batched execution model (no per-op scalar next() on the hot path,
+though one is provided for parity).
+
+theta = 0.99 reproduces the canonical YCSB skew (BASELINE.md configs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _zeta(n: int, theta: float, chunk: int = 1 << 22) -> float:
+    """zeta(n, theta) = sum_{i=1..n} 1/i^theta, chunked to bound memory."""
+    total = 0.0
+    i = 1
+    while i <= n:
+        j = min(n, i + chunk - 1)
+        ks = np.arange(i, j + 1, dtype=np.float64)
+        total += float(np.sum(ks ** -theta))
+        i = j + 1
+    return total
+
+
+class ZipfGen:
+    """Zipf(theta) rank sampler over [0, n)."""
+
+    def __init__(self, n: int, theta: float = 0.99, seed: int = 0):
+        assert n >= 1 and 0.0 <= theta < 1.0
+        self.n = n
+        self.theta = theta
+        self.rng = np.random.default_rng(seed)
+        self.zetan = _zeta(n, theta)
+        self.zeta2 = _zeta(2, theta)
+        self.alpha = 1.0 / (1.0 - theta)
+        self.eta = ((1.0 - (2.0 / n) ** (1.0 - theta))
+                    / (1.0 - self.zeta2 / self.zetan))
+
+    def sample(self, size: int) -> np.ndarray:
+        """-> int64 ranks [size] in [0, n); rank 0 is the hottest."""
+        u = self.rng.random(size)
+        uz = u * self.zetan
+        ranks = (self.n * (self.eta * u - self.eta + 1.0) ** self.alpha
+                 ).astype(np.int64)
+        ranks = np.where(uz < 1.0, 0, ranks)
+        ranks = np.where((uz >= 1.0) & (uz < 1.0 + 0.5 ** self.theta),
+                         1, ranks)
+        return np.clip(ranks, 0, self.n - 1)
+
+    def next(self) -> int:
+        """Scalar parity API (mehcached_zipf_next)."""
+        return int(self.sample(1)[0])
+
+
+def uniform_ranks(n: int, size: int, rng: np.random.Generator) -> np.ndarray:
+    """theta=0 degenerate case: uniform over [0, n)."""
+    return rng.integers(0, n, size, dtype=np.int64)
