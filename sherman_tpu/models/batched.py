@@ -51,6 +51,7 @@ ST_FULL = 3         # leaf full -> host split path
 ST_LOCKED = 4       # page lock held (host split in flight) -> retry
 ST_RETRY = 5        # routing overflow / descent incomplete -> retry
 ST_BAD = 6          # failed sanity checks (not a level-0 page / fence)
+ST_NOT_FOUND = 7    # delete: key absent (final)
 
 _PW = C.PAGE_WORDS
 
@@ -424,6 +425,109 @@ def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
 
 
 # ---------------------------------------------------------------------------
+# Batched delete: descend + routed owner-side slot clear.
+# ---------------------------------------------------------------------------
+
+def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
+    """Clear routed delete requests on this node's leaf pages.
+
+    Mirrors ``Tree::del``'s leaf step (btree.py delete / reference
+    ``Tree.cpp`` del path): zero the slot's fver/rver pair — the two-level
+    version liveness rule makes the slot free.  Clearing is idempotent, so
+    same-key duplicates need no dedup (they scatter identical zeros).
+    Returns (pool, counters, status [M]).
+    """
+    M = inc["addr"].shape[0]
+    P = pool.shape[0]
+    L = locks.shape[0]
+    act = inc["active"]
+    khi, klo = inc["khi"], inc["klo"]
+    page_idx = bits.addr_page(inc["addr"])
+    safe_page = jnp.clip(page_idx, 0, P - 1)
+    pg = pool[safe_page]
+
+    lock_idx = bits.lock_index(inc["addr"], cfg.locks_per_node)
+    locked = locks[jnp.clip(lock_idx, 0, L - 1)] != 0
+
+    sane = act & (page_idx >= 0) & (page_idx < P) \
+        & (layout.h_level(pg) == 0) & layout.in_fence(pg, khi, klo) \
+        & layout.page_consistent(pg)
+    ok_req = sane & ~locked
+
+    found, _, _, slot = layout.leaf_find_key(pg, khi, klo)
+    applied = ok_req & found
+    safe_slot = jnp.clip(slot, 0, C.LEAF_CAP - 1)
+
+    # zero the version pair (SoA blocks) — slot becomes free
+    flat = pool.reshape(-1)
+    wf = safe_page * _PW + C.L_FVER_W + safe_slot
+    wr = safe_page * _PW + C.L_RVER_W + safe_slot
+    zero = jnp.zeros(M, jnp.int32)
+    flat = flat.at[jnp.where(applied, wf, P * _PW)].set(zero, mode="drop")
+    flat = flat.at[jnp.where(applied, wr, P * _PW)].set(zero, mode="drop")
+
+    # page version bump (front+rear together: step-atomic, stays consistent;
+    # same-page duplicates accumulate identically on both words)
+    bump = applied.astype(jnp.int32)
+    vf = jnp.where(applied, safe_page * _PW + C.W_FRONT_VER, P * _PW)
+    vr = jnp.where(applied, safe_page * _PW + C.W_REAR_VER, P * _PW)
+    flat = flat.at[vf].add(bump, mode="drop")
+    flat = flat.at[vr].add(bump, mode="drop")
+    pool = flat.reshape(P, _PW)
+
+    status = jnp.full(M, ST_INVALID, jnp.int32)
+    status = jnp.where(act, ST_BAD, status)
+    status = jnp.where(act & sane & locked, ST_LOCKED, status)
+    status = jnp.where(ok_req & ~found, ST_NOT_FOUND, status)
+    status = jnp.where(applied, ST_APPLIED, status)
+
+    u32 = lambda m: jnp.sum(m.astype(jnp.uint32))
+    counters = counters.at[D.CNT_WRITE_OPS].add(u32(applied))
+    # 2 slot-version words + the front/rear page-version pair
+    counters = counters.at[D.CNT_WRITE_WORDS].add(u32(applied) * jnp.uint32(4))
+    return pool, counters, status
+
+
+def delete_step_spmd(pool, locks, counters, khi, klo, root, active,
+                     table=None, *, cfg: DSMConfig, iters: int,
+                     lb: int | None = None, axis_name: str = AXIS):
+    """One batched delete step: descend + route to owners + slot clear.
+
+    Returns (pool, counters, status [B]) per this node's key shard.
+    """
+    B = khi.shape[0]
+    N, cap = cfg.machine_nr, cfg.step_capacity
+    start = _router_start(table, khi, lb) if table is not None else None
+    counters, addr, _, done = descend_spmd(
+        pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
+        axis_name=axis_name, start=start)
+
+    if N == 1:
+        inc = {"active": done, "addr": addr, "khi": khi, "klo": klo}
+        pool, counters, st = leaf_delete_apply_spmd(pool, locks, counters,
+                                                    inc, cfg=cfg)
+        status = jnp.where(active, jnp.where(done, st, ST_RETRY), ST_INVALID)
+        return pool, counters, status
+
+    dest = bits.addr_node(addr)
+    bucket_idx, routed = transport.bucketize(dest, done, N, cap)
+    out_fields = {"active": done & routed, "addr": addr,
+                  "khi": khi, "klo": klo}
+    out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
+           for k, v in out_fields.items()}
+    inc = transport.exchange(out, axis_name)
+
+    pool, counters, st = leaf_delete_apply_spmd(pool, locks, counters, inc,
+                                                cfg=cfg)
+
+    rep = transport.exchange({"st": st}, axis_name)
+    safe_b = jnp.where(routed, bucket_idx, 0)
+    status = jnp.where(done & routed, rep["st"][safe_b], ST_RETRY)
+    status = jnp.where(active, status, ST_INVALID)
+    return pool, counters, status
+
+
+# ---------------------------------------------------------------------------
 # Host-facing engine: jit/shard_map wrappers + retry loop.
 # ---------------------------------------------------------------------------
 
@@ -445,6 +549,7 @@ class BatchedEngine:
         self.router = None
         self._search_cache: dict = {}
         self._insert_cache: dict = {}
+        self._delete_cache: dict = {}
         spec = jax.sharding.PartitionSpec(AXIS)
         self._spec = spec
         self._rep = jax.sharding.PartitionSpec()
@@ -511,6 +616,26 @@ class BatchedEngine:
                 check_vma=False)
             fn = jax.jit(sm, donate_argnums=(0, 2))
             self._insert_cache[key] = fn
+        return fn
+
+    def _get_delete(self, iters: int, with_router: bool):
+        lb = self.router.lb if with_router else None
+        key = (iters, lb)
+        fn = self._delete_cache.get(key)
+        if fn is None:
+            spec, rep = self._spec, self._rep
+            in_specs = [spec, spec, spec, spec, spec, rep, spec]
+            if with_router:
+                in_specs.append(rep)
+            sm = jax.shard_map(
+                functools.partial(delete_step_spmd, cfg=self.cfg,
+                                  iters=iters, lb=lb),
+                mesh=self.dsm.mesh,
+                in_specs=tuple(in_specs),
+                out_specs=(spec, spec, spec),
+                check_vma=False)
+            fn = jax.jit(sm, donate_argnums=(0, 2))
+            self._delete_cache[key] = fn
         return fn
 
     # -- helpers -------------------------------------------------------------
@@ -633,6 +758,147 @@ class BatchedEngine:
         for j in np.nonzero(pending)[0]:
             self.tree.insert(int(keys[j]), int(values[j]))
             stats["host_path"] += 1
+
+    def range_query(self, lo: int, hi: int) -> tuple[np.ndarray, np.ndarray]:
+        """All (k, v) with lo <= k < hi, sorted.  See module-level
+        :func:`range_query`."""
+        return range_query(self, lo, hi)
+
+    def delete(self, keys, max_rounds: int | None = None) -> np.ndarray:
+        """Batched delete (``Tree::del`` parity).  Returns found bool [n]
+        (True where the key existed and was removed)."""
+        if max_rounds is None:
+            max_rounds = self.tcfg.insert_rounds
+        keys = np.asarray(keys, np.uint64)
+        if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
+            raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+        n = keys.shape[0]
+        total = self.cfg.machine_nr * self.B
+        out = np.zeros(n, bool)
+        for i in range(0, n, total):
+            out[i:i + total] = self._delete_chunk(keys[i:i + total],
+                                                  max_rounds)
+        return out
+
+    def _delete_chunk(self, keys, max_rounds) -> np.ndarray:
+        n = keys.shape[0]
+        found_out = np.zeros(n, bool)
+        pending = np.ones(n, bool)
+        for round_i in range(max_rounds):
+            if not pending.any():
+                return found_out
+            idx = np.nonzero(pending)[0]
+            khi, klo = bits.keys_to_pairs(keys[idx])
+            (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
+            active, _ = self._pad(np.ones(idx.shape[0], bool))
+            use_router = self.router is not None and round_i == 0
+            fn = self._get_delete(self._iters(), use_router)
+            args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
+                    self._shard(khi), self._shard(klo),
+                    np.int32(self.tree._root_addr), self._shard(active)]
+            if use_router:
+                args.append(self.router.table)
+            self.dsm.pool, self.dsm.counters, status = fn(*args)
+            status = np.asarray(status)[:idx.shape[0]]
+
+            found_out[idx[status == ST_APPLIED]] = True
+            done = (status == ST_APPLIED) | (status == ST_NOT_FOUND)
+            pending[idx[done]] = False
+            bad = status == ST_BAD
+            for j in idx[bad]:
+                found_out[j] = self.tree.delete(int(keys[j]))
+                pending[j] = False
+            if bad.any():
+                self.tree._refresh_root()
+        for j in np.nonzero(pending)[0]:
+            found_out[j] = self.tree.delete(int(keys[j]))
+        return found_out
+
+
+# ---------------------------------------------------------------------------
+# Range query: cache-seeded batched leaf fetch (Tree.cpp:461-522).
+# ---------------------------------------------------------------------------
+
+def _addr_rows(addrs: np.ndarray, pages_per_node: int) -> np.ndarray:
+    """Packed addrs -> global pool row indices (host)."""
+    a = np.asarray(addrs).astype(np.uint32).astype(np.uint64)
+    return ((a >> C.ADDR_PAGE_BITS) * np.uint64(pages_per_node)
+            + (a & np.uint64(C.ADDR_PAGE_MASK))).astype(np.int64)
+
+
+@jax.jit
+def _gather_rows(pool, rows):
+    return pool[rows]
+
+
+def range_query(eng: "BatchedEngine", lo: int, hi: int
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """All (k, v) with lo <= k < hi, sorted by key.
+
+    TPU-native shape of the reference's pipelined scan
+    (``Tree.cpp:461-522``): the index cache (router table) yields the
+    candidate leaf set for the range in O(1); ONE device gather fetches all
+    candidate pages at once (beating the reference's 32-deep fetch window);
+    the host walks the B-link chain over the prefetched pages and only
+    touches the DSM again for chain gaps (stale cache), mirroring the
+    re-descend fallback.  Returns (keys u64 [n], values u64 [n]).
+    """
+    tree = eng.tree
+    cfg = eng.cfg
+    lo = int(lo); hi = int(hi)
+    assert C.KEY_MIN <= lo and hi <= C.KEY_POS_INF and lo < hi
+
+    # -- candidate prefetch from the router table ---------------------------
+    fetched: dict[int, np.ndarray] = {}
+    if eng.router is not None:
+        r = eng.router
+        b_lo = lo >> r.shift
+        b_hi = min(r.nb - 1, max(0, (hi - 1) >> r.shift))
+        cand = np.unique(np.asarray(r.table)[b_lo:b_hi + 1])
+        if cand.size:
+            rows = _addr_rows(cand, cfg.pages_per_node)
+            pages = np.asarray(_gather_rows(eng.dsm.pool, jnp.asarray(rows)))
+            for a, p in zip(cand.tolist(), pages):
+                if int(p[C.W_LEVEL]) == 0:   # stale entries may be internal
+                    fetched[int(a) & 0xFFFFFFFF] = p
+
+    def get_page(addr: int) -> np.ndarray:
+        p = fetched.get(addr & 0xFFFFFFFF)
+        if p is None:
+            p = tree.dsm.read_page(addr)
+            fetched[addr & 0xFFFFFFFF] = p
+        return p
+
+    # -- find the first leaf containing lo ----------------------------------
+    start = None
+    for a, p in fetched.items():
+        if layout.np_lowest(p) <= lo < layout.np_highest(p):
+            start = a
+            break
+    if start is None:
+        start, _, _ = tree._descend(lo, 0)
+
+    # -- walk the chain -----------------------------------------------------
+    addr = start
+    chain_pages = []
+    hops = 0
+    while True:
+        pg = get_page(addr)
+        chain_pages.append(pg)
+        if layout.np_highest(pg) >= hi:
+            break
+        sib = int(pg[C.W_SIBLING])
+        if bits.addr_is_null(sib):
+            break
+        addr = sib
+        hops += 1
+        assert hops < cfg.machine_nr * cfg.pages_per_node, "chain runaway"
+    pages = np.stack(chain_pages)
+    keys, vals, live = layout.np_leaf_entries_batch(pages)
+    m = live & (keys >= np.uint64(lo)) & (keys < np.uint64(hi))
+    out_k, out_v = keys[m], vals[m]
+    order = np.argsort(out_k)
+    return out_k[order], out_v[order]
 
 
 # ---------------------------------------------------------------------------

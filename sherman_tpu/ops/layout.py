@@ -231,6 +231,22 @@ def np_leaf_entries(pg: np.ndarray) -> list[tuple[int, int, int]]:
     return out
 
 
+def np_leaf_entries_batch(pages: np.ndarray):
+    """Vectorized live-entry extraction from [W, PAGE_WORDS] leaf pages
+    (host twin of `leaf_slot_used`/`leaf_find_key` for whole-page scans).
+
+    Returns (keys u64 [W, CAP], vals u64 [W, CAP], live bool [W, CAP]).
+    """
+    fv = pages[:, C.L_FVER_W:C.L_FVER_W + C.LEAF_CAP]
+    rv = pages[:, C.L_RVER_W:C.L_RVER_W + C.LEAF_CAP]
+    live = (fv == rv) & (fv != 0)
+    keys = bits.pairs_to_keys(pages[:, C.L_KHI_W:C.L_KHI_W + C.LEAF_CAP],
+                              pages[:, C.L_KLO_W:C.L_KLO_W + C.LEAF_CAP])
+    vals = bits.pairs_to_keys(pages[:, C.L_VHI_W:C.L_VHI_W + C.LEAF_CAP],
+                              pages[:, C.L_VLO_W:C.L_VLO_W + C.LEAF_CAP])
+    return keys, vals, live
+
+
 def np_internal_entries(pg: np.ndarray) -> list[tuple[int, int]]:
     out = []
     for s in range(int(pg[C.W_NKEYS])):

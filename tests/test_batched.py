@@ -162,3 +162,83 @@ def test_counters_move(eight_devices):
     after = tree.dsm.counter_snapshot()
     assert after["read_ops"] > before["read_ops"]
     assert after["write_ops"] >= before["write_ops"] + 32
+
+
+def test_batched_delete(eight_devices):
+    tree, eng = make()
+    rng = np.random.default_rng(6)
+    keys = np.unique(rng.integers(1, 1 << 32, 1500, dtype=np.uint64))
+    batched.bulk_load(tree, keys, keys + np.uint64(5))
+
+    gone = keys[::4]
+    kept = np.setdiff1d(keys, gone)
+    found = eng.delete(gone)
+    assert found.all()
+    tree.check_structure()
+
+    _, f = eng.search(gone)
+    assert not f.any()
+    got, f = eng.search(kept)
+    assert f.all()
+    np.testing.assert_array_equal(got, kept + np.uint64(5))
+
+    # deleting again reports not-found
+    found2 = eng.delete(gone[:50])
+    assert not found2.any()
+
+    # re-insert deleted keys works (slots were freed)
+    stats = eng.insert(gone, gone * np.uint64(2))
+    assert stats["applied"] + stats["superseded"] + stats["host_path"] \
+        == gone.shape[0]
+    got, f = eng.search(gone)
+    assert f.all()
+    np.testing.assert_array_equal(got, gone * np.uint64(2))
+
+
+def test_batched_delete_duplicates_and_misses(eight_devices):
+    tree, eng = make()
+    keys = np.arange(1, 300, dtype=np.uint64)
+    batched.bulk_load(tree, keys, keys)
+    req = np.array([10, 10, 10, 999_999, 20], np.uint64)
+    found = eng.delete(req)
+    # all three duplicate requests observe the same pre-step state: found
+    assert found[0] and found[1] and found[2]
+    assert not found[3]
+    assert found[4]
+    _, f = eng.search(np.array([10, 20], np.uint64))
+    assert not f.any()
+
+
+def test_range_query_engine(eight_devices):
+    tree, eng = make()
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.integers(1, 1 << 24, 2000, dtype=np.uint64))
+    batched.bulk_load(tree, keys, keys * np.uint64(3))
+    eng.attach_router()
+
+    lo, hi = int(keys[300]), int(keys[900])
+    k, v = eng.range_query(lo, hi)
+    expect = keys[(keys >= lo) & (keys < hi)]
+    np.testing.assert_array_equal(k, expect)
+    np.testing.assert_array_equal(v, expect * np.uint64(3))
+
+    # range past the end + empty range
+    k, v = eng.range_query(int(keys[-1]), int(keys[-1]) + 1000)
+    np.testing.assert_array_equal(k, keys[-1:])
+    k, v = eng.range_query(3, 4)
+    assert k.size == (1 if 3 in keys else 0)
+
+
+def test_range_query_no_router_and_after_writes(eight_devices):
+    tree, eng = make()
+    keys = np.arange(10, 5000, 10, dtype=np.uint64)
+    batched.bulk_load(tree, keys, keys)
+    # no router attached: pure descend + chain walk
+    k, v = eng.range_query(100, 1000)
+    np.testing.assert_array_equal(k, np.arange(100, 1000, 10, np.uint64))
+    # deletes and inserts are reflected
+    eng.delete(np.array([100, 110], np.uint64))
+    eng.insert(np.array([105], np.uint64), np.array([1], np.uint64))
+    k, v = eng.range_query(100, 130)
+    np.testing.assert_array_equal(k, np.array([105, 120], np.uint64))
+    np.testing.assert_array_equal(v, np.array([1, 120], np.uint64))
