@@ -44,6 +44,8 @@ class Tree:
     # device index cache handle (models/router.py); attached by the
     # batched engine, notified on leaf splits
     router = None
+    # host index cache handle (native.IndexCache); see enable_index_cache
+    index_cache = None
 
     def __init__(self, cluster: Cluster, ctx: ClientContext | None = None):
         self.cluster = cluster
@@ -114,21 +116,64 @@ class Tree:
     def _unlock(self, lock_addr: int) -> None:
         self.dsm.write_word(lock_addr, 0, 0, space=D.SPACE_LOCK)
 
+    # -- index cache (host tier) ---------------------------------------------
+
+    def enable_index_cache(self, capacity: int = 1 << 16) -> None:
+        """Attach the native compute-node IndexCache (IndexCache.h role):
+        descents that hit jump straight to the leaf, skipping every
+        internal level (Tree.cpp:415-427)."""
+        from sherman_tpu import native
+        self.index_cache = native.IndexCache(capacity)
+
+    def _cache_level1(self, pg: np.ndarray, key: int) -> None:
+        """Record the child range covering `key` from a level-1 page
+        (add_to_cache on level-1 fetch, Tree.cpp:644-646).  Only the one
+        range this miss actually needed — caching all ~fanout children per
+        descent would pay O(fanout) cache maintenance on every miss."""
+        lo = layout.np_lowest(pg)
+        prev_key, prev_child = lo, int(pg[C.W_LEFTMOST])
+        for k, child in layout.np_internal_entries(pg):
+            if key < k:
+                break
+            prev_key, prev_child = k, child
+        else:
+            k = layout.np_highest(pg)
+        self.index_cache.add(prev_key, k, prev_child)
+
     # -- descent -------------------------------------------------------------
 
     def _descend(self, key: int, stop_level: int = 0):
         """Walk root -> stop_level; -> (addr, page, path{level: addr}).
 
         The hot read loop (Tree.cpp:429-458): one one-sided page read per
-        level, B-link sibling chase on overshoot.
+        level, B-link sibling chase on overshoot.  With the index cache
+        attached, a hit seeds the walk at the leaf (Tree.cpp:415-427); a
+        stale hit invalidates and restarts from the root
+        (Tree.cpp:430-443).
         """
         addr = self._root_addr
+        from_cache = False
+        if stop_level == 0 and self.index_cache is not None:
+            hit = self.index_cache.lookup(key)
+            if hit:
+                addr, from_cache = hit, True
         path: dict[int, int] = {}
         hops = 0
         while True:
             pg = self.dsm.read_page(addr)
             lvl = int(pg[C.W_LEVEL])
+            if from_cache and (lvl != 0 or key < layout.np_lowest(pg)):
+                # stale cache entry (page repurposed is impossible — pages
+                # are never freed — but a non-leaf/fence miss means the
+                # mapping is junk): drop it, restart uncached
+                self.index_cache.invalidate(key)
+                addr, from_cache = self._root_addr, False
+                continue
             if key >= layout.np_highest(pg):
+                if from_cache:
+                    # split moved the key right since caching: invalidate,
+                    # then chase the sibling (cheaper than a full restart)
+                    self.index_cache.invalidate(key)
                 sib = int(pg[C.W_SIBLING])
                 if bits.addr_is_null(sib):
                     # stale root cache (concurrent new root): refresh
@@ -136,12 +181,15 @@ class Tree:
                     addr = self._root_addr
                 else:
                     addr = sib
+                from_cache = False
                 hops += 1
                 assert hops < 1000, "sibling chase runaway"
                 continue
             path[lvl] = addr
             if lvl == stop_level:
                 return addr, pg, path
+            if lvl == 1 and self.index_cache is not None:
+                self._cache_level1(pg, key)
             addr = layout.np_pick_child(pg, key)
 
     # -- public API (Tree.h:45-63 surface) -----------------------------------

@@ -1,0 +1,312 @@
+"""Native runtime ring — C++ components behind a ctypes C ABI.
+
+The reference is 100% native C++ (SURVEY.md §2); this package holds the TPU
+build's native equivalents for everything host-side on the hot path but
+outside the XLA data plane:
+
+- ``ZipfGen``          — workload generator (test/zipf.h role)
+- ``LatencyHistogram`` — 0.1 µs-bucket latency histogram + percentiles
+                         (Tree.cpp:17 / benchmark.cpp:207-249 role)
+- ``SkipList``         — concurrent skiplist (third_party/inlineskiplist.h
+                         role; standalone skiplist_test parity)
+- ``IndexCache``       — range -> leaf-addr cache with CAS invalidation,
+                         delay-free epochs, 2-random eviction, hit stats
+                         (include/IndexCache.h role)
+- ``LocalLockTable``   — ticket locks with bounded hand-over
+                         (Tree.cpp:1124-1173 role)
+
+Built on first import with ``g++`` into ``build/libsherman_native.so``
+(rebuilt when any source is newer).  ``available()`` reports whether the
+library loaded; callers keep pure-Python fallbacks where one exists.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_BUILD = os.path.join(_DIR, "build")
+_LIB = os.path.join(_BUILD, "libsherman_native.so")
+
+_lib = None
+_load_error: str | None = None
+
+
+def _sources() -> list[str]:
+    return sorted(
+        os.path.join(_SRC, f) for f in os.listdir(_SRC) if f.endswith(".cc"))
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    t = os.path.getmtime(_LIB)
+    deps = _sources() + [
+        os.path.join(_SRC, f) for f in os.listdir(_SRC) if f.endswith(".h")]
+    return any(os.path.getmtime(s) > t for s in deps)
+
+
+def _build() -> None:
+    os.makedirs(_BUILD, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-fvisibility=hidden", "-o", tmp] + _sources()
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)  # atomic under concurrent builders
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _sig(name: str, res, args) -> None:
+    fn = getattr(_lib, name)
+    fn.restype = res
+    fn.argtypes = args
+    globals()["_" + name] = fn
+
+
+def _load() -> None:
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return
+    try:
+        if _stale():
+            _build()
+        _lib = ct.CDLL(_LIB)
+    except (OSError, RuntimeError) as e:  # no g++ / bad toolchain
+        _load_error = str(e)
+        return
+    P, U64, I32, F64 = ct.c_void_p, ct.c_uint64, ct.c_int, ct.c_double
+    PU64, PF64 = ct.POINTER(ct.c_uint64), ct.POINTER(ct.c_double)
+    _sig("shn_zipf_new", P, [U64, F64, U64])
+    _sig("shn_zipf_fill", None, [P, PU64, U64])
+    _sig("shn_zipf_free", None, [P])
+    _sig("shn_hist_new", P, [])
+    _sig("shn_hist_free", None, [P])
+    _sig("shn_hist_reset", None, [P])
+    _sig("shn_hist_record", None, [P, U64])
+    _sig("shn_hist_record_many", None, [P, PU64, U64])
+    _sig("shn_hist_record_batch", None, [P, U64, U64])
+    _sig("shn_hist_count", U64, [P])
+    _sig("shn_hist_percentiles", None, [P, PF64, U64, PF64])
+    _sig("shn_skl_new", P, [U64])
+    _sig("shn_skl_free", None, [P])
+    _sig("shn_skl_insert", I32, [P, U64, U64])
+    _sig("shn_skl_seek_ge", I32, [P, U64, PU64, PU64])
+    _sig("shn_skl_count", U64, [P])
+    _sig("shn_cache_new", P, [U64])
+    _sig("shn_cache_free", None, [P])
+    _sig("shn_cache_add", I32, [P, U64, U64, U64])
+    _sig("shn_cache_add_many", None, [P, PU64, PU64, PU64, U64])
+    _sig("shn_cache_lookup", U64, [P, U64])
+    _sig("shn_cache_lookup_many", None, [P, PU64, U64, PU64])
+    _sig("shn_cache_invalidate", I32, [P, U64])
+    _sig("shn_cache_stats", None, [P, PU64])
+    _sig("shn_lt_new", P, [U64])
+    _sig("shn_lt_free", None, [P])
+    _sig("shn_lt_acquire", I32, [P, U64])
+    _sig("shn_lt_release", I32, [P, U64, I32])
+
+
+def available() -> bool:
+    _load()
+    return _lib is not None
+
+
+def load_error() -> str | None:
+    _load()
+    return _load_error
+
+
+def _u64p(a: np.ndarray):
+    return a.ctypes.data_as(ct.POINTER(ct.c_uint64))
+
+
+def _require() -> None:
+    if not available():
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+
+
+class ZipfGen:
+    """Zipf(theta) ranks over [0, n); theta <= 0 means uniform."""
+
+    def __init__(self, n: int, theta: float = 0.99, seed: int = 0):
+        _require()
+        self._h = _shn_zipf_new(n, float(theta), seed)
+        if not self._h:
+            raise MemoryError("zipf_new failed")
+
+    def sample(self, size: int) -> np.ndarray:
+        out = np.empty(size, np.uint64)
+        _shn_zipf_fill(self._h, _u64p(out), size)
+        return out
+
+    def __del__(self):
+        h, f = getattr(self, "_h", None), globals().get("_shn_zipf_free")
+        if h and f:
+            f(h)
+            self._h = None
+
+
+class LatencyHistogram:
+    """Thread-safe 0.1 µs-bucket histogram; percentiles in µs."""
+
+    def __init__(self):
+        _require()
+        self._h = _shn_hist_new()
+        if not self._h:
+            raise MemoryError("hist_new failed")
+
+    def record_ns(self, ns: int) -> None:
+        _shn_hist_record(self._h, int(ns))
+
+    def record_many_ns(self, ns: np.ndarray) -> None:
+        ns = np.ascontiguousarray(ns, np.uint64)
+        _shn_hist_record_many(self._h, _u64p(ns), ns.size)
+
+    def record_batch(self, span_ns: int, count: int) -> None:
+        """count ops that completed together after span_ns (one step)."""
+        _shn_hist_record_batch(self._h, int(span_ns), int(count))
+
+    @property
+    def count(self) -> int:
+        return int(_shn_hist_count(self._h))
+
+    def percentiles_us(self, qs=(0.5, 0.9, 0.95, 0.99, 0.999)) -> dict:
+        q = np.asarray(qs, np.float64)
+        out = np.empty(q.size, np.float64)
+        _shn_hist_percentiles(self._h, q.ctypes.data_as(
+            ct.POINTER(ct.c_double)), q.size,
+            out.ctypes.data_as(ct.POINTER(ct.c_double)))
+        return {"p" + ("%g" % (v * 100)).replace(".", ""): float(o)
+                for v, o in zip(qs, out)}
+
+    def reset(self) -> None:
+        _shn_hist_reset(self._h)
+
+    def __del__(self):
+        h, f = getattr(self, "_h", None), globals().get("_shn_hist_free")
+        if h and f:
+            f(h)
+            self._h = None
+
+
+class SkipList:
+    """Concurrent (key: u64 -> value: u64) skiplist; seek_ge iteration."""
+
+    def __init__(self, capacity: int):
+        _require()
+        self._h = _shn_skl_new(capacity)
+        if not self._h:
+            raise MemoryError(f"skiplist alloc failed (capacity={capacity})")
+
+    def insert(self, key: int, value: int) -> int:
+        r = _shn_skl_insert(self._h, key, value)
+        if r < 0:
+            raise MemoryError("skiplist arena full")
+        return r
+
+    def seek_ge(self, key: int):
+        k, v = ct.c_uint64(), ct.c_uint64()
+        if _shn_skl_seek_ge(self._h, key, ct.byref(k), ct.byref(v)):
+            return int(k.value), int(v.value)
+        return None
+
+    def __len__(self) -> int:
+        return int(_shn_skl_count(self._h))
+
+    def __del__(self):
+        h, f = getattr(self, "_h", None), globals().get("_shn_skl_free")
+        if h and f:
+            f(h)
+            self._h = None
+
+
+STAT_FIELDS = ("hits", "misses", "adds", "evictions", "invalidates",
+               "used_slots", "capacity", "skiplist_nodes", "add_fails")
+
+
+class IndexCache:
+    """Range -> leaf-address cache (IndexCache.h role); see src docs."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        _require()
+        self._h = _shn_cache_new(capacity)
+        if not self._h:
+            raise MemoryError(
+                f"index cache alloc failed (capacity={capacity}; "
+                "max 2**28 entries)")
+
+    def add(self, from_key: int, to_key: int, ptr: int) -> int:
+        return _shn_cache_add(self._h, from_key, to_key, ptr)
+
+    def add_many(self, from_keys, to_keys, ptrs) -> None:
+        f = np.ascontiguousarray(from_keys, np.uint64)
+        t = np.ascontiguousarray(to_keys, np.uint64)
+        p = np.ascontiguousarray(ptrs, np.uint64)
+        assert f.size == t.size == p.size
+        _shn_cache_add_many(self._h, _u64p(f), _u64p(t), _u64p(p), f.size)
+
+    def lookup(self, key: int) -> int:
+        """-> leaf addr, or 0 on miss."""
+        return int(_shn_cache_lookup(self._h, key))
+
+    def lookup_many(self, keys) -> np.ndarray:
+        k = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty(k.size, np.uint64)
+        _shn_cache_lookup_many(self._h, _u64p(k), k.size, _u64p(out))
+        return out
+
+    def invalidate(self, key: int) -> bool:
+        return bool(_shn_cache_invalidate(self._h, key))
+
+    def stats(self) -> dict:
+        out = np.zeros(9, np.uint64)
+        _shn_cache_stats(self._h, _u64p(out))
+        return dict(zip(STAT_FIELDS, (int(x) for x in out)))
+
+    def hit_rate(self) -> float:
+        s = self.stats()
+        tot = s["hits"] + s["misses"]
+        return s["hits"] / tot if tot else 0.0
+
+    def __del__(self):
+        h, f = getattr(self, "_h", None), globals().get("_shn_cache_free")
+        if h and f:
+            f(h)
+            self._h = None
+
+
+class LocalLockTable:
+    """Node-local ticket locks with bounded global-lock hand-over."""
+
+    def __init__(self, n_locks: int):
+        _require()
+        self.n = n_locks
+        self._h = _shn_lt_new(n_locks)
+        if not self._h:
+            raise MemoryError(f"lock table alloc failed (n={n_locks})")
+
+    def acquire(self, i: int) -> bool:
+        """Blocks. -> True if the GLOBAL lock was handed over too."""
+        return bool(_shn_lt_acquire(self._h, i))
+
+    def release(self, i: int, handover_ok: bool = True) -> bool:
+        """-> True if handed over (do NOT release the global lock)."""
+        return bool(_shn_lt_release(self._h, i, int(handover_ok)))
+
+    def __del__(self):
+        h, f = getattr(self, "_h", None), globals().get("_shn_lt_free")
+        if h and f:
+            f(h)
+            self._h = None

@@ -29,13 +29,30 @@ def _zeta(n: int, theta: float, chunk: int = 1 << 22) -> float:
 
 
 class ZipfGen:
-    """Zipf(theta) rank sampler over [0, n)."""
+    """Zipf(theta) rank sampler over [0, n).
+
+    Prefers the native C++ sampler (:mod:`sherman_tpu.native`) and falls
+    back to the vectorized numpy path when the toolchain is unavailable.
+    """
 
     def __init__(self, n: int, theta: float = 0.99, seed: int = 0):
         assert n >= 1 and 0.0 <= theta < 1.0
         self.n = n
         self.theta = theta
+        self._native = None
+        try:
+            from sherman_tpu import native
+            if native.available():
+                self._native = native.ZipfGen(n, theta, seed)
+        except Exception:
+            self._native = None
         self.rng = np.random.default_rng(seed)
+        if self._native is None:
+            self._init_fallback()
+
+    def _init_fallback(self) -> None:
+        """O(n) zeta sums — only paid when the native sampler is absent."""
+        n, theta = self.n, self.theta
         self.zetan = _zeta(n, theta)
         self.zeta2 = _zeta(2, theta)
         self.alpha = 1.0 / (1.0 - theta)
@@ -44,6 +61,8 @@ class ZipfGen:
 
     def sample(self, size: int) -> np.ndarray:
         """-> int64 ranks [size] in [0, n); rank 0 is the hottest."""
+        if self._native is not None:
+            return self._native.sample(size).astype(np.int64)
         u = self.rng.random(size)
         uz = u * self.zetan
         ranks = (self.n * (self.eta * u - self.eta + 1.0) ** self.alpha

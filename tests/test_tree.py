@@ -108,3 +108,37 @@ def test_two_clients_share_index(cluster, tree):
     assert t2.search(5) == 555
     t2.insert(77777, 1)
     assert tree.search(77777) == 1
+
+
+def test_index_cache_descent(cluster):
+    """Host IndexCache wiring: hits jump straight to the leaf; splits make
+    entries stale, which the descent invalidates + heals via B-link chase
+    (Tree.cpp:415-443 semantics)."""
+    from sherman_tpu import native
+    if not native.available():
+        pytest.skip(f"native lib: {native.load_error()}")
+    t = Tree(cluster)
+    t.enable_index_cache(capacity=4096)
+    base = 1_000_000
+    keys = list(range(base, base + 600))
+    rng = np.random.default_rng(2)
+    rng.shuffle(keys)
+    for k in keys:
+        t.insert(k, k + 1)
+    # first pass warms the cache (level-1 pages seen during descents)
+    for k in keys:
+        assert t.search(k) == k + 1
+    s0 = t.index_cache.stats()
+    assert s0["adds"] > 0
+    # second pass should be mostly cache hits
+    for k in keys[:200]:
+        assert t.search(k) == k + 1
+    s1 = t.index_cache.stats()
+    assert s1["hits"] > s0["hits"] + 100
+    # splits after caching: insert a fresh dense run, then verify healing
+    more = list(range(base + 600, base + 1200))
+    for k in more:
+        t.insert(k, k + 1)
+    for k in more:
+        assert t.search(k) == k + 1
+    t.check_structure()
