@@ -131,32 +131,35 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
     return counters, addr, page, done
 
 
-def _router_start(table, khi, lb: int):
-    """Seed addresses from the replicated index-cache table (router.py)."""
-    uhi = jnp.asarray(khi, jnp.int32).astype(jnp.uint32)
-    bucket = jnp.right_shift(uhi, jnp.uint32(32 - lb)).astype(jnp.int32)
-    return table[bucket]
-
-
-def search_routed_spmd(pool, counters, khi, klo, root, active, table, *,
-                       cfg: DSMConfig, iters: int, lb: int,
+def search_routed_spmd(pool, counters, khi, klo, root, active, start, *,
+                       cfg: DSMConfig, iters: int,
                        axis_name: str = AXIS):
     """Single-node cache-hit search: one full-batch leaf read, then a
     COMPACTED straggler loop.
 
-    With a warm index cache ~95% of keys finish in round 1 (their bucket's
-    page IS their leaf).  The few stragglers (bucket-boundary sibling
+    ``start`` is the per-key seed address from the host index-cache probe
+    (router.host_start): with a warm cache ~90%+ of keys finish in round 1
+    (their seed IS their leaf).  The stragglers (bucket-boundary sibling
     chases, stale entries) are compacted into a small fixed buffer so later
     rounds gather S rows instead of B — full-batch rounds are what make a
     naive descent loop pay the whole batch's bandwidth per level.
 
     Single-node only (no routing exchange); the generic ``search_spmd``
     remains the multi-node / no-cache path.
+
+    Perf notes (measured on v5e): the page gather is per-row latency-bound
+    (~20-25 ns/row regardless of row width), so the step does exactly ONE
+    full-batch gather.  Round 1 is leaf-only — seeds always satisfy
+    ``page.lowest <= key`` (router invariant: buckets are only ever
+    remapped to right-siblings whose ``lowest`` is the split key, so a
+    seed can never land right of the key's leaf), and non-leaf seeds
+    (cold router) fall into the compacted loop, which runs the full
+    descent logic on S rows only.
     """
     assert cfg.machine_nr == 1
     B = khi.shape[0]
     P = pool.shape[0]
-    S = max(min(256, B), B // 4)
+    S = max(min(1024, B), B // 16)
     max_rounds = iters * 4
 
     def read(addrs):
@@ -173,23 +176,26 @@ def search_routed_spmd(pool, counters, khi, klo, root, active, table, *,
         f, vh, vl, _ = layout.leaf_find_key(pg, kh, kl)
         return at_leaf, nxt, f, vh, vl
 
-    # round 1: full batch from the cache-seeded start
-    start = _router_start(table, khi, lb)
+    # round 1: full batch from the cache-seeded start; leaf-only logic
+    # (no internal_pick_child on the full batch — stragglers descend in
+    # the compacted loop below)
     pg, ok = read(start)
-    at_leaf, nxt, f, vh, vl = advance(pg, ok, khi, klo)
+    chase = layout.needs_sibling_chase(pg, khi, klo)
+    at_leaf = ok & (layout.h_level(pg) == 0) & ~chase
+    f, vh, vl, _ = layout.leaf_find_key(pg, khi, klo)
     hit = active & at_leaf
     done = ~active | at_leaf
     found = hit & f
     vhi = jnp.where(found, vh, 0)
     vlo = jnp.where(found, vl, 0)
-    addr = jnp.where(ok, nxt, start)
+    addr = jnp.where(ok & chase, layout.h_sibling(pg), start)
 
     def cond(st):
         it, done = st[0], st[1]
         return (it < max_rounds) & jnp.any(~done)
 
     def body(st):
-        it, done, addr, found, vhi, vlo = st
+        it, done, addr, found, vhi, vlo, loop_reads = st
         sidx = jnp.nonzero(~done, size=S, fill_value=B)[0].astype(jnp.int32)
         valid = sidx < B
         ci = jnp.clip(sidx, 0, B - 1)
@@ -205,29 +211,29 @@ def search_routed_spmd(pool, counters, khi, klo, root, active, table, *,
         vlo = vlo.at[tgt].set(jnp.where(f & fin, vl, 0), mode="drop")
         adv = jnp.where(ok & ~at_leaf, sidx, B)
         addr = addr.at[adv].set(nxt, mode="drop")
-        return it + 1, done, addr, found, vhi, vlo
+        loop_reads = loop_reads + jnp.sum(valid.astype(jnp.uint32))
+        return it + 1, done, addr, found, vhi, vlo, loop_reads
 
-    _, done, addr, found, vhi, vlo = lax.while_loop(
-        cond, body, (1, done, addr, found, vhi, vlo))
+    _, done, addr, found, vhi, vlo, loop_reads = lax.while_loop(
+        cond, body, (1, done, addr, found, vhi, vlo, jnp.uint32(0)))
 
-    counters = counters.at[D.CNT_READ_OPS].add(
-        jnp.sum(active.astype(jnp.uint32)))
-    counters = counters.at[D.CNT_READ_PAGES].add(
-        jnp.sum(active.astype(jnp.uint32)))
+    # round-1 gather (one page per active key) + every straggler-loop row
+    n_reads = jnp.sum(active.astype(jnp.uint32)) + loop_reads
+    counters = counters.at[D.CNT_READ_OPS].add(n_reads)
+    counters = counters.at[D.CNT_READ_PAGES].add(n_reads)
     done = done & active
     return counters, done, found & done, vhi, vlo
 
 
-def search_spmd(pool, counters, khi, klo, root, active, table=None, *,
-                cfg: DSMConfig, iters: int, lb: int | None = None,
+def search_spmd(pool, counters, khi, klo, root, active, start=None, *,
+                cfg: DSMConfig, iters: int,
                 axis_name: str = AXIS):
     """Batched ``Tree::search`` (Tree.cpp:405-458): pure one-sided reads.
 
-    With ``table`` (the index cache), descent starts at the bucket's page —
-    normally the leaf itself (cache-hit path, Tree.cpp:415-427).
+    With ``start`` (host index-cache seeds), descent starts at the seeded
+    page — normally the leaf itself (cache-hit path, Tree.cpp:415-427).
     Returns (done, found, vhi, vlo) per key.
     """
-    start = _router_start(table, khi, lb) if table is not None else None
     counters, _, page, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
         axis_name=axis_name, start=start)
@@ -381,15 +387,14 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
 
 
 def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
-                     table=None, *, cfg: DSMConfig, iters: int,
-                     lb: int | None = None, axis_name: str = AXIS):
+                     start=None, *, cfg: DSMConfig, iters: int,
+                     axis_name: str = AXIS):
     """One batched insert step: descend + route to owners + leaf apply.
 
     Returns (pool, counters, status [B]) per this node's key shard.
     """
     B = khi.shape[0]
     N, cap = cfg.machine_nr, cfg.step_capacity
-    start = _router_start(table, khi, lb) if table is not None else None
     counters, addr, _, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
         axis_name=axis_name, start=start)
@@ -489,15 +494,14 @@ def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
 
 
 def delete_step_spmd(pool, locks, counters, khi, klo, root, active,
-                     table=None, *, cfg: DSMConfig, iters: int,
-                     lb: int | None = None, axis_name: str = AXIS):
+                     start=None, *, cfg: DSMConfig, iters: int,
+                     axis_name: str = AXIS):
     """One batched delete step: descend + route to owners + slot clear.
 
     Returns (pool, counters, status [B]) per this node's key shard.
     """
     B = khi.shape[0]
     N, cap = cfg.machine_nr, cfg.step_capacity
-    start = _router_start(table, khi, lb) if table is not None else None
     counters, addr, _, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
         axis_name=axis_name, start=start)
@@ -573,21 +577,20 @@ class BatchedEngine:
         self.router = r
         return r
 
-    def _get_search(self, iters: int, with_router: bool):
-        lb = self.router.lb if with_router else None
-        key = (iters, lb)
+    def _get_search(self, iters: int, with_start: bool):
+        key = (iters, with_start)
         fn = self._search_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
             in_specs = [spec, spec, spec, spec, rep, spec]
-            if with_router:
-                in_specs.append(rep)
-            if with_router and self.cfg.machine_nr == 1:
+            if with_start:
+                in_specs.append(spec)
+            if with_start and self.cfg.machine_nr == 1:
                 kernel = functools.partial(search_routed_spmd, cfg=self.cfg,
-                                           iters=iters, lb=lb)
+                                           iters=iters)
             else:
                 kernel = functools.partial(search_spmd, cfg=self.cfg,
-                                           iters=iters, lb=lb)
+                                           iters=iters)
             sm = jax.shard_map(
                 kernel,
                 mesh=self.dsm.mesh,
@@ -598,18 +601,17 @@ class BatchedEngine:
             self._search_cache[key] = fn
         return fn
 
-    def _get_insert(self, iters: int, with_router: bool):
-        lb = self.router.lb if with_router else None
-        key = (iters, lb)
+    def _get_insert(self, iters: int, with_start: bool):
+        key = (iters, with_start)
         fn = self._insert_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
             in_specs = [spec, spec, spec, spec, spec, spec, spec, rep, spec]
-            if with_router:
-                in_specs.append(rep)
+            if with_start:
+                in_specs.append(spec)
             sm = jax.shard_map(
                 functools.partial(insert_step_spmd, cfg=self.cfg,
-                                  iters=iters, lb=lb),
+                                  iters=iters),
                 mesh=self.dsm.mesh,
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec),
@@ -618,18 +620,17 @@ class BatchedEngine:
             self._insert_cache[key] = fn
         return fn
 
-    def _get_delete(self, iters: int, with_router: bool):
-        lb = self.router.lb if with_router else None
-        key = (iters, lb)
+    def _get_delete(self, iters: int, with_start: bool):
+        key = (iters, with_start)
         fn = self._delete_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
             in_specs = [spec, spec, spec, spec, spec, rep, spec]
-            if with_router:
-                in_specs.append(rep)
+            if with_start:
+                in_specs.append(spec)
             sm = jax.shard_map(
                 functools.partial(delete_step_spmd, cfg=self.cfg,
-                                  iters=iters, lb=lb),
+                                  iters=iters),
                 mesh=self.dsm.mesh,
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec),
@@ -679,7 +680,7 @@ class BatchedEngine:
                 self._shard(khi), self._shard(klo),
                 np.int32(self.tree._root_addr), self._shard(active)]
         if use_router:
-            args.append(self.router.table)
+            args.append(self._shard(self.router.host_start(khi)))
         self.dsm.counters, done, found, vhi, vlo = fn(*args)
         done = np.asarray(done)[:n]
         if not done.all():
@@ -736,7 +737,7 @@ class BatchedEngine:
                     self._shard(vhi), self._shard(vlo),
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
-                args.append(self.router.table)
+                args.append(self._shard(self.router.host_start(khi)))
             self.dsm.pool, self.dsm.counters, status = fn(*args)
             status = np.asarray(status)[:idx.shape[0]]
 
@@ -797,7 +798,7 @@ class BatchedEngine:
                     self._shard(khi), self._shard(klo),
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
-                args.append(self.router.table)
+                args.append(self._shard(self.router.host_start(khi)))
             self.dsm.pool, self.dsm.counters, status = fn(*args)
             status = np.asarray(status)[:idx.shape[0]]
 
@@ -854,7 +855,7 @@ def range_query(eng: "BatchedEngine", lo: int, hi: int
         r = eng.router
         b_lo = lo >> r.shift
         b_hi = min(r.nb - 1, max(0, (hi - 1) >> r.shift))
-        cand = np.unique(np.asarray(r.table)[b_lo:b_hi + 1])
+        cand = np.unique(r.table_np[b_lo:b_hi + 1])
         if cand.size:
             rows = _addr_rows(cand, cfg.pages_per_node)
             pages = np.asarray(_gather_rows(eng.dsm.pool, jnp.asarray(rows)))

@@ -402,6 +402,17 @@ class Tree:
         self._insert_parent(up_key, sib_addr, level + 1, path,
                             child_left=addr)
 
+    def lock_bench(self, key: int, loops: int = 100) -> float:
+        """Micro-bench hook (Tree.cpp:310-321): lock/unlock round trips on
+        the global lock table word for ``key``; returns ns per round trip."""
+        import time
+        pa = bits.make_addr(0, key)
+        t0 = time.perf_counter_ns()
+        for _ in range(loops):
+            la = self._lock(pa)
+            self._unlock(la)
+        return (time.perf_counter_ns() - t0) / max(loops, 1)
+
     # -- diagnostics (print_and_check_tree parity, Tree.cpp:151-203) ---------
 
     def check_structure(self) -> dict:

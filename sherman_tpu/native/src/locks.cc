@@ -38,7 +38,12 @@ struct LockTable {
 }  // namespace
 
 SHN_EXPORT void* shn_lt_new(uint64_t n_locks) {
-  return new (std::nothrow) LockTable(n_locks);
+  auto* t = new (std::nothrow) LockTable(n_locks);
+  if (t && !t->locks) {  // inner array alloc failed: report, don't segfault
+    delete t;
+    return nullptr;
+  }
+  return t;
 }
 
 SHN_EXPORT void shn_lt_free(void* h) { delete (LockTable*)h; }

@@ -1,0 +1,49 @@
+"""Nanosecond timer — ``include/Timer.h`` parity.
+
+The reference wraps ``clock_gettime(CLOCK_REALTIME)`` with ``begin()`` /
+``end(loop)`` / ``end_print(loop)`` (`Timer.h:12-43`) plus a spinning
+``sleep`` helper (`Timer.h:45-53`).  Here ``time.perf_counter_ns`` is the
+monotonic ns clock; the API shape is kept so drivers read the same.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    """begin/end ns timer; ``end(loop)`` returns ns amortized per loop."""
+
+    def __init__(self):
+        self._t0 = 0
+
+    def begin(self) -> None:
+        self._t0 = time.perf_counter_ns()
+
+    def end(self, loop: int = 1) -> float:
+        """Elapsed ns since ``begin``, divided by ``loop`` (Timer.h:24-33)."""
+        return (time.perf_counter_ns() - self._t0) / max(loop, 1)
+
+    def end_print(self, loop: int = 1, label: str = "") -> float:
+        ns = self.end(loop)
+        prefix = f"{label}: " if label else ""
+        if ns >= 1e9:
+            print(f"{prefix}{ns / 1e9:.3f} s")
+        elif ns >= 1e6:
+            print(f"{prefix}{ns / 1e6:.3f} ms")
+        elif ns >= 1e3:
+            print(f"{prefix}{ns / 1e3:.3f} us")
+        else:
+            print(f"{prefix}{ns:.0f} ns")
+        return ns
+
+    def end_us(self, loop: int = 1) -> float:
+        return self.end(loop) / 1e3
+
+
+def spin_sleep_ns(ns: int) -> None:
+    """Busy-wait for ``ns`` nanoseconds (Timer.h:45-53 ``sleep``) — for
+    sub-scheduler-quantum pacing in benchmark drivers."""
+    end = time.perf_counter_ns() + ns
+    while time.perf_counter_ns() < end:
+        pass
