@@ -1,12 +1,21 @@
-"""Pallas page-gather kernel: the hot op of the DSM read path.
+"""Pallas page-gather kernel: an alternate path for the DSM read hot op.
 
 Fetching a batch of 1 KB pages at data-dependent addresses is the innermost
 loop of every tree operation (one gather per level per step — the analogue
-of the NIC servicing ``rdmaRead`` requests, ``Operation.cpp:170``).  XLA
-lowers a generic row gather poorly on TPU (serialized dynamic-slices), so
-this kernel does what the NIC does: stream row DMAs HBM -> VMEM with many
-copies in flight, scalar-prefetching the page indices so DMA targets are
-known before the body runs.
+of the NIC servicing ``rdmaRead`` requests, ``Operation.cpp:170``).  This
+kernel streams row DMAs HBM -> VMEM with ``N_INFLIGHT`` copies in flight,
+scalar-prefetching the page indices so DMA targets are known before the
+body runs.
+
+MEASURED VERDICT (v5e, 262144 rows x 1 KB): XLA's native gather runs at
+~20-25 ns/row (latency-bound, independent of row width); this kernel's
+sequential grid + per-row DMA wait achieves ~310 ns/row — 15x slower —
+and single-row HBM slices additionally violate the (8,128) tiling on the
+current Mosaic toolchain (worked around by 8-row aligned block DMAs, which
+adds 8x read amplification).  The production read path therefore uses the
+XLA gather (``pool[idx]``); this kernel is kept as the fallback shape for
+toolchains where the tiling restriction is lifted and as the template for
+future multi-core DMA pipelining.
 
 Grid: one program per block of rows; each program pipelines its rows with
 ``N_INFLIGHT`` outstanding DMAs (double-buffering generalized).

@@ -1,0 +1,46 @@
+"""Smoke tests for the CLI drivers (tools/ — the test/*.cpp role)."""
+
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+
+def test_skiplist_test_driver(capsys):
+    import skiplist_test
+    skiplist_test.main(["--inserts", "2000", "--seeks", "200"])
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_tree_test_driver(eight_devices, capsys):
+    import tree_test
+    tree_test.main(["1", "--n", "600"])
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_write_test_driver(eight_devices, capsys):
+    import write_test
+    write_test.main(["1", "--n", "2000", "--batch", "1024"])
+    out = capsys.readouterr().out
+    assert "PASS" in out
+    assert "write amplification" in out
+    assert "lock_bench" in out
+
+
+def test_benchmark_driver_mixed(eight_devices, capsys):
+    import benchmark
+    r = benchmark.main(["2", "50", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5"])
+    assert r["peak_ops"] > 0
+    assert "cluster tp" in capsys.readouterr().out
+
+
+def test_benchmark_driver_read_only(eight_devices, capsys):
+    import benchmark
+    r = benchmark.main(["1", "100", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5"])
+    assert r["peak_ops"] > 0

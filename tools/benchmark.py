@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""YCSB-style benchmark driver — ``test/benchmark.cpp`` parity.
+
+CLI contract (benchmark.cpp:193-205):
+
+    python tools/benchmark.py <kNodeCount> <kReadRatio> <kThreadCount>
+        [--keys N] [--theta T] [--secs S] [--ops-per-coro N] [--windows W]
+
+- ``kNodeCount``   — cluster nodes (mesh size; 1 = the real chip, >1 runs
+  on a virtual CPU mesh when the hardware doesn't have that many chips).
+- ``kReadRatio``   — percent of operations that are searches (YCSB-C=100,
+  YCSB-B=95, YCSB-A=50); the rest are upserts.
+- ``kThreadCount`` — client threads per node.  The reference keeps
+  kThreadCount x kCoroCnt ops in flight per node (``Tree.cpp:1059-1122``);
+  the batched engine realizes the same concurrency as one step of
+  B = kThreadCount x kCoroCnt x opsPerCoro keys.
+
+Workload (benchmark.cpp:15-24,159-188): keyspace of --keys unique keys,
+warm ratio 0.8 bulk-loaded, zipf(--theta) sampling over the warm set.
+Reports per 2-second window: per-node + cluster throughput (via
+keeper.sum, DSMKeeper.cpp:163-176), reads/op, and every 3rd window the
+p50/p90/p95/p99/p999 op latency from the native 0.1 us histogram
+(cal_latency, benchmark.cpp:207-249).  In the batched execution model a
+key's completion latency IS its step's latency, so each step records
+(span, batch) into the histogram.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from common import build_cluster, pages_for_keys, setup_platform
+
+KCORO = 8          # kCoroCnt (Common.h:62-71)
+WARM_RATIO = 0.8   # kWarmRatio (benchmark.cpp:19)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("kNodeCount", type=int)
+    p.add_argument("kReadRatio", type=int)
+    p.add_argument("kThreadCount", type=int)
+    p.add_argument("--keys", type=int, default=1_000_000)
+    p.add_argument("--theta", type=float, default=0.99)
+    p.add_argument("--secs", type=float, default=10.0)
+    p.add_argument("--ops-per-coro", type=int, default=64,
+                   help="batched ops per (thread, coroutine) slot")
+    p.add_argument("--window", type=float, default=2.0,
+                   help="report window seconds (benchmark.cpp:300)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> dict:
+    a = parse_args(argv)
+    jax = setup_platform(a.kNodeCount)
+    import jax.numpy as jnp
+
+    from sherman_tpu import native
+    from sherman_tpu.models import batched
+    from sherman_tpu.ops import bits
+    from sherman_tpu.utils import Timer, notify_info
+    from sherman_tpu.workload.zipf import ZipfGen, uniform_ranks
+
+    B = a.kThreadCount * KCORO * a.ops_per_coro
+    n_nodes = a.kNodeCount
+    total_batch = B * n_nodes
+    cluster, tree, eng = build_cluster(
+        n_nodes, pages_for_keys(a.keys) // n_nodes or 4096, B)
+    notify_info("[bench] nodes=%d read%%=%d threads=%d B/node=%d keys=%d "
+                "theta=%.2f", n_nodes, a.kReadRatio, a.kThreadCount, B,
+                a.keys, a.theta)
+
+    # --- warmup: bulk-load the warm fraction (benchmark.cpp:114-120) --------
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.integers(1, 1 << 63, int(a.keys * 1.05),
+                                  dtype=np.uint64))[:a.keys]
+    assert keys.shape[0] == a.keys, "keyspace generation came up short"
+    n_warm = int(a.keys * WARM_RATIO)
+    warm = np.sort(rng.choice(keys, n_warm, replace=False))
+    vals = warm ^ np.uint64(0xDEADBEEF)
+    t = Timer()
+    t.begin()
+    stats = batched.bulk_load(tree, warm, vals)
+    router = eng.attach_router()
+    cluster.keeper.barrier("warm_finish")
+    notify_info("[bench] warm %d keys in %.1fs %s", n_warm, t.end() / 1e9,
+                stats)
+
+    # --- pre-generate batches (zipf over the warm set) ----------------------
+    n_batches = 32
+    if a.theta > 0:
+        ranks = ZipfGen(n_warm, a.theta, seed=11).sample(
+            n_batches * total_batch)
+    else:
+        ranks = uniform_ranks(n_warm, n_batches * total_batch, rng)
+    bkeys = warm[ranks].reshape(n_batches, total_batch)
+
+    n_read = total_batch * a.kReadRatio // 100
+    shard = tree.dsm.shard
+    batches = []
+    for i in range(n_batches):
+        khi, klo = bits.keys_to_pairs(bkeys[i])
+        start = router.host_start(khi)
+        nv_hi, nv_lo = bits.keys_to_pairs(bkeys[i] ^ np.uint64(0xBEEF + i))
+        batches.append(dict(
+            khi=jax.device_put(khi, shard), klo=jax.device_put(klo, shard),
+            start=jax.device_put(start, shard),
+            vhi=jax.device_put(nv_hi, shard),
+            vlo=jax.device_put(nv_lo, shard)))
+    active_r = np.zeros(total_batch, bool)
+    active_r[:n_read] = True
+    active_w = ~active_r
+    active_r = jax.device_put(active_r, shard)
+    active_w = jax.device_put(active_w, shard)
+    root = np.int32(tree._root_addr)
+
+    sfn = eng._get_search(eng._iters(), True) if n_read else None
+    wfn = (eng._get_insert(eng._iters(), True)
+           if n_read < total_batch else None)
+    dsm = tree.dsm
+    hist = native.LatencyHistogram() if native.available() else None
+
+    def one_step(i):
+        b = batches[i % n_batches]
+        out = None
+        if sfn is not None:
+            dsm.counters, done, found, vh, vl = sfn(
+                dsm.pool, dsm.counters, b["khi"], b["klo"], root, active_r,
+                b["start"])
+            out = found
+        if wfn is not None:
+            dsm.pool, dsm.counters, status = wfn(
+                dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
+                b["vhi"], b["vlo"], root, active_w, b["start"])
+            out = status
+        return out
+
+    # Multi-node meshes must drain every step: two queued SPMD programs can
+    # interleave across device threads (device 1 enters program i+1's
+    # all_to_all while device 0 is still in program i's), deadlocking the
+    # collective rendezvous.  Single-node programs have no collectives, so
+    # deep queueing is safe and hides the access-tunnel sync cost (~100 ms).
+    def drain(x):
+        np.asarray(jnp.ravel(x)[0])
+
+    # warm + compile + settle
+    out = one_step(0)
+    drain(out)
+    for i in range(8):
+        out = one_step(i)
+        if n_nodes > 1:
+            drain(out)
+    drain(out)
+
+    # --- timed windows ------------------------------------------------------
+    t0 = time.time()
+    for i in range(4):
+        out = one_step(i)
+        if n_nodes > 1:
+            drain(out)
+    drain(out)
+    est = max((time.time() - t0) / 4, 1e-4)
+    steps_per_block = 1 if n_nodes > 1 else max(1, int(0.5 / est))
+
+    windows = max(1, int(a.secs / a.window))
+    notify_info("[bench] est step %.1f ms -> %d steps/block",
+                est * 1e3, steps_per_block)
+    results = []
+    step_i = 0
+    c_prev = dsm.counter_snapshot()
+    for w in range(windows):
+        w0 = time.time()
+        blocks = 0
+        while time.time() - w0 < a.window:
+            b0 = time.time()
+            for _ in range(steps_per_block):
+                out = one_step(step_i)
+                step_i += 1
+                if n_nodes > 1:
+                    drain(out)
+            drain(out)
+            span = time.time() - b0
+            blocks += 1
+            if hist is not None:
+                hist.record_batch(int(span / steps_per_block * 1e9),
+                                  total_batch * steps_per_block)
+        elapsed = time.time() - w0
+        ops = blocks * steps_per_block * total_batch
+        tp_node = ops / elapsed / n_nodes
+        tp_cluster = cluster.keeper.sum(f"tp:{w}", int(ops / elapsed))
+        c_now = dsm.counter_snapshot()
+        reads = c_now["read_ops"] - c_prev["read_ops"]
+        c_prev = c_now
+        line = (f"[window {w}] node tp {tp_node / 1e6:.2f} Mops/s, "
+                f"cluster tp {tp_cluster / 1e6:.2f} Mops/s, "
+                f"reads/op {reads / max(ops, 1):.2f}")
+        if hist is not None and w % 3 == 2:
+            line += f", lat(us) {hist.percentiles_us()}"
+        print(line, flush=True)
+        results.append(tp_cluster)
+
+    # --- verify the last step's statuses (writes must have applied) --------
+    if wfn is not None:
+        st = np.asarray(out)
+        okw = np.isin(st[np.asarray(active_w)],
+                      (batched.ST_APPLIED, batched.ST_SUPERSEDED))
+        assert okw.mean() > 0.99, f"write fast-path misses: {1-okw.mean():.3%}"
+    if sfn is not None and wfn is None:
+        assert bool(np.asarray(out).all()), "searches missed warm keys"
+
+    best = max(results)
+    print(f"[bench] peak cluster throughput {best / 1e6:.2f} Mops/s "
+          f"({a.kReadRatio}% read, theta={a.theta})")
+    return {"peak_ops": best, "windows": results}
+
+
+if __name__ == "__main__":
+    main()

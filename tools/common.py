@@ -1,0 +1,63 @@
+"""Shared plumbing for the CLI drivers (the ``test/*.cpp`` role).
+
+Every reference test binary begins with ``DSM::getInstance`` +
+``registerThread`` + ``new Tree`` (e.g. ``test/benchmark.cpp:253-266``);
+this module is that prologue: platform selection, cluster construction,
+and tree/engine setup from CLI-ish knobs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup_platform(n_nodes: int):
+    """Pick the backend: n_nodes == 1 uses the default platform (the real
+    chip when present); n_nodes > 1 forces an n-node virtual CPU mesh (the
+    in-process multi-node backend, SURVEY.md §4's fake-transport lesson)
+    unless SHERMAN_PLATFORM overrides.  Must run before the first jax
+    device query — a devices() call initializes the backend and freezes
+    XLA_FLAGS."""
+    platform = os.environ.get("SHERMAN_PLATFORM", "")
+    if n_nodes > 1 and not platform:
+        platform = "cpu"
+    if platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_nodes}"
+            ).strip()
+    import jax
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    devs = jax.devices()
+    assert len(devs) >= n_nodes, (
+        f"need {n_nodes} devices, have {len(devs)}")
+    return jax
+
+
+def build_cluster(n_nodes: int, pages_per_node: int, batch_per_node: int,
+                  locks_per_node: int = 65_536, chunk_pages: int = 4096):
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig, TreeConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+
+    cfg = DSMConfig(machine_nr=n_nodes, pages_per_node=pages_per_node,
+                    locks_per_node=locks_per_node,
+                    step_capacity=batch_per_node, chunk_pages=chunk_pages)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=batch_per_node,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    return cluster, tree, eng
+
+
+def pages_for_keys(n_keys: int, fill: float = 0.75) -> int:
+    from sherman_tpu.config import LEAF_CAP
+    per_leaf = max(1, int(LEAF_CAP * fill))
+    est = int(n_keys / per_leaf * 1.10) + 8192
+    return 1 << max(12, (est - 1).bit_length())

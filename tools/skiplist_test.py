@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Native skiplist micro-bench — ``test/skiplist_test.cpp`` parity.
+
+The only host-only unit test in the reference: insert 100K keys into the
+concurrent skiplist, then time 10K seeks (``skiplist_test.cpp:54-95``).
+Exercises the native library's SkipList (the IndexCache's ordered core).
+
+    python tools/skiplist_test.py [--inserts N] [--seeks N]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import common  # noqa: F401  (repo-root sys.path bootstrap)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--inserts", type=int, default=100_000)
+    p.add_argument("--seeks", type=int, default=10_000)
+    a = p.parse_args(argv)
+
+    from sherman_tpu import native
+    from sherman_tpu.utils import Timer
+
+    if not native.available():
+        print(f"native library unavailable: {native.load_error()}")
+        raise SystemExit(1)
+
+    sl = native.SkipList(a.inserts + 16)
+    rng = np.random.default_rng(5)
+    keys = rng.permutation(np.arange(1, a.inserts + 1, dtype=np.uint64))
+
+    t = Timer()
+    t.begin()
+    for k in keys:
+        sl.insert(int(k), int(k) * 2)
+    ins_ns = t.end(a.inserts)
+    assert len(sl) == a.inserts
+
+    probe = rng.integers(1, a.inserts, a.seeks, dtype=np.uint64)
+    t.begin()
+    for k in probe:
+        kv = sl.seek_ge(int(k))
+        assert kv is not None and kv[0] >= int(k)
+    seek_ns = t.end(a.seeks)
+
+    # correctness spot check: seek_ge returns the exact key when present
+    for k in (1, a.inserts // 2, a.inserts):
+        kv = sl.seek_ge(k)
+        assert kv == (k, k * 2), kv
+
+    print(f"skiplist: insert {ins_ns:.0f} ns/op, seek_ge {seek_ns:.0f} ns/op "
+          f"({a.inserts} inserts, {a.seeks} seeks)")
+    print("skiplist_test PASS")
+
+
+if __name__ == "__main__":
+    main()
