@@ -157,6 +157,19 @@ def search_routed_spmd(pool, counters, khi, klo, root, active, start, *,
     descent logic on S rows only.
     """
     assert cfg.machine_nr == 1
+    counters, done, addr, found, vhi, vlo = _routed_resolve(
+        pool, counters, khi, klo, active, start, iters=iters)
+    return counters, done, found, vhi, vlo
+
+
+def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
+    """Walk every active key from its cache seed to its leaf (single-node).
+
+    Shared core of the routed search and mixed steps: round 1 + compacted
+    straggler loop as described in :func:`search_routed_spmd`.  Returns
+    (counters, done, addr, found, vhi, vlo): ``addr`` is the key's leaf
+    page (for owner-side applies), found/vhi/vlo its lookup result.
+    """
     B = khi.shape[0]
     P = pool.shape[0]
     S = max(min(1024, B), B // 16)
@@ -222,7 +235,7 @@ def search_routed_spmd(pool, counters, khi, klo, root, active, start, *,
     counters = counters.at[D.CNT_READ_OPS].add(n_reads)
     counters = counters.at[D.CNT_READ_PAGES].add(n_reads)
     done = done & active
-    return counters, done, found & done, vhi, vlo
+    return counters, done, addr, found & done, vhi, vlo
 
 
 def search_spmd(pool, counters, khi, klo, root, active, start=None, *,
@@ -386,6 +399,44 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     return pool, counters, status
 
 
+def _request_prio(B: int, axis_name: str):
+    """Globally unique request priorities (lower wins dedup races)."""
+    me = lax.axis_index(axis_name).astype(jnp.int32)
+    return me * jnp.int32(B) + jnp.arange(B, dtype=jnp.int32)
+
+
+def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
+                     fields, *, cfg: DSMConfig, axis_name: str):
+    """Ship ``eligible`` requests to their owner nodes and apply.
+
+    Shared tail of the insert/delete/mixed steps: single-node applies
+    directly; multi-node bucketizes by owner, all_to_all-exchanges the
+    request fields, applies on the owner, and routes statuses back.
+    ``fields`` are the per-request arrays ``apply_fn`` expects beyond
+    active/addr.  Returns (pool, counters, status_raw [B]) where
+    status_raw is the apply status for eligible routed rows and ST_RETRY
+    for rows that missed the bucket capacity (full RDMA send queue moral
+    equivalent) — callers mask inactive rows to ST_INVALID.
+    """
+    N, cap = cfg.machine_nr, cfg.step_capacity
+    if N == 1:
+        inc = {"active": eligible, "addr": addr, **fields}
+        pool, counters, st = apply_fn(pool, locks, counters, inc, cfg=cfg)
+        return pool, counters, jnp.where(eligible, st, ST_RETRY)
+
+    dest = bits.addr_node(addr)
+    bucket_idx, routed = transport.bucketize(dest, eligible, N, cap)
+    out_fields = {"active": eligible & routed, "addr": addr, **fields}
+    out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
+           for k, v in out_fields.items()}
+    inc = transport.exchange(out, axis_name)
+    pool, counters, st = apply_fn(pool, locks, counters, inc, cfg=cfg)
+    rep = transport.exchange({"st": st}, axis_name)
+    safe_b = jnp.where(routed, bucket_idx, 0)
+    return pool, counters, jnp.where(eligible & routed, rep["st"][safe_b],
+                                     ST_RETRY)
+
+
 def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
                      start=None, *, cfg: DSMConfig, iters: int,
                      axis_name: str = AXIS):
@@ -394,39 +445,15 @@ def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
     Returns (pool, counters, status [B]) per this node's key shard.
     """
     B = khi.shape[0]
-    N, cap = cfg.machine_nr, cfg.step_capacity
     counters, addr, _, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
         axis_name=axis_name, start=start)
-
-    if N == 1:
-        # Single-node fast path: requests are already local — no routing.
-        prio = jnp.arange(B, dtype=jnp.int32)
-        inc = {"active": done, "addr": addr, "khi": khi, "klo": klo,
-               "vhi": vhi, "vlo": vlo, "prio": prio}
-        pool, counters, st = leaf_apply_spmd(pool, locks, counters, inc,
-                                             cfg=cfg)
-        status = jnp.where(active, jnp.where(done, st, ST_RETRY), ST_INVALID)
-        return pool, counters, status
-
-    dest = bits.addr_node(addr)
-    bucket_idx, routed = transport.bucketize(dest, done, N, cap)
-
-    me = lax.axis_index(axis_name).astype(jnp.int32)
-    prio = me * jnp.int32(B) + jnp.arange(B, dtype=jnp.int32)
-    out_fields = {"active": done & routed, "addr": addr, "khi": khi,
-                  "klo": klo, "vhi": vhi, "vlo": vlo, "prio": prio}
-    out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
-           for k, v in out_fields.items()}
-    inc = transport.exchange(out, axis_name)
-
-    pool, counters, st = leaf_apply_spmd(pool, locks, counters, inc, cfg=cfg)
-
-    rep = transport.exchange({"st": st}, axis_name)
-    safe_b = jnp.where(routed, bucket_idx, 0)
-    status = jnp.where(done & routed, rep["st"][safe_b], ST_RETRY)
-    status = jnp.where(active, status, ST_INVALID)
-    return pool, counters, status
+    pool, counters, status = _route_and_apply(
+        pool, locks, counters, leaf_apply_spmd, addr, done,
+        {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo,
+         "prio": _request_prio(B, axis_name)},
+        cfg=cfg, axis_name=axis_name)
+    return pool, counters, jnp.where(active, status, ST_INVALID)
 
 
 # ---------------------------------------------------------------------------
@@ -500,35 +527,66 @@ def delete_step_spmd(pool, locks, counters, khi, klo, root, active,
 
     Returns (pool, counters, status [B]) per this node's key shard.
     """
-    B = khi.shape[0]
-    N, cap = cfg.machine_nr, cfg.step_capacity
     counters, addr, _, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
         axis_name=axis_name, start=start)
+    pool, counters, status = _route_and_apply(
+        pool, locks, counters, leaf_delete_apply_spmd, addr, done,
+        {"khi": khi, "klo": klo}, cfg=cfg, axis_name=axis_name)
+    return pool, counters, jnp.where(active, status, ST_INVALID)
 
-    if N == 1:
-        inc = {"active": done, "addr": addr, "khi": khi, "klo": klo}
-        pool, counters, st = leaf_delete_apply_spmd(pool, locks, counters,
-                                                    inc, cfg=cfg)
-        status = jnp.where(active, jnp.where(done, st, ST_RETRY), ST_INVALID)
-        return pool, counters, status
 
-    dest = bits.addr_node(addr)
-    bucket_idx, routed = transport.bucketize(dest, done, N, cap)
-    out_fields = {"active": done & routed, "addr": addr,
-                  "khi": khi, "klo": klo}
-    out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
-           for k, v in out_fields.items()}
-    inc = transport.exchange(out, axis_name)
+# ---------------------------------------------------------------------------
+# Mixed step: searches and upserts share one descent (YCSB-A/B shape).
+# ---------------------------------------------------------------------------
 
-    pool, counters, st = leaf_delete_apply_spmd(pool, locks, counters, inc,
-                                                cfg=cfg)
+def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
+                    active_r, active_w, start=None, *, cfg: DSMConfig,
+                    iters: int, axis_name: str = AXIS):
+    """One fused step of searches (``active_r``) and upserts (``active_w``).
 
-    rep = transport.exchange({"st": st}, axis_name)
-    safe_b = jnp.where(routed, bucket_idx, 0)
-    status = jnp.where(done & routed, rep["st"][safe_b], ST_RETRY)
-    status = jnp.where(active, status, ST_INVALID)
-    return pool, counters, status
+    The reference interleaves reads and writes per thread from one open
+    loop (``benchmark.cpp:159-188``); the batched equivalent runs both
+    workload classes through a SINGLE descent per step — a read costs the
+    same whether its neighbor is a write.  Consistency: reads that resolve
+    in this step see the pre-step pool snapshot, and writes apply at the
+    step boundary — the serial order is (resolved reads) < (writes).
+    Reads that overrun the descent budget (done_r False) are NOT part of
+    this step's linearization: the caller retries them in a later step,
+    where they may legally observe this step's writes (the same outcome
+    as a reference thread whose read lost the race to a concurrent
+    writer).
+
+    Returns (pool, counters, status [B], done_r [B], found [B], vhi [B],
+    vlo [B]); status is ST_* for write keys, done_r/found/v* cover reads.
+    """
+    B = khi.shape[0]
+    active = active_r | active_w
+
+    if cfg.machine_nr == 1 and start is not None:
+        counters, done, addr, found, rvh, rvl = _routed_resolve(
+            pool, counters, khi, klo, active, start, iters=iters)
+    else:
+        counters, addr, page, done = descend_spmd(
+            pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
+            axis_name=axis_name, start=start)
+        f, vh, vl, _ = layout.leaf_find_key(page, khi, klo)
+        found = f & done
+        rvh = jnp.where(found, vh, 0)
+        rvl = jnp.where(found, vl, 0)
+
+    done_r = done & active_r
+    found = found & done_r
+    rvh = jnp.where(found, rvh, 0)
+    rvl = jnp.where(found, rvl, 0)
+
+    pool, counters, status = _route_and_apply(
+        pool, locks, counters, leaf_apply_spmd, addr, done & active_w,
+        {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo,
+         "prio": _request_prio(B, axis_name)},
+        cfg=cfg, axis_name=axis_name)
+    status = jnp.where(active_w, status, ST_INVALID)
+    return pool, counters, status, done_r, found, rvh, rvl
 
 
 # ---------------------------------------------------------------------------
@@ -554,6 +612,7 @@ class BatchedEngine:
         self._search_cache: dict = {}
         self._insert_cache: dict = {}
         self._delete_cache: dict = {}
+        self._mixed_cache: dict = {}
         spec = jax.sharding.PartitionSpec(AXIS)
         self._spec = spec
         self._rep = jax.sharding.PartitionSpec()
@@ -638,6 +697,74 @@ class BatchedEngine:
             fn = jax.jit(sm, donate_argnums=(0, 2))
             self._delete_cache[key] = fn
         return fn
+
+    def _get_mixed(self, iters: int, with_start: bool):
+        key = (iters, with_start)
+        fn = self._mixed_cache.get(key)
+        if fn is None:
+            spec, rep = self._spec, self._rep
+            in_specs = [spec, spec, spec, spec, spec, spec, spec, rep,
+                        spec, spec]
+            if with_start:
+                in_specs.append(spec)
+            sm = jax.shard_map(
+                functools.partial(mixed_step_spmd, cfg=self.cfg,
+                                  iters=iters),
+                mesh=self.dsm.mesh,
+                in_specs=tuple(in_specs),
+                out_specs=(spec, spec, spec, spec, spec, spec, spec),
+                check_vma=False)
+            fn = jax.jit(sm, donate_argnums=(0, 2))
+            self._mixed_cache[key] = fn
+        return fn
+
+    def mixed(self, keys, values, is_read):
+        """One fused step of reads and upserts over one key batch.
+
+        keys u64 [n], values u64 [n] (ignored where is_read), is_read
+        bool [n].  Returns (out_values u64 [n], found bool [n] — read
+        rows only, status int32 [n] — write rows only).  One-round
+        best-effort on the write side: callers retry ST_FULL/ST_RETRY
+        via :meth:`insert` (the bench drivers treat them as open-loop
+        misses).  Reads that overran the descent budget retry inline as
+        a LATER step — per the mixed_step_spmd linearization rule they
+        may observe this step's writes.
+        """
+        keys = np.asarray(keys, np.uint64)
+        if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
+            raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+        values = np.asarray(values, np.uint64)
+        is_read = np.asarray(is_read, bool)
+        n = keys.shape[0]
+        total = self.cfg.machine_nr * self.B
+        assert n <= total, "chunk the batch to machine_nr * B"
+        khi, klo = bits.keys_to_pairs(keys)
+        vhi, vlo = bits.keys_to_pairs(values)
+        (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
+        (vhi, _), (vlo, _) = self._pad(vhi), self._pad(vlo)
+        ar, _ = self._pad(is_read)   # pad rows are neither read nor write
+        aw, _ = self._pad(~is_read)
+        use_router = self.router is not None
+        fn = self._get_mixed(self._iters(), use_router)
+        args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
+                self._shard(khi), self._shard(klo),
+                self._shard(vhi), self._shard(vlo),
+                np.int32(self.tree._root_addr),
+                self._shard(ar), self._shard(aw)]
+        if use_router:
+            args.append(self._shard(self.router.host_start(khi)))
+        (self.dsm.pool, self.dsm.counters, status, done_r, found,
+         rvh, rvl) = fn(*args)
+        status = np.asarray(status)[:n]
+        done_r = np.asarray(done_r)[:n]
+        found = np.asarray(found)[:n]
+        out_vals = np.array(bits.pairs_to_keys(
+            np.asarray(rvh)[:n], np.asarray(rvl)[:n]))
+        miss_r = is_read & ~done_r
+        if miss_r.any():
+            v2, f2 = self.search(keys[miss_r])
+            out_vals[miss_r], found[miss_r] = v2, f2
+        return out_vals, found, status
 
     # -- helpers -------------------------------------------------------------
 

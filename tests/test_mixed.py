@@ -1,0 +1,85 @@
+"""Mixed fused-step tests (batched.mixed_step_spmd / BatchedEngine.mixed)."""
+
+import numpy as np
+import pytest
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+
+
+def _mk(n_nodes, pages=512, batch=256):
+    cfg = DSMConfig(machine_nr=n_nodes, pages_per_node=pages,
+                    locks_per_node=512, step_capacity=batch, chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=batch)
+    return cluster, tree, eng
+
+
+@pytest.mark.parametrize("n_nodes", [1, 4])
+def test_mixed_step_reads_and_writes(eight_devices, n_nodes):
+    cluster, tree, eng = _mk(n_nodes)
+    rng = np.random.default_rng(2)
+    keys = np.unique(rng.integers(1, 1 << 60, 600, dtype=np.uint64))[:500]
+    vals = keys * np.uint64(2)
+    batched.bulk_load(tree, keys, vals)
+    eng.attach_router()
+
+    n = 200
+    bk = keys[rng.integers(0, len(keys), n)]
+    is_read = np.zeros(n, bool)
+    is_read[::2] = True
+    new_vals = bk ^ np.uint64(0x55)
+    out_vals, found, status = eng.mixed(bk, new_vals, is_read)
+
+    # read rows: pre-step snapshot values
+    assert found[is_read].all()
+    np.testing.assert_array_equal(out_vals[is_read], bk[is_read] * 2)
+    # write rows: applied or deduped behind an applied winner
+    st = status[~is_read]
+    assert np.isin(st, (batched.ST_APPLIED, batched.ST_SUPERSEDED)).all(), st
+
+    # post-step: writes visible, untouched keys unchanged
+    got, f = eng.search(bk)
+    assert f.all()
+    written = np.unique(bk[~is_read])
+    expect = {int(k): int(k ^ np.uint64(0x55)) for k in written}
+    for k, v in zip(bk, got):
+        assert int(v) == expect.get(int(k), int(k) * 2)
+
+
+def test_mixed_reads_see_prestep_snapshot(eight_devices):
+    """A read and a write of the SAME key in one step: the read returns the
+    pre-step value (reads linearize before writes)."""
+    cluster, tree, eng = _mk(1)
+    keys = np.arange(1, 101, dtype=np.uint64)
+    batched.bulk_load(tree, keys, keys * np.uint64(10))
+    eng.attach_router()
+
+    bk = np.array([7, 7], dtype=np.uint64)
+    is_read = np.array([True, False])
+    out_vals, found, status = eng.mixed(bk, np.array([0, 999], np.uint64),
+                                        is_read)
+    assert found[0] and out_vals[0] == 70
+    assert status[1] == batched.ST_APPLIED
+    got, _ = eng.search(np.array([7], np.uint64))
+    assert got[0] == 999
+
+
+def test_mixed_without_router_descends(eight_devices):
+    cluster, tree, eng = _mk(2)
+    keys = np.unique(np.random.default_rng(4).integers(
+        1, 1 << 58, 300, dtype=np.uint64))[:250]
+    batched.bulk_load(tree, keys, keys)
+    # no router attached: generic descend path
+    n = 100
+    bk = keys[:n]
+    is_read = np.ones(n, bool)
+    is_read[10:20] = False
+    out_vals, found, status = eng.mixed(bk, bk + np.uint64(1), is_read)
+    assert found[is_read].all()
+    np.testing.assert_array_equal(out_vals[is_read], bk[is_read])
+    assert np.isin(status[~is_read],
+                   (batched.ST_APPLIED, batched.ST_SUPERSEDED)).all()

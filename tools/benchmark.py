@@ -118,26 +118,32 @@ def main(argv=None) -> dict:
     active_w = jax.device_put(active_w, shard)
     root = np.int32(tree._root_addr)
 
-    sfn = eng._get_search(eng._iters(), True) if n_read else None
-    wfn = (eng._get_insert(eng._iters(), True)
-           if n_read < total_batch else None)
     dsm = tree.dsm
     hist = native.LatencyHistogram() if native.available() else None
+    mixed = 0 < n_read < total_batch
+    mfn = eng._get_mixed(eng._iters(), True) if mixed else None
+    sfn = (eng._get_search(eng._iters(), True)
+           if not mixed and n_read else None)
+    wfn = (eng._get_insert(eng._iters(), True)
+           if not mixed and n_read < total_batch else None)
 
     def one_step(i):
         b = batches[i % n_batches]
-        out = None
+        if mfn is not None:
+            # fused step: searches and upserts share one descent
+            (dsm.pool, dsm.counters, status, done_r, found, vh, vl) = mfn(
+                dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
+                b["vhi"], b["vlo"], root, active_r, active_w, b["start"])
+            return status
         if sfn is not None:
             dsm.counters, done, found, vh, vl = sfn(
                 dsm.pool, dsm.counters, b["khi"], b["klo"], root, active_r,
                 b["start"])
-            out = found
-        if wfn is not None:
-            dsm.pool, dsm.counters, status = wfn(
-                dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
-                b["vhi"], b["vlo"], root, active_w, b["start"])
-            out = status
-        return out
+            return found
+        dsm.pool, dsm.counters, status = wfn(
+            dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
+            b["vhi"], b["vlo"], root, active_w, b["start"])
+        return status
 
     # Multi-node meshes must drain every step: two queued SPMD programs can
     # interleave across device threads (device 1 enters program i+1's
@@ -204,12 +210,12 @@ def main(argv=None) -> dict:
         results.append(tp_cluster)
 
     # --- verify the last step's statuses (writes must have applied) --------
-    if wfn is not None:
+    if mfn is not None or wfn is not None:
         st = np.asarray(out)
         okw = np.isin(st[np.asarray(active_w)],
                       (batched.ST_APPLIED, batched.ST_SUPERSEDED))
         assert okw.mean() > 0.99, f"write fast-path misses: {1-okw.mean():.3%}"
-    if sfn is not None and wfn is None:
+    elif sfn is not None:
         assert bool(np.asarray(out).all()), "searches missed warm keys"
 
     best = max(results)
