@@ -36,13 +36,23 @@ class ClientContext:
 
 
 class Cluster:
-    def __init__(self, cfg: DSMConfig, mesh: jax.sharding.Mesh | None = None):
+    def __init__(self, cfg: DSMConfig, mesh: jax.sharding.Mesh | None = None,
+                 keeper: Keeper | None = None):
         self.cfg = cfg
         self.dsm = DSM(cfg, mesh)
-        self.keeper = Keeper(cfg.machine_nr)
-        # every process slot enters like a symmetric CN+MN node
-        self.node_ids = [self.keeper.server_enter()
-                         for _ in range(cfg.machine_nr)]
+        self.keeper = keeper if keeper is not None else Keeper(cfg.machine_nr)
+        if self.keeper.is_multihost:
+            # each host process enters once and serves its own node's
+            # directory (bootstrap.DistributedKeeper; node id = process id)
+            assert cfg.machine_nr == self.keeper.machine_nr, (
+                f"cfg.machine_nr={cfg.machine_nr} must equal the process "
+                f"count {self.keeper.machine_nr} in a multi-host cluster")
+            self.node_ids = [self.keeper.server_enter()]
+        else:
+            # single-process SPMD: this process plays every symmetric
+            # CN+MN node
+            self.node_ids = [self.keeper.server_enter()
+                             for _ in range(cfg.machine_nr)]
         self.directories = [Directory(n, cfg) for n in self.node_ids]
         self._next_client = 0
         self.keeper.barrier("DSM-init")
@@ -53,7 +63,10 @@ class Cluster:
         return ClientContext(client_id=cid,
                              alloc=LocalAllocator(self.directories))
 
-    # NEW_ROOT broadcast (Tree.cpp:116-124): update every directory's hint.
+    # NEW_ROOT broadcast (Tree.cpp:116-124): update the local directories'
+    # hints.  The hint is advisory acceleration only — the authoritative
+    # root is the meta-page word every client reads (Tree._refresh_root),
+    # so other hosts' hints converge lazily rather than via cross-host RPC.
     def broadcast_new_root(self, addr: int, level: int) -> None:
         for d in self.directories:
             d.new_root(addr, level)

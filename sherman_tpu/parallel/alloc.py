@@ -81,29 +81,48 @@ class Directory:
 
 
 class LocalAllocator:
-    """Per-client page allocator over leased chunks, one lease per node."""
+    """Per-client page allocator over leased chunks, one lease per node.
+
+    ``directories`` are the node agents this client can lease from — all
+    nodes in single-process SPMD, only the host-local node(s) in a
+    multi-host deployment (each host allocates from its own partition;
+    remote-chunk RPC is not needed because any node's pages are reachable
+    one-sidedly once allocated).  Addresses are always packed with the
+    directory's REAL node id, which need not equal its list position.
+    """
 
     def __init__(self, directories: list[Directory]):
         self._dirs = directories
+        self._by_node = {d.node_id: d for d in directories}
         self._cur: dict[int, tuple[int, int]] = {}  # node -> (next_page, end)
         self._rr = 0
+
+    def _pick(self, node: int | None) -> Directory:
+        if node is None:
+            d = self._dirs[self._rr % len(self._dirs)]
+            self._rr += 1
+            return d
+        if node not in self._by_node:
+            raise KeyError(
+                f"node {node} has no local directory (locals: "
+                f"{sorted(self._by_node)}); allocate from a local node")
+        return self._by_node[node]
 
     def alloc(self, npages: int = 1, node: int | None = None) -> int:
         """Allocate npages *contiguous* pages; -> packed addr of the first.
 
         Target node round-robins per call unless pinned (DSM.h:200-203).
         """
-        if node is None:
-            node = self._rr % len(self._dirs)
-            self._rr += 1
-        nxt, end = self._cur.get(node, (0, 0))
+        d = self._pick(node)
+        nid = d.node_id
+        nxt, end = self._cur.get(nid, (0, 0))
         if nxt + npages > end:
-            base_addr, chunk_pages = self._dirs[node].malloc_chunk()
+            base_addr, chunk_pages = d.malloc_chunk()
             assert npages <= chunk_pages
             nxt = bits.addr_page(base_addr)
             end = nxt + chunk_pages
-        self._cur[node] = (nxt + npages, end)
-        return bits.make_addr(node, nxt)
+        self._cur[nid] = (nxt + npages, end)
+        return bits.make_addr(nid, nxt)
 
     def alloc_many(self, count: int) -> np.ndarray:
         """Vectorized allocation of ``count`` single pages (bulk-load path).
@@ -115,19 +134,20 @@ class LocalAllocator:
         out = np.empty(count, np.int64)
         filled = 0
         while filled < count:
-            node = self._rr % len(self._dirs)
+            d = self._dirs[self._rr % len(self._dirs)]
             self._rr += 1
-            nxt, end = self._cur.pop(node, (0, 0))
+            nid = d.node_id
+            nxt, end = self._cur.pop(nid, (0, 0))
             if nxt >= end:
-                base_addr, chunk_pages = self._dirs[node].malloc_chunk()
+                base_addr, chunk_pages = d.malloc_chunk()
                 nxt = bits.addr_page(base_addr)
                 end = nxt + chunk_pages
             take = min(end - nxt, count - filled)
             out[filled:filled + take] = (
-                (node << ADDR_PAGE_BITS) | np.arange(nxt, nxt + take))
+                (nid << ADDR_PAGE_BITS) | np.arange(nxt, nxt + take))
             filled += take
             if nxt + take < end:
-                self._cur[node] = (nxt + take, end)
+                self._cur[nid] = (nxt + take, end)
         return out
 
     def free(self, addr: int, npages: int = 1) -> None:

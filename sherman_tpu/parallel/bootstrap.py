@@ -22,6 +22,8 @@ from collections import defaultdict
 class Keeper:
     """In-process KV / barrier / sum with DSMKeeper's interface."""
 
+    is_multihost = False
+
     def __init__(self, machine_nr: int):
         self.machine_nr = machine_nr
         self._kv: dict[str, bytes] = {}
@@ -69,3 +71,61 @@ class Keeper:
             k = "sum:" + name
             self._counters[k] += int(value)
             return self._counters[k]
+
+
+def init_multihost(coordinator_address: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> "DistributedKeeper":
+    """Join a multi-host deployment and return its Keeper.
+
+    The memcached bootstrap role (``Keeper.cpp:28-56``): every host calls
+    this before building the Cluster; ``jax.distributed.initialize`` is the
+    out-of-band rendezvous (its coordinator service is the memcached
+    analogue), after which the global mesh spans all hosts and the
+    ICI/DCN fabric is the data plane.  Args follow jax.distributed
+    (auto-detected on TPU pods when omitted).
+    """
+    import jax
+    if coordinator_address is not None:
+        # Must run before ANY jax computation or backend query — even
+        # jax.process_count() initializes the backends and would make
+        # this raise.  Omit coordinator_address if jax.distributed was
+        # already initialized out-of-band (e.g. TPU pod auto-init).
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    return DistributedKeeper()
+
+
+class DistributedKeeper(Keeper):
+    """Multi-host Keeper over jax's process group.
+
+    Replaces the in-process KV/collectives when the mesh spans hosts:
+    node-ID assignment maps to ``jax.process_index`` (``serverEnter``'s
+    atomic-increment role, Keeper.cpp:67-85), ``barrier`` to a global
+    device sync (DSMKeeper.cpp:148-161), and ``sum`` to a process
+    allgather + reduce (DSMKeeper.cpp:163-176).  The KV surface stays
+    host-local: cluster-global state lives in the DSM itself (the root
+    pointer is a meta-page word installed by CAS), so cross-host KV is
+    only needed for diagnostics.
+    """
+
+    is_multihost = True
+
+    def __init__(self):
+        import jax
+        super().__init__(machine_nr=jax.process_count())
+        self._jax = jax
+
+    def server_enter(self) -> int:
+        return self._jax.process_index()
+
+    def barrier(self, name: str) -> None:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+    def sum(self, name: str, value: int) -> int:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            np.asarray([value], np.int64))
+        return int(np.sum(gathered))
