@@ -15,8 +15,8 @@ Consistency model (stronger than the reference, by construction):
   cross-driver/host interleavings and protocol parity.
 - All writes of a step become visible atomically at the step boundary; this
   IS the write+unlock doorbell guarantee (``Operation.cpp:351-380``).
-- Intra-batch conflicts are linearized deterministically by request priority
-  (a serial order exists: the priority order), which replaces the reference's
+- Intra-batch conflicts are linearized deterministically by stable request
+  order (a serial order exists: the (source, slot) order), which replaces the reference's
   hierarchical local-lock hand-over (``Tree.cpp:1124-1173``): requests to the
   same leaf are *combined* in one step instead of queueing on a ticket lock.
 
@@ -46,7 +46,10 @@ from sherman_tpu.parallel.mesh import AXIS
 # Per-key insert status codes (reply of one insert step).
 ST_INVALID = 0      # inactive slot (padding)
 ST_APPLIED = 1      # written in this step
-ST_SUPERSEDED = 2   # same-key request with higher priority applied instead
+ST_SUPERSEDED = 2   # an earlier-ordered same-key request won AND applied
+                    # (final: the winner's write is a legal concurrent
+                    # overwrite of this one; losers of a non-applying
+                    # winner get ST_RETRY instead)
 ST_FULL = 3         # leaf full -> host split path
 ST_LOCKED = 4       # page lock held (host split in flight) -> retry
 ST_RETRY = 5        # routing overflow / descent incomplete -> retry
@@ -258,36 +261,18 @@ def search_spmd(pool, counters, khi, klo, root, active, start=None, *,
 # Owner-side leaf apply: the write fast path.
 # ---------------------------------------------------------------------------
 
-def _rank_within_group(group_key, member, sentinel):
-    """Stable 0-based rank of each member within its group.
-
-    group_key: [M] int32; non-members get ``sentinel`` (must sort last and
-    be unique-ish or shared — ranks for non-members are meaningless).
-    Returns (rank [M], perm, sorted_key) for reuse.
-    """
-    M = group_key.shape[0]
-    prio = jnp.arange(M, dtype=jnp.int32)
-    key = jnp.where(member, group_key, sentinel)
-    perm = jnp.lexsort((prio, key))
-    sk = key[perm]
-    starts = jnp.searchsorted(sk, sk, side="left")
-    rank_s = jnp.arange(M, dtype=jnp.int32) - starts.astype(jnp.int32)
-    rank = jnp.zeros(M, jnp.int32).at[perm].set(rank_s)
-    return rank, perm, sk
-
-
 def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     """Apply routed insert requests to this node's leaf pages.
 
-    inc: dict of [M] arrays — active, addr (leaf), khi, klo, vhi, vlo,
-    prio (globally unique, lower wins).  Returns
-    (pool, counters, status [M]).
+    inc: dict of [M] arrays — active, addr (leaf), khi, klo, vhi, vlo.
+    Returns (pool, counters, status [M]).
 
     Mirrors ``leaf_page_store`` (Tree.cpp:828-921) minus splits: in-place
     update of an existing key, or insert into a free slot, with the
     single-entry write-back (only the touched 6-word entry + version words
-    are written).  Same-key requests are deduped (priority winner) —
-    the intra-step linearization that replaces local-lock hand-over.
+    are written).  Same-key requests are deduped (stable request order:
+    lowest (source, slot) wins) — the intra-step linearization that
+    replaces local-lock hand-over.
     """
     M = inc["addr"].shape[0]
     P = pool.shape[0]
@@ -306,51 +291,71 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
         & layout.page_consistent(pg)
     ok_req = sane & ~locked
 
-    # --- dedupe same (page, key) requests: lowest prio wins ----------------
-    # Group key must be collision-free: combine page and both key words by
-    # sorting on a tuple via lexsort layers.
-    prio = inc["prio"]
-    gkey_sort = jnp.lexsort((
-        prio,
-        bits._ux(klo),
-        bits._ux(khi),
-        jnp.where(ok_req, page_idx, P),
-    ))
-    sp = jnp.where(ok_req, page_idx, P)[gkey_sort]
-    skhi, sklo = khi[gkey_sort], klo[gkey_sort]
-    sok = ok_req[gkey_sort]
+    found, _, _, fslot = layout.leaf_find_key(pg, khi, klo)
+    free = ~layout.leaf_slot_used(pg)                      # [M, CAP]
+    cumfree = jnp.cumsum(free.astype(jnp.int32), axis=-1)
+    freec = cumfree[:, -1]                                 # page free slots
+
+    # --- dedupe + insert-rank in ONE sorted pass ---------------------------
+    # A single multi-operand lax.sort (stable) groups requests by
+    # (page, key) and carries the original index / found / free-count
+    # along — measured 4x cheaper than lexsort + per-array permutation
+    # gathers, and it subsumes the old second sort for insert ranks: the
+    # sort's outer key IS the page, so a segmented count over the sorted
+    # order ranks each fresh-insert winner within its page (the scan-based
+    # segment base replaces an O(B log B) searchsorted).
+    # Dedup winner = first row of its group = lowest original index.  A
+    # superseded loser is final ONLY when its winner applied (the winner's
+    # write is then a legal concurrent overwrite of the loser's value); a
+    # loser whose winner went to the split path (ST_FULL) must retry — the
+    # acked write would otherwise be observably absent.
+    idx0 = jnp.arange(M, dtype=jnp.int32)
+    pk = jnp.where(ok_req, page_idx, P)
+    sp, skhi, sklo, sidx, sfound, sfreec = lax.sort(
+        (pk, bits._ux(khi), bits._ux(klo), idx0, found, freec), num_keys=3)
+    sok = sp < P
     same_prev = jnp.concatenate([
         jnp.zeros(1, bool),
         (sp[1:] == sp[:-1]) & (skhi[1:] == skhi[:-1]) & (sklo[1:] == sklo[:-1])
-        & sok[1:] & sok[:-1],
+        & sok[1:],
     ])
     winner_s = sok & ~same_prev
-    winner = jnp.zeros(M, bool).at[gkey_sort].set(winner_s)
-    # Propagate each group's winner (original index) to its losers so a
-    # superseded request can report whether its winner actually applied.
-    # Groups are contiguous in sorted order and every group head is a
-    # winner, so an inclusive running max of head positions gives, at each
-    # sorted position, the sorted position of its group's head.
-    head_pos_s = lax.associative_scan(
+    need_ins_s = winner_s & ~sfound
+    # rank among the page's fresh inserts: cum at row minus cum at the
+    # page segment's head (cum_excl is nondecreasing, so a running max
+    # over head-masked values yields the latest head's base)
+    page_head = jnp.concatenate([jnp.ones(1, bool), sp[1:] != sp[:-1]])
+    cum = jnp.cumsum(need_ins_s.astype(jnp.int32))
+    cum_excl = cum - need_ins_s
+    base = lax.associative_scan(
+        jnp.maximum, jnp.where(page_head, cum_excl, -1))
+    rank_s = cum_excl - base
+    # whether each group's winner applies: update, or insert that fits the
+    # page's free slots; propagate the head's verdict to its losers with a
+    # position-encoded running max (groups are contiguous, heads are
+    # winners)
+    applied_s = winner_s & (sfound | (rank_s < sfreec))
+    enc = lax.associative_scan(
         jnp.maximum,
-        jnp.where(~same_prev, jnp.arange(M, dtype=jnp.int32), -1))
-    winner_orig_s = gkey_sort[jnp.clip(head_pos_s, 0, M - 1)].astype(jnp.int32)
-    winner_orig_s = jnp.where(sok, winner_orig_s, -1)
-    group_winner = jnp.full(M, -1, jnp.int32).at[gkey_sort].set(winner_orig_s)
-    superseded = ok_req & ~winner
+        jnp.where(winner_s, idx0 * 2 + applied_s.astype(jnp.int32), -1))
+    grp_winner_applied = (enc & 1) == 1
+    # one scatter ships every sorted-space verdict back: -4 loser whose
+    # winner did not apply (retry), -3 dropped, -2 superseded-final,
+    # -1 winner-found (update), r>=0 winner insert rank
+    code_s = jnp.where(
+        ~sok, -3,
+        jnp.where(~winner_s, jnp.where(grp_winner_applied, -2, -4),
+                  jnp.where(sfound, -1, rank_s)))
+    code = jnp.full(M, -3, jnp.int32).at[sidx].set(code_s)
+    winner = code >= -1
+    superseded = code == -2
+    loser_retry = code == -4
+    need_ins = code >= 0
+    rank = jnp.maximum(code, 0)
 
-    # --- existing-key slot or fresh free slot ------------------------------
-    found, _, _, fslot = layout.leaf_find_key(pg, khi, klo)
-    need_ins = winner & ~found
-
-    # rank of each inserting winner within its page
-    rank, _, _ = _rank_within_group(page_idx, need_ins, P)
-
-    free = ~layout.leaf_slot_used(pg)                      # [M, CAP]
-    cumfree = jnp.cumsum(free.astype(jnp.int32), axis=-1)
     target = (rank + 1)[:, None]
     islot = jnp.argmax(cumfree >= target, axis=-1)
-    have_slot = cumfree[:, -1] >= (rank + 1)
+    have_slot = freec >= (rank + 1)
     full = need_ins & ~have_slot
 
     applied = winner & (found | (need_ins & have_slot))
@@ -364,31 +369,36 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     new_ver = (old_fv + 1) & 0x7FFFFFFF
     new_ver = jnp.where(new_ver == 0, 1, new_ver)
 
-    ent = jnp.stack([new_ver, khi, klo, inc["vhi"], inc["vlo"], new_ver],
-                    axis=-1)                               # [M, 6]
+    # ONE fused scatter pass: 6 entry words + the front/rear page-version
+    # pair per applied request.  The version bump is a computed SET (every
+    # same-page writer computes the same snapshot_version + 1 from the
+    # shared pre-step page), not an ADD — identical protocol value, and
+    # fusing the three scatter passes into one saves ~40 ms per step at
+    # B=2^18 on v5e (each O(B) scatter pass costs ~20 ms regardless of
+    # payload width).
+    hdr_ver = pg[:, C.W_FRONT_VER]
+    new_pv = (hdr_ver + 1) & 0x7FFFFFFF
+    new_pv = jnp.where(new_pv == 0, 1, new_pv)
+    ent = jnp.stack([new_ver, khi, klo, inc["vhi"], inc["vlo"], new_ver,
+                     new_pv, new_pv], axis=-1)             # [M, 8]
     field_w = jnp.asarray([C.L_FVER_W, C.L_KHI_W, C.L_KLO_W, C.L_VHI_W,
                            C.L_VLO_W, C.L_RVER_W], jnp.int32)
-    idx = (safe_page * _PW)[:, None] + field_w[None, :] + slot[:, None]
+    idx = jnp.concatenate([
+        (safe_page * _PW)[:, None] + field_w[None, :] + slot[:, None],
+        (safe_page * _PW)[:, None] + jnp.asarray(
+            [[C.W_FRONT_VER, C.W_REAR_VER]], jnp.int32),
+    ], axis=-1)                                            # [M, 8]
     idx = jnp.where(applied[:, None], idx, P * _PW)
     flat = pool.reshape(-1)
     flat = flat.at[idx.reshape(-1)].set(ent.reshape(-1), mode="drop")
-
-    # page version bump (front+rear together: step-atomic, stays consistent)
-    bump = applied.astype(jnp.int32)
-    vf = jnp.where(applied, safe_page * _PW + C.W_FRONT_VER, P * _PW)
-    vr = jnp.where(applied, safe_page * _PW + C.W_REAR_VER, P * _PW)
-    flat = flat.at[vf].add(bump, mode="drop")
-    flat = flat.at[vr].add(bump, mode="drop")
     pool = flat.reshape(P, _PW)
 
     # --- status ------------------------------------------------------------
-    winner_applied = jnp.where(
-        group_winner >= 0, applied[jnp.clip(group_winner, 0, M - 1)], False)
     status = jnp.full(M, ST_INVALID, jnp.int32)
     status = jnp.where(act, ST_BAD, status)
     status = jnp.where(act & sane & locked, ST_LOCKED, status)
-    status = jnp.where(superseded & winner_applied, ST_SUPERSEDED, status)
-    status = jnp.where(superseded & ~winner_applied, ST_RETRY, status)
+    status = jnp.where(loser_retry, ST_RETRY, status)
+    status = jnp.where(superseded, ST_SUPERSEDED, status)
     status = jnp.where(full, ST_FULL, status)
     status = jnp.where(applied, ST_APPLIED, status)
 
@@ -399,10 +409,23 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     return pool, counters, status
 
 
-def _request_prio(B: int, axis_name: str):
-    """Globally unique request priorities (lower wins dedup races)."""
-    me = lax.axis_index(axis_name).astype(jnp.int32)
-    return me * jnp.int32(B) + jnp.arange(B, dtype=jnp.int32)
+def _resolve_leaves(pool, counters, khi, klo, root, active, start, *,
+                    cfg: DSMConfig, iters: int, axis_name: str):
+    """Walk every active key to its leaf, picking the best descent:
+    cache-seeded compacted loop on a single node, generic full-batch
+    descent otherwise.  -> (counters, done, addr, found, vhi, vlo);
+    callers that only need addresses let XLA drop the lookup outputs.
+    """
+    if cfg.machine_nr == 1 and start is not None:
+        return _routed_resolve(pool, counters, khi, klo, active, start,
+                               iters=iters)
+    counters, addr, page, done = descend_spmd(
+        pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
+        axis_name=axis_name, start=start)
+    f, vh, vl, _ = layout.leaf_find_key(page, khi, klo)
+    found = f & done
+    return (counters, done, addr, found,
+            jnp.where(found, vh, 0), jnp.where(found, vl, 0))
 
 
 def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
@@ -444,14 +467,12 @@ def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
 
     Returns (pool, counters, status [B]) per this node's key shard.
     """
-    B = khi.shape[0]
-    counters, addr, _, done = descend_spmd(
-        pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
-        axis_name=axis_name, start=start)
+    counters, done, addr, _, _, _ = _resolve_leaves(
+        pool, counters, khi, klo, root, active, start, cfg=cfg, iters=iters,
+        axis_name=axis_name)
     pool, counters, status = _route_and_apply(
         pool, locks, counters, leaf_apply_spmd, addr, done,
-        {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo,
-         "prio": _request_prio(B, axis_name)},
+        {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo},
         cfg=cfg, axis_name=axis_name)
     return pool, counters, jnp.where(active, status, ST_INVALID)
 
@@ -490,21 +511,24 @@ def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     applied = ok_req & found
     safe_slot = jnp.clip(slot, 0, C.LEAF_CAP - 1)
 
-    # zero the version pair (SoA blocks) — slot becomes free
-    flat = pool.reshape(-1)
-    wf = safe_page * _PW + C.L_FVER_W + safe_slot
-    wr = safe_page * _PW + C.L_RVER_W + safe_slot
+    # ONE fused scatter pass: zero the slot's version pair (slot becomes
+    # free) + the front/rear page-version bump.  The bump is a computed
+    # SET from the shared pre-step snapshot (see leaf_apply_spmd) — safe
+    # for same-page duplicates, and one O(B) scatter pass instead of four.
+    hdr_ver = pg[:, C.W_FRONT_VER]
+    new_pv = (hdr_ver + 1) & 0x7FFFFFFF
+    new_pv = jnp.where(new_pv == 0, 1, new_pv)
     zero = jnp.zeros(M, jnp.int32)
-    flat = flat.at[jnp.where(applied, wf, P * _PW)].set(zero, mode="drop")
-    flat = flat.at[jnp.where(applied, wr, P * _PW)].set(zero, mode="drop")
-
-    # page version bump (front+rear together: step-atomic, stays consistent;
-    # same-page duplicates accumulate identically on both words)
-    bump = applied.astype(jnp.int32)
-    vf = jnp.where(applied, safe_page * _PW + C.W_FRONT_VER, P * _PW)
-    vr = jnp.where(applied, safe_page * _PW + C.W_REAR_VER, P * _PW)
-    flat = flat.at[vf].add(bump, mode="drop")
-    flat = flat.at[vr].add(bump, mode="drop")
+    vals = jnp.stack([zero, zero, new_pv, new_pv], axis=-1)   # [M, 4]
+    idx = jnp.stack([
+        safe_page * _PW + C.L_FVER_W + safe_slot,
+        safe_page * _PW + C.L_RVER_W + safe_slot,
+        safe_page * _PW + C.W_FRONT_VER,
+        safe_page * _PW + C.W_REAR_VER,
+    ], axis=-1)                                               # [M, 4]
+    idx = jnp.where(applied[:, None], idx, P * _PW)
+    flat = pool.reshape(-1)
+    flat = flat.at[idx.reshape(-1)].set(vals.reshape(-1), mode="drop")
     pool = flat.reshape(P, _PW)
 
     status = jnp.full(M, ST_INVALID, jnp.int32)
@@ -527,9 +551,9 @@ def delete_step_spmd(pool, locks, counters, khi, klo, root, active,
 
     Returns (pool, counters, status [B]) per this node's key shard.
     """
-    counters, addr, _, done = descend_spmd(
-        pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
-        axis_name=axis_name, start=start)
+    counters, done, addr, _, _, _ = _resolve_leaves(
+        pool, counters, khi, klo, root, active, start, cfg=cfg, iters=iters,
+        axis_name=axis_name)
     pool, counters, status = _route_and_apply(
         pool, locks, counters, leaf_delete_apply_spmd, addr, done,
         {"khi": khi, "klo": klo}, cfg=cfg, axis_name=axis_name)
@@ -560,20 +584,10 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
     Returns (pool, counters, status [B], done_r [B], found [B], vhi [B],
     vlo [B]); status is ST_* for write keys, done_r/found/v* cover reads.
     """
-    B = khi.shape[0]
     active = active_r | active_w
-
-    if cfg.machine_nr == 1 and start is not None:
-        counters, done, addr, found, rvh, rvl = _routed_resolve(
-            pool, counters, khi, klo, active, start, iters=iters)
-    else:
-        counters, addr, page, done = descend_spmd(
-            pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
-            axis_name=axis_name, start=start)
-        f, vh, vl, _ = layout.leaf_find_key(page, khi, klo)
-        found = f & done
-        rvh = jnp.where(found, vh, 0)
-        rvl = jnp.where(found, vl, 0)
+    counters, done, addr, found, rvh, rvl = _resolve_leaves(
+        pool, counters, khi, klo, root, active, start, cfg=cfg, iters=iters,
+        axis_name=axis_name)
 
     done_r = done & active_r
     found = found & done_r
@@ -582,8 +596,7 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
 
     pool, counters, status = _route_and_apply(
         pool, locks, counters, leaf_apply_spmd, addr, done & active_w,
-        {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo,
-         "prio": _request_prio(B, axis_name)},
+        {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo},
         cfg=cfg, axis_name=axis_name)
     status = jnp.where(active_w, status, ST_INVALID)
     return pool, counters, status, done_r, found, rvh, rvl

@@ -25,6 +25,20 @@ as the executable spec for the batched device kernels
 - Root: packed addr + level in the reserved meta page (node 0, page 0),
   installed by CAS (``Tree.cpp:55``, root slot parity ``Tree.cpp:90-97``),
   broadcast via NEW_ROOT (``Tree.cpp:116-124``).
+
+Reference options deliberately NOT carried over (``Common.h:19-23``):
+
+- ``CONFIG_ENABLE_CRC`` (page checksum): guards against torn NIC reads.
+  The DSM's step-atomic visibility makes a torn page *unobservable* —
+  a read returns one pre-step snapshot — so the CRC's failure mode
+  cannot occur; the front/rear page versions and per-entry version
+  pairs are kept for protocol parity and cross-step interleavings.
+- ``CONFIG_ENABLE_EMBEDDING_LOCK`` (lock word inside the page): an
+  alternative to the on-chip lock table.  The separate per-node lock
+  space IS the on-chip table analogue and composes with coalesced
+  cas_read/write+unlock chains; embedding would save nothing here
+  (same step count) while costing a page word the SoA layout uses
+  for entries.
 """
 
 from __future__ import annotations
@@ -103,6 +117,25 @@ class Tree:
                                    space=D.SPACE_LOCK)
             if ok:
                 return la
+            spins += 1
+            if spins > LOCK_SPIN_LIMIT:
+                raise RuntimeError(
+                    f"possible deadlock on lock {la:#x}: holder tag {old}")
+
+    def _lock_and_read(self, page_addr: int) -> tuple[int, np.ndarray]:
+        """Acquire the page's global lock and fetch the page in ONE step —
+        lock_and_read_page (Tree.cpp:300-308) over the coalesced
+        rdmaCasRead chain (Operation.cpp:382-414).  The snapshot the step
+        returns is valid under the lock because the previous holder's
+        payload write and unlock landed together in one earlier step.
+        -> (lock_addr, page)."""
+        la = self._lock_word_addr(page_addr)
+        spins = 0
+        while True:
+            old, ok, pg = self.dsm.cas_read(la, 0, 0, self.ctx.tag,
+                                            page_addr)
+            if ok:
+                return la, pg
             spins += 1
             if spins > LOCK_SPIN_LIMIT:
                 raise RuntimeError(
@@ -211,8 +244,7 @@ class Tree:
         assert C.KEY_MIN <= key <= C.KEY_MAX
         while True:
             addr, _, _ = self._descend(key, 0)
-            la = self._lock(addr)
-            pg = self.dsm.read_page(addr)
+            la, pg = self._lock_and_read(addr)
             if not (layout.np_lowest(pg) <= key < layout.np_highest(pg)):
                 self._unlock(la)
                 continue  # concurrent split: re-descend
@@ -255,8 +287,7 @@ class Tree:
                     path: dict[int, int]) -> bool:
         """leaf_page_store (Tree.cpp:828-987).  True on success, False to
         re-descend (fence moved under us)."""
-        la = self._lock(addr)
-        pg = self.dsm.read_page(addr)  # fresh read under lock
+        la, pg = self._lock_and_read(addr)  # fused lock + fresh read
         if not (layout.np_lowest(pg) <= key < layout.np_highest(pg)):
             self._unlock(la)
             return False
@@ -340,8 +371,7 @@ class Tree:
         if addr is None:
             addr, _, _ = self._descend(key, level)
         while True:
-            la = self._lock(addr)
-            pg = self.dsm.read_page(addr)
+            la, pg = self._lock_and_read(addr)
             if key >= layout.np_highest(pg):
                 self._unlock(la)
                 sib = int(pg[C.W_SIBLING])

@@ -413,6 +413,62 @@ class DSM:
                           "arg1": value, "space": space}])
         assert r.ok[0]
 
+    # -- coalesced dependent-op chains (doorbell parity) ----------------------
+    # One step = one "doorbell": its ops land atomically at the step
+    # boundary, which is the guarantee the reference builds from chained
+    # WRs + fences (Operation.cpp:351-481).
+
+    def cas_read(self, cas_addr: int, woff: int, expected: int, desired: int,
+                 read_addr: int, cas_space: int = SPACE_LOCK
+                 ) -> tuple[int, bool, np.ndarray]:
+        """CAS a word and read a page in ONE step (rdmaCasRead,
+        Operation.cpp:382-414) — the lock-acquire + page-fetch fusion.
+
+        The read returns the pre-step page snapshot.  That is exactly the
+        fenced post-CAS read when the CAS wins a *lock*: the previous
+        holder's page write and its unlock land in one earlier step, so
+        any snapshot taken at or after the unlock already contains the
+        protected write.  -> (old_word, cas_won, page).
+        """
+        r = self._batch([
+            {"op": OP_CAS, "addr": cas_addr, "woff": woff,
+             "arg0": expected, "arg1": desired, "space": cas_space},
+            {"op": OP_READ, "addr": read_addr},
+        ])
+        assert r.ok[1], "cas_read: bad page address"
+        return int(r.old[0]), bool(r.ok[0]), r.data[1]
+
+    def write_cas(self, waddr: int, woff: int, payload: np.ndarray,
+                  cas_addr: int, cas_woff: int, expected: int, desired: int,
+                  cas_space: int = SPACE_LOCK) -> bool:
+        """Write words and CAS a word in ONE step (rdmaWriteCas,
+        Operation.cpp:449-481).  The CAS linearizes on the pre-step value;
+        both effects land together.  -> cas_won."""
+        payload = np.asarray(payload, np.int32)
+        r = self._batch([
+            {"op": OP_WRITE, "addr": waddr, "woff": woff,
+             "nw": payload.shape[0], "payload": payload},
+            {"op": OP_CAS, "addr": cas_addr, "woff": cas_woff,
+             "arg0": expected, "arg1": desired, "space": cas_space},
+        ])
+        assert r.ok[0], "write_cas: bad write address"
+        return bool(r.ok[1])
+
+    def write_faa(self, waddr: int, woff: int, payload: np.ndarray,
+                  faa_addr: int, faa_woff: int, delta: int,
+                  faa_space: int = SPACE_POOL) -> int:
+        """Write words and fetch-and-add a word in ONE step (rdmaWriteFaa,
+        Operation.cpp:416-447).  -> the FAA's serial pre-value."""
+        payload = np.asarray(payload, np.int32)
+        r = self._batch([
+            {"op": OP_WRITE, "addr": waddr, "woff": woff,
+             "nw": payload.shape[0], "payload": payload},
+            {"op": OP_FAA, "addr": faa_addr, "woff": faa_woff,
+             "arg0": delta, "space": faa_space},
+        ])
+        assert r.ok[0] and r.ok[1], "write_faa: bad address"
+        return int(r.old[1])
+
     # -- observability (write_test.cpp:72-76 parity) -------------------------
 
     def counter_snapshot(self) -> dict[str, int]:

@@ -170,7 +170,14 @@ def main(argv=None) -> dict:
             drain(out)
     drain(out)
     est = max((time.time() - t0) / 4, 1e-4)
-    steps_per_block = 1 if n_nodes > 1 else max(1, int(0.5 / est))
+    # Amortize the drain (~100 ms through the access tunnel) over many
+    # steps, but never let one block overrun the report window: target
+    # block span = max(0.5 s, 32 steps) capped at the window.
+    if n_nodes > 1:
+        steps_per_block = 1
+    else:
+        span = min(max(0.5, 32 * est), a.window)
+        steps_per_block = max(1, int(span / est))
 
     windows = max(1, int(a.secs / a.window))
     notify_info("[bench] est step %.1f ms -> %d steps/block",
