@@ -51,13 +51,20 @@ OP_WRITE_WORD = 3  # write single word arg1 at (addr, woff) / lock word
 OP_CAS = 4         # compare-and-swap word: expected=arg0, desired=arg1
 OP_FAA = 5         # fetch-and-add word: delta=arg0
 OP_READ_WORD = 6   # read single word; reply in old
+OP_MASKED_CAS = 7  # CAS under bitmask arg2 (ibv_exp masked CAS,
+                   #   Operation.cpp:253-283): compare/swap only mask bits
+OP_MASKED_FAA = 8  # fetch-add within the field arg2 (boundary FAA,
+                   #   Operation.cpp:316-348): delta=arg0 pre-shifted to the
+                   #   field; carries never leave the field.  One winner per
+                   #   word per step (losers retry with ok=0)
 
 # Address spaces: pool pages vs the lock table ("on-chip device memory",
 # reference DirectoryConnection.cpp:24-30, DSM::fill_keys_dest DSM.cpp:169).
 SPACE_POOL = 0
 SPACE_LOCK = 1
 
-REQ_FIELDS = ("op", "addr", "woff", "nw", "space", "arg0", "arg1")
+REQ_FIELDS = ("op", "addr", "woff", "nw", "space", "arg0", "arg1",
+              "arg2")
 
 # Counter slots (reference op counters, DSM.cpp:17-21).
 CNT_READ_OPS = 0
@@ -81,31 +88,51 @@ def empty_requests(n: int) -> dict[str, np.ndarray]:
 # Owner-side apply (runs on each node's local shard).
 # ---------------------------------------------------------------------------
 
-def _word_apply(flat, m_cas, m_faa, m_ww, m_rw, widx, arg0, arg1):
+def _word_apply(flat, m_cas, m_faa, m_ww, m_rw, widx, arg0, arg1,
+                m_mcas=None, m_mfaa=None, arg2=None):
     """Linearized word ops on a flat word array.
 
     Returns (new_flat, old[M], ok[M]) where old is: pre-step value for
-    CAS/READ_WORD; serial pre-value for FAA.  ok is the CAS winner flag
-    (True for everything else).
+    CAS/READ_WORD/masked ops; serial pre-value for FAA.  ok is the winner
+    flag for CAS-like ops (True for everything else).
+
+    Masked ops fold into the CAS machinery by rewriting expected/desired
+    against the pre-step value: masked CAS compares and swaps only the
+    ``arg2`` bits; masked FAA always matches and adds ``arg0`` inside the
+    ``arg2`` field, dropping carries that leave it — at most one masked
+    FAA per word lands per step (the NIC serializes; here losers retry).
     """
     M = widx.shape[0]
     W = flat.shape[0]
+    if m_mcas is None:
+        m_mcas = jnp.zeros(M, bool)
+    if m_mfaa is None:
+        m_mfaa = jnp.zeros(M, bool)
+    if arg2 is None:
+        arg2 = jnp.zeros(M, jnp.int32)
     prio = jnp.arange(M, dtype=jnp.int32)
-    any_word = m_cas | m_faa | m_ww | m_rw
+    any_word = m_cas | m_faa | m_ww | m_rw | m_mcas | m_mfaa
     gidx = jnp.where(any_word, widx, 0)
     gidx = jnp.clip(gidx, 0, W - 1)
     cur = flat[gidx]
 
-    # CAS: at most one winner per word per step — the lowest-priority
+    # CAS-like: at most one winner per word per step — the lowest-priority
     # request whose expected value matches (linearization point = step start).
-    eligible = m_cas & (cur == arg0)
-    key_w = jnp.where(m_cas, widx, W)
+    m_caslike = m_cas | m_mcas | m_mfaa
+    exp_eff = jnp.where(m_mcas, (cur & ~arg2) | (arg0 & arg2),
+                        jnp.where(m_mfaa, cur, arg0))
+    des_eff = jnp.where(
+        m_mcas, (cur & ~arg2) | (arg1 & arg2),
+        jnp.where(m_mfaa, (cur & ~arg2) | (((cur & arg2) + arg0) & arg2),
+                  arg1))
+    eligible = m_caslike & (cur == exp_eff)
+    key_w = jnp.where(m_caslike, widx, W)
     perm = jnp.lexsort((prio, ~eligible, key_w))
     sw = key_w[perm]
     head = jnp.concatenate([jnp.ones(1, bool), sw[1:] != sw[:-1]])
     winner_s = head & eligible[perm] & (sw < W)
     winner = jnp.zeros(M, bool).at[perm].set(winner_s)
-    flat = flat.at[jnp.where(winner, widx, W)].set(arg1, mode="drop")
+    flat = flat.at[jnp.where(winner, widx, W)].set(des_eff, mode="drop")
 
     # FAA: all succeed; each sees the serial prefix value (post-CAS state).
     cur2 = flat[gidx]
@@ -125,7 +152,7 @@ def _word_apply(flat, m_cas, m_faa, m_ww, m_rw, widx, arg0, arg1):
     flat = flat.at[jnp.where(m_ww, widx, W)].set(arg1, mode="drop")
 
     old = jnp.where(m_faa, old_faa, cur)
-    ok = jnp.where(m_cas, winner, True)
+    ok = jnp.where(m_caslike, winner, True)
     return flat, old, ok
 
 
@@ -153,6 +180,8 @@ def _apply(pool, locks, counters, req):
     m_faa = (op == OP_FAA) & wordspace & page_ok & word_ok
     m_ww = (op == OP_WRITE_WORD) & wordspace & page_ok & word_ok
     m_rw = (op == OP_READ_WORD) & wordspace & page_ok & word_ok
+    m_mcas = (op == OP_MASKED_CAS) & wordspace & page_ok & word_ok
+    m_mfaa = (op == OP_MASKED_FAA) & wordspace & page_ok & word_ok
     is_write = (op == OP_WRITE) & m_pool & page_ok & write_ok
 
     # READ: snapshot gather of whole pages before any mutation.
@@ -164,11 +193,13 @@ def _apply(pool, locks, counters, req):
     widx_pool = page * PW + woff
     flatpool, old_p, ok_p = _word_apply(
         flatpool, m_cas & m_pool, m_faa & m_pool, m_ww & m_pool, m_rw & m_pool,
-        widx_pool, req["arg0"], req["arg1"])
+        widx_pool, req["arg0"], req["arg1"],
+        m_mcas & m_pool, m_mfaa & m_pool, req["arg2"])
     # ...and on the lock space (lock index rides the addr page field).
     locks, old_l, ok_l = _word_apply(
         locks, m_cas & m_lock, m_faa & m_lock, m_ww & m_lock, m_rw & m_lock,
-        page, req["arg0"], req["arg1"])
+        page, req["arg0"], req["arg1"],
+        m_mcas & m_lock, m_mfaa & m_lock, req["arg2"])
 
     # Page WRITE: word-masked scatter (single-entry write-back support —
     # the reference's write-amplification optimization, Tree.cpp:914-921).
@@ -180,7 +211,8 @@ def _apply(pool, locks, counters, req):
         req["payload"].reshape(-1), mode="drop")
     pool = flatpool.reshape(P, PW)
 
-    handled = is_read | is_write | m_cas | m_faa | m_ww | m_rw
+    handled = (is_read | is_write | m_cas | m_faa | m_ww | m_rw
+               | m_mcas | m_mfaa)
     old = jnp.where(m_lock, old_l, old_p)
     ok = jnp.where(m_lock, ok_l, ok_p) & handled
 
@@ -190,8 +222,8 @@ def _apply(pool, locks, counters, req):
     counters = counters.at[CNT_WRITE_OPS].add(u32(is_write))
     counters = counters.at[CNT_WRITE_WORDS].add(
         jnp.sum(jnp.where(is_write, req["nw"], 0)).astype(jnp.uint32))
-    counters = counters.at[CNT_CAS_OPS].add(u32(m_cas))
-    counters = counters.at[CNT_FAA_OPS].add(u32(m_faa))
+    counters = counters.at[CNT_CAS_OPS].add(u32(m_cas | m_mcas))
+    counters = counters.at[CNT_FAA_OPS].add(u32(m_faa | m_mfaa))
     counters = counters.at[CNT_WW_OPS].add(u32(m_ww))
     return pool, locks, counters, data, old, ok
 
@@ -354,7 +386,11 @@ class DSM:
                     v = np.asarray(v, np.int32)
                     reqs["payload"][slot, :v.shape[0]] = v
                 else:
-                    reqs[k][slot] = v
+                    # accept full uint32 bit patterns (e.g. high-bit masks
+                    # like 0xFFFF0000): wrap to the int32 representation —
+                    # NumPy 2 raises OverflowError on a raw assignment
+                    reqs[k][slot] = np.uint32(
+                        int(v) & 0xFFFFFFFF).astype(np.int32)
         rep = self.step(reqs)
         sl = np.array(slots, np.int64)
         return Replies(data=rep.data[sl], old=rep.old[sl], ok=rep.ok[sl])
@@ -412,6 +448,27 @@ class DSM:
         r = self._batch([{"op": OP_WRITE_WORD, "addr": addr, "woff": woff,
                           "arg1": value, "space": space}])
         assert r.ok[0]
+
+    def masked_cas(self, addr: int, woff: int, expected: int, desired: int,
+                   mask: int, space: int = SPACE_POOL) -> tuple[int, bool]:
+        """CAS only the ``mask`` bits (ibv_exp masked CAS parity,
+        Operation.cpp:253-283): other bits are untouched and ignored in
+        the comparison.  -> (old_word, won)."""
+        r = self._batch([{"op": OP_MASKED_CAS, "addr": addr, "woff": woff,
+                          "arg0": expected, "arg1": desired, "arg2": mask,
+                          "space": space}])
+        return int(r.old[0]), bool(r.ok[0])
+
+    def masked_faa(self, addr: int, woff: int, delta: int, mask: int,
+                   space: int = SPACE_POOL) -> tuple[int, bool]:
+        """Fetch-and-add within the ``mask`` field (boundary FAA parity,
+        Operation.cpp:316-348): ``delta`` must be pre-shifted into the
+        field; carries never cross out of it.  One per word lands per
+        step; a lost race returns won=False to retry.
+        -> (old_word, won)."""
+        r = self._batch([{"op": OP_MASKED_FAA, "addr": addr, "woff": woff,
+                          "arg0": delta, "arg2": mask, "space": space}])
+        return int(r.old[0]), bool(r.ok[0])
 
     # -- coalesced dependent-op chains (doorbell parity) ----------------------
     # One step = one "doorbell": its ops land atomically at the step

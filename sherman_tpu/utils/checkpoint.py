@@ -1,0 +1,87 @@
+"""Checkpoint / resume of the whole DSM cluster state.
+
+The reference has NO durability story (SURVEY.md §5: "Checkpoint /
+resume. Absent.") — a crashed cluster loses the index.  This module goes
+beyond parity: one call snapshots everything a cluster needs to come
+back — the sharded pool (which contains every page AND the root-pointer
+meta words), the lock table, op counters, and each directory's allocator
+bump state — into a single ``.npz``; ``restore`` rebuilds a live Cluster
+on any mesh of the same ``machine_nr``.
+
+Client-side chunk leases (LocalAllocator tails) are deliberately NOT
+saved: clients re-register after restore and lease fresh chunks.  The
+abandoned tails are unreachable pages — the same class of leak as the
+reference's no-op ``free`` (DSM.h:226), bounded by one chunk per client.
+
+Locks are saved as-is; a checkpoint taken mid-operation may hold locks
+whose owners are gone, so ``restore(clear_locks=True)`` (default) zeroes
+the table — valid because restore is a cluster-wide restart: no client
+of the old incarnation survives.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from sherman_tpu.config import DSMConfig
+
+_CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
+               "step_capacity", "host_step_capacity", "chunk_pages")
+
+
+def checkpoint(cluster, path: str) -> None:
+    """Write the cluster's full state to ``path`` (.npz).
+
+    Single-process clusters only (every shard addressable from this
+    host): a multi-host deployment needs per-host shard files + a
+    gathered manifest, which is future work.
+    """
+    if cluster.keeper.is_multihost:
+        raise NotImplementedError(
+            "checkpoint of a multi-host cluster is not supported yet: "
+            "the pool spans non-addressable devices; snapshot per host")
+    dsm = cluster.dsm
+    cfg = {f: getattr(cluster.cfg, f) for f in _CFG_FIELDS}
+    np.savez_compressed(
+        path,
+        cfg=np.frombuffer(json.dumps(cfg).encode(), np.uint8),
+        pool=np.asarray(dsm.pool),
+        locks=np.asarray(dsm.locks),
+        counters=np.asarray(dsm.counters),
+        dir_nodes=np.asarray([d.node_id for d in cluster.directories],
+                             np.int64),
+        dir_next=np.asarray(
+            [d.allocator._next for d in cluster.directories], np.int64),
+        dir_root=np.asarray(
+            [[d.root_ptr, d.root_level] for d in cluster.directories],
+            np.int64),
+    )
+
+
+def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
+    """Rebuild a live Cluster from a checkpoint.  -> Cluster."""
+    import jax
+
+    from sherman_tpu.cluster import Cluster
+
+    with np.load(path) as z:
+        cfg = DSMConfig(**json.loads(bytes(z["cfg"]).decode()))
+        cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
+        dsm = cluster.dsm
+        dsm.pool = jax.device_put(z["pool"], dsm.shard)
+        locks = z["locks"]
+        if clear_locks:
+            locks = np.zeros_like(locks)
+        dsm.locks = jax.device_put(locks, dsm.shard)
+        dsm.counters = jax.device_put(z["counters"], dsm.shard)
+        by_node = {int(n): i for i, n in enumerate(z["dir_nodes"])}
+        for d in cluster.directories:
+            i = by_node.get(d.node_id)
+            if i is None:
+                continue  # node had no directory in the saved cluster
+            d.allocator._next = int(z["dir_next"][i])
+            d.root_ptr = int(z["dir_root"][i][0])
+            d.root_level = int(z["dir_root"][i][1])
+    return cluster

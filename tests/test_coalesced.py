@@ -1,0 +1,128 @@
+"""Coalesced dependent-op chains (rdmaCasRead/WriteFaa/WriteCas parity)."""
+
+import numpy as np
+
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import dsm as D
+
+
+def _dsm(n=4):
+    return D.DSM(DSMConfig(machine_nr=n, pages_per_node=64,
+                           locks_per_node=128, step_capacity=64,
+                           chunk_pages=16))
+
+
+def test_cas_read_returns_page_with_win(eight_devices):
+    dsm = _dsm()
+    page_addr = bits.make_addr(2, 5)
+    la = bits.make_addr(1, 9)
+    pg = np.arange(256, dtype=np.int32)
+    dsm.write_page(page_addr, pg)
+    old, won, got = dsm.cas_read(la, 0, 0, 77, page_addr)
+    assert won and old == 0
+    np.testing.assert_array_equal(got, pg)
+    # second acquire loses but still returns the page snapshot
+    old, won, got = dsm.cas_read(la, 0, 0, 88, page_addr)
+    assert not won and old == 77
+    np.testing.assert_array_equal(got, pg)
+
+
+def test_write_cas_lands_together(eight_devices):
+    dsm = _dsm()
+    waddr = bits.make_addr(3, 2)
+    la = bits.make_addr(0, 4)
+    won = dsm.write_cas(waddr, 10, np.array([42, 43], np.int32),
+                        la, 0, 0, 5)
+    assert won
+    page = dsm.read_page(waddr)
+    assert page[10] == 42 and page[11] == 43
+    assert dsm.read_word(la, 0, space=D.SPACE_LOCK) == 5
+    # losing CAS still writes (write is unconditional in the chain)
+    won = dsm.write_cas(waddr, 10, np.array([1], np.int32), la, 0, 0, 9)
+    assert not won
+    assert dsm.read_page(waddr)[10] == 1
+
+
+def test_write_faa_serial_prevalue(eight_devices):
+    dsm = _dsm()
+    waddr = bits.make_addr(1, 3)
+    fa = bits.make_addr(2, 7)
+    assert dsm.write_faa(waddr, 0, np.array([9], np.int32), fa, 1, 5) == 0
+    assert dsm.write_faa(waddr, 0, np.array([8], np.int32), fa, 1, 5) == 5
+    assert dsm.read_word(fa, 1) == 10
+
+
+def test_tree_lock_and_read_fused(eight_devices):
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.models.btree import Tree
+
+    cfg = DSMConfig(machine_nr=2, pages_per_node=128, locks_per_node=64,
+                    step_capacity=64, chunk_pages=16)
+    tree = Tree(Cluster(cfg))
+    tree.insert(10, 100)
+    addr, pg, _ = tree._descend(10, 0)
+    la, pg2 = tree._lock_and_read(addr)
+    np.testing.assert_array_equal(pg, pg2)
+    # lock word is held by our tag until unlock
+    assert tree.dsm.read_word(la, 0, space=D.SPACE_LOCK) == tree.ctx.tag
+    tree._unlock(la)
+    assert tree.dsm.read_word(la, 0, space=D.SPACE_LOCK) == 0
+
+
+def test_masked_cas(eight_devices):
+    dsm = _dsm()
+    a = bits.make_addr(1, 2)
+    dsm.write_word(a, 0, 0b1111_0000)
+    # compare/swap only the low nibble: high nibble untouched & ignored
+    old, won = dsm.masked_cas(a, 0, 0b0000, 0b1010, 0b1111)
+    assert won and old == 0b1111_0000
+    assert dsm.read_word(a, 0) == 0b1111_1010
+    # mismatch under the mask fails
+    old, won = dsm.masked_cas(a, 0, 0b0000, 0b0101, 0b1111)
+    assert not won
+    assert dsm.read_word(a, 0) == 0b1111_1010
+
+
+def test_masked_cas_single_winner_per_step(eight_devices):
+    dsm = _dsm()
+    a = bits.make_addr(2, 3)
+    rows = [{"op": D.OP_MASKED_CAS, "addr": a, "woff": 0,
+             "arg0": 0, "arg1": i + 1, "arg2": 0xFF} for i in range(5)]
+    rep = dsm._batch(rows)
+    assert rep.ok.sum() == 1
+    assert dsm.read_word(a, 0) in range(1, 6)
+
+
+def test_masked_faa_field_wraps(eight_devices):
+    dsm = _dsm()
+    a = bits.make_addr(0, 7)
+    # 4-bit field at bits 4-7; neighbor bits must survive a wrap
+    dsm.write_word(a, 0, (0b1 << 8) | (0xF << 4) | 0b1111)
+    old, won = dsm.masked_faa(a, 0, 1 << 4, 0xF0)
+    assert won
+    v = dsm.read_word(a, 0)
+    assert (v >> 4) & 0xF == 0          # field wrapped 15 -> 0
+    assert v & 0xF == 0b1111            # low bits untouched
+    assert (v >> 8) & 1 == 1            # high bit untouched (no carry out)
+
+
+def test_masked_faa_one_per_step(eight_devices):
+    dsm = _dsm()
+    a = bits.make_addr(3, 1)
+    rows = [{"op": D.OP_MASKED_FAA, "addr": a, "woff": 0,
+             "arg0": 1, "arg2": 0xFF} for _ in range(4)]
+    rep = dsm._batch(rows)
+    assert rep.ok.sum() == 1            # NIC-serialized: one lands per step
+    assert dsm.read_word(a, 0) == 1
+
+
+def test_masked_cas_high_bit_mask(eight_devices):
+    """Masks with bit 31 set (e.g. 0xFFFF0000) must round-trip through the
+    int32 request arrays without OverflowError."""
+    dsm = _dsm()
+    a = bits.make_addr(1, 5)
+    old, won = dsm.masked_cas(a, 0, 0, 0xABCD0000, 0xFFFF0000)
+    assert won
+    v = dsm.read_word(a, 0) & 0xFFFFFFFF
+    assert v == 0xABCD0000
