@@ -64,8 +64,12 @@ _PW = C.PAGE_WORDS
 # ---------------------------------------------------------------------------
 
 def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
-                 iters: int, axis_name: str = AXIS, start=None):
-    """Walk each active key from ``root`` to its leaf (level 0, in fence).
+                 iters: int, axis_name: str = AXIS, start=None,
+                 stop_level: int = 0):
+    """Walk each active key from ``root`` to its ``stop_level`` page
+    (default: the leaf, level 0, in fence).  ``stop_level=1`` is the
+    parent-maintenance descent (internal_page_store's target,
+    Tree.cpp:980-987).
 
     Runs inside shard_map; khi/klo are this node's [B] key shard.  ``iters``
     is a static trip count (tree height + sibling-chase budget).  ``start``
@@ -91,7 +95,7 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
                                       axis_name=axis_name, active=~done)
         lvl = layout.h_level(pages)
         chase = layout.needs_sibling_chase(pages, khi, klo)
-        at_leaf = (lvl == 0) & ~chase
+        at_leaf = (lvl == stop_level) & ~chase
         nxt = jnp.where(chase, layout.h_sibling(pages),
                         layout.internal_pick_child(pages, khi, klo))
         step_ok = ok & ~done
@@ -261,18 +265,33 @@ def search_spmd(pool, counters, khi, klo, root, active, start=None, *,
 # Owner-side leaf apply: the write fast path.
 # ---------------------------------------------------------------------------
 
-def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
+def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
+                    cfg: DSMConfig):
     """Apply routed insert requests to this node's leaf pages.
 
     inc: dict of [M] arrays — active, addr (leaf), khi, klo, vhi, vlo.
-    Returns (pool, counters, status [M]).
+    fresh: optional [F] int32 pre-allocated LOCAL page addrs (0 = no
+    grant) enabling device-side leaf splits.
+    Returns (pool, counters, status [M]) — plus a split log dict when
+    ``fresh`` is given.
 
-    Mirrors ``leaf_page_store`` (Tree.cpp:828-921) minus splits: in-place
-    update of an existing key, or insert into a free slot, with the
-    single-entry write-back (only the touched 6-word entry + version words
-    are written).  Same-key requests are deduped (stable request order:
+    Mirrors ``leaf_page_store`` (Tree.cpp:828-921): in-place update of an
+    existing key, or insert into a free slot, with the single-entry
+    write-back (only the touched 6-word entry + version words are
+    written).  Same-key requests are deduped (stable request order:
     lowest (source, slot) wins) — the intra-step linearization that
     replaces local-lock hand-over.
+
+    Splits (Tree.cpp:922-963, TPU-shaped): the first overflowing insert
+    winner of a page (its in-page rank equals the page's free-slot count)
+    becomes the page's *splitter* and is granted a fresh page; the owner
+    sorts the 41 slots + pending entry, writes the upper half to the
+    fresh right sibling and rewrites the left page with fences/sibling
+    updated — the B-link makes the split correct before any parent knows
+    (the log lets the host insert parent entries lazily, which is why
+    splits don't need the recursive ascent on-device).  Every other write
+    to a splitting page retries next step: the split rewrites the whole
+    page from the pre-step snapshot, so co-applying would be lost.
     """
     M = inc["addr"].shape[0]
     P = pool.shape[0]
@@ -341,24 +360,49 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     grp_winner_applied = (enc & 1) == 1
     # one scatter ships every sorted-space verdict back: -4 loser whose
     # winner did not apply (retry), -3 dropped, -2 superseded-final,
-    # -1 winner-found (update), r>=0 winner insert rank
+    # -1 winner-found (update), 0 <= r < SPLIT_CODE winner insert rank,
+    # SPLIT_CODE + f granted splitter using fresh slot f.  Ranks are
+    # strictly below M (at most M requests per page), so M is a safe
+    # static boundary for any batch geometry.
+    SPLIT_CODE = M
     code_s = jnp.where(
         ~sok, -3,
         jnp.where(~winner_s, jnp.where(grp_winner_applied, -2, -4),
                   jnp.where(sfound, -1, rank_s)))
+    if fresh is not None:
+        F = fresh.shape[0]
+        # the page's FIRST overflowing insert (rank == free count) splits
+        splitter_s = need_ins_s & (rank_s == sfreec)
+        sf_idx = jnp.cumsum(splitter_s.astype(jnp.int32)) - 1
+        grant = fresh[jnp.clip(sf_idx, 0, F - 1)]
+        granted_s = splitter_s & (sf_idx < F) & (grant != 0)
+        code_s = jnp.where(granted_s, SPLIT_CODE + sf_idx, code_s)
     code = jnp.full(M, -3, jnp.int32).at[sidx].set(code_s)
-    winner = code >= -1
+    splitter = code >= SPLIT_CODE
+    winner_upd = code == -1
+    winner_ins = (code >= 0) & ~splitter
     superseded = code == -2
     loser_retry = code == -4
-    need_ins = code >= 0
-    rank = jnp.maximum(code, 0)
+    rank = jnp.where(winner_ins, code, 0)
+    have_slot = freec >= (rank + 1)
+
+    if fresh is not None:
+        # every OTHER request on a splitting page must retry: the split
+        # rewrites the page from the pre-step snapshot
+        has_split = jnp.zeros(P + 1, bool).at[
+            jnp.where(splitter, safe_page, P)].set(True, mode="drop")
+        page_splitting = has_split[safe_page]
+    else:
+        page_splitting = jnp.zeros(M, bool)
+
+    suppressed = page_splitting & (winner_upd | winner_ins | superseded)
+    need_ins = winner_ins & ~page_splitting
+    full = need_ins & ~have_slot
+    applied = (winner_upd | (winner_ins & have_slot)) & ~page_splitting
+    superseded = superseded & ~page_splitting
 
     target = (rank + 1)[:, None]
     islot = jnp.argmax(cumfree >= target, axis=-1)
-    have_slot = freec >= (rank + 1)
-    full = need_ins & ~have_slot
-
-    applied = winner & (found | (need_ins & have_slot))
     slot = jnp.where(found, fslot, islot)
 
     # --- single-entry write-back scatter -----------------------------------
@@ -393,20 +437,130 @@ def leaf_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     flat = flat.at[idx.reshape(-1)].set(ent.reshape(-1), mode="drop")
     pool = flat.reshape(P, _PW)
 
+    # --- device-side splits ------------------------------------------------
+    if fresh is not None:
+        pool, counters, log = _leaf_split_apply(
+            pool, counters, pg, inc, splitter, code - SPLIT_CODE, fresh,
+            safe_page, cfg=cfg)
+
     # --- status ------------------------------------------------------------
     status = jnp.full(M, ST_INVALID, jnp.int32)
     status = jnp.where(act, ST_BAD, status)
     status = jnp.where(act & sane & locked, ST_LOCKED, status)
-    status = jnp.where(loser_retry, ST_RETRY, status)
+    status = jnp.where(loser_retry | suppressed, ST_RETRY, status)
     status = jnp.where(superseded, ST_SUPERSEDED, status)
     status = jnp.where(full, ST_FULL, status)
-    status = jnp.where(applied, ST_APPLIED, status)
+    status = jnp.where(applied | splitter, ST_APPLIED, status)
 
     u32 = lambda m: jnp.sum(m.astype(jnp.uint32))
     counters = counters.at[D.CNT_WRITE_OPS].add(u32(applied))
     counters = counters.at[D.CNT_WRITE_WORDS].add(
         u32(applied) * jnp.uint32(C.LEAF_ENTRY_WORDS + 2))
+    if fresh is not None:
+        return pool, counters, status, log
     return pool, counters, status
+
+
+def _leaf_split_apply(pool, counters, pg, inc, splitter, fidx, fresh,
+                      safe_page, *, cfg: DSMConfig):
+    """Execute granted leaf splits in a compacted [F] buffer.
+
+    pg is the [M, PW] pre-step page snapshot; splitter/fidx select granted
+    rows and their fresh-page slots.  Builds both halves as whole pages
+    (a split is a full-page rewrite in the reference too, Tree.cpp:922-963)
+    and returns a log for lazy parent insertion + index-cache refresh.
+    """
+    M = splitter.shape[0]
+    P = pool.shape[0]
+    F = fresh.shape[0]
+    CAP = C.LEAF_CAP
+
+    sidx2 = jnp.nonzero(splitter, size=F, fill_value=M)[0].astype(jnp.int32)
+    valid = sidx2 < M
+    ci = jnp.clip(sidx2, 0, M - 1)
+    spg = pg[ci]                                   # [F, PW] snapshots
+    pkhi, pklo = inc["khi"][ci], inc["klo"][ci]
+    pvhi, pvlo = inc["vhi"][ci], inc["vlo"][ci]
+    left_row = safe_page[ci]
+    new_addr = fresh[jnp.clip(fidx[ci], 0, F - 1)]
+    right_row = jnp.clip(bits.addr_page(new_addr), 0, P - 1)
+    valid = valid & (new_addr != 0)
+
+    # sort the 41 slots + pending entry by key; dead slots sort last
+    sv = layout.leaf_slots_view(spg)
+    live = jnp.concatenate(
+        [layout.leaf_slot_used(spg), jnp.ones((F, 1), bool)], axis=1)
+    cat = lambda blk, pend: jnp.concatenate([blk, pend[:, None]], axis=1)
+    k_hi, k_lo = cat(sv["khi"], pkhi), cat(sv["klo"], pklo)
+    v_hi, v_lo = cat(sv["vhi"], pvhi), cat(sv["vlo"], pvlo)
+    inf = jnp.int32(0x7FFFFFFF)
+    gkh_key = jnp.where(live, bits._ux(k_hi), inf)
+    gkl_key = jnp.where(live, bits._ux(k_lo), inf)
+    # dead slots sort last, so sorted column j is live iff j < n
+    _, _, gkh, gkl, gvh, gvl = lax.sort(
+        (gkh_key, gkl_key, k_hi, k_lo, v_hi, v_lo), num_keys=2,
+        dimension=1)                               # [F, CAP+1] each
+
+    n = jnp.sum(live, axis=1).astype(jnp.int32)    # live incl pending
+    m = n // 2                                     # left keeps m entries
+    cols = jnp.arange(CAP + 1, dtype=jnp.int32)[None, :]
+    # split key = first right entry (one-hot: column == m)
+    at_m = cols == m[:, None]
+    skhi = jnp.sum(jnp.where(at_m, gkh, 0), axis=1)
+    sklo = jnp.sum(jnp.where(at_m, gkl, 0), axis=1)
+
+    colsC = jnp.arange(CAP, dtype=jnp.int32)[None, :]
+    l_live = colsC < m[:, None]
+    ridx = jnp.clip(m[:, None] + colsC, 0, CAP)
+    r_live = colsC < (n - m)[:, None]
+    take = lambda a: jnp.take_along_axis(a, ridx, axis=1)
+
+    def build(blk_khi, blk_klo, blk_vhi, blk_vlo, blk_live, ver, low_hi,
+              low_lo, high_hi, high_lo, sibling):
+        page = jnp.zeros((F, _PW), jnp.int32)
+        page = page.at[:, C.W_FRONT_VER].set(ver)
+        page = page.at[:, C.W_REAR_VER].set(ver)
+        page = page.at[:, C.W_SIBLING].set(sibling)
+        page = page.at[:, C.W_LOW_HI].set(low_hi)
+        page = page.at[:, C.W_LOW_LO].set(low_lo)
+        page = page.at[:, C.W_HIGH_HI].set(high_hi)
+        page = page.at[:, C.W_HIGH_LO].set(high_lo)
+        lv = blk_live.astype(jnp.int32)
+        page = page.at[:, C.L_FVER_W:C.L_FVER_W + CAP].set(lv)
+        page = page.at[:, C.L_RVER_W:C.L_RVER_W + CAP].set(lv)
+        z = lambda b: jnp.where(blk_live, b, 0)
+        page = page.at[:, C.L_KHI_W:C.L_KHI_W + CAP].set(z(blk_khi))
+        page = page.at[:, C.L_KLO_W:C.L_KLO_W + CAP].set(z(blk_klo))
+        page = page.at[:, C.L_VHI_W:C.L_VHI_W + CAP].set(z(blk_vhi))
+        page = page.at[:, C.L_VLO_W:C.L_VLO_W + CAP].set(z(blk_vlo))
+        return page
+
+    old_ver = spg[:, C.W_FRONT_VER]
+    bumped = (old_ver + 1) & 0x7FFFFFFF
+    lver = jnp.where(bumped == 0, 1, bumped)
+    old_hhi, old_hlo = spg[:, C.W_HIGH_HI], spg[:, C.W_HIGH_LO]
+    left = build(gkh[:, :CAP], gkl[:, :CAP], gvh[:, :CAP], gvl[:, :CAP],
+                 l_live, lver, spg[:, C.W_LOW_HI], spg[:, C.W_LOW_LO],
+                 skhi, sklo, new_addr)
+    right = build(take(gkh), take(gkl), take(gvh), take(gvl), r_live,
+                  jnp.ones(F, jnp.int32), skhi, sklo, old_hhi, old_hlo,
+                  spg[:, C.W_SIBLING])
+
+    # right page first in program order is irrelevant — both land at the
+    # step boundary (the atomic-split guarantee, stronger than the
+    # reference's ordered sibling-then-page writes)
+    pool = pool.at[jnp.where(valid, right_row, P)].set(right, mode="drop")
+    pool = pool.at[jnp.where(valid, left_row, P)].set(left, mode="drop")
+
+    u32 = lambda x: jnp.sum(x.astype(jnp.uint32))
+    counters = counters.at[D.CNT_WRITE_OPS].add(u32(valid) * jnp.uint32(2))
+    counters = counters.at[D.CNT_WRITE_WORDS].add(
+        u32(valid) * jnp.uint32(2 * _PW))
+
+    log = {"valid": valid, "skhi": skhi, "sklo": sklo,
+           "new_addr": jnp.where(valid, new_addr, 0),
+           "old_hhi": old_hhi, "old_hlo": old_hlo}
+    return pool, counters, log
 
 
 def _resolve_leaves(pool, counters, khi, klo, root, active, start, *,
@@ -436,16 +590,20 @@ def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
     directly; multi-node bucketizes by owner, all_to_all-exchanges the
     request fields, applies on the owner, and routes statuses back.
     ``fields`` are the per-request arrays ``apply_fn`` expects beyond
-    active/addr.  Returns (pool, counters, status_raw [B]) where
+    active/addr.  Returns (pool, counters, status_raw [B], extra) where
     status_raw is the apply status for eligible routed rows and ST_RETRY
     for rows that missed the bucket capacity (full RDMA send queue moral
-    equivalent) — callers mask inactive rows to ST_INVALID.
+    equivalent) — callers mask inactive rows to ST_INVALID.  ``extra`` is
+    the apply_fn's optional 4th output (e.g. the split log), which stays
+    owner-node-local (no reply routing).
     """
     N, cap = cfg.machine_nr, cfg.step_capacity
     if N == 1:
         inc = {"active": eligible, "addr": addr, **fields}
-        pool, counters, st = apply_fn(pool, locks, counters, inc, cfg=cfg)
-        return pool, counters, jnp.where(eligible, st, ST_RETRY)
+        out = apply_fn(pool, locks, counters, inc, cfg=cfg)
+        pool, counters, st = out[:3]
+        extra = out[3] if len(out) > 3 else None
+        return pool, counters, jnp.where(eligible, st, ST_RETRY), extra
 
     dest = bits.addr_node(addr)
     bucket_idx, routed = transport.bucketize(dest, eligible, N, cap)
@@ -453,28 +611,39 @@ def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
     out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
            for k, v in out_fields.items()}
     inc = transport.exchange(out, axis_name)
-    pool, counters, st = apply_fn(pool, locks, counters, inc, cfg=cfg)
+    aout = apply_fn(pool, locks, counters, inc, cfg=cfg)
+    pool, counters, st = aout[:3]
+    extra = aout[3] if len(aout) > 3 else None
     rep = transport.exchange({"st": st}, axis_name)
     safe_b = jnp.where(routed, bucket_idx, 0)
-    return pool, counters, jnp.where(eligible & routed, rep["st"][safe_b],
-                                     ST_RETRY)
+    return (pool, counters,
+            jnp.where(eligible & routed, rep["st"][safe_b], ST_RETRY),
+            extra)
 
 
 def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
-                     start=None, *, cfg: DSMConfig, iters: int,
+                     start=None, fresh=None, *, cfg: DSMConfig, iters: int,
                      axis_name: str = AXIS):
     """One batched insert step: descend + route to owners + leaf apply.
 
-    Returns (pool, counters, status [B]) per this node's key shard.
+    With ``fresh`` (per-node pre-allocated pages), full leaves split
+    owner-side and a split log is returned for lazy parent insertion.
+    Returns (pool, counters, status [B]) per this node's key shard —
+    plus the log when ``fresh`` is given.
     """
     counters, done, addr, _, _, _ = _resolve_leaves(
         pool, counters, khi, klo, root, active, start, cfg=cfg, iters=iters,
         axis_name=axis_name)
-    pool, counters, status = _route_and_apply(
-        pool, locks, counters, leaf_apply_spmd, addr, done,
+    apply_fn = (functools.partial(leaf_apply_spmd, fresh=fresh)
+                if fresh is not None else leaf_apply_spmd)
+    pool, counters, status, log = _route_and_apply(
+        pool, locks, counters, apply_fn, addr, done,
         {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo},
         cfg=cfg, axis_name=axis_name)
-    return pool, counters, jnp.where(active, status, ST_INVALID)
+    status = jnp.where(active, status, ST_INVALID)
+    if fresh is not None:
+        return pool, counters, status, log
+    return pool, counters, status
 
 
 # ---------------------------------------------------------------------------
@@ -554,7 +723,7 @@ def delete_step_spmd(pool, locks, counters, khi, klo, root, active,
     counters, done, addr, _, _, _ = _resolve_leaves(
         pool, counters, khi, klo, root, active, start, cfg=cfg, iters=iters,
         axis_name=axis_name)
-    pool, counters, status = _route_and_apply(
+    pool, counters, status, _ = _route_and_apply(
         pool, locks, counters, leaf_delete_apply_spmd, addr, done,
         {"khi": khi, "klo": klo}, cfg=cfg, axis_name=axis_name)
     return pool, counters, jnp.where(active, status, ST_INVALID)
@@ -594,7 +763,7 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
     rvh = jnp.where(found, rvh, 0)
     rvl = jnp.where(found, rvl, 0)
 
-    pool, counters, status = _route_and_apply(
+    pool, counters, status, _ = _route_and_apply(
         pool, locks, counters, leaf_apply_spmd, addr, done & active_w,
         {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo},
         cfg=cfg, axis_name=axis_name)
@@ -621,6 +790,13 @@ class BatchedEngine:
         self.cfg = tree.cfg
         self.tcfg = tcfg if tcfg is not None else TreeConfig()
         self.B = batch_per_node
+        # device-split grant slots per node per insert round; unused grants
+        # are cached host-side and re-offered (free() is a no-op, so
+        # abandoning them would leak pages every round)
+        self.split_slots = min(256, batch_per_node)
+        self._fresh_cache: dict[int, list[int]] = {}
+        self._pending_parents: list[tuple[int, int]] = []
+        self._parent_descend_cache: dict = {}
         self.router = None
         self._search_cache: dict = {}
         self._insert_cache: dict = {}
@@ -631,8 +807,13 @@ class BatchedEngine:
         self._rep = jax.sharding.PartitionSpec()
 
     def _iters(self) -> int:
-        # static descent budget: height + chase slack
-        return self.tree._root_level + 1 + self.tcfg.sibling_chase_budget
+        # STATIC descent budget: max height + chase slack.  Deliberately
+        # NOT tied to the live root level — that would change the compiled
+        # program shape on every root growth, and a recompile through the
+        # remote-compile path costs ~minutes.  Single-node loops exit
+        # early dynamically (while_loop), so the slack is free there; the
+        # multi-node fori pays it only on CPU test meshes.
+        return self.tcfg.max_level + self.tcfg.sibling_chase_budget
 
     def attach_router(self, log2_buckets: int | None = None):
         """Create + seed the device index cache (see router.py).  Uses the
@@ -674,6 +855,8 @@ class BatchedEngine:
         return fn
 
     def _get_insert(self, iters: int, with_start: bool):
+        """Insert step with the device-split path: takes a per-node fresh
+        page array and returns the split log alongside statuses."""
         key = (iters, with_start)
         fn = self._insert_cache.get(key)
         if fn is None:
@@ -681,12 +864,24 @@ class BatchedEngine:
             in_specs = [spec, spec, spec, spec, spec, spec, spec, rep, spec]
             if with_start:
                 in_specs.append(spec)
+            in_specs.append(spec)  # fresh pages [N*F]
+            log_spec = {k: spec for k in ("valid", "skhi", "sklo",
+                                          "new_addr", "old_hhi",
+                                          "old_hlo")}
+
+            def kernel(pool, locks, counters, khi, klo, vhi, vlo, root,
+                       active, *rest):
+                start = rest[0] if with_start else None
+                fresh = rest[-1]
+                return insert_step_spmd(
+                    pool, locks, counters, khi, klo, vhi, vlo, root, active,
+                    start, fresh, cfg=self.cfg, iters=iters)
+
             sm = jax.shard_map(
-                functools.partial(insert_step_spmd, cfg=self.cfg,
-                                  iters=iters),
+                kernel,
                 mesh=self.dsm.mesh,
                 in_specs=tuple(in_specs),
-                out_specs=(spec, spec, spec),
+                out_specs=(spec, spec, spec, log_spec),
                 check_vma=False)
             fn = jax.jit(sm, donate_argnums=(0, 2))
             self._insert_cache[key] = fn
@@ -855,12 +1050,193 @@ class BatchedEngine:
         for i in range(0, n, total):
             self._insert_chunk(keys[i:i + total], values[i:i + total],
                                max_rounds, stats)
+        self.flush_parents()
         return stats
 
+    def _get_parent_descend(self, iters: int):
+        fn = self._parent_descend_cache.get(iters)
+        if fn is None:
+            spec, rep = self._spec, self._rep
+            sm = jax.shard_map(
+                functools.partial(descend_spmd, cfg=self.cfg, iters=iters,
+                                  stop_level=1),
+                mesh=self.dsm.mesh,
+                in_specs=(spec, spec, spec, spec, rep, spec),
+                out_specs=(spec, spec, spec, spec),
+                check_vma=False)
+            fn = jax.jit(sm, donate_argnums=(1,))
+            self._parent_descend_cache[iters] = fn
+        return fn
+
+    def _descend_level1(self, keys: np.ndarray):
+        """Batched root -> level-1 descent.  -> (addrs [n], done [n])."""
+        n = keys.shape[0]
+        total = self.cfg.machine_nr * self.B
+        if n > total:
+            parts = [self._descend_level1(keys[i:i + total])
+                     for i in range(0, n, total)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        khi, klo = bits.keys_to_pairs(keys)
+        (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
+        active, _ = self._pad(np.ones(n, bool))
+        fn = self._get_parent_descend(self._iters())
+        self.dsm.counters, addr, _, done = fn(
+            self.dsm.pool, self.dsm.counters, self._shard(khi),
+            self._shard(klo), np.int32(self.tree._root_addr),
+            self._shard(active))
+        return np.asarray(addr)[:n], np.asarray(done)[:n]
+
+    def flush_parents(self) -> int:
+        """Insert deferred parent entries for device-side splits — the
+        internal_page_store ascent (Tree.cpp:980-987), BATCHED: one
+        device descent to level 1 for every pending key, one step that
+        lock+reads every touched parent page (coalesced cas_read rows),
+        a host-side sorted merge, and one step writing every rebuilt
+        page together with all unlocks.  Searches are correct without
+        this — the B-link covers the new pages — it only trims sibling
+        chases.  Returns the number of entries flushed."""
+        import collections
+        import os
+        import time as _t
+        dbg = os.environ.get("SHERMAN_DEBUG_INSERT")
+
+        total = len(self._pending_parents)
+        if not total:
+            return 0
+        pend = self._pending_parents
+        self._pending_parents = []
+        tree, dsm = self.tree, self.dsm
+        for _attempt in range(8):
+            if not pend:
+                break
+            if dbg:
+                print(f"[flush] attempt {_attempt} pend={len(pend)} "
+                      f"t={_t.time():.1f}", flush=True)
+            tree._refresh_root()
+            if tree._root_level < 1:
+                break  # root is a leaf: the host path grows it
+            keysu = np.array([k for k, _ in pend], np.uint64)
+            addrs, done = self._descend_level1(keysu)
+
+            # lock + read every unique parent page in ONE step; two pages
+            # hashing to one lock word -> second CAS loses -> next attempt
+            uaddr = [int(a) for a in np.unique(addrs[done])]
+            rows = []
+            for a in uaddr:
+                la = tree._lock_word_addr(a)
+                rows.append({"op": D.OP_CAS, "addr": la, "woff": 0,
+                             "arg0": 0, "arg1": tree.ctx.tag,
+                             "space": D.SPACE_LOCK})
+                rows.append({"op": D.OP_READ, "addr": a})
+            rep = dsm._batch(rows)
+            pages, unlock_rows = {}, []
+            for i, a in enumerate(uaddr):
+                if bool(rep.ok[2 * i]):
+                    pages[a] = np.array(rep.data[2 * i + 1])
+                    unlock_rows.append(tree._unlock_row(
+                        tree._lock_word_addr(a)))
+
+            group = collections.defaultdict(list)
+            next_pend = []
+            for (k, c), a, d in zip(pend, addrs, done):
+                if d and int(a) in pages:
+                    group[int(a)].append((int(k), int(c)))
+                else:
+                    next_pend.append((k, c))
+
+            write_rows, host_fb = [], []
+            for a, ents_new in group.items():
+                pg = pages[a]
+                lo, hi = layout.np_lowest(pg), layout.np_highest(pg)
+                stay = [(k, c) for k, c in ents_new if lo <= k < hi]
+                next_pend += [(k, c) for k, c in ents_new
+                              if not (lo <= k < hi)]  # fence moved: redo
+                if not stay:
+                    continue
+                ents = sorted(set(layout.np_internal_entries(pg) + stay))
+                if len(ents) > C.INTERNAL_CAP:
+                    host_fb += stay  # internal split needed: per-key path
+                    continue
+                ver = int(pg[C.W_FRONT_VER]) + 1
+                newpg = layout.np_empty_page(
+                    1, lo, hi, sibling=int(pg[C.W_SIBLING]),
+                    leftmost=int(pg[C.W_LEFTMOST]), version=ver)
+                for i, (k, c) in enumerate(ents):
+                    layout.np_internal_set_entry(newpg, i, k, c)
+                newpg[C.W_NKEYS] = len(ents)
+                write_rows.append({"op": D.OP_WRITE, "addr": a, "woff": 0,
+                                   "nw": C.PAGE_WORDS, "payload": newpg})
+            if write_rows or unlock_rows:
+                dsm.write_rows(write_rows + unlock_rows)
+            if dbg:
+                print(f"[flush] wrote={len(write_rows)} host_fb={len(host_fb)} "
+                      f"next={len(next_pend)} t={_t.time():.1f}", flush=True)
+            for k, c in host_fb:
+                tree._insert_parent(k, c, 1, {})
+            pend = next_pend
+        if dbg and pend:
+            print(f"[flush] per-key fallback for {len(pend)}", flush=True)
+        for k, c in pend:
+            tree._insert_parent(int(k), int(c), 1, {})
+        return total
+
+    def _fill_fresh(self, grant: bool) -> np.ndarray:
+        """Per-node fresh-page grants for the next insert round ([N*F],
+        0 = no grant).  Grants are node-local pages (a split's right
+        sibling is written by the page's owner).  Unconsumed grants stay
+        in the host cache for the next round."""
+        N, F = self.cfg.machine_nr, self.split_slots
+        arr = np.zeros(N * F, np.int32)
+        if not grant:
+            return arr
+        for nd in range(N):
+            lst = self._fresh_cache.setdefault(nd, [])
+            while len(lst) < F:
+                try:
+                    lst.append(self.tree.ctx.alloc.alloc(node=nd))
+                except (KeyError, MemoryError):
+                    break  # node not local / partition exhausted
+            arr[nd * F:nd * F + len(lst[:F])] = lst[:F]
+        return arr
+
+    def _drain_split_log(self, log, stats) -> None:
+        """Apply a round's split log: reclaim unconsumed grants, refresh
+        the index cache, and lazily insert the parent entries (the B-link
+        already makes the split pages reachable — Tree.cpp:116-124's
+        broadcast role, deferred)."""
+        valid = np.asarray(log["valid"])
+        if not valid.any():
+            return
+        new_addr = np.asarray(log["new_addr"])[valid]
+        sk = bits.pairs_to_keys(np.asarray(log["skhi"])[valid],
+                                np.asarray(log["sklo"])[valid])
+        oh = bits.pairs_to_keys(np.asarray(log["old_hhi"])[valid],
+                                np.asarray(log["old_hlo"])[valid])
+        consumed = set(int(a) for a in new_addr)
+        for nd, lst in self._fresh_cache.items():
+            self._fresh_cache[nd] = [a for a in lst if a not in consumed]
+        stats["device_splits"] = stats.get("device_splits", 0) + len(sk)
+        for i in range(len(sk)):
+            if self.router is not None:
+                self.router.note_split(int(sk[i]), int(new_addr[i]),
+                                       int(oh[i]))
+            # parent entries are deferred (flush_parents): the B-link
+            # keeps the tree correct meanwhile, and retries reach the new
+            # pages through the refreshed router seeds
+            self._pending_parents.append((int(sk[i]), int(new_addr[i])))
+
     def _insert_chunk(self, keys, values, max_rounds, stats):
+        import os
+        import time as _t
+        dbg = os.environ.get("SHERMAN_DEBUG_INSERT")
         n = keys.shape[0]
         pending = np.ones(n, bool)
+        fresh_np = self._fill_fresh(False)  # round 0: optimistic, no splits
         for round_i in range(max_rounds):
+            if dbg:
+                print(f"[ins] round {round_i} pending={pending.sum()} "
+                      f"t={_t.time():.1f}", flush=True)
             if not pending.any():
                 return
             stats["rounds"] += 1
@@ -870,7 +1246,10 @@ class BatchedEngine:
             (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
             (vhi, _), (vlo, _) = self._pad(vhi), self._pad(vlo)
             active, _ = self._pad(np.ones(idx.shape[0], bool))
-            use_router = self.router is not None and round_i == 0
+            # the router is safe on EVERY round (seeds never land right of
+            # a key's leaf; note_split keeps it current for device splits),
+            # and retries then land directly on the freshly split leaves
+            use_router = self.router is not None
             fn = self._get_insert(self._iters(), use_router)
             args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
                     self._shard(khi), self._shard(klo),
@@ -878,23 +1257,31 @@ class BatchedEngine:
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
                 args.append(self._shard(self.router.host_start(khi)))
-            self.dsm.pool, self.dsm.counters, status = fn(*args)
+            args.append(self._shard(fresh_np))
+            self.dsm.pool, self.dsm.counters, status, log = fn(*args)
             status = np.asarray(status)[:idx.shape[0]]
+            if dbg:
+                import collections as _c
+                print(f"[ins] status {dict(_c.Counter(status.tolist()))} "
+                      f"t={_t.time():.1f}", flush=True)
+            self._drain_split_log(log, stats)
 
             stats["applied"] += int((status == ST_APPLIED).sum())
             stats["superseded"] += int((status == ST_SUPERSEDED).sum())
             done = (status == ST_APPLIED) | (status == ST_SUPERSEDED)
             pending[idx[done]] = False
 
-            # FULL leaves need splits: host path (rare).  BAD shouldn't
-            # happen but is retried via host for robustness.
-            hard = (status == ST_FULL) | (status == ST_BAD)
-            for j in idx[hard]:
+            # ST_FULL keys retry with fresh-page grants: the next round
+            # splits their leaves on-device.  ST_BAD shouldn't happen but
+            # is retried via host for robustness.
+            bad = status == ST_BAD
+            for j in idx[bad]:
                 self.tree.insert(int(keys[j]), int(values[j]))
                 stats["host_path"] += 1
                 pending[j] = False
-            if hard.any():
+            if bad.any():
                 self.tree._refresh_root()
+            fresh_np = self._fill_fresh(bool((status == ST_FULL).any()))
         # anything still pending after max_rounds: host path
         for j in np.nonzero(pending)[0]:
             self.tree.insert(int(keys[j]), int(values[j]))
@@ -1122,7 +1509,10 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     child_lows = lows
     while len(child_addrs) > 1:
         level += 1
-        fan = C.INTERNAL_CAP  # children per internal page (incl leftmost)
+        # children per internal page (incl leftmost): same fill slack as
+        # leaves — packing internal pages to capacity would force an
+        # internal split on the FIRST post-bulk leaf split under them
+        fan = max(2, int(C.INTERNAL_CAP * fill))
         m = len(child_addrs)
         n_pages = -(-m // fan)
         addrs = alloc.alloc_many(n_pages)

@@ -342,19 +342,27 @@ class Tree:
         ])
         if self.router is not None:
             self.router.note_split(split_key, sib_addr, old_high)
-        self._insert_parent(split_key, sib_addr, 1, path, child_left=addr)
+        self._insert_parent(split_key, sib_addr, 1, path)
         return True
 
     def _insert_parent(self, key: int, child: int, level: int,
-                       path: dict[int, int], child_left: int) -> None:
-        """internal_page_store + root growth (Tree.cpp:980-987,116-124)."""
+                       path: dict[int, int]) -> None:
+        """internal_page_store + root growth (Tree.cpp:980-987,116-124).
+
+        Root growth always anchors the new root's leftmost pointer at the
+        CURRENT root: the old root is the leftmost page of its level (its
+        ``lowest`` fence is -inf forever), so every page of that level is
+        reachable from it via the B-link chain.  Anchoring at the split's
+        left half instead would orphan everything left of an arbitrary
+        split when parent insertions are deferred (device-split logs
+        flush out of order)."""
         if self._root_level < level:
             self._refresh_root()
         if self._root_level < level:
-            # Grow the tree: new root with leftmost = left half.
+            # Grow the tree: new root over the whole old-root level.
             new_root = self.ctx.alloc.alloc()
             pg = layout.np_empty_page(level, C.KEY_NEG_INF, C.KEY_POS_INF,
-                                      leftmost=child_left)
+                                      leftmost=self._root_addr)
             layout.np_internal_set_entry(pg, 0, key, child)
             pg[C.W_NKEYS] = 1
             self.dsm.write_page(new_root, pg)
@@ -429,8 +437,7 @@ class Tree:
              "nw": C.PAGE_WORDS, "payload": left},
             self._unlock_row(la),
         ])
-        self._insert_parent(up_key, sib_addr, level + 1, path,
-                            child_left=addr)
+        self._insert_parent(up_key, sib_addr, level + 1, path)
 
     def lock_bench(self, key: int, loops: int = 100) -> float:
         """Micro-bench hook (Tree.cpp:310-321): lock/unlock round trips on

@@ -36,7 +36,14 @@ class LeafRouter:
     the compute node exactly as in the reference, and per-batch lookups
     (:meth:`host_start`) are a vectorized host gather whose result ships
     to the device with the batch — so the device step pays exactly one
-    page gather per key."""
+    page gather per key.
+
+    Buckets partition the keyspace by the TOP ``lb`` key bits, so seeding
+    is only effective when keys spread across the high bits (YCSB keys
+    hash to full uint64, as do the bench drivers').  A keyspace confined
+    to the low bits degenerates to one bucket — correctness holds (seeds
+    self-heal rightward) but every lookup pays the full sibling chase;
+    hash keys before insertion if your key domain is dense-low."""
 
     def __init__(self, tree, log2_buckets: int):
         assert 1 <= log2_buckets <= 32

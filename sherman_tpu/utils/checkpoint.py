@@ -22,6 +22,7 @@ of the old incarnation survives.
 from __future__ import annotations
 
 import json
+import os
 
 import numpy as np
 
@@ -38,6 +39,8 @@ def checkpoint(cluster, path: str) -> None:
     host): a multi-host deployment needs per-host shard files + a
     gathered manifest, which is future work.
     """
+    if not path.endswith(".npz"):
+        path += ".npz"  # np.savez appends it silently; keep restore in sync
     if cluster.keeper.is_multihost:
         raise NotImplementedError(
             "checkpoint of a multi-host cluster is not supported yet: "
@@ -66,6 +69,8 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
 
     from sherman_tpu.cluster import Cluster
 
+    if not path.endswith(".npz") and not os.path.exists(path):
+        path += ".npz"
     with np.load(path) as z:
         cfg = DSMConfig(**json.loads(bytes(z["cfg"]).decode()))
         cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
