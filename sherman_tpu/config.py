@@ -152,8 +152,10 @@ class TreeConfig:
     # Extra descent iterations budgeted for B-link sibling chases per op.
     sibling_chase_budget: int = 4
     # Rounds of the device-side insert retry loop before falling back to the
-    # host slow path (lock conflicts / splits).
-    insert_rounds: int = 8
+    # host slow path.  Mass inserts into a small tree split at most one new
+    # page per leaf per round (suppression), so leaf count doubles per
+    # round: the budget covers ~2^16 leaves of growth from a cold tree.
+    insert_rounds: int = 16
     # Bulk-load leaf fill fraction (cf. kWarmRatio=0.8, benchmark.cpp:19).
     bulk_fill: float = 0.75
     # Local lock table size for the hierarchical lock (kNumOfLock parity).

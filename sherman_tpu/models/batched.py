@@ -387,19 +387,21 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     have_slot = freec >= (rank + 1)
 
     if fresh is not None:
-        # every OTHER request on a splitting page must retry: the split
-        # rewrites the page from the pre-step snapshot
         has_split = jnp.zeros(P + 1, bool).at[
             jnp.where(splitter, safe_page, P)].set(True, mode="drop")
         page_splitting = has_split[safe_page]
     else:
         page_splitting = jnp.zeros(M, bool)
 
-    suppressed = page_splitting & (winner_upd | winner_ins | superseded)
-    need_ins = winner_ins & ~page_splitting
-    full = need_ins & ~have_slot
-    applied = (winner_upd | (winner_ins & have_slot)) & ~page_splitting
-    superseded = superseded & ~page_splitting
+    # On a splitting page, updates and fitting inserts (rank < free count)
+    # STILL apply — the split consumes the post-apply page, so nothing is
+    # lost and the page splits exactly full.  Only inserts ranked past the
+    # free slots retry (they land in the halves next round).  Without
+    # this, an append-shaped workload funnels into the rightmost leaf at
+    # ONE key per step.
+    suppressed = winner_ins & page_splitting & ~have_slot
+    full = winner_ins & ~have_slot & ~page_splitting
+    applied = winner_upd | (winner_ins & have_slot)
 
     target = (rank + 1)[:, None]
     islot = jnp.argmax(cumfree >= target, axis=-1)
@@ -437,10 +439,10 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     flat = flat.at[idx.reshape(-1)].set(ent.reshape(-1), mode="drop")
     pool = flat.reshape(P, _PW)
 
-    # --- device-side splits ------------------------------------------------
+    # --- device-side splits (consume the POST-apply page) ------------------
     if fresh is not None:
         pool, counters, log = _leaf_split_apply(
-            pool, counters, pg, inc, splitter, code - SPLIT_CODE, fresh,
+            pool, counters, inc, splitter, code - SPLIT_CODE, fresh,
             safe_page, cfg=cfg)
 
     # --- status ------------------------------------------------------------
@@ -461,14 +463,16 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     return pool, counters, status
 
 
-def _leaf_split_apply(pool, counters, pg, inc, splitter, fidx, fresh,
+def _leaf_split_apply(pool, counters, inc, splitter, fidx, fresh,
                       safe_page, *, cfg: DSMConfig):
     """Execute granted leaf splits in a compacted [F] buffer.
 
-    pg is the [M, PW] pre-step page snapshot; splitter/fidx select granted
-    rows and their fresh-page slots.  Builds both halves as whole pages
-    (a split is a full-page rewrite in the reference too, Tree.cpp:922-963)
-    and returns a log for lazy parent insertion + index-cache refresh.
+    splitter/fidx select granted rows and their fresh-page slots.  Reads
+    the POST-apply page from ``pool`` (this step's fitting inserts and
+    updates already landed, so the page splits exactly full and nothing
+    co-applied is lost), builds both halves as whole pages (a split is a
+    full-page rewrite in the reference too, Tree.cpp:922-963), and
+    returns a log for lazy parent insertion + index-cache refresh.
     """
     M = splitter.shape[0]
     P = pool.shape[0]
@@ -478,10 +482,10 @@ def _leaf_split_apply(pool, counters, pg, inc, splitter, fidx, fresh,
     sidx2 = jnp.nonzero(splitter, size=F, fill_value=M)[0].astype(jnp.int32)
     valid = sidx2 < M
     ci = jnp.clip(sidx2, 0, M - 1)
-    spg = pg[ci]                                   # [F, PW] snapshots
+    left_row = safe_page[ci]
+    spg = pool[left_row]                           # [F, PW] POST-apply
     pkhi, pklo = inc["khi"][ci], inc["klo"][ci]
     pvhi, pvlo = inc["vhi"][ci], inc["vlo"][ci]
-    left_row = safe_page[ci]
     new_addr = fresh[jnp.clip(fidx[ci], 0, F - 1)]
     right_row = jnp.clip(bits.addr_page(new_addr), 0, P - 1)
     valid = valid & (new_addr != 0)
@@ -1158,13 +1162,7 @@ class BatchedEngine:
                 if len(ents) > C.INTERNAL_CAP:
                     host_fb += stay  # internal split needed: per-key path
                     continue
-                ver = int(pg[C.W_FRONT_VER]) + 1
-                newpg = layout.np_empty_page(
-                    1, lo, hi, sibling=int(pg[C.W_SIBLING]),
-                    leftmost=int(pg[C.W_LEFTMOST]), version=ver)
-                for i, (k, c) in enumerate(ents):
-                    layout.np_internal_set_entry(newpg, i, k, c)
-                newpg[C.W_NKEYS] = len(ents)
+                newpg = layout.np_internal_rebuild(pg, ents, 1)
                 write_rows.append({"op": D.OP_WRITE, "addr": a, "woff": 0,
                                    "nw": C.PAGE_WORDS, "payload": newpg})
             if write_rows or unlock_rows:
@@ -1233,12 +1231,21 @@ class BatchedEngine:
         n = keys.shape[0]
         pending = np.ones(n, bool)
         fresh_np = self._fill_fresh(False)  # round 0: optimistic, no splits
-        for round_i in range(max_rounds):
+        # Progress-adaptive rounds: append-shaped workloads drain the
+        # rightmost leaf at ~(free slots + 1) keys per round (the same
+        # serialization the reference pays on the last leaf's lock), so a
+        # fixed budget would spill long appends to the host path.  Keep
+        # going while rounds make progress; stop after 2 stalled rounds.
+        round_i, stalled = 0, 0
+        while round_i < max_rounds or (stalled < 2
+                                       and round_i < max_rounds * 16):
+            round_i += 1
             if dbg:
                 print(f"[ins] round {round_i} pending={pending.sum()} "
                       f"t={_t.time():.1f}", flush=True)
             if not pending.any():
                 return
+            n_before = int(pending.sum())
             stats["rounds"] += 1
             idx = np.nonzero(pending)[0]
             khi, klo = bits.keys_to_pairs(keys[idx])
@@ -1265,6 +1272,12 @@ class BatchedEngine:
                 print(f"[ins] status {dict(_c.Counter(status.tolist()))} "
                       f"t={_t.time():.1f}", flush=True)
             self._drain_split_log(log, stats)
+            if self._pending_parents:
+                # flush between rounds: parents keep descent paths short —
+                # deferring across many split rounds can grow a B-link
+                # chain past the static descent budget, spilling the batch
+                # tail to the per-key host path
+                self.flush_parents()
 
             stats["applied"] += int((status == ST_APPLIED).sum())
             stats["superseded"] += int((status == ST_SUPERSEDED).sum())
@@ -1281,7 +1294,13 @@ class BatchedEngine:
                 pending[j] = False
             if bad.any():
                 self.tree._refresh_root()
-            fresh_np = self._fill_fresh(bool((status == ST_FULL).any()))
+            # grant fresh pages whenever anything retries: suppressed
+            # writers on a splitting page report ST_RETRY, and their next
+            # round may need to split again — granting only on ST_FULL
+            # would split every OTHER round
+            fresh_np = self._fill_fresh(
+                bool(((status == ST_FULL) | (status == ST_RETRY)).any()))
+            stalled = stalled + 1 if int(pending.sum()) == n_before else 0
         # anything still pending after max_rounds: host path
         for j in np.nonzero(pending)[0]:
             self.tree.insert(int(keys[j]), int(values[j]))

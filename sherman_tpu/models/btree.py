@@ -394,14 +394,7 @@ class Tree:
         ents.append((key, child))
         ents.sort()
         if len(ents) <= C.INTERNAL_CAP:
-            ver = int(pg[C.W_FRONT_VER]) + 1
-            newpg = layout.np_empty_page(
-                level, layout.np_lowest(pg), layout.np_highest(pg),
-                sibling=int(pg[C.W_SIBLING]), leftmost=int(pg[C.W_LEFTMOST]),
-                version=ver)
-            for i, (k, c) in enumerate(ents):
-                layout.np_internal_set_entry(newpg, i, k, c)
-            newpg[C.W_NKEYS] = len(ents)
+            newpg = layout.np_internal_rebuild(pg, ents, level)
             self.dsm.write_rows([
                 {"op": D.OP_WRITE, "addr": addr, "woff": 0,
                  "nw": C.PAGE_WORDS, "payload": newpg},

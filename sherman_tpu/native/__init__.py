@@ -116,6 +116,13 @@ def _load() -> None:
     _sig("shn_lt_free", None, [P])
     _sig("shn_lt_acquire", I32, [P, U64])
     _sig("shn_lt_release", I32, [P, U64, I32])
+    _sig("shn_rw_new", P, [])
+    _sig("shn_rw_free", None, [P])
+    _sig("shn_rw_rlock", None, [P])
+    _sig("shn_rw_runlock", None, [P])
+    _sig("shn_rw_wlock", None, [P])
+    _sig("shn_rw_wunlock", None, [P])
+    _sig("shn_rw_try_rlock", I32, [P])
 
 
 def available() -> bool:
@@ -282,6 +289,39 @@ class IndexCache:
 
     def __del__(self):
         h, f = getattr(self, "_h", None), globals().get("_shn_cache_free")
+        if h and f:
+            f(h)
+            self._h = None
+
+
+class WRLock:
+    """Spinning writer-preference RW lock (``include/WRLock.h`` parity:
+    the reference guards the DSM singleton + the IndexCache delay-free
+    list with it)."""
+
+    def __init__(self):
+        _require()
+        self._h = _shn_rw_new()
+        if not self._h:
+            raise MemoryError("rw lock alloc failed")
+
+    def rlock(self) -> None:
+        _shn_rw_rlock(self._h)
+
+    def runlock(self) -> None:
+        _shn_rw_runlock(self._h)
+
+    def try_rlock(self) -> bool:
+        return bool(_shn_rw_try_rlock(self._h))
+
+    def wlock(self) -> None:
+        _shn_rw_wlock(self._h)
+
+    def wunlock(self) -> None:
+        _shn_rw_wunlock(self._h)
+
+    def __del__(self):
+        h, f = getattr(self, "_h", None), globals().get("_shn_rw_free")
         if h and f:
             f(h)
             self._h = None

@@ -79,3 +79,76 @@ SHN_EXPORT int shn_lt_release(void* h, uint64_t i, int handover_ok) {
   l.current.store(my + 1, std::memory_order_release);
   return pass ? 1 : 0;
 }
+
+// ---------------------------------------------------------------------------
+// WRLock — spinning writer-preference reader/writer lock (WRLock.h parity:
+// the reference guards its DSM singleton and the IndexCache delay-free list
+// with it).  Writers announce intent via the high bit; new readers then
+// spin until the writer cycles through.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline void cpu_relax() {
+#if defined(__x86_64__)
+  __builtin_ia32_pause();
+#else
+  std::atomic_signal_fence(std::memory_order_seq_cst);
+#endif
+}
+
+struct WRLock {
+  static constexpr uint32_t kWriter = 1u << 31;
+  std::atomic<uint32_t> state{0};  // kWriter bit | reader count
+};
+
+}  // namespace
+
+SHN_EXPORT void* shn_rw_new() { return new (std::nothrow) WRLock(); }
+SHN_EXPORT void shn_rw_free(void* h) { delete (WRLock*)h; }
+
+SHN_EXPORT void shn_rw_rlock(void* h) {
+  auto& s = ((WRLock*)h)->state;
+  for (;;) {
+    uint32_t v = s.load(std::memory_order_relaxed);
+    if (!(v & WRLock::kWriter) &&
+        s.compare_exchange_weak(v, v + 1, std::memory_order_acquire))
+      return;
+    cpu_relax();
+  }
+}
+
+SHN_EXPORT void shn_rw_runlock(void* h) {
+  ((WRLock*)h)->state.fetch_sub(1, std::memory_order_release);
+}
+
+SHN_EXPORT void shn_rw_wlock(void* h) {
+  auto& s = ((WRLock*)h)->state;
+  // announce writer intent (writer preference: blocks new readers)...
+  for (;;) {
+    uint32_t v = s.load(std::memory_order_relaxed);
+    if (!(v & WRLock::kWriter) &&
+        s.compare_exchange_weak(v, v | WRLock::kWriter,
+                                std::memory_order_acquire))
+      break;
+    cpu_relax();
+  }
+  // ...then drain the readers
+  while (s.load(std::memory_order_acquire) != WRLock::kWriter) cpu_relax();
+}
+
+SHN_EXPORT void shn_rw_wunlock(void* h) {
+  ((WRLock*)h)->state.store(0, std::memory_order_release);
+}
+
+SHN_EXPORT int shn_rw_try_rlock(void* h) {
+  auto& s = ((WRLock*)h)->state;
+  uint32_t v = s.load(std::memory_order_relaxed);
+  // retry while the CAS loses to concurrent READERS — failure must mean
+  // "writer active", not "another reader raced me"
+  while (!(v & WRLock::kWriter)) {
+    if (s.compare_exchange_weak(v, v + 1, std::memory_order_acquire))
+      return 1;
+  }
+  return 0;
+}

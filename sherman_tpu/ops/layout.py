@@ -207,6 +207,21 @@ def np_leaf_clear_entry(pg: np.ndarray, slot: int) -> None:
         pg[w] = 0
 
 
+def np_internal_rebuild(pg: np.ndarray, ents: list, level: int) -> np.ndarray:
+    """Rebuild an internal page around sorted ``ents`` [(key, child)],
+    preserving fences/sibling/leftmost and bumping the version — the
+    shared merge protocol of internal_page_store's no-split branch
+    (host _insert_parent and the engine's batched parent flush)."""
+    ver = ((int(pg[C.W_FRONT_VER]) + 1) & 0x7FFFFFFF) or 1
+    newpg = np_empty_page(
+        level, np_lowest(pg), np_highest(pg), sibling=int(pg[C.W_SIBLING]),
+        leftmost=int(pg[C.W_LEFTMOST]), version=ver)
+    for i, (k, c) in enumerate(ents):
+        np_internal_set_entry(newpg, i, k, c)
+    newpg[C.W_NKEYS] = len(ents)
+    return newpg
+
+
 def np_internal_set_entry(pg: np.ndarray, slot: int, key: int, child: int) -> None:
     pg[C.I_KHI_W + slot], pg[C.I_KLO_W + slot] = bits.key_to_pair(key)
     pg[C.I_PTR_W + slot] = child

@@ -256,3 +256,38 @@ def test_histogram_batch_record():
     h.record_batch(5_000, 100)  # 100 ops completed together at 5 µs
     assert h.count == 100
     assert abs(h.percentiles_us([0.5])["p50"] - 5.0) < 0.2
+
+
+def test_wrlock_writer_preference_and_counts():
+    import threading
+    import time
+
+    from sherman_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip(native.load_error())
+    rw = native.WRLock()
+    # readers share
+    rw.rlock()
+    assert rw.try_rlock()
+    rw.runlock()
+    rw.runlock()
+    # writer excludes readers
+    rw.wlock()
+    assert not rw.try_rlock()
+    seen = []
+
+    def reader():
+        rw.rlock()
+        seen.append(time.monotonic())
+        rw.runlock()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.05)
+    assert not seen  # blocked while the writer holds it
+    t0 = time.monotonic()
+    rw.wunlock()
+    t.join(timeout=5)
+    assert seen and seen[0] >= t0
