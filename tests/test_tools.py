@@ -44,3 +44,12 @@ def test_benchmark_driver_read_only(eight_devices, capsys):
     r = benchmark.main(["1", "100", "1", "--keys", "20000", "--secs", "1",
                         "--ops-per-coro", "8", "--window", "0.5"])
     assert r["peak_ops"] > 0
+
+
+def test_benchmark_driver_combined(eight_devices, capsys):
+    import benchmark
+    r = benchmark.main(["1", "100", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5",
+                        "--combine", "on"])
+    assert r["peak_ops"] > 0
+    assert "combine" in capsys.readouterr().out
