@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Multi-host launcher — the reference's memcached-coordination role
+# (script/restartMemc.sh + memcached.conf), TPU-native: jax.distributed is
+# the rendezvous service, so "restarting memcached" reduces to picking a
+# coordinator address and launching one process per host.
+#
+# Usage (run on EVERY host, same coordinator):
+#   scripts/multihost_launch.sh <coordinator_ip:port> <num_hosts> <host_id> \
+#       <python_script> [args...]
+#
+# The script exports SHERMAN_COORD/SHERMAN_NPROC/SHERMAN_PROC_ID; the driver
+# calls sherman_tpu.parallel.bootstrap.init_multihost() which reads them (or
+# pass explicitly).  On TPU pods with auto-init, all three may be omitted.
+set -euo pipefail
+if [ "$#" -lt 4 ]; then
+  echo "usage: $0 <coordinator_ip:port> <num_hosts> <host_id> <script> [args...]" >&2
+  exit 1
+fi
+export SHERMAN_COORD="$1" SHERMAN_NPROC="$2" SHERMAN_PROC_ID="$3"
+shift 3
+cd "$(dirname "$0")/.."
+exec python "$@"

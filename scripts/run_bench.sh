@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Headline benchmark entry point (the reference's run.sh role).
+#
+# Runs bench.py (YCSB-C zipf-0.99 point lookups on one chip) and prints the
+# one-line JSON result.  Knobs via environment:
+#   SHERMAN_BENCH_KEYS / SHERMAN_BENCH_BATCH / SHERMAN_BENCH_SECS /
+#   SHERMAN_BENCH_THETA / SHERMAN_BENCH_COMBINE   (see bench.py docstring)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python bench.py "$@"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Full test suite on the 8-virtual-device CPU mesh (conftest.py forces the
+# platform), usable on any host — the in-process multi-node backend the
+# reference lacked (SURVEY.md §4).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q "$@"

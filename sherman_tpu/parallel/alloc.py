@@ -39,15 +39,20 @@ class GlobalAllocator:
         self._next = reserved
         self._limit = pages_per_node
 
-    def alloc_chunk(self) -> int:
-        """-> first page index of a fresh chunk; raises when exhausted."""
-        if self._next + self.chunk_pages > self._limit:
+    def alloc_chunk(self) -> tuple[int, int]:
+        """-> (first page index, size) of a fresh chunk; raises when
+        exhausted.  The partition tail yields one truncated chunk (the
+        reserved page 0 makes partitions non-multiples of chunk_pages, so
+        insisting on full chunks would strand the tail — e.g. a
+        single-chunk partition would be unusable)."""
+        size = min(self.chunk_pages, self._limit - self._next)
+        if size <= 0:
             raise MemoryError(
                 f"node {self.node_id}: DSM partition exhausted "
                 f"({self._limit} pages)")
         start = self._next
-        self._next += self.chunk_pages
-        return start
+        self._next += size
+        return start, size
 
     @property
     def pages_used(self) -> int:
@@ -70,9 +75,9 @@ class Directory:
         self.root_level = -1   # g_root_level analogue
 
     def malloc_chunk(self) -> tuple[int, int]:
-        """MALLOC RPC: -> (chunk base addr, chunk_pages)."""
-        start = self.allocator.alloc_chunk()
-        return bits.make_addr(self.node_id, start), self.allocator.chunk_pages
+        """MALLOC RPC: -> (chunk base addr, chunk size in pages)."""
+        start, size = self.allocator.alloc_chunk()
+        return bits.make_addr(self.node_id, start), size
 
     def new_root(self, addr: int, level: int) -> None:
         """NEW_ROOT RPC (broadcast target, ``Tree.cpp:116-124``)."""
@@ -118,9 +123,14 @@ class LocalAllocator:
         nxt, end = self._cur.get(nid, (0, 0))
         if nxt + npages > end:
             base_addr, chunk_pages = d.malloc_chunk()
-            assert npages <= chunk_pages
             nxt = bits.addr_page(base_addr)
             end = nxt + chunk_pages
+            if npages > chunk_pages:
+                # keep the (truncated) grant leased for smaller allocs
+                self._cur[nid] = (nxt, end)
+                raise MemoryError(
+                    f"node {nid}: contiguous alloc of {npages} pages "
+                    f"exceeds the granted chunk ({chunk_pages} pages)")
         self._cur[nid] = (nxt + npages, end)
         return bits.make_addr(nid, nxt)
 

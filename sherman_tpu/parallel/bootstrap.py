@@ -83,9 +83,22 @@ def init_multihost(coordinator_address: str | None = None,
     out-of-band rendezvous (its coordinator service is the memcached
     analogue), after which the global mesh spans all hosts and the
     ICI/DCN fabric is the data plane.  Args follow jax.distributed
-    (auto-detected on TPU pods when omitted).
+    (auto-detected on TPU pods when omitted).  ``scripts/
+    multihost_launch.sh`` passes them via SHERMAN_COORD / SHERMAN_NPROC /
+    SHERMAN_PROC_ID, read here when the args are omitted.
     """
+    import os
+
     import jax
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("SHERMAN_COORD")
+        if coordinator_address is not None:
+            # partial launcher env falls through as None (jax.distributed
+            # auto-detects where the platform supports it)
+            nproc = os.environ.get("SHERMAN_NPROC")
+            pid = os.environ.get("SHERMAN_PROC_ID")
+            num_processes = int(nproc) if nproc else None
+            process_id = int(pid) if pid else None
     if coordinator_address is not None:
         # Must run before ANY jax computation or backend query — even
         # jax.process_count() initializes the backends and would make

@@ -13,14 +13,17 @@ def _dirs(machine_nr=4, pages=64, chunk=8):
 
 def test_chunk_alloc_skips_reserved_page():
     ga = GlobalAllocator(0, pages_per_node=64, chunk_pages=8)
-    assert ga.alloc_chunk() == 1  # page 0 reserved
-    assert ga.alloc_chunk() == 9
+    assert ga.alloc_chunk() == (1, 8)  # page 0 reserved
+    assert ga.alloc_chunk() == (9, 8)
 
 
 def test_chunk_exhaustion():
     ga = GlobalAllocator(0, pages_per_node=20, chunk_pages=8)
-    ga.alloc_chunk()
-    ga.alloc_chunk()
+    assert ga.alloc_chunk() == (1, 8)
+    assert ga.alloc_chunk() == (9, 8)
+    # the tail yields one truncated chunk (a single-chunk partition must
+    # not strand the pages after the reserved page)
+    assert ga.alloc_chunk() == (17, 3)
     with pytest.raises(MemoryError):
         ga.alloc_chunk()
 
@@ -67,3 +70,17 @@ def test_directory_new_root():
     d.new_root(bits.make_addr(1, 5), 3)
     assert d.root_ptr == bits.make_addr(1, 5)
     assert d.root_level == 3
+
+
+def test_truncated_tail_grant_stays_leased():
+    from sherman_tpu.parallel.alloc import Directory, LocalAllocator
+    from sherman_tpu.config import DSMConfig
+    cfg = DSMConfig(machine_nr=1, pages_per_node=20, chunk_pages=8,
+                    step_capacity=8)
+    la = LocalAllocator([Directory(0, cfg)])
+    la.alloc(8)
+    la.alloc(8)
+    # tail chunk is 3 pages: a 4-page ask fails but must not strand them
+    with pytest.raises(MemoryError):
+        la.alloc(4)
+    assert bits.addr_page(la.alloc(3)) == 17

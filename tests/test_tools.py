@@ -53,3 +53,12 @@ def test_benchmark_driver_combined(eight_devices, capsys):
                         "--combine", "on"])
     assert r["peak_ops"] > 0
     assert "combine" in capsys.readouterr().out
+
+
+def test_benchmark_driver_scans_multinode(eight_devices, capsys):
+    import benchmark
+    r = benchmark.main(["4", "50", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5",
+                        "--scans", "2", "--scan-span", "50"])
+    assert r["peak_ops"] > 0
+    assert "scans 2 x" in capsys.readouterr().out

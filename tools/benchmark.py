@@ -56,6 +56,12 @@ def parse_args(argv=None):
                    help="read-request combining: duplicate lookups in a "
                         "batch share one descent (auto: on for read-only "
                         "skewed workloads)")
+    p.add_argument("--scans", type=int, default=0,
+                   help="range scans per report window (the multi-node "
+                        "mixed + range-scan config: exercises sibling-link "
+                        "traversal, Tree.cpp:461-522)")
+    p.add_argument("--scan-span", type=int, default=1000,
+                   help="target entries per range scan")
     return p.parse_args(argv)
 
 
@@ -117,9 +123,8 @@ def main(argv=None) -> dict:
         a.combine == "on" or (a.combine == "auto" and a.theta > 0))
     dev_batch = total_batch
     if combine:
-        uniq = [np.unique(bkeys[i], return_inverse=True)
-                for i in range(n_batches)]
-        max_u = max(u[0].shape[0] for u in uniq)
+        uniq = [np.unique(bkeys[i]) for i in range(n_batches)]
+        max_u = max(u.shape[0] for u in uniq)
         if a.combine == "auto" and max_u * 2 > total_batch:
             combine = False  # not enough duplication to pay
         else:
@@ -134,7 +139,7 @@ def main(argv=None) -> dict:
         bk = bkeys[i]
         act_n = dev_batch
         if combine:
-            uk = uniq[i][0]
+            uk = uniq[i]
             act_n = uk.shape[0]
             bk = np.pad(uk, (0, dev_batch - act_n))
         khi, klo = bits.keys_to_pairs(bk)
@@ -148,6 +153,8 @@ def main(argv=None) -> dict:
             vhi=jax.device_put(nv_hi, shard),
             vlo=jax.device_put(nv_lo, shard),
             act=jax.device_put(act, shard)))
+    if combine:
+        del uniq
     n_read_dev = dev_batch * a.kReadRatio // 100
     active_r = np.zeros(dev_batch, bool)
     active_r[:n_read_dev] = True
@@ -245,6 +252,20 @@ def main(argv=None) -> dict:
                 hist.record_batch(int(span / steps_per_block * 1e9),
                                   total_batch * steps_per_block)
         elapsed = time.time() - w0
+        # range scans (config 5: mixed + range-scan — sibling-link
+        # traversal over the cache-seeded prefetch, Tree.cpp:461-522).
+        # Timed separately AFTER the window closes so the point-op
+        # throughput (ops/elapsed) is not deflated by scan time.
+        scan_entries = scan_ns = 0
+        for s in range(a.scans):
+            span_keys = a.scan_span
+            i0 = int(rng.integers(0, max(1, n_warm - span_keys)))
+            lo = int(warm[i0])
+            hi = int(warm[min(n_warm - 1, i0 + span_keys)])
+            s0 = time.time_ns()
+            ks, _ = eng.range_query(lo, max(hi, lo + 1))
+            scan_ns += time.time_ns() - s0
+            scan_entries += ks.size
         ops = blocks * steps_per_block * total_batch
         tp_node = ops / elapsed / n_nodes
         tp_cluster = cluster.keeper.sum(f"tp:{w}", int(ops / elapsed))
@@ -254,6 +275,9 @@ def main(argv=None) -> dict:
         line = (f"[window {w}] node tp {tp_node / 1e6:.2f} Mops/s, "
                 f"cluster tp {tp_cluster / 1e6:.2f} Mops/s, "
                 f"reads/op {reads / max(ops, 1):.2f}")
+        if a.scans:
+            line += (f", scans {a.scans} x {scan_entries // max(a.scans, 1)} "
+                     f"entries @ {scan_ns / max(a.scans, 1) / 1e6:.1f} ms")
         if hist is not None and w % 3 == 2:
             line += f", lat(us) {hist.percentiles_us()}"
         print(line, flush=True)
