@@ -739,7 +739,8 @@ def delete_step_spmd(pool, locks, counters, khi, klo, root, active,
 
 def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
                     active_r, active_w, start=None, *, cfg: DSMConfig,
-                    iters: int, axis_name: str = AXIS):
+                    iters: int, axis_name: str = AXIS,
+                    write_lo: int | None = None):
     """One fused step of searches (``active_r``) and upserts (``active_w``).
 
     The reference interleaves reads and writes per thread from one open
@@ -756,6 +757,12 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
 
     Returns (pool, counters, status [B], done_r [B], found [B], vhi [B],
     vlo [B]); status is ST_* for write keys, done_r/found/v* cover reads.
+
+    ``write_lo`` (static): when the caller lays each node's shard out as
+    ``[reads | writes]`` with writes in ``[write_lo:]``, the apply runs on
+    that half-width slice only — the apply path (page snapshot gather,
+    dedup sort, write-back scatter) costs per ROW regardless of activity,
+    so applying over the full batch pays ~2x for a 50/50 mix.
     """
     active = active_r | active_w
     counters, done, addr, found, rvh, rvl = _resolve_leaves(
@@ -767,11 +774,21 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
     rvh = jnp.where(found, rvh, 0)
     rvl = jnp.where(found, rvl, 0)
 
-    pool, counters, status, _ = _route_and_apply(
-        pool, locks, counters, leaf_apply_spmd, addr, done & active_w,
-        {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo},
+    if write_lo is None:
+        w = slice(None)
+        pad = 0
+    else:
+        w = slice(write_lo, None)
+        pad = write_lo
+    pool, counters, st_w, _ = _route_and_apply(
+        pool, locks, counters, leaf_apply_spmd, addr[w],
+        (done & active_w)[w],
+        {"khi": khi[w], "klo": klo[w], "vhi": vhi[w], "vlo": vlo[w]},
         cfg=cfg, axis_name=axis_name)
-    status = jnp.where(active_w, status, ST_INVALID)
+    if pad:
+        st_w = jnp.concatenate(
+            [jnp.full(pad, ST_INVALID, jnp.int32), st_w])
+    status = jnp.where(active_w, st_w, ST_INVALID)
     return pool, counters, status, done_r, found, rvh, rvl
 
 
@@ -910,8 +927,12 @@ class BatchedEngine:
             self._delete_cache[key] = fn
         return fn
 
-    def _get_mixed(self, iters: int, with_start: bool):
-        key = (iters, with_start)
+    def _get_mixed(self, iters: int, with_start: bool,
+                   write_lo: int | None = None):
+        """``write_lo`` (static, per-node offset): callers that lay each
+        node's shard out as [reads | writes] get the half-width apply
+        (see mixed_step_spmd)."""
+        key = (iters, with_start, write_lo)
         fn = self._mixed_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
@@ -921,7 +942,7 @@ class BatchedEngine:
                 in_specs.append(spec)
             sm = jax.shard_map(
                 functools.partial(mixed_step_spmd, cfg=self.cfg,
-                                  iters=iters),
+                                  iters=iters, write_lo=write_lo),
                 mesh=self.dsm.mesh,
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec, spec, spec, spec, spec),
@@ -935,12 +956,15 @@ class BatchedEngine:
 
         keys u64 [n], values u64 [n] (ignored where is_read), is_read
         bool [n].  Returns (out_values u64 [n], found bool [n] — read
-        rows only, status int32 [n] — write rows only).  One-round
-        best-effort on the write side: callers retry ST_FULL/ST_RETRY
-        via :meth:`insert` (the bench drivers treat them as open-loop
-        misses).  Reads that overran the descent budget retry inline as
-        a LATER step — per the mixed_step_spmd linearization rule they
-        may observe this step's writes.
+        rows only, status int32 [n] — write rows only).  Writes that
+        miss the fast path (ST_FULL / ST_RETRY / ST_LOCKED — splits in
+        flight, chase-budget overruns on stale seeds) retry through
+        :meth:`insert`, which owns the split/host fallbacks; their
+        status is rewritten to the retry outcome.  Reads that overran
+        the descent budget retry inline as a LATER step — per the
+        mixed_step_spmd linearization rule they may observe this step's
+        writes.  (The bench drivers bypass this wrapper and treat
+        fast-path misses as open-loop misses.)
         """
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
@@ -976,6 +1000,17 @@ class BatchedEngine:
         if miss_r.any():
             v2, f2 = self.search(keys[miss_r])
             out_vals[miss_r], found[miss_r] = v2, f2
+        miss_w = ~is_read & np.isin(status, (ST_FULL, ST_RETRY, ST_LOCKED))
+        if miss_w.any():
+            self.insert(keys[miss_w], values[miss_w])
+            # per-request outcomes match the fast path's dedup semantics:
+            # the first-ordered request of a key applies, later duplicates
+            # are superseded by it (insert linearizes them the same way)
+            idx_w = np.nonzero(miss_w)[0]
+            first = np.zeros(idx_w.shape[0], bool)
+            first[np.unique(keys[idx_w], return_index=True)[1]] = True
+            status[idx_w[first]] = ST_APPLIED
+            status[idx_w[~first]] = ST_SUPERSEDED
         return out_vals, found, status
 
     # -- helpers -------------------------------------------------------------

@@ -38,19 +38,20 @@ class LeafRouter:
     to the device with the batch — so the device step pays exactly one
     page gather per key.
 
-    Buckets partition the keyspace by the TOP ``lb`` key bits, so seeding
-    is only effective when keys spread across the high bits (YCSB keys
-    hash to full uint64, as do the bench drivers').  A keyspace confined
-    to the low bits degenerates to one bucket — correctness holds (seeds
-    self-heal rightward) but every lookup pays the full sibling chase;
-    hash keys before insertion if your key domain is dense-low."""
+    Buckets partition the keyspace by ``lb`` bits starting at ``shift``:
+    by default the TOP bits, and :meth:`seed_from_leaves` adapts ``shift``
+    to the observed key range — keyspaces spanning (2^32, 2^64) (e.g.
+    48-bit ids) would otherwise collapse into bucket 0 and pay a
+    full-chain sibling chase per lookup.  ``shift`` never drops below 32
+    because the probe reads only the key's high word: a keyspace entirely
+    below 2^32 still degenerates to one bucket — pre-hash such keys."""
 
     def __init__(self, tree, log2_buckets: int):
         assert 1 <= log2_buckets <= 32
         self.tree = tree
         self.lb = log2_buckets
         self.nb = 1 << log2_buckets
-        self.shift = 64 - log2_buckets
+        self.shift = max(32, 64 - log2_buckets)
         self.table_np = np.full(self.nb, np.int32(tree._root_addr))
         self.splits_noted = 0
         tree.router = self
@@ -64,7 +65,17 @@ class LeafRouter:
     def seed_from_leaves(self, leaf_addrs: np.ndarray,
                          leaf_lows: np.ndarray) -> None:
         """Vectorized rebuild: leaf_lows must be sorted ascending with
-        leaf_lows[0] == KEY_NEG_INF (a bulk load's leaf directory)."""
+        leaf_lows[0] == KEY_NEG_INF (a bulk load's leaf directory).
+
+        Adapts ``shift`` so the bucket range covers exactly the observed
+        key span: with keys confined to the low bits (sequential ids),
+        top-bit bucketing would put every key in bucket 0."""
+        hi = int(np.max(leaf_lows)) if len(leaf_lows) else 0
+        span_bits = max(1, hi.bit_length())
+        # cover [0, 2^span_bits) with 2^lb buckets, probe-limited to the
+        # key's high word (shift >= 32); keys beyond the span clip into
+        # the last bucket and self-heal rightward like any stale seed
+        self.shift = min(64 - self.lb, max(32, span_bits - self.lb))
         starts = (np.arange(self.nb, dtype=np.uint64)
                   << np.uint64(self.shift))
         idx = np.searchsorted(leaf_lows, starts, side="right") - 1
@@ -90,7 +101,7 @@ class LeafRouter:
         """Start addresses for a batch: khi is the int32 high-word view of
         the keys; returns [B] int32 page addrs (normally the leaf)."""
         bucket = np.asarray(khi).view(np.uint32) >> np.uint32(self.shift - 32)
-        return self.table_np[bucket]
+        return self.table_np[np.minimum(bucket, np.uint32(self.nb - 1))]
 
 
 def default_log2_buckets(n_leaves: int) -> int:

@@ -93,12 +93,15 @@ def init_multihost(coordinator_address: str | None = None,
     if coordinator_address is None:
         coordinator_address = os.environ.get("SHERMAN_COORD")
         if coordinator_address is not None:
-            # partial launcher env falls through as None (jax.distributed
-            # auto-detects where the platform supports it)
+            # env fills only the args the caller omitted; partial launcher
+            # env falls through as None (jax.distributed auto-detects
+            # where the platform supports it)
             nproc = os.environ.get("SHERMAN_NPROC")
             pid = os.environ.get("SHERMAN_PROC_ID")
-            num_processes = int(nproc) if nproc else None
-            process_id = int(pid) if pid else None
+            if num_processes is None and nproc:
+                num_processes = int(nproc)
+            if process_id is None and pid:
+                process_id = int(pid)
     if coordinator_address is not None:
         # Must run before ANY jax computation or backend query — even
         # jax.process_count() initializes the backends and would make

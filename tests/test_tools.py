@@ -62,3 +62,12 @@ def test_benchmark_driver_scans_multinode(eight_devices, capsys):
                         "--scans", "2", "--scan-span", "50"])
     assert r["peak_ops"] > 0
     assert "scans 2 x" in capsys.readouterr().out
+
+
+def test_benchmark_driver_uneven_ratio_multinode(eight_devices, capsys):
+    # (B * kReadRatio) % 100 != 0: per-node and global read counts must
+    # agree (regression: tiled mask vs global split size mismatch)
+    import benchmark
+    r = benchmark.main(["4", "95", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5"])
+    assert r["peak_ops"] > 0

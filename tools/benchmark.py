@@ -110,67 +110,104 @@ def main(argv=None) -> dict:
         ranks = uniform_ranks(n_warm, n_batches * total_batch, rng)
     bkeys = warm[ranks].reshape(n_batches, total_batch)
 
-    n_read = total_batch * a.kReadRatio // 100
+    # Per-node read count first, global count from it: the tiled per-node
+    # [reads | writes] layout must agree exactly with the global split
+    # (B * ratio // 100 summed over nodes != total * ratio // 100 when the
+    # per-node count doesn't divide evenly).
+    r_node = B * a.kReadRatio // 100
+    n_read = r_node * n_nodes
     shard = tree.dsm.shard
 
-    # Read-request combining (see bench.py): duplicate lookups in a batch
-    # share one descent.  Only the pure-read workload combines — a mixed
-    # batch's read/write interleaving semantics stay per-request.
-    if a.combine == "on" and a.kReadRatio != 100:
-        notify_info("[bench] --combine on ignored: only kReadRatio=100 "
-                    "workloads combine")
-    combine = a.kReadRatio == 100 and (
-        a.combine == "on" or (a.combine == "auto" and a.theta > 0))
-    dev_batch = total_batch
-    if combine:
-        uniq = [np.unique(bkeys[i]) for i in range(n_batches)]
-        max_u = max(u.shape[0] for u in uniq)
-        if a.combine == "auto" and max_u * 2 > total_batch:
-            combine = False  # not enough duplication to pay
-        else:
-            # device batch must shard evenly over the node mesh
-            quantum = 8192 * n_nodes
-            dev_batch = min(-(-max_u // quantum) * quantum, total_batch)
-            notify_info("[bench] combine: %d ops -> %d unique (dev %d)",
-                        total_batch, max_u, dev_batch)
-
-    batches = []
-    for i in range(n_batches):
-        bk = bkeys[i]
-        act_n = dev_batch
-        if combine:
-            uk = uniq[i]
-            act_n = uk.shape[0]
-            bk = np.pad(uk, (0, dev_batch - act_n))
+    def pack_batch(bk, act_r, act_w, salt):
+        """Device-side batch dict from key layout + activity masks."""
         khi, klo = bits.keys_to_pairs(bk)
-        start = router.host_start(khi)
-        nv_hi, nv_lo = bits.keys_to_pairs(bk ^ np.uint64(0xBEEF + i))
-        act = np.zeros(dev_batch, bool)
-        act[:act_n] = True
-        batches.append(dict(
+        nv_hi, nv_lo = bits.keys_to_pairs(bk ^ np.uint64(0xBEEF + salt))
+        return dict(
             khi=jax.device_put(khi, shard), klo=jax.device_put(klo, shard),
-            start=jax.device_put(start, shard),
+            start=jax.device_put(router.host_start(khi), shard),
             vhi=jax.device_put(nv_hi, shard),
             vlo=jax.device_put(nv_lo, shard),
-            act=jax.device_put(act, shard)))
+            act_r=(act_r if hasattr(act_r, "devices")
+                   else jax.device_put(act_r, shard)),
+            act_w=(act_w if hasattr(act_w, "devices")
+                   else jax.device_put(act_w, shard)))
+
+    # Request combining (see bench.py): duplicate lookups in a batch share
+    # one descent, and duplicate upserts collapse to their last writer —
+    # exactly the step's own same-key dedup (ST_SUPERSEDED), applied at
+    # prep.  Reads and writes dedup separately; a key in both classes
+    # keeps per-request semantics (the read sees the pre-step snapshot,
+    # the write applies at the boundary — the step's serial order).
+    # Single-node only: multi-node shards need per-node static layouts.
+    if a.combine == "on" and n_nodes > 1:
+        notify_info("[bench] --combine on ignored on multi-node meshes")
+    combine = n_nodes == 1 and a.combine != "off" and (
+        a.combine == "on" or a.theta > 0)
+
+    def _cap(lens, limit):
+        """Static class capacity: next 8192 above the max unique count,
+        never above the class's own request count (tiny forced-combine
+        runs must not inflate the device batch)."""
+        m = max(lens, default=0)
+        return min(-(-m // 8192) * 8192, limit) if m else 0
+
+    batches = []
     if combine:
-        del uniq
-    n_read_dev = dev_batch * a.kReadRatio // 100
-    active_r = np.zeros(dev_batch, bool)
-    active_r[:n_read_dev] = True
-    active_w = ~active_r
-    if combine:
-        active_r = None  # combined mode is read-only; per-batch act masks
-        active_w = None
-    else:
-        active_r = jax.device_put(active_r, shard)
-        active_w = jax.device_put(active_w, shard)
+        # per batch: unique reads, unique writes
+        ur = [np.unique(bkeys[i][:n_read]) for i in range(n_batches)]
+        uw = [np.unique(bkeys[i][n_read:]) for i in range(n_batches)]
+        r_cap = _cap([u.shape[0] for u in ur], n_read)
+        w_cap = _cap([u.shape[0] for u in uw], total_batch - n_read)
+        if a.combine == "auto" and (r_cap + w_cap) * 2 > total_batch:
+            combine = False  # not enough duplication to pay
+        else:
+            dev_batch = r_cap + w_cap
+            write_lo = r_cap
+            notify_info("[bench] combine: %d ops -> dev %d "
+                        "(reads %d cap %d, writes %d cap %d)",
+                        total_batch, dev_batch,
+                        max((u.shape[0] for u in ur), default=0), r_cap,
+                        max((u.shape[0] for u in uw), default=0), w_cap)
+            for i in range(n_batches):
+                bk = np.zeros(dev_batch, np.uint64)
+                act_r = np.zeros(dev_batch, bool)
+                act_w = np.zeros(dev_batch, bool)
+                nr, nw = ur[i].shape[0], uw[i].shape[0]
+                bk[:nr] = ur[i]
+                act_r[:nr] = True
+                bk[r_cap:r_cap + nw] = uw[i]
+                act_w[r_cap:r_cap + nw] = True
+                batches.append(pack_batch(bk, act_r, act_w, i))
+            del ur, uw
+    if not combine:
+        # Per-NODE [reads | writes] layout: the mesh shards dim 0
+        # contiguously, so each node's chunk holds its reads first — the
+        # mixed step then applies writes on a static half-width slice
+        # (mixed_step_spmd write_lo), halving the apply cost of a 50/50
+        # mix.  Key slots are arbitrary zipf draws, so reassigning which
+        # slots are reads is workload-neutral.
+        dev_batch = total_batch
+        write_lo = r_node
+        node_mask = np.zeros(B, bool)
+        node_mask[:r_node] = True
+        active_r = np.tile(node_mask, n_nodes)
+        active_w = ~active_r
+        ar_dev = jax.device_put(active_r, shard)
+        aw_dev = jax.device_put(active_w, shard)
+        for i in range(n_batches):
+            # slot-to-class assignment is positional: lay the batch's keys
+            # out so each node chunk is [reads | writes]
+            bk = np.empty(total_batch, np.uint64)
+            bk[active_r] = bkeys[i][:n_read]
+            bk[active_w] = bkeys[i][n_read:]
+            batches.append(pack_batch(bk, ar_dev, aw_dev, i))
     root = np.int32(tree._root_addr)
 
     dsm = tree.dsm
     hist = native.LatencyHistogram() if native.available() else None
     mixed = 0 < n_read < total_batch
-    mfn = eng._get_mixed(eng._iters(), True) if mixed else None
+    mfn = (eng._get_mixed(eng._iters(), True, write_lo=write_lo)
+           if mixed else None)
     sfn = (eng._get_search(eng._iters(), True)
            if not mixed and n_read else None)
     wfn = (eng._get_insert(eng._iters(), True)
@@ -182,17 +219,17 @@ def main(argv=None) -> dict:
             # fused step: searches and upserts share one descent
             (dsm.pool, dsm.counters, status, done_r, found, vh, vl) = mfn(
                 dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
-                b["vhi"], b["vlo"], root, active_r, active_w, b["start"])
+                b["vhi"], b["vlo"], root, b["act_r"], b["act_w"],
+                b["start"])
             return status
         if sfn is not None:
-            act = b["act"] if combine else active_r
             dsm.counters, done, found, vh, vl = sfn(
-                dsm.pool, dsm.counters, b["khi"], b["klo"], root, act,
-                b["start"])
+                dsm.pool, dsm.counters, b["khi"], b["klo"], root,
+                b["act_r"], b["start"])
             return found
         dsm.pool, dsm.counters, status = wfn(
             dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
-            b["vhi"], b["vlo"], root, active_w, b["start"])
+            b["vhi"], b["vlo"], root, b["act_w"], b["start"])
         return status
 
     # Multi-node meshes must drain every step: two queued SPMD programs can
@@ -284,16 +321,14 @@ def main(argv=None) -> dict:
         results.append(tp_cluster)
 
     # --- verify the last step's statuses (writes must have applied) --------
+    last_b = batches[(step_i - 1) % n_batches]
     if mfn is not None or wfn is not None:
         st = np.asarray(out)
-        okw = np.isin(st[np.asarray(active_w)],
+        okw = np.isin(st[np.asarray(last_b["act_w"])],
                       (batched.ST_APPLIED, batched.ST_SUPERSEDED))
         assert okw.mean() > 0.99, f"write fast-path misses: {1-okw.mean():.3%}"
     elif sfn is not None:
-        found = np.asarray(out)
-        if combine:
-            found = found[np.asarray(
-                batches[(step_i - 1) % n_batches]["act"])]
+        found = np.asarray(out)[np.asarray(last_b["act_r"])]
         assert bool(found.all()), "searches missed warm keys"
 
     best = max(results)
