@@ -71,3 +71,23 @@ def test_benchmark_driver_uneven_ratio_multinode(eight_devices, capsys):
     r = benchmark.main(["4", "95", "1", "--keys", "20000", "--secs", "1",
                         "--ops-per-coro", "8", "--window", "0.5"])
     assert r["peak_ops"] > 0
+
+
+def test_benchmark_driver_write_only(eight_devices, capsys):
+    # kReadRatio=0: the pure insert-step path (regression: fresh grants
+    # argument and 4-output unpack were missing)
+    import benchmark
+    r = benchmark.main(["1", "0", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5"])
+    assert r["peak_ops"] > 0
+
+
+def test_benchmark_driver_multinode_read_combine(eight_devices, capsys):
+    # pure-read combining must work on multi-node meshes (regression:
+    # it was briefly disabled for n_nodes > 1)
+    import benchmark
+    r = benchmark.main(["2", "100", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5",
+                        "--combine", "on"])
+    assert r["peak_ops"] > 0
+    assert "combine" in capsys.readouterr().out

@@ -133,23 +133,29 @@ def main(argv=None) -> dict:
                    else jax.device_put(act_w, shard)))
 
     # Request combining (see bench.py): duplicate lookups in a batch share
-    # one descent, and duplicate upserts collapse to their last writer —
-    # exactly the step's own same-key dedup (ST_SUPERSEDED), applied at
-    # prep.  Reads and writes dedup separately; a key in both classes
-    # keeps per-request semantics (the read sees the pre-step snapshot,
-    # the write applies at the boundary — the step's serial order).
-    # Single-node only: multi-node shards need per-node static layouts.
-    if a.combine == "on" and n_nodes > 1:
-        notify_info("[bench] --combine on ignored on multi-node meshes")
-    combine = n_nodes == 1 and a.combine != "off" and (
+    # one descent, and duplicate upserts collapse to their first-ordered
+    # writer — exactly the step's own same-key dedup (the winner applies,
+    # later duplicates are ST_SUPERSEDED), applied at prep.  Reads and
+    # writes dedup separately; a key in both classes keeps per-request
+    # semantics (the read sees the pre-step snapshot, the write applies
+    # at the boundary — the step's serial order).  Write combining is
+    # single-node only (the mixed [reads | writes] layout is per-node
+    # static); pure-read combining works on any mesh.
+    can_combine = n_nodes == 1 or a.kReadRatio == 100
+    if a.combine == "on" and not can_combine:
+        notify_info("[bench] --combine on ignored: multi-node write "
+                    "combining needs per-node static layouts")
+    combine = can_combine and a.combine != "off" and (
         a.combine == "on" or a.theta > 0)
 
     def _cap(lens, limit):
-        """Static class capacity: next 8192 above the max unique count,
+        """Static class capacity: next quantum above the max unique count,
         never above the class's own request count (tiny forced-combine
-        runs must not inflate the device batch)."""
+        runs must not inflate the device batch).  The quantum keeps the
+        device batch sharding evenly over the node mesh."""
+        quantum = 8192 * n_nodes
         m = max(lens, default=0)
-        return min(-(-m // 8192) * 8192, limit) if m else 0
+        return min(-(-m // quantum) * quantum, limit) if m else 0
 
     batches = []
     if combine:
@@ -212,6 +218,9 @@ def main(argv=None) -> dict:
            if not mixed and n_read else None)
     wfn = (eng._get_insert(eng._iters(), True)
            if not mixed and n_read < total_batch else None)
+    fresh_zero = (jax.device_put(
+        np.zeros(n_nodes * eng.split_slots, np.int32), shard)
+        if wfn is not None else None)
 
     def one_step(i):
         b = batches[i % n_batches]
@@ -227,9 +236,12 @@ def main(argv=None) -> dict:
                 dsm.pool, dsm.counters, b["khi"], b["klo"], root,
                 b["act_r"], b["start"])
             return found
-        dsm.pool, dsm.counters, status = wfn(
+        # steady-state writes update warm keys in place (no splits), so
+        # the insert step runs with zero fresh-page grants; a split-heavy
+        # load would drive inserts through eng.insert instead
+        dsm.pool, dsm.counters, status, _log = wfn(
             dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
-            b["vhi"], b["vlo"], root, b["act_w"], b["start"])
+            b["vhi"], b["vlo"], root, b["act_w"], b["start"], fresh_zero)
         return status
 
     # Multi-node meshes must drain every step: two queued SPMD programs can
