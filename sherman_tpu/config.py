@@ -65,10 +65,15 @@ class DSMConfig:
     # (kChunkSize = 32 MB -> 32768 pages, Common.h:80).  Scaled down by
     # default so small test pools still have multiple chunks.
     chunk_pages: int = 256
+    # Inter-node exchange implementation: "xla" = all_to_all collectives
+    # (default); "pallas" = explicit per-peer one-sided remote-DMA writes
+    # (transport_pallas.py — the literal RDMA-verbs analogue).
+    exchange_impl: str = "xla"
 
     def __post_init__(self):
         assert 1 <= self.machine_nr <= MAX_MACHINE
         assert self.pages_per_node <= (1 << ADDR_PAGE_BITS)
+        assert self.exchange_impl in ("xla", "pallas")
 
 
 # ---------------------------------------------------------------------------

@@ -614,11 +614,13 @@ def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
     out_fields = {"active": eligible & routed, "addr": addr, **fields}
     out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
            for k, v in out_fields.items()}
-    inc = transport.exchange(out, axis_name)
+    inc = transport.exchange(out, axis_name, impl=cfg.exchange_impl,
+                             n_nodes=N)
     aout = apply_fn(pool, locks, counters, inc, cfg=cfg)
     pool, counters, st = aout[:3]
     extra = aout[3] if len(aout) > 3 else None
-    rep = transport.exchange({"st": st}, axis_name)
+    rep = transport.exchange({"st": st}, axis_name, impl=cfg.exchange_impl,
+                             n_nodes=N)
     safe_b = jnp.where(routed, bucket_idx, 0)
     return (pool, counters,
             jnp.where(eligible & routed, rep["st"][safe_b], ST_RETRY),

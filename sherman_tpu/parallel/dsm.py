@@ -241,17 +241,19 @@ def dsm_step_spmd(pool, locks, counters, reqs, *, cfg: DSMConfig,
     {"data": [R,256], "old": [R], "ok": [R] bool}.
     """
     N, C = cfg.machine_nr, cfg.step_capacity
+    xch = functools.partial(transport.exchange, axis_name=axis_name,
+                            impl=cfg.exchange_impl, n_nodes=N)
     active = reqs["op"] != OP_NOP
     dest = bits.addr_node(reqs["addr"])
     bucket_idx, routed = transport.bucketize(dest, active, N, C)
 
     out = {k: transport.scatter_to_buckets(v, bucket_idx, N * C)
            for k, v in reqs.items()}
-    inc = transport.exchange(out, axis_name)
+    inc = xch(out)
 
     pool, locks, counters, data, old, ok = _apply(pool, locks, counters, inc)
 
-    rep = transport.exchange({"data": data, "old": old, "ok": ok}, axis_name)
+    rep = xch({"data": data, "old": old, "ok": ok})
     safe_b = jnp.where(routed, bucket_idx, 0)
     replies = {
         "data": jnp.where((active & routed)[:, None], rep["data"][safe_b], 0),
@@ -280,12 +282,13 @@ def read_pages_spmd(pool, addrs, *, cfg: DSMConfig, axis_name: str = AXIS,
         pages = pool[jnp.clip(page, 0, P - 1)]
         return jnp.where(ok[:, None], pages, 0), ok
     dest = bits.addr_node(addrs)
+    xch = functools.partial(transport.exchange, axis_name=axis_name,
+                            impl=cfg.exchange_impl, n_nodes=N)
     bucket_idx, routed = transport.bucketize(dest, active, N, C)
     out = transport.scatter_to_buckets(bits.addr_page(addrs), bucket_idx, N * C)
-    inc = transport.exchange(out, axis_name)
+    inc = xch(out)
     data = pool[jnp.clip(inc, 0, P - 1)]
-    rep = transport.exchange(
-        {"data": data, "okb": (inc >= 0) & (inc < P)}, axis_name)
+    rep = xch({"data": data, "okb": (inc >= 0) & (inc < P)})
     safe_b = jnp.where(routed, bucket_idx, 0)
     served = active & routed & rep["okb"][safe_b]
     pages = jnp.where(served[:, None], rep["data"][safe_b], 0)

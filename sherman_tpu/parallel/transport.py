@@ -49,8 +49,21 @@ def scatter_to_buckets(field, bucket_idx, n_slots: int):
     return out.at[safe].set(field, mode="drop")
 
 
-def exchange(tree, axis_name: str):
-    """Tiled all_to_all of every array in the pytree along dim 0."""
+def exchange(tree, axis_name: str, *, impl: str = "xla",
+             n_nodes: int | None = None):
+    """Tiled all_to_all of every array in the pytree along dim 0.
+
+    impl="xla" (default): one XLA all_to_all per array — compiler-
+    scheduled over ICI.  impl="pallas": explicit per-peer one-sided
+    remote-DMA writes (:mod:`transport_pallas`) — the literal RDMA-verbs
+    analogue; interpreter-mode on CPU meshes.
+    """
+    if impl == "pallas":
+        from sherman_tpu.parallel import transport_pallas
+        assert n_nodes is not None
+        interpret = jax.default_backend() != "tpu"
+        return transport_pallas.exchange(tree, axis_name, n_nodes,
+                                         interpret=interpret)
     return jax.tree.map(
         lambda x: jax.lax.all_to_all(x, axis_name, 0, 0, tiled=True), tree
     )

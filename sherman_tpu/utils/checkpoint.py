@@ -29,7 +29,8 @@ import numpy as np
 from sherman_tpu.config import DSMConfig
 
 _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
-               "step_capacity", "host_step_capacity", "chunk_pages")
+               "step_capacity", "host_step_capacity", "chunk_pages",
+               "exchange_impl")
 
 
 def checkpoint(cluster, path: str) -> None:
