@@ -614,13 +614,12 @@ def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
     out_fields = {"active": eligible & routed, "addr": addr, **fields}
     out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
            for k, v in out_fields.items()}
-    inc = transport.exchange(out, axis_name, impl=cfg.exchange_impl,
-                             n_nodes=N)
+    inc = transport.exchange(out, axis_name, impl=cfg.exchange_impl)
     aout = apply_fn(pool, locks, counters, inc, cfg=cfg)
     pool, counters, st = aout[:3]
     extra = aout[3] if len(aout) > 3 else None
-    rep = transport.exchange({"st": st}, axis_name, impl=cfg.exchange_impl,
-                             n_nodes=N)
+    rep = transport.exchange({"st": st}, axis_name,
+                             impl=cfg.exchange_impl)
     safe_b = jnp.where(routed, bucket_idx, 0)
     return (pool, counters,
             jnp.where(eligible & routed, rep["st"][safe_b], ST_RETRY),
@@ -993,9 +992,9 @@ class BatchedEngine:
             args.append(self._shard(self.router.host_start(khi)))
         (self.dsm.pool, self.dsm.counters, status, done_r, found,
          rvh, rvl) = fn(*args)
-        status = np.asarray(status)[:n]
+        status = np.array(status[:n])  # writable: retry outcomes land here
         done_r = np.asarray(done_r)[:n]
-        found = np.asarray(found)[:n]
+        found = np.array(found[:n])
         out_vals = np.array(bits.pairs_to_keys(
             np.asarray(rvh)[:n], np.asarray(rvl)[:n]))
         miss_r = is_read & ~done_r

@@ -242,7 +242,7 @@ def dsm_step_spmd(pool, locks, counters, reqs, *, cfg: DSMConfig,
     """
     N, C = cfg.machine_nr, cfg.step_capacity
     xch = functools.partial(transport.exchange, axis_name=axis_name,
-                            impl=cfg.exchange_impl, n_nodes=N)
+                            impl=cfg.exchange_impl)
     active = reqs["op"] != OP_NOP
     dest = bits.addr_node(reqs["addr"])
     bucket_idx, routed = transport.bucketize(dest, active, N, C)
@@ -283,7 +283,7 @@ def read_pages_spmd(pool, addrs, *, cfg: DSMConfig, axis_name: str = AXIS,
         return jnp.where(ok[:, None], pages, 0), ok
     dest = bits.addr_node(addrs)
     xch = functools.partial(transport.exchange, axis_name=axis_name,
-                            impl=cfg.exchange_impl, n_nodes=N)
+                            impl=cfg.exchange_impl)
     bucket_idx, routed = transport.bucketize(dest, active, N, C)
     out = transport.scatter_to_buckets(bits.addr_page(addrs), bucket_idx, N * C)
     inc = xch(out)

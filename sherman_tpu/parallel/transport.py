@@ -49,18 +49,18 @@ def scatter_to_buckets(field, bucket_idx, n_slots: int):
     return out.at[safe].set(field, mode="drop")
 
 
-def exchange(tree, axis_name: str, *, impl: str = "xla",
-             n_nodes: int | None = None):
+def exchange(tree, axis_name: str, *, impl: str = "xla"):
     """Tiled all_to_all of every array in the pytree along dim 0.
 
     impl="xla" (default): one XLA all_to_all per array — compiler-
-    scheduled over ICI.  impl="pallas": explicit per-peer one-sided
-    remote-DMA writes (:mod:`transport_pallas`) — the literal RDMA-verbs
-    analogue; interpreter-mode on CPU meshes.
+    scheduled over ICI.  impl="pallas": the whole pytree packed into one
+    buffer of explicit per-peer one-sided remote-DMA writes
+    (:mod:`transport_pallas`) — the literal RDMA-verbs analogue;
+    interpreter-mode on CPU meshes.
     """
     if impl == "pallas":
         from sherman_tpu.parallel import transport_pallas
-        assert n_nodes is not None
+        n_nodes = jax.lax.axis_size(axis_name)
         interpret = jax.default_backend() != "tpu"
         return transport_pallas.exchange(tree, axis_name, n_nodes,
                                          interpret=interpret)

@@ -17,9 +17,13 @@ semaphores as the completion queue.  Per step and per peer:
 
 Parity/selection: ``DSMConfig.exchange_impl = "xla" | "pallas"`` switches
 the DSM step's exchanges.  The Pallas path is validated in interpreter mode
-on the virtual CPU mesh (tests) and compiles for real multi-chip ICI; the
-XLA path remains the default (measured equal-or-faster under XLA's
-scheduler, and exempt from Mosaic toolchain constraints).
+on the virtual CPU mesh (tests); the XLA path remains the default
+(compiler-scheduled, equal-or-faster, and exempt from Mosaic toolchain
+constraints).  KNOWN COVERAGE GAP: the pre-post cluster barrier
+(``use_barrier``) only exists in compiled multi-chip programs — the
+interpreter cannot lower ``get_barrier_semaphore`` and runs devices
+sequentially, so that branch ships untested until a real multi-chip run;
+treat "pallas" as experimental on hardware.
 
 Layout contract (same as ``transport.exchange`` with tiled all_to_all):
 arrays are ``[N * C, ...]`` per node — row block ``d*C:(d+1)*C`` is the
@@ -125,11 +129,19 @@ def exchange_pallas(x, axis_name: str, n_nodes: int, *,
 
 
 def exchange(tree, axis_name: str, n_nodes: int, *, interpret: bool = False):
-    """Drop-in for ``transport.exchange``: every array in the pytree rides
-    its own posted remote writes.  Bools widen to int32; other 32-bit
-    dtypes travel BIT-EXACTLY via bitcast (a value cast would corrupt
-    floats); anything else is rejected rather than silently truncated."""
-    def one(x):
+    """Drop-in for ``transport.exchange``: the whole pytree is packed into
+    ONE [N*C, sum(W)] int32 buffer and rides one kernel — one barrier and
+    N-1 posted writes per step, however many request fields there are.
+
+    Bools widen to int32; other 32-bit dtypes travel BIT-EXACTLY via
+    bitcast (a value cast would corrupt floats); anything else is
+    rejected rather than silently truncated.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    assert leaves, "empty exchange"
+    rows = leaves[0].shape[0]
+
+    def to_i32(x):
         dt = x.dtype
         if dt == jnp.bool_:
             x2 = x.astype(jnp.int32)
@@ -140,14 +152,22 @@ def exchange(tree, axis_name: str, n_nodes: int, *, interpret: bool = False):
         else:
             raise TypeError(
                 f"pallas exchange carries 32-bit lanes; got {dt}")
-        shp = x2.shape
-        if x2.ndim == 1:
-            x2 = x2[:, None]
-        out = exchange_pallas(x2, axis_name, n_nodes, interpret=interpret)
-        out = out.reshape(shp)
-        if dt == jnp.bool_:
-            return out.astype(dt)
-        if dt == jnp.int32:
-            return out
-        return jax.lax.bitcast_convert_type(out, dt)
-    return jax.tree.map(one, tree)
+        assert x2.shape[0] == rows, "exchange arrays must share dim 0"
+        return x2.reshape(rows, -1)
+
+    cols = [to_i32(x) for x in leaves]
+    widths = [c.shape[1] for c in cols]
+    packed = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=1)
+    out = exchange_pallas(packed, axis_name, n_nodes, interpret=interpret)
+
+    outs = []
+    off = 0
+    for x, w in zip(leaves, widths):
+        piece = out[:, off:off + w].reshape(x.shape)
+        off += w
+        if x.dtype == jnp.bool_:
+            piece = piece.astype(jnp.bool_)
+        elif x.dtype != jnp.int32:
+            piece = jax.lax.bitcast_convert_type(piece, x.dtype)
+        outs.append(piece)
+    return jax.tree.unflatten(treedef, outs)

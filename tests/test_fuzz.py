@@ -1,0 +1,103 @@
+"""Differential fuzz: random interleaved batched ops vs a dict model.
+
+The reference's correctness story is asserts sprinkled through Tree.cpp
+plus multi-node integration binaries (SURVEY.md §4); the in-process mesh
+lets us do better: drive the full batched surface (insert with device
+splits, delete, search, combined search, mixed read/write, range query)
+with randomized batches against a python dict, verifying every result and
+the structural invariants at the end.
+"""
+
+import numpy as np
+import pytest
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_batched_vs_model(eight_devices, seed):
+    rng = np.random.default_rng(seed)
+    cfg = DSMConfig(machine_nr=4, pages_per_node=4096, locks_per_node=1024,
+                    step_capacity=512, chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=128)
+
+    keyspace = np.unique(rng.integers(1, 1 << 56, 6000, dtype=np.uint64))
+    model: dict[int, int] = {}
+
+    # seed half the keyspace via bulk load
+    k0 = keyspace[: keyspace.shape[0] // 2]
+    v0 = k0 * np.uint64(3)
+    batched.bulk_load(tree, k0, v0)
+    eng.attach_router()
+    model.update(zip(k0.tolist(), v0.tolist()))
+
+    def pick(n):
+        return rng.choice(keyspace, size=n, replace=True)
+
+    for round_i in range(12):
+        op = rng.integers(0, 5)
+        if op == 0:  # batched upsert (mix of new + existing keys, dups)
+            ks = pick(200)
+            vs = ks ^ np.uint64(round_i * 7 + 1)
+            eng.insert(ks, vs)
+            # first occurrence of each key wins within one batch
+            first = np.unique(ks, return_index=True)[1]
+            for i in sorted(first):
+                model[int(ks[i])] = int(vs[i])
+        elif op == 1:  # batched delete (some present, some absent, dups)
+            ks = pick(100)
+            found = eng.delete(ks)
+            # found == presence before the batch (same-step duplicates all
+            # see the pre-step snapshot, so each occurrence reports True)
+            exp = np.array([int(k) in model for k in ks.tolist()])
+            np.testing.assert_array_equal(found, exp)
+            for k in np.unique(ks).tolist():
+                model.pop(int(k), None)
+        elif op == 2:  # search + combined search
+            ks = pick(300)
+            v1, f1 = eng.search(ks)
+            v2, f2 = eng.search_combined(ks)
+            exp_f = np.array([int(k) in model for k in ks])
+            exp_v = np.array([model.get(int(k), 0) for k in ks], np.uint64)
+            np.testing.assert_array_equal(f1, exp_f)
+            np.testing.assert_array_equal(v1[f1], exp_v[exp_f])
+            np.testing.assert_array_equal(f2, exp_f)
+            np.testing.assert_array_equal(v2[f2], exp_v[exp_f])
+        elif op == 3:  # mixed read/write step
+            ks = pick(160)
+            is_read = rng.random(160) < 0.5
+            vs = ks ^ np.uint64(round_i * 13 + 5)
+            ov, fnd, st = eng.mixed(ks, vs, is_read)
+            exp_f = np.array([int(k) in model for k in ks]) & is_read
+            np.testing.assert_array_equal(fnd & is_read, exp_f)
+            for i in np.nonzero(exp_f)[0]:
+                assert ov[i] == model[int(ks[i])]
+            wmask = ~is_read
+            wk, wi = np.unique(ks[wmask], return_index=True)
+            wv = vs[wmask]
+            for k, i in zip(wk.tolist(), wi.tolist()):
+                model[int(k)] = int(wv[i])
+        else:  # range query
+            lo, hi = sorted(rng.integers(1, 1 << 56, 2).tolist())
+            if lo == hi:
+                hi += 1
+            ks, vs = eng.range_query(lo, hi)
+            exp = sorted(k for k in model if lo <= k < hi)
+            np.testing.assert_array_equal(ks, np.array(exp, np.uint64))
+            np.testing.assert_array_equal(
+                vs, np.array([model[k] for k in exp], np.uint64))
+
+    # structural invariants after the storm
+    info = tree.check_structure()
+    assert info["leaves"] >= 1
+    # final full verification
+    all_keys = np.array(sorted(model), np.uint64)
+    v, f = eng.search(all_keys)
+    assert f.all()
+    np.testing.assert_array_equal(
+        v, np.array([model[int(k)] for k in all_keys], np.uint64))

@@ -19,7 +19,7 @@ def _mesh_exchange(n, arr, impl):
     spec = jax.sharding.PartitionSpec(AXIS)
 
     def inner(x):
-        return transport.exchange(x, AXIS, impl=impl, n_nodes=n)
+        return transport.exchange(x, AXIS, impl=impl)
 
     fn = jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=(spec,),
                                out_specs=spec, check_vma=False))
