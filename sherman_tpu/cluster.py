@@ -42,12 +42,11 @@ class Cluster:
         self.dsm = DSM(cfg, mesh)
         self.keeper = keeper if keeper is not None else Keeper(cfg.machine_nr)
         if self.keeper.is_multihost:
-            # each host process enters once and serves its own node's
-            # directory (bootstrap.DistributedKeeper; node id = process id)
-            assert cfg.machine_nr == self.keeper.machine_nr, (
-                f"cfg.machine_nr={cfg.machine_nr} must equal the process "
-                f"count {self.keeper.machine_nr} in a multi-host cluster")
-            self.node_ids = [self.keeper.server_enter()]
+            # each host process enters the cluster once and serves the
+            # directories of its process-local mesh nodes (the DSM derives
+            # them from the mesh; 1..k devices per host all work)
+            self.keeper.server_enter()
+            self.node_ids = list(self.dsm.local_nodes)
         else:
             # single-process SPMD: this process plays every symmetric
             # CN+MN node

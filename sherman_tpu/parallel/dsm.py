@@ -322,11 +322,34 @@ class DSM:
             raise ValueError("mesh size must equal cfg.machine_nr")
         self.shard = node_sharding(self.mesh)
         N, P, L = cfg.machine_nr, cfg.pages_per_node, cfg.locks_per_node
-        self.pool = jax.device_put(
-            jnp.zeros((N * P, PAGE_WORDS), jnp.int32), self.shard)
-        self.locks = jax.device_put(jnp.zeros(N * L, jnp.int32), self.shard)
-        self.counters = jax.device_put(
-            jnp.zeros(N * N_COUNTERS, jnp.uint32), self.shard)
+
+        # Multi-host: the mesh spans processes.  Host-API calls are then
+        # COLLECTIVES — every process must issue the same sequence of
+        # steps, each contributing requests from its own (contiguous)
+        # block of nodes and receiving its own replies (multi-controller
+        # SPMD, the jax.distributed execution model).
+        me = jax.process_index()
+        flat = list(self.mesh.devices.flat)
+        self.multihost = any(d.process_index != me for d in flat)
+        local_idx = [i for i, d in enumerate(flat) if d.process_index == me]
+        assert local_idx, "mesh has no process-local devices"
+        lo, hi = local_idx[0], local_idx[-1] + 1
+        assert local_idx == list(range(lo, hi)), (
+            "process-local devices must be contiguous in the mesh")
+        self.local_nodes = range(lo, hi)
+
+        def _zeros(shape, dtype):
+            if not self.multihost:
+                return jax.device_put(jnp.zeros(shape, dtype), self.shard)
+            return jax.make_array_from_callback(
+                shape, self.shard,
+                lambda idx: np.zeros(
+                    tuple(len(range(*s.indices(d)))
+                          for s, d in zip(idx, shape)), dtype))
+
+        self.pool = _zeros((N * P, PAGE_WORDS), jnp.int32)
+        self.locks = _zeros((N * L,), jnp.int32)
+        self.counters = _zeros((N * N_COUNTERS,), jnp.uint32)
 
         spec = jax.sharding.PartitionSpec(AXIS)
         in_specs = (spec, spec, spec,
@@ -345,16 +368,34 @@ class DSM:
         self._step = jax.jit(step, donate_argnums=(0, 1, 2))
         # Per-step request slots available to the *host* API; device kernels
         # compose dsm_step_spmd directly and have their own batches.
-        self.host_slots = N * self._host_cfg.step_capacity
+        self.host_slots = len(self.local_nodes) * self._host_cfg.step_capacity
 
     # -- raw step ------------------------------------------------------------
 
     def step(self, reqs: dict[str, np.ndarray]) -> Replies:
-        """Run one DSM step over host-built global request arrays [N*R]."""
-        reqs = {k: jax.device_put(jnp.asarray(v), self.shard)
-                for k, v in reqs.items()}
+        """Run one DSM step.
+
+        Single-process: ``reqs`` are global request arrays [N*R]; replies
+        cover all slots.  Multi-host: a COLLECTIVE — every process calls
+        with its own host-local arrays [len(local_nodes)*R] and receives
+        replies for its slots only.
+        """
+        if self.multihost:
+            from jax.experimental import multihost_utils as mhu
+            reqs = {k: mhu.host_local_array_to_global_array(
+                        np.asarray(v), self.mesh,
+                        jax.sharding.PartitionSpec(AXIS))
+                    for k, v in reqs.items()}
+        else:
+            reqs = {k: jax.device_put(jnp.asarray(v), self.shard)
+                    for k, v in reqs.items()}
         self.pool, self.locks, self.counters, rep = self._step(
             self.pool, self.locks, self.counters, reqs)
+        if self.multihost:
+            from jax.experimental import multihost_utils as mhu
+            spec = jax.sharding.PartitionSpec(AXIS)
+            rep = {k: mhu.global_array_to_host_local_array(v, self.mesh, spec)
+                   for k, v in rep.items()}
         return Replies(data=np.asarray(rep["data"]), old=np.asarray(rep["old"]),
                        ok=np.asarray(rep["ok"]))
 
@@ -365,9 +406,22 @@ class DSM:
     def _batch(self, rows: list[dict]) -> Replies:
         # Cap one host step at host_step_capacity TOTAL rows so that no
         # destination bucket can overflow regardless of the rows' targets.
+        # Multi-host: rows ride THIS process's node block only (each
+        # process contributes its own rows to the collective step).
         cap = self._host_cfg.step_capacity
-        n = self.cfg.machine_nr * cap
+        n_src = len(self.local_nodes)
+        n = n_src * cap
         if len(rows) > cap:
+            if self.multihost:
+                # Refuse to split silently: each chunk is one COLLECTIVE
+                # step, and a data-dependent chunk count would desync the
+                # processes' step sequences (a silent cluster deadlock).
+                # Callers chunk identically on every host instead.
+                raise ValueError(
+                    f"multi-host host-API batch of {len(rows)} rows "
+                    f"exceeds host_step_capacity={cap}: chunk the call "
+                    "identically on every process (each chunk is one "
+                    "collective step)")
             out = [self._batch(rows[i:i + cap])
                    for i in range(0, len(rows), cap)]
             return Replies(
@@ -377,10 +431,10 @@ class DSM:
         reqs = empty_requests(n)
         R = cap
         slots = []
-        # round-robin rows over source nodes: slot = src*R + idx_within_src
-        per_src = [0] * self.cfg.machine_nr
+        # round-robin rows over local source nodes: slot = s*R + idx
+        per_src = [0] * n_src
         for i, row in enumerate(rows):
-            src = i % self.cfg.machine_nr
+            src = i % n_src
             slot = src * R + per_src[src]
             per_src[src] += 1
             slots.append(slot)
@@ -532,7 +586,16 @@ class DSM:
     # -- observability (write_test.cpp:72-76 parity) -------------------------
 
     def counter_snapshot(self) -> dict[str, int]:
-        c = np.asarray(self.counters).reshape(self.cfg.machine_nr, N_COUNTERS)
+        """Op counters summed over this process's nodes (single-process:
+        the whole cluster).  Multi-host drivers aggregate across hosts
+        with ``keeper.sum`` — the reference's pattern exactly
+        (``dsm->sum``, test/benchmark.cpp:336-346)."""
+        if self.multihost:
+            c = np.concatenate([np.asarray(s.data)
+                                for s in self.counters.addressable_shards])
+        else:
+            c = np.asarray(self.counters)
+        c = c.reshape(-1, N_COUNTERS)
         tot = c.sum(axis=0, dtype=np.uint64)
         return {
             "read_ops": int(tot[CNT_READ_OPS]),
