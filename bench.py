@@ -10,7 +10,7 @@ Prints ONE JSON line:
 
 Environment knobs:
   SHERMAN_BENCH_KEYS     keyspace size (default 10_000_000)
-  SHERMAN_BENCH_BATCH    client ops per step (default 2_097_152)
+  SHERMAN_BENCH_BATCH    client ops per step (default 4_194_304)
   SHERMAN_BENCH_SECS     timed window   (default 10)
   SHERMAN_BENCH_THETA    zipf skew      (default 0.99; 0 = uniform)
   SHERMAN_BENCH_COMBINE  1/0 force read-combining on/off (default: auto —
@@ -53,10 +53,10 @@ def main() -> None:
     from sherman_tpu.workload.zipf import ZipfGen, uniform_ranks
 
     n_keys = int(os.environ.get("SHERMAN_BENCH_KEYS", 10_000_000))
-    # Step width trades latency for throughput (step-atomic batching): 2 M
-    # client ops/step runs ~22 ms/step on v5e — open-loop throughput at a
-    # bounded batch latency, with a 3.3x zipf-0.99 combining ratio.
-    batch = int(os.environ.get("SHERMAN_BENCH_BATCH", 2_097_152))
+    # Step width trades latency for throughput (step-atomic batching): 4 M
+    # client ops/step runs ~39 ms/step on v5e — open-loop throughput at a
+    # bounded batch latency, with a ~3.9x zipf-0.99 combining ratio.
+    batch = int(os.environ.get("SHERMAN_BENCH_BATCH", 4_194_304))
     secs = float(os.environ.get("SHERMAN_BENCH_SECS", 10))
     theta = float(os.environ.get("SHERMAN_BENCH_THETA", 0.99))
 

@@ -41,6 +41,13 @@ class Cluster:
         self.cfg = cfg
         self.dsm = DSM(cfg, mesh)
         self.keeper = keeper if keeper is not None else Keeper(cfg.machine_nr)
+        # The DSM derives multihost-ness from the mesh; the keeper must
+        # agree, or every process would serve ALL nodes' directories and
+        # two hosts would hand out the same chunks (silent corruption).
+        assert self.dsm.multihost == self.keeper.is_multihost, (
+            "mesh spans processes but the keeper is single-process (or "
+            "vice versa): pass bootstrap.init_multihost()'s keeper to "
+            "Cluster on every host")
         if self.keeper.is_multihost:
             # each host process enters the cluster once and serves the
             # directories of its process-local mesh nodes (the DSM derives

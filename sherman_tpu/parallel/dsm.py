@@ -343,9 +343,7 @@ class DSM:
                 return jax.device_put(jnp.zeros(shape, dtype), self.shard)
             return jax.make_array_from_callback(
                 shape, self.shard,
-                lambda idx: np.zeros(
-                    tuple(len(range(*s.indices(d)))
-                          for s, d in zip(idx, shape)), dtype))
+                lambda idx: np.zeros(self.shard.shard_shape(shape), dtype))
 
         self.pool = _zeros((N * P, PAGE_WORDS), jnp.int32)
         self.locks = _zeros((N * L,), jnp.int32)
