@@ -41,13 +41,15 @@ class Cluster:
         self.cfg = cfg
         self.dsm = DSM(cfg, mesh)
         self.keeper = keeper if keeper is not None else Keeper(cfg.machine_nr)
-        # The DSM derives multihost-ness from the mesh; the keeper must
-        # agree, or every process would serve ALL nodes' directories and
-        # two hosts would hand out the same chunks (silent corruption).
-        assert self.dsm.multihost == self.keeper.is_multihost, (
-            "mesh spans processes but the keeper is single-process (or "
-            "vice versa): pass bootstrap.init_multihost()'s keeper to "
-            "Cluster on every host")
+        # A process-spanning mesh REQUIRES the multihost keeper: with the
+        # in-process keeper every host would take the single-process
+        # branch and serve ALL nodes' directories, so two hosts hand out
+        # the same chunks (silent corruption).  (The converse — a
+        # DistributedKeeper on a 1-process deployment — is fine: it is
+        # just a 1-host cluster.)
+        assert not (self.dsm.multihost and not self.keeper.is_multihost), (
+            "mesh spans processes but the keeper is single-process: pass "
+            "bootstrap.init_multihost()'s keeper to Cluster on every host")
         if self.keeper.is_multihost:
             # each host process enters the cluster once and serves the
             # directories of its process-local mesh nodes (the DSM derives

@@ -1290,6 +1290,7 @@ class BatchedEngine:
         # fixed budget would spill long appends to the host path.  Keep
         # going while rounds make progress; stop after 2 stalled rounds.
         round_i, stalled = 0, 0
+        router_usable = self.router is not None
         while round_i < max_rounds or (stalled < 2
                                        and round_i < max_rounds * 16):
             round_i += 1
@@ -1306,10 +1307,22 @@ class BatchedEngine:
             (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
             (vhi, _), (vlo, _) = self._pad(vhi), self._pad(vlo)
             active, _ = self._pad(np.ones(idx.shape[0], bool))
-            # the router is safe on EVERY round (seeds never land right of
-            # a key's leaf; note_split keeps it current for device splits),
-            # and retries then land directly on the freshly split leaves
-            use_router = self.router is not None
+            # The router is CORRECT on every round (seeds never land right
+            # of a key's leaf; note_split keeps it current), and retries
+            # then land directly on freshly split leaves.  But a
+            # degenerate router (e.g. a sub-2^32 keyspace collapsing into
+            # one bucket) seeds far left of the leaf, and keys whose
+            # sibling chase exceeds the descent budget would retry
+            # FOREVER: once a round makes no progress, LATCH off the
+            # router for the rest of the chunk and use root descents
+            # (fence-guided, height-bounded) like search's straggler
+            # retry.  (The latch also avoids oscillating: resetting on
+            # progress would re-enable the same degenerate seeds every
+            # other round.)  First fallback round pays a one-time compile
+            # of the no-seed insert kernel; it is cached after that.
+            if stalled > 0:
+                router_usable = False
+            use_router = router_usable
             fn = self._get_insert(self._iters(), use_router)
             args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
                     self._shard(khi), self._shard(klo),
