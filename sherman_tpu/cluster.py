@@ -18,7 +18,7 @@ import jax
 from sherman_tpu.config import DSMConfig
 from sherman_tpu.parallel.alloc import Directory, LocalAllocator
 from sherman_tpu.parallel.bootstrap import Keeper
-from sherman_tpu.parallel.dsm import DSM
+from sherman_tpu.parallel.dsm import DSM, ReplicatedDSM
 
 
 @dataclass
@@ -51,21 +51,43 @@ class Cluster:
             "mesh spans processes but the keeper is single-process: pass "
             "bootstrap.init_multihost()'s keeper to Cluster on every host")
         if self.keeper.is_multihost:
-            # each host process enters the cluster once and serves the
-            # directories of its process-local mesh nodes (the DSM derives
-            # them from the mesh; 1..k devices per host all work)
+            # Replicated-driver SPMD (see dsm.ReplicatedDSM): every host
+            # process enters the cluster once and then mirrors ALL nodes'
+            # directories.  Identical replicated control flow keeps the
+            # mirrors in lock-step, which is what lets any client lease
+            # chunks on ANY node — DSM::alloc's round-robin over every
+            # directory (DSM.h:200-221) — without a cross-host RPC.
+            # Divergent per-process request streams would desync the
+            # mirrors (and the collective step sequences); the batched
+            # engine guards that with input-digest checks.
             self.keeper.server_enter()
-            self.node_ids = list(self.dsm.local_nodes)
+            self.node_ids = list(range(cfg.machine_nr))
         else:
             # single-process SPMD: this process plays every symmetric
             # CN+MN node
             self.node_ids = [self.keeper.server_enter()
                              for _ in range(cfg.machine_nr)]
         self.directories = [Directory(n, cfg) for n in self.node_ids]
+        # host_dsm is the handle Tree/engine host paths use: raw DSM in
+        # single-process mode; the leader-posted replicated wrapper when
+        # the mesh spans processes (each host-API op must execute once
+        # cluster-wide even though every process requests it)
+        self.host_dsm = (ReplicatedDSM(self.dsm) if self.dsm.multihost
+                         else self.dsm)
         self._next_client = 0
         self.keeper.barrier("DSM-init")
 
     def register_client(self) -> ClientContext:
+        """Per-client context (``DSM::registerThread``).
+
+        Multi-host caution: allocation state is MIRRORED on every process
+        (replicated-driver SPMD).  A registered client may only allocate
+        from replicated control flow — identical calls on every process
+        (the BatchedEngine/Tree path, which digest-checks its inputs).
+        Divergent per-process allocation would advance the mirrors
+        differently and hand out colliding pages; raw per-process drivers
+        (``cluster.dsm``) must not allocate.
+        """
         cid = self._next_client
         self._next_client += 1
         return ClientContext(client_id=cid,

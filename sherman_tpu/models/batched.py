@@ -797,6 +797,26 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
 # Host-facing engine: jit/shard_map wrappers + retry loop.
 # ---------------------------------------------------------------------------
 
+def _assert_replicated(multihost: bool, arrays, what: str) -> None:
+    """Multihost divergence guard: all processes must drive identical
+    request streams — mirrored allocators and collective step sequences
+    depend on it.  Cheap digest allgather; raises loudly on skew."""
+    if not multihost:
+        return
+    import zlib
+
+    from jax.experimental import multihost_utils as mhu
+    dig = 0
+    for a in arrays:
+        dig = zlib.crc32(np.ascontiguousarray(a).tobytes(), dig)
+    digs = np.asarray(mhu.process_allgather(
+        np.asarray([dig], np.uint32))).ravel()
+    if not (digs == np.uint32(dig)).all():
+        raise RuntimeError(
+            f"multihost {what} diverged across processes: every process "
+            "must drive identical request streams (replicated-driver SPMD)")
+
+
 class BatchedEngine:
     """Compiled batched ops over a :class:`~sherman_tpu.models.btree.Tree`.
 
@@ -827,6 +847,12 @@ class BatchedEngine:
         spec = jax.sharding.PartitionSpec(AXIS)
         self._spec = spec
         self._rep = jax.sharding.PartitionSpec()
+        # Multihost = replicated-driver SPMD: every process must call the
+        # engine with IDENTICAL request streams (multi-controller JAX runs
+        # the same host program everywhere; host-API ops execute once via
+        # cluster.host_dsm, and the device batch shards over the
+        # process-spanning mesh).  _check_replicated enforces it.
+        self._mh = self.dsm.multihost
 
     def _iters(self) -> int:
         # STATIC descent budget: max height + chase slack.  Deliberately
@@ -972,6 +998,7 @@ class BatchedEngine:
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
         values = np.asarray(values, np.uint64)
         is_read = np.asarray(is_read, bool)
+        self._check_replicated(keys, values, is_read)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
         assert n <= total, "chunk the batch to machine_nr * B"
@@ -992,11 +1019,12 @@ class BatchedEngine:
             args.append(self._shard(self.router.host_start(khi)))
         (self.dsm.pool, self.dsm.counters, status, done_r, found,
          rvh, rvl) = fn(*args)
+        status, done_r, found, rvh, rvl = self._unshard(
+            status, done_r, found, rvh, rvl)
         status = np.array(status[:n])  # writable: retry outcomes land here
-        done_r = np.asarray(done_r)[:n]
+        done_r = done_r[:n]
         found = np.array(found[:n])
-        out_vals = np.array(bits.pairs_to_keys(
-            np.asarray(rvh)[:n], np.asarray(rvl)[:n]))
+        out_vals = np.array(bits.pairs_to_keys(rvh[:n], rvl[:n]))
         miss_r = is_read & ~done_r
         if miss_r.any():
             v2, f2 = self.search(keys[miss_r])
@@ -1017,7 +1045,37 @@ class BatchedEngine:
     # -- helpers -------------------------------------------------------------
 
     def _shard(self, x):
-        return jax.device_put(x, self.dsm.shard)
+        """Global-shape host array -> node-sharded device array.  In
+        multihost mode ``x`` is the full (replicated) batch; each process
+        contributes its local node block."""
+        if not self._mh:
+            return jax.device_put(x, self.dsm.shard)
+        from jax.experimental import multihost_utils as mhu
+        per = x.shape[0] // self.cfg.machine_nr
+        lo = self.dsm.local_nodes[0] * per
+        hi = (self.dsm.local_nodes[-1] + 1) * per
+        return mhu.host_local_array_to_global_array(
+            np.ascontiguousarray(x[lo:hi]), self.dsm.mesh,
+            jax.sharding.PartitionSpec(AXIS))
+
+    def _unshard(self, *ys):
+        """Node-sharded device arrays -> full host arrays on every process
+        (multihost: local block + ONE tiled allgather for all arrays;
+        block order asserted ascending by ReplicatedDSM).  Returns a
+        single array for one input, else a tuple."""
+        if not self._mh:
+            out = tuple(np.asarray(y) for y in ys)
+            return out[0] if len(ys) == 1 else out
+        from jax.experimental import multihost_utils as mhu
+        spec = jax.sharding.PartitionSpec(AXIS)
+        locals_ = tuple(np.asarray(mhu.global_array_to_host_local_array(
+            y, self.dsm.mesh, spec)) for y in ys)
+        g = mhu.process_allgather(locals_, tiled=True)
+        out = tuple(np.asarray(x) for x in g)
+        return out[0] if len(ys) == 1 else out
+
+    def _check_replicated(self, *arrays) -> None:
+        _assert_replicated(self._mh, arrays, "engine drivers")
 
     def _pad(self, arr: np.ndarray, fill=0) -> tuple[np.ndarray, int]:
         total = self.cfg.machine_nr * self.B
@@ -1037,6 +1095,8 @@ class BatchedEngine:
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+        if _depth == 0:
+            self._check_replicated(keys)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
         if n > total:
@@ -1057,21 +1117,20 @@ class BatchedEngine:
         if use_router:
             args.append(self._shard(self.router.host_start(khi)))
         self.dsm.counters, done, found, vhi, vlo = fn(*args)
-        done = np.asarray(done)[:n]
+        done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
+        done = done[:n]
         if not done.all():
             assert _depth < 8, "search stragglers not converging"
             # stale cache / height growth / capacity overflow: refresh root,
             # full descent for the stragglers
             self.tree._refresh_root()
-            vals = np.array(bits.pairs_to_keys(
-                np.asarray(vhi)[:n], np.asarray(vlo)[:n]))
+            vals = np.array(bits.pairs_to_keys(vhi[:n], vlo[:n]))
             fnd = np.array(found[:n])
             miss = ~done
             v2, f2 = self.search(keys[miss], _depth=_depth + 1)
             vals[miss], fnd[miss] = v2, f2
             return vals, fnd
-        return (bits.pairs_to_keys(np.asarray(vhi)[:n], np.asarray(vlo)[:n]),
-                np.asarray(found)[:n])
+        return bits.pairs_to_keys(vhi[:n], vlo[:n]), found[:n]
 
     def search_combined(self, keys) -> tuple[np.ndarray, np.ndarray]:
         """Batched lookup with request combining: duplicate keys share one
@@ -1101,6 +1160,7 @@ class BatchedEngine:
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
         values = np.asarray(values, np.uint64)
+        self._check_replicated(keys, values)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
         stats = {"applied": 0, "superseded": 0, "host_path": 0, "rounds": 0}
@@ -1142,7 +1202,8 @@ class BatchedEngine:
             self.dsm.pool, self.dsm.counters, self._shard(khi),
             self._shard(klo), np.int32(self.tree._root_addr),
             self._shard(active))
-        return np.asarray(addr)[:n], np.asarray(done)[:n]
+        addr, done = self._unshard(addr, done)
+        return addr[:n], done[:n]
 
     def flush_parents(self) -> int:
         """Insert deferred parent entries for device-side splits — the
@@ -1256,14 +1317,14 @@ class BatchedEngine:
         the index cache, and lazily insert the parent entries (the B-link
         already makes the split pages reachable — Tree.cpp:116-124's
         broadcast role, deferred)."""
-        valid = np.asarray(log["valid"])
+        valid, new_addr, skhi, sklo, ohhi, ohlo = self._unshard(
+            log["valid"], log["new_addr"], log["skhi"], log["sklo"],
+            log["old_hhi"], log["old_hlo"])
         if not valid.any():
             return
-        new_addr = np.asarray(log["new_addr"])[valid]
-        sk = bits.pairs_to_keys(np.asarray(log["skhi"])[valid],
-                                np.asarray(log["sklo"])[valid])
-        oh = bits.pairs_to_keys(np.asarray(log["old_hhi"])[valid],
-                                np.asarray(log["old_hlo"])[valid])
+        new_addr = new_addr[valid]
+        sk = bits.pairs_to_keys(skhi[valid], sklo[valid])
+        oh = bits.pairs_to_keys(ohhi[valid], ohlo[valid])
         consumed = set(int(a) for a in new_addr)
         for nd, lst in self._fresh_cache.items():
             self._fresh_cache[nd] = [a for a in lst if a not in consumed]
@@ -1332,7 +1393,7 @@ class BatchedEngine:
                 args.append(self._shard(self.router.host_start(khi)))
             args.append(self._shard(fresh_np))
             self.dsm.pool, self.dsm.counters, status, log = fn(*args)
-            status = np.asarray(status)[:idx.shape[0]]
+            status = self._unshard(status)[:idx.shape[0]]
             if dbg:
                 import collections as _c
                 print(f"[ins] status {dict(_c.Counter(status.tolist()))} "
@@ -1385,6 +1446,7 @@ class BatchedEngine:
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+        self._check_replicated(keys)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
         out = np.zeros(n, bool)
@@ -1412,7 +1474,7 @@ class BatchedEngine:
             if use_router:
                 args.append(self._shard(self.router.host_start(khi)))
             self.dsm.pool, self.dsm.counters, status = fn(*args)
-            status = np.asarray(status)[:idx.shape[0]]
+            status = self._unshard(status)[:idx.shape[0]]
 
             found_out[idx[status == ST_APPLIED]] = True
             done = (status == ST_APPLIED) | (status == ST_NOT_FOUND)
@@ -1469,8 +1531,13 @@ def range_query(eng: "BatchedEngine", lo: int, hi: int
         b_hi = min(r.nb - 1, max(0, (hi - 1) >> r.shift))
         cand = np.unique(r.table_np[b_lo:b_hi + 1])
         if cand.size:
-            rows = _addr_rows(cand, cfg.pages_per_node)
-            pages = np.asarray(_gather_rows(eng.dsm.pool, jnp.asarray(rows)))
+            if eng._mh:
+                # replicated host reads (chunked collective steps)
+                pages = tree.dsm.read_pages([int(a) for a in cand])
+            else:
+                rows = _addr_rows(cand, cfg.pages_per_node)
+                pages = np.asarray(_gather_rows(eng.dsm.pool,
+                                                jnp.asarray(rows)))
             for a, p in zip(cand.tolist(), pages):
                 if int(p[C.W_LEVEL]) == 0:   # stale entries may be internal
                     fetched[int(a) & 0xFFFFFFFF] = p
@@ -1532,6 +1599,11 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     cfg = tree.cfg
     if fill is None:
         fill = TreeConfig().bulk_fill
+    # replicated-driver invariant: every process must bulk-load the
+    # identical data (mirrored allocators depend on it)
+    _assert_replicated(tree.dsm.multihost,
+                       (np.asarray(keys, np.uint64),
+                        np.asarray(values, np.uint64)), "bulk_load")
     # Guard: bulk load replaces the whole tree, so refuse to drop existing
     # data — the current tree must be an empty root leaf.
     tree._refresh_root()
@@ -1652,8 +1724,16 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     nodes = (flat_addrs.astype(np.uint64) & 0xFFFFFFFF) >> C.ADDR_PAGE_BITS
     pgs = flat_addrs.astype(np.uint64) & C.ADDR_PAGE_MASK
     rows = (nodes * np.uint64(P) + pgs).astype(np.int32)
-    tree.dsm.pool = _install_pages(tree.dsm.pool, jnp.asarray(rows),
-                                   jnp.asarray(flat_pages))
+    if tree.dsm.multihost:
+        # multi-controller jit needs explicit (replicated) global arrays
+        rep_shard = jax.sharding.NamedSharding(
+            tree.dsm.mesh, jax.sharding.PartitionSpec())
+        mk = lambda x: jax.make_array_from_callback(
+            x.shape, rep_shard, lambda idx: x[idx])
+        rowsj, pagesj = mk(rows), mk(flat_pages)
+    else:
+        rowsj, pagesj = jnp.asarray(rows), jnp.asarray(flat_pages)
+    tree.dsm.pool = _install_pages(tree.dsm.pool, rowsj, pagesj)
 
     # Install root (bulk load is cluster-quiescent) and POISON the old root:
     # clients holding a stale root handle recover through the B-link chase

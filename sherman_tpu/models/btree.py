@@ -63,7 +63,10 @@ class Tree:
 
     def __init__(self, cluster: Cluster, ctx: ClientContext | None = None):
         self.cluster = cluster
-        self.dsm = cluster.dsm
+        # host-API handle: the raw DSM single-process, the replicated
+        # leader-posted wrapper on a process-spanning mesh (host ops
+        # execute once cluster-wide); device state passes through either
+        self.dsm = cluster.host_dsm
         self.cfg = cluster.cfg
         self.ctx = ctx if ctx is not None else cluster.register_client()
 

@@ -306,7 +306,150 @@ class Replies:
     ok: np.ndarray
 
 
-class DSM:
+class _HostOps:
+    """Host convenience API over :meth:`_batch` (one small step per call).
+
+    Shared by :class:`DSM` (single-process / raw per-process multihost
+    mode) and :class:`ReplicatedDSM` (replicated-driver multihost mode);
+    subclasses provide ``_batch``.
+    """
+
+    def _batch(self, rows: list[dict]) -> Replies:  # pragma: no cover
+        raise NotImplementedError
+
+    def read_page(self, addr: int) -> np.ndarray:
+        r = self._batch([{"op": OP_READ, "addr": addr}])
+        assert r.ok[0]
+        return r.data[0]
+
+    def read_pages(self, addrs) -> np.ndarray:
+        rows = [{"op": OP_READ, "addr": int(a)} for a in addrs]
+        r = self._batch(rows)
+        assert r.ok.all(), "read overflow: raise step_capacity"
+        return r.data
+
+    def write_page(self, addr: int, words: np.ndarray):
+        r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": 0,
+                          "nw": PAGE_WORDS, "payload": words}])
+        assert r.ok[0]
+
+    def write_words(self, addr: int, woff: int, words: np.ndarray):
+        words = np.asarray(words, np.int32)
+        r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": woff,
+                          "nw": words.shape[0], "payload": words}])
+        assert r.ok[0]
+
+    def write_rows(self, rows: list[dict]):
+        """Batched writes in ONE step — the write_batch/doorbell analogue
+        (Operation.cpp:351-380): all writes in a step become visible
+        atomically at the step boundary."""
+        r = self._batch(rows)
+        assert r.ok.all()
+
+    def cas(self, addr: int, woff: int, expected: int, desired: int,
+            space: int = SPACE_POOL) -> tuple[int, bool]:
+        r = self._batch([{"op": OP_CAS, "addr": addr, "woff": woff,
+                          "arg0": expected, "arg1": desired, "space": space}])
+        return int(r.old[0]), bool(r.ok[0])
+
+    def faa(self, addr: int, woff: int, delta: int,
+            space: int = SPACE_POOL) -> int:
+        r = self._batch([{"op": OP_FAA, "addr": addr, "woff": woff,
+                          "arg0": delta, "space": space}])
+        assert r.ok[0], "faa failed (bad address?)"
+        return int(r.old[0])
+
+    def read_word(self, addr: int, woff: int, space: int = SPACE_POOL) -> int:
+        r = self._batch([{"op": OP_READ_WORD, "addr": addr, "woff": woff,
+                          "space": space}])
+        assert r.ok[0], "read_word failed (bad address?)"
+        return int(r.old[0])
+
+    def write_word(self, addr: int, woff: int, value: int,
+                   space: int = SPACE_POOL):
+        r = self._batch([{"op": OP_WRITE_WORD, "addr": addr, "woff": woff,
+                          "arg1": value, "space": space}])
+        assert r.ok[0]
+
+    def masked_cas(self, addr: int, woff: int, expected: int, desired: int,
+                   mask: int, space: int = SPACE_POOL) -> tuple[int, bool]:
+        """CAS only the ``mask`` bits (ibv_exp masked CAS parity,
+        Operation.cpp:253-283): other bits are untouched and ignored in
+        the comparison.  -> (old_word, won)."""
+        r = self._batch([{"op": OP_MASKED_CAS, "addr": addr, "woff": woff,
+                          "arg0": expected, "arg1": desired, "arg2": mask,
+                          "space": space}])
+        return int(r.old[0]), bool(r.ok[0])
+
+    def masked_faa(self, addr: int, woff: int, delta: int, mask: int,
+                   space: int = SPACE_POOL) -> tuple[int, bool]:
+        """Fetch-and-add within the ``mask`` field (boundary FAA parity,
+        Operation.cpp:316-348): ``delta`` must be pre-shifted into the
+        field; carries never cross out of it.  One per word lands per
+        step; a lost race returns won=False to retry.
+        -> (old_word, won)."""
+        r = self._batch([{"op": OP_MASKED_FAA, "addr": addr, "woff": woff,
+                          "arg0": delta, "arg2": mask, "space": space}])
+        return int(r.old[0]), bool(r.ok[0])
+
+    # -- coalesced dependent-op chains (doorbell parity) ----------------------
+    # One step = one "doorbell": its ops land atomically at the step
+    # boundary, which is the guarantee the reference builds from chained
+    # WRs + fences (Operation.cpp:351-481).
+
+    def cas_read(self, cas_addr: int, woff: int, expected: int, desired: int,
+                 read_addr: int, cas_space: int = SPACE_LOCK
+                 ) -> tuple[int, bool, np.ndarray]:
+        """CAS a word and read a page in ONE step (rdmaCasRead,
+        Operation.cpp:382-414) — the lock-acquire + page-fetch fusion.
+
+        The read returns the pre-step page snapshot.  That is exactly the
+        fenced post-CAS read when the CAS wins a *lock*: the previous
+        holder's page write and its unlock land in one earlier step, so
+        any snapshot taken at or after the unlock already contains the
+        protected write.  -> (old_word, cas_won, page).
+        """
+        r = self._batch([
+            {"op": OP_CAS, "addr": cas_addr, "woff": woff,
+             "arg0": expected, "arg1": desired, "space": cas_space},
+            {"op": OP_READ, "addr": read_addr},
+        ])
+        assert r.ok[1], "cas_read: bad page address"
+        return int(r.old[0]), bool(r.ok[0]), r.data[1]
+
+    def write_cas(self, waddr: int, woff: int, payload: np.ndarray,
+                  cas_addr: int, cas_woff: int, expected: int, desired: int,
+                  cas_space: int = SPACE_LOCK) -> bool:
+        """Write words and CAS a word in ONE step (rdmaWriteCas,
+        Operation.cpp:449-481).  The CAS linearizes on the pre-step value;
+        both effects land together.  -> cas_won."""
+        payload = np.asarray(payload, np.int32)
+        r = self._batch([
+            {"op": OP_WRITE, "addr": waddr, "woff": woff,
+             "nw": payload.shape[0], "payload": payload},
+            {"op": OP_CAS, "addr": cas_addr, "woff": cas_woff,
+             "arg0": expected, "arg1": desired, "space": cas_space},
+        ])
+        assert r.ok[0], "write_cas: bad write address"
+        return bool(r.ok[1])
+
+    def write_faa(self, waddr: int, woff: int, payload: np.ndarray,
+                  faa_addr: int, faa_woff: int, delta: int,
+                  faa_space: int = SPACE_POOL) -> int:
+        """Write words and fetch-and-add a word in ONE step (rdmaWriteFaa,
+        Operation.cpp:416-447).  -> the FAA's serial pre-value."""
+        payload = np.asarray(payload, np.int32)
+        r = self._batch([
+            {"op": OP_WRITE, "addr": waddr, "woff": woff,
+             "nw": payload.shape[0], "payload": payload},
+            {"op": OP_FAA, "addr": faa_addr, "woff": faa_woff,
+             "arg0": delta, "space": faa_space},
+        ])
+        assert r.ok[0] and r.ok[1], "write_faa: bad address"
+        return int(r.old[1])
+
+
+class DSM(_HostOps):
     """Host handle to the cluster: owns the sharded pool/locks/counters and a
     jitted step.  The analogue of ``DSM::getInstance`` (DSM.cpp:23-35).
 
@@ -450,137 +593,6 @@ class DSM:
         sl = np.array(slots, np.int64)
         return Replies(data=rep.data[sl], old=rep.old[sl], ok=rep.ok[sl])
 
-    def read_page(self, addr: int) -> np.ndarray:
-        r = self._batch([{"op": OP_READ, "addr": addr}])
-        assert r.ok[0]
-        return r.data[0]
-
-    def read_pages(self, addrs) -> np.ndarray:
-        rows = [{"op": OP_READ, "addr": int(a)} for a in addrs]
-        r = self._batch(rows)
-        assert r.ok.all(), "read overflow: raise step_capacity"
-        return r.data
-
-    def write_page(self, addr: int, words: np.ndarray):
-        r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": 0,
-                          "nw": PAGE_WORDS, "payload": words}])
-        assert r.ok[0]
-
-    def write_words(self, addr: int, woff: int, words: np.ndarray):
-        words = np.asarray(words, np.int32)
-        r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": woff,
-                          "nw": words.shape[0], "payload": words}])
-        assert r.ok[0]
-
-    def write_rows(self, rows: list[dict]):
-        """Batched writes in ONE step — the write_batch/doorbell analogue
-        (Operation.cpp:351-380): all writes in a step become visible
-        atomically at the step boundary."""
-        r = self._batch(rows)
-        assert r.ok.all()
-
-    def cas(self, addr: int, woff: int, expected: int, desired: int,
-            space: int = SPACE_POOL) -> tuple[int, bool]:
-        r = self._batch([{"op": OP_CAS, "addr": addr, "woff": woff,
-                          "arg0": expected, "arg1": desired, "space": space}])
-        return int(r.old[0]), bool(r.ok[0])
-
-    def faa(self, addr: int, woff: int, delta: int,
-            space: int = SPACE_POOL) -> int:
-        r = self._batch([{"op": OP_FAA, "addr": addr, "woff": woff,
-                          "arg0": delta, "space": space}])
-        assert r.ok[0], "faa failed (bad address?)"
-        return int(r.old[0])
-
-    def read_word(self, addr: int, woff: int, space: int = SPACE_POOL) -> int:
-        r = self._batch([{"op": OP_READ_WORD, "addr": addr, "woff": woff,
-                          "space": space}])
-        assert r.ok[0], "read_word failed (bad address?)"
-        return int(r.old[0])
-
-    def write_word(self, addr: int, woff: int, value: int,
-                   space: int = SPACE_POOL):
-        r = self._batch([{"op": OP_WRITE_WORD, "addr": addr, "woff": woff,
-                          "arg1": value, "space": space}])
-        assert r.ok[0]
-
-    def masked_cas(self, addr: int, woff: int, expected: int, desired: int,
-                   mask: int, space: int = SPACE_POOL) -> tuple[int, bool]:
-        """CAS only the ``mask`` bits (ibv_exp masked CAS parity,
-        Operation.cpp:253-283): other bits are untouched and ignored in
-        the comparison.  -> (old_word, won)."""
-        r = self._batch([{"op": OP_MASKED_CAS, "addr": addr, "woff": woff,
-                          "arg0": expected, "arg1": desired, "arg2": mask,
-                          "space": space}])
-        return int(r.old[0]), bool(r.ok[0])
-
-    def masked_faa(self, addr: int, woff: int, delta: int, mask: int,
-                   space: int = SPACE_POOL) -> tuple[int, bool]:
-        """Fetch-and-add within the ``mask`` field (boundary FAA parity,
-        Operation.cpp:316-348): ``delta`` must be pre-shifted into the
-        field; carries never cross out of it.  One per word lands per
-        step; a lost race returns won=False to retry.
-        -> (old_word, won)."""
-        r = self._batch([{"op": OP_MASKED_FAA, "addr": addr, "woff": woff,
-                          "arg0": delta, "arg2": mask, "space": space}])
-        return int(r.old[0]), bool(r.ok[0])
-
-    # -- coalesced dependent-op chains (doorbell parity) ----------------------
-    # One step = one "doorbell": its ops land atomically at the step
-    # boundary, which is the guarantee the reference builds from chained
-    # WRs + fences (Operation.cpp:351-481).
-
-    def cas_read(self, cas_addr: int, woff: int, expected: int, desired: int,
-                 read_addr: int, cas_space: int = SPACE_LOCK
-                 ) -> tuple[int, bool, np.ndarray]:
-        """CAS a word and read a page in ONE step (rdmaCasRead,
-        Operation.cpp:382-414) — the lock-acquire + page-fetch fusion.
-
-        The read returns the pre-step page snapshot.  That is exactly the
-        fenced post-CAS read when the CAS wins a *lock*: the previous
-        holder's page write and its unlock land in one earlier step, so
-        any snapshot taken at or after the unlock already contains the
-        protected write.  -> (old_word, cas_won, page).
-        """
-        r = self._batch([
-            {"op": OP_CAS, "addr": cas_addr, "woff": woff,
-             "arg0": expected, "arg1": desired, "space": cas_space},
-            {"op": OP_READ, "addr": read_addr},
-        ])
-        assert r.ok[1], "cas_read: bad page address"
-        return int(r.old[0]), bool(r.ok[0]), r.data[1]
-
-    def write_cas(self, waddr: int, woff: int, payload: np.ndarray,
-                  cas_addr: int, cas_woff: int, expected: int, desired: int,
-                  cas_space: int = SPACE_LOCK) -> bool:
-        """Write words and CAS a word in ONE step (rdmaWriteCas,
-        Operation.cpp:449-481).  The CAS linearizes on the pre-step value;
-        both effects land together.  -> cas_won."""
-        payload = np.asarray(payload, np.int32)
-        r = self._batch([
-            {"op": OP_WRITE, "addr": waddr, "woff": woff,
-             "nw": payload.shape[0], "payload": payload},
-            {"op": OP_CAS, "addr": cas_addr, "woff": cas_woff,
-             "arg0": expected, "arg1": desired, "space": cas_space},
-        ])
-        assert r.ok[0], "write_cas: bad write address"
-        return bool(r.ok[1])
-
-    def write_faa(self, waddr: int, woff: int, payload: np.ndarray,
-                  faa_addr: int, faa_woff: int, delta: int,
-                  faa_space: int = SPACE_POOL) -> int:
-        """Write words and fetch-and-add a word in ONE step (rdmaWriteFaa,
-        Operation.cpp:416-447).  -> the FAA's serial pre-value."""
-        payload = np.asarray(payload, np.int32)
-        r = self._batch([
-            {"op": OP_WRITE, "addr": waddr, "woff": woff,
-             "nw": payload.shape[0], "payload": payload},
-            {"op": OP_FAA, "addr": faa_addr, "woff": faa_woff,
-             "arg0": delta, "space": faa_space},
-        ])
-        assert r.ok[0] and r.ok[1], "write_faa: bad address"
-        return int(r.old[1])
-
     # -- observability (write_test.cpp:72-76 parity) -------------------------
 
     def counter_snapshot(self) -> dict[str, int]:
@@ -604,3 +616,86 @@ class DSM:
             "faa_ops": int(tot[CNT_FAA_OPS]),
             "write_word_ops": int(tot[CNT_WW_OPS]),
         }
+
+
+class ReplicatedDSM(_HostOps):
+    """Replicated-driver host API over a process-spanning DSM.
+
+    Multi-controller JAX runs the SAME host program on every process, so
+    a host-API op (lock CAS, page read/write, coalesced chains) is
+    requested by every process but must execute on the cluster exactly
+    ONCE.  This wrapper is that contract: every process calls every
+    method with identical arguments (replicated control flow — the
+    engine enforces it with input digests); process 0 posts the real
+    request rows while the others contribute empty collective steps, and
+    the replies are broadcast so each process returns identical results.
+    The role parallels the reference's UD-RPC control plane
+    (``Directory.cpp:60-92``): one requester executes, everyone learns
+    the outcome (here: synchronously, via the broadcast).
+
+    Batches of any length are chunked to ``host_step_capacity`` rows per
+    step; the chunk count derives from the (replicated) row list, so the
+    processes' collective step sequences can never desync — the hazard
+    :meth:`DSM._batch` refuses to risk in raw per-process mode.
+
+    Device state (pool/locks/counters) is shared with the wrapped DSM;
+    the batched engine keeps driving the raw arrays directly.
+    """
+
+    def __init__(self, dsm: DSM):
+        from jax.experimental import multihost_utils as mhu
+        assert dsm.multihost, "ReplicatedDSM wraps a process-spanning DSM"
+        self._dsm = dsm
+        self._leader = jax.process_index() == 0
+        # tiled reassembly in engine._unshard requires process-local node
+        # blocks ordered by process index; verify once per cluster
+        firsts = np.asarray(mhu.process_allgather(
+            np.asarray([dsm.local_nodes[0]], np.int32))).ravel()
+        assert (np.diff(firsts) > 0).all(), (
+            "mesh node blocks must ascend with process index")
+
+    # -- shared-state passthrough (the engine mutates pool/counters) ---------
+
+    pool = property(lambda s: s._dsm.pool,
+                    lambda s, v: setattr(s._dsm, "pool", v))
+    locks = property(lambda s: s._dsm.locks,
+                     lambda s, v: setattr(s._dsm, "locks", v))
+    counters = property(lambda s: s._dsm.counters,
+                        lambda s, v: setattr(s._dsm, "counters", v))
+    cfg = property(lambda s: s._dsm.cfg)
+    mesh = property(lambda s: s._dsm.mesh)
+    shard = property(lambda s: s._dsm.shard)
+    multihost = property(lambda s: s._dsm.multihost)
+    local_nodes = property(lambda s: s._dsm.local_nodes)
+    host_slots = property(lambda s: s._dsm.host_slots)
+    _host_cfg = property(lambda s: s._dsm._host_cfg)
+
+    def counter_snapshot(self) -> dict[str, int]:
+        return self._dsm.counter_snapshot()
+
+    def _batch(self, rows: list[dict]) -> Replies:
+        from jax.experimental import multihost_utils as mhu
+        if not rows:
+            self._dsm._batch([])  # still one collective step
+            return Replies(data=np.zeros((0, PAGE_WORDS), np.int32),
+                           old=np.zeros(0, np.int32), ok=np.zeros(0, bool))
+        cap = self._dsm._host_cfg.step_capacity
+        parts = []
+        for i in range(0, len(rows), cap):
+            chunk = rows[i:i + cap]
+            if self._leader:
+                parts.append(self._dsm._batch(chunk))
+            else:
+                self._dsm._batch([])
+                parts.append(Replies(
+                    data=np.zeros((len(chunk), PAGE_WORDS), np.int32),
+                    old=np.zeros(len(chunk), np.int32),
+                    ok=np.zeros(len(chunk), bool)))
+        rep = Replies(data=np.concatenate([p.data for p in parts]),
+                      old=np.concatenate([p.old for p in parts]),
+                      ok=np.concatenate([p.ok for p in parts]))
+        # one-to-all broadcast of the leader's replies (non-leaders pass
+        # shape/dtype placeholders — rows are replicated so shapes agree)
+        g = mhu.broadcast_one_to_all((rep.data, rep.old, rep.ok))
+        return Replies(data=np.asarray(g[0]), old=np.asarray(g[1]),
+                       ok=np.asarray(g[2]))

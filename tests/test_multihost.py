@@ -77,12 +77,78 @@ print(f"[{pid}] MULTIHOST-PASS", flush=True)
 '''
 
 
-def test_two_process_cluster(tmp_path):
+_ENGINE_WORKER = r'''
+import os, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["SHERMAN_COORD"] = f"localhost:{port}"
+os.environ["SHERMAN_NPROC"] = str(nproc)
+os.environ["SHERMAN_PROC_ID"] = str(pid)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.parallel import bootstrap
+
+keeper = bootstrap.init_multihost()
+
+# 2 processes x 2 local CPU devices = 4 nodes.  Replicated-driver SPMD:
+# both processes run this IDENTICAL program; host-API ops execute once
+# cluster-wide (leader posts, replies broadcast), device steps shard the
+# batch over the process-spanning mesh.
+cfg = DSMConfig(machine_nr=4, pages_per_node=256, locks_per_node=256,
+                step_capacity=64, host_step_capacity=16, chunk_pages=4)
+cluster = Cluster(cfg, keeper=keeper)
+assert cluster.dsm.multihost
+tree = Tree(cluster)
+eng = batched.BatchedEngine(tree, batch_per_node=32)
+
+rng = np.random.default_rng(7)
+keys = np.unique(rng.integers(1, 1 << 48, 800, dtype=np.uint64))[:700]
+vals = keys * np.uint64(3)
+bulk, rest = keys[:400], keys[400:]
+
+# bulk load on the shared tree; cross-host MALLOC: the mirrored
+# round-robin allocators must spread leaves over ALL nodes (DSM::alloc
+# round-robin over every directory, DSM.h:200-221)
+batched.bulk_load(tree, bulk, bulk * np.uint64(3))
+leaf_nodes = set(int(a) >> 24 for a in tree._bulk_leaf_dir[0].tolist())
+assert leaf_nodes == {0, 1, 2, 3}, f"leaves not spread: {leaf_nodes}"
+eng.attach_router()
+
+# batched insert across the process-spanning mesh, with device splits
+stats = eng.insert(rest, rest * np.uint64(3))
+assert stats.get("device_splits", 0) > 0, f"no device splits: {stats}"
+
+got, found = eng.search(keys)
+assert found.all(), f"missing {int((~found).sum())} keys"
+np.testing.assert_array_equal(got, vals)
+
+# batched delete + re-verify
+dropped = keys[::10]
+fnd = eng.delete(dropped)
+assert fnd.all()
+got2, found2 = eng.search(dropped)
+assert not found2.any()
+
+tree.check_structure()
+total_splits = keeper.sum("splits", int(stats.get("device_splits", 0)))
+assert total_splits == nproc * stats["device_splits"]  # identical streams
+keeper.barrier("done")
+print(f"[{pid}] ENGINE-PASS splits={stats['device_splits']}", flush=True)
+'''
+
+
+def _run_workers(tmp_path, script, timeout, tag):
     import socket
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(script)
     with socket.socket() as s:  # pick a free coordinator port
         s.bind(("localhost", 0))
         port = str(s.getsockname()[1])
@@ -97,7 +163,7 @@ def test_two_process_cluster(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=220)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -105,4 +171,15 @@ def test_two_process_cluster(tmp_path):
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
-        assert f"[{pid}] MULTIHOST-PASS" in out
+        assert f"[{pid}] {tag}" in out
+
+
+def test_two_process_cluster(tmp_path):
+    _run_workers(tmp_path, _WORKER, 220, "MULTIHOST-PASS")
+
+
+def test_two_process_engine(tmp_path):
+    """The flagship BatchedEngine end-to-end on a process-spanning mesh:
+    bulk_load spread over all nodes (cross-host MALLOC), batched insert
+    with device-side splits, search, delete, structure check."""
+    _run_workers(tmp_path, _ENGINE_WORKER, 900, "ENGINE-PASS")
