@@ -77,17 +77,29 @@ class Cluster:
         self._next_client = 0
         self.keeper.barrier("DSM-init")
 
-    def register_client(self) -> ClientContext:
+    def register_client(self, replicated: bool | None = None
+                        ) -> ClientContext:
         """Per-client context (``DSM::registerThread``).
 
-        Multi-host caution: allocation state is MIRRORED on every process
-        (replicated-driver SPMD).  A registered client may only allocate
-        from replicated control flow — identical calls on every process
-        (the BatchedEngine/Tree path, which digest-checks its inputs).
-        Divergent per-process allocation would advance the mirrors
-        differently and hand out colliding pages; raw per-process drivers
-        (``cluster.dsm``) must not allocate.
+        Multi-host: allocation state is MIRRORED on every process
+        (replicated-driver SPMD), so a registered client may only
+        allocate from replicated control flow — identical calls on every
+        process (the Tree/BatchedEngine path, which digest-checks its
+        inputs).  Divergent per-process allocation would advance the
+        mirrors differently and hand out colliding pages.  To make that
+        contract structural rather than documentation, registering a
+        client on a multi-host cluster requires ``replicated=True`` as
+        an explicit acknowledgment; raw per-process drivers
+        (``cluster.dsm``) get a loud error here instead of silent
+        corruption later.
         """
+        if self.dsm.multihost and replicated is not True:
+            raise RuntimeError(
+                "multi-host clients allocate from MIRRORED directories: "
+                "pass register_client(replicated=True) to acknowledge "
+                "that this client runs identical (replicated) control "
+                "flow on every process; raw per-process drivers must "
+                "not allocate")
         cid = self._next_client
         self._next_client += 1
         return ClientContext(client_id=cid,

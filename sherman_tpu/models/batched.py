@@ -1088,19 +1088,22 @@ class BatchedEngine:
 
     # -- public ops ----------------------------------------------------------
 
-    def search(self, keys, _depth: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    def search(self, keys, _depth: int = 0,
+               _checked: bool = False) -> tuple[np.ndarray, np.ndarray]:
         """Batched lookup.  keys: uint64 array [n] (n <= N*B per call is
         chunked automatically).  Returns (values uint64 [n], found bool [n]).
         """
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
-        if _depth == 0:
+        if _depth == 0 and not _checked:
             self._check_replicated(keys)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
         if n > total:
-            parts = [self.search(keys[i:i + total])
+            # chunks were digest-checked as one array; each still routes
+            # like a fresh call (_depth=0)
+            parts = [self.search(keys[i:i + total], _checked=True)
                      for i in range(0, n, total)]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
@@ -1436,6 +1439,9 @@ class BatchedEngine:
     def range_query(self, lo: int, hi: int) -> tuple[np.ndarray, np.ndarray]:
         """All (k, v) with lo <= k < hi, sorted.  See module-level
         :func:`range_query`."""
+        # replication guard: the chain walk issues a data-dependent number
+        # of collective host reads — divergent bounds would desync them
+        self._check_replicated(np.asarray([lo, hi], np.uint64))
         return range_query(self, lo, hi)
 
     def delete(self, keys, max_rounds: int | None = None) -> np.ndarray:

@@ -68,7 +68,10 @@ class Tree:
         # execute once cluster-wide); device state passes through either
         self.dsm = cluster.host_dsm
         self.cfg = cluster.cfg
-        self.ctx = ctx if ctx is not None else cluster.register_client()
+        # the Tree host path IS replicated control flow in multi-host
+        # deployments (all its DSM ops ride cluster.host_dsm)
+        self.ctx = (ctx if ctx is not None
+                    else cluster.register_client(replicated=True))
 
         # Adopt an existing root if one is installed; otherwise construct an
         # empty root leaf and CAS-install it (one winner across the cluster,

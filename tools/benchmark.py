@@ -324,6 +324,16 @@ def main(argv=None) -> dict:
         line = (f"[window {w}] node tp {tp_node / 1e6:.2f} Mops/s, "
                 f"cluster tp {tp_cluster / 1e6:.2f} Mops/s, "
                 f"reads/op {reads / max(ops, 1):.2f}")
+        if combine:
+            # distinct metrics so combined client-ops and raw device-row
+            # throughput can't be conflated (client tp counts each
+            # duplicate request; the device executes dev_batch rows/step
+            # and the per-request fan-out is NOT part of this driver's
+            # timed loop — bench.py's headline kernel does fan out
+            # in-step and is the number to quote)
+            dev_tp = blocks * steps_per_block * dev_batch / elapsed
+            line += (f", dev rows {dev_tp / 1e6:.2f} M/s "
+                     f"(combine {total_batch / dev_batch:.1f}x)")
         if a.scans:
             line += (f", scans {a.scans} x {scan_entries // max(a.scans, 1)} "
                      f"entries @ {scan_ns / max(a.scans, 1) / 1e6:.1f} ms")
