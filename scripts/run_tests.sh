@@ -1,7 +1,19 @@
 #!/usr/bin/env bash
-# Full test suite on the 8-virtual-device CPU mesh (conftest.py forces the
+# Test suite on the 8-virtual-device CPU mesh (conftest.py forces the
 # platform), usable on any host — the in-process multi-node backend the
 # reference lacked (SURVEY.md §4).
+#
+# Default: the FAST tier (slow-marked files deselected: differential
+# fuzz, multi-process clusters, split storms, driver smoke runs).
+# --slow runs everything.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m pytest tests/ -q "$@"
+slow=0
+args=()
+for a in "$@"; do
+  if [[ "$a" == "--slow" ]]; then slow=1; else args+=("$a"); fi
+done
+if [[ "$slow" == 1 ]]; then
+  exec python -m pytest tests/ -q "${args[@]+"${args[@]}"}"
+fi
+exec python -m pytest tests/ -q -m "not slow" "${args[@]+"${args[@]}"}"

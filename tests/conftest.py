@@ -20,7 +20,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's cost on a small host is almost
+# entirely XLA compiles of the same step shapes; cache them across runs so
+# the fast tier gives signal in bounded time after the first population.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tier (differential fuzz, multi-process "
+        "clusters, split storms, driver smoke runs); deselected by "
+        "default in scripts/run_tests.sh — run with --slow there or "
+        "-m '' here")
 
 
 @pytest.fixture(scope="session")

@@ -11,6 +11,8 @@ the structural invariants at the end.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from sherman_tpu.cluster import Cluster
 from sherman_tpu.config import DSMConfig
 from sherman_tpu.models import batched

@@ -193,6 +193,7 @@ def test_lock_handover_bounded():
     assert sum(r is False for r in results) >= 2
 
 
+@pytest.mark.slow
 def test_lock_mutual_exclusion():
     lt = native.LocalLockTable(1)
     counter = {"v": 0}

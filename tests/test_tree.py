@@ -74,6 +74,7 @@ def test_big_keys_64bit(tree):
         assert tree.search(k) == k % 1000
 
 
+@pytest.mark.slow
 def test_tree_test_parity(cluster):
     """Scaled tree_test.cpp loop (insert, overwrite x2, verify v==i*3,
     delete evens, verify, re-insert, verify; test/tree_test.cpp:30-70)."""
@@ -110,6 +111,7 @@ def test_two_clients_share_index(cluster, tree):
     assert tree.search(77777) == 1
 
 
+@pytest.mark.slow
 def test_index_cache_descent(cluster):
     """Host IndexCache wiring: hits jump straight to the leaf; splits make
     entries stale, which the descent invalidates + heals via B-link chase
