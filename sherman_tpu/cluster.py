@@ -74,6 +74,19 @@ class Cluster:
         # cluster-wide even though every process requests it)
         self.host_dsm = (ReplicatedDSM(self.dsm) if self.dsm.multihost
                          else self.dsm)
+        # Hierarchical lock, local tier (Sherman technique #1,
+        # Tree.cpp:1124-1173): one process-wide native ticket-lock table
+        # indexed like the global lock space; Tree clients of this
+        # process queue here first and hand the GLOBAL lock down the
+        # ticket train (bounded by kMaxHandOverTime=8), paying one
+        # remote CAS + one remote unlock per train instead of per op.
+        # Disabled on process-spanning meshes: hand-over decisions are
+        # per-process thread-timing-dependent, and ReplicatedDSM requires
+        # every process to issue the IDENTICAL collective step sequence.
+        from sherman_tpu import native
+        self.local_locks = (
+            native.LocalLockTable(cfg.machine_nr * cfg.locks_per_node)
+            if native.available() and not self.dsm.multihost else None)
         self._next_client = 0
         self.keeper.barrier("DSM-init")
 

@@ -72,6 +72,11 @@ class Tree:
         # deployments (all its DSM ops ride cluster.host_dsm)
         self.ctx = (ctx if ctx is not None
                     else cluster.register_client(replicated=True))
+        # hierarchical lock, local tier (shared per process via the
+        # cluster; None when the native lib is unavailable)
+        self._llocks = cluster.local_locks
+        self._lheld: dict[int, int] = {}   # lock addr -> local table index
+        self._lpass: dict[int, bool] = {}  # lock addr -> handover decision
 
         # Adopt an existing root if one is installed; otherwise construct an
         # empty root leaf and CAS-install it (one winner across the cluster,
@@ -105,8 +110,14 @@ class Tree:
         self._root_level = int(
             self.dsm.read_page(self._root_addr)[C.W_LEVEL])
 
-    # -- locking (global lock table; hierarchical local tier lives in the
-    #    batched path where real intra-step contention exists) ---------------
+    # -- locking: hierarchical — node-local ticket tier with bounded
+    #    hand-over in front of the global CAS word (Sherman technique #1,
+    #    Tree.cpp:1124-1173 + 205-242).  Same-process clients queue on the
+    #    native ticket lock; the holder hands the GLOBAL lock down the
+    #    train (<= kMaxHandOverTime=8), so a train pays ONE remote CAS and
+    #    ONE remote unlock.  (The batched device path replaces this with
+    #    in-step request combining — contention there collapses within the
+    #    step itself.) -------------------------------------------------------
 
     def _lock_word_addr(self, page_addr: int) -> int:
         node = bits.addr_node(page_addr)
@@ -115,8 +126,31 @@ class Tree:
             self.cfg.locks_per_node)))
         return bits.make_addr(node, idx)
 
+    def _acquire_local(self, la: int) -> bool:
+        """Join the local ticket queue for lock word ``la``
+        (acquire_local_lock, Tree.cpp:1125-1147); blocks until this
+        client holds the local lock.  -> True when the GLOBAL lock was
+        handed over with it (skip the remote CAS)."""
+        if self._llocks is None:
+            return False
+        li = (bits.addr_node(la) * self.cfg.locks_per_node
+              + bits.addr_page(la))
+        self._lheld[la] = li
+        return self._llocks.acquire(li)
+
+    def _abort_local(self, la: int) -> None:
+        """Drop the local ticket on a failed GLOBAL acquisition (deadlock
+        reporter path): never hand over (we don't hold the global lock),
+        and clear the held entry so other local clients don't spin on a
+        leaked ticket forever."""
+        li = self._lheld.pop(la, None)
+        if li is not None:
+            self._llocks.release(li, False)
+
     def _lock(self, page_addr: int) -> int:
         la = self._lock_word_addr(page_addr)
+        if self._acquire_local(la):
+            return la
         spins = 0
         while True:
             old, ok = self.dsm.cas(la, 0, 0, self.ctx.tag,
@@ -125,6 +159,7 @@ class Tree:
                 return la
             spins += 1
             if spins > LOCK_SPIN_LIMIT:
+                self._abort_local(la)
                 raise RuntimeError(
                     f"possible deadlock on lock {la:#x}: holder tag {old}")
 
@@ -134,8 +169,12 @@ class Tree:
         rdmaCasRead chain (Operation.cpp:382-414).  The snapshot the step
         returns is valid under the lock because the previous holder's
         payload write and unlock landed together in one earlier step.
-        -> (lock_addr, page)."""
+        On a local hand-over the global lock is already ours: a plain
+        read suffices (the predecessor's write step landed before its
+        release).  -> (lock_addr, page)."""
         la = self._lock_word_addr(page_addr)
+        if self._acquire_local(la):
+            return la, self.dsm.read_page(page_addr)
         spins = 0
         while True:
             old, ok, pg = self.dsm.cas_read(la, 0, 0, self.ctx.tag,
@@ -144,16 +183,55 @@ class Tree:
                 return la, pg
             spins += 1
             if spins > LOCK_SPIN_LIMIT:
+                self._abort_local(la)
                 raise RuntimeError(
                     f"possible deadlock on lock {la:#x}: holder tag {old}")
 
     def _unlock_row(self, lock_addr: int) -> dict:
-        """Unlock as a request row, to coalesce with payload writes."""
+        """Raw global-unlock request row (no local tier involvement)."""
         return {"op": D.OP_WRITE_WORD, "addr": lock_addr, "woff": 0,
                 "arg1": 0, "space": D.SPACE_LOCK}
 
+    def _unlock_rows(self, lock_addr: int) -> list[dict]:
+        """Unlock rows to coalesce into the protected write step — EMPTY
+        when the global lock will be handed to a local waiter
+        (can_hand_over, Tree.cpp:1149-1167), keeping the remote unlock
+        off the wire for the train.  The decision is made before the
+        step and is binding (waiters block; see locks.cc).  Callers MUST
+        call :meth:`_release_local` after the step lands."""
+        if lock_addr in self._lheld:
+            pas = self._llocks.can_handover(self._lheld[lock_addr])
+            self._lpass[lock_addr] = pas
+            if pas:
+                return []
+        return [self._unlock_row(lock_addr)]
+
+    def _release_local(self, lock_addr: int) -> None:
+        """Release the local ticket AFTER the protected write step landed
+        (releases_local_lock, Tree.cpp:1169-1173): the next local holder
+        then reads post-step state.  Must follow every _unlock_rows."""
+        li = self._lheld.pop(lock_addr, None)
+        if li is None:
+            return
+        decided = self._lpass.pop(lock_addr, False)
+        passed = self._llocks.release(li, decided)
+        if decided and not passed:  # unreachable (waiters block); belt
+            self.dsm.write_word(lock_addr, 0, 0, space=D.SPACE_LOCK)
+
     def _unlock(self, lock_addr: int) -> None:
-        self.dsm.write_word(lock_addr, 0, 0, space=D.SPACE_LOCK)
+        rows = self._unlock_rows(lock_addr)
+        if rows:
+            self.dsm.write_rows(rows)
+        self._release_local(lock_addr)
+
+    def _write_and_unlock(self, rows: list[dict], lock_addr: int) -> None:
+        """Protected-write epilogue, made structural: coalesce the global
+        unlock into the payload step (or hand the lock down the local
+        train), then release the local ticket AFTER the step lands and
+        BEFORE any further lock acquisition (a parent's lock word may
+        hash onto the same local ticket — self-deadlock otherwise)."""
+        self.dsm.write_rows(rows + self._unlock_rows(lock_addr))
+        self._release_local(lock_addr)
 
     # -- index cache (host tier) ---------------------------------------------
 
@@ -263,13 +341,12 @@ class Tree:
             # the version pair decides liveness)
             wf, _, _, _, _, wr = layout.leaf_slot_words(slot)
             zero = np.zeros(1, np.int32)
-            self.dsm.write_rows([
+            self._write_and_unlock([
                 {"op": D.OP_WRITE, "addr": addr, "woff": wf, "nw": 1,
                  "payload": zero},
                 {"op": D.OP_WRITE, "addr": addr, "woff": wr, "nw": 1,
                  "payload": zero},
-                self._unlock_row(la),
-            ])
+            ], la)
             return True
 
     def range_query(self, lo: int, hi: int) -> dict[int, int]:
@@ -314,8 +391,7 @@ class Tree:
                  "payload": np.array([v], np.int32)}
                 for w, v in zip(words, vals)
             ]
-            rows.append(self._unlock_row(la))
-            self.dsm.write_rows(rows)
+            self._write_and_unlock(rows, la)
             return True
 
         # Leaf full: split (Tree.cpp:922-963).
@@ -339,13 +415,12 @@ class Tree:
             layout.np_leaf_set_entry(left, i, k, v)
 
         # sibling + rebuilt page + unlock all in ONE step: atomic split.
-        self.dsm.write_rows([
+        self._write_and_unlock([
             {"op": D.OP_WRITE, "addr": sib_addr, "woff": 0,
              "nw": C.PAGE_WORDS, "payload": right},
             {"op": D.OP_WRITE, "addr": addr, "woff": 0,
              "nw": C.PAGE_WORDS, "payload": left},
-            self._unlock_row(la),
-        ])
+        ], la)
         if self.router is not None:
             self.router.note_split(split_key, sib_addr, old_high)
         self._insert_parent(split_key, sib_addr, 1, path)
@@ -401,11 +476,10 @@ class Tree:
         ents.sort()
         if len(ents) <= C.INTERNAL_CAP:
             newpg = layout.np_internal_rebuild(pg, ents, level)
-            self.dsm.write_rows([
+            self._write_and_unlock([
                 {"op": D.OP_WRITE, "addr": addr, "woff": 0,
                  "nw": C.PAGE_WORDS, "payload": newpg},
-                self._unlock_row(la),
-            ])
+            ], la)
             return
 
         # Internal split: middle key moves up.
@@ -429,13 +503,12 @@ class Tree:
             layout.np_internal_set_entry(left, i, k, c)
         left[C.W_NKEYS] = m
 
-        self.dsm.write_rows([
+        self._write_and_unlock([
             {"op": D.OP_WRITE, "addr": sib_addr, "woff": 0,
              "nw": C.PAGE_WORDS, "payload": right},
             {"op": D.OP_WRITE, "addr": addr, "woff": 0,
              "nw": C.PAGE_WORDS, "payload": left},
-            self._unlock_row(la),
-        ])
+        ], la)
         self._insert_parent(up_key, sib_addr, level + 1, path)
 
     def lock_bench(self, key: int, loops: int = 100) -> float:

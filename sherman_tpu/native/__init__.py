@@ -115,6 +115,7 @@ def _load() -> None:
     _sig("shn_lt_new", P, [U64])
     _sig("shn_lt_free", None, [P])
     _sig("shn_lt_acquire", I32, [P, U64])
+    _sig("shn_lt_can_handover", I32, [P, U64])
     _sig("shn_lt_release", I32, [P, U64, I32])
     _sig("shn_rw_new", P, [])
     _sig("shn_rw_free", None, [P])
@@ -340,6 +341,12 @@ class LocalLockTable:
     def acquire(self, i: int) -> bool:
         """Blocks. -> True if the GLOBAL lock was handed over too."""
         return bool(_shn_lt_acquire(self._h, i))
+
+    def can_handover(self, i: int) -> bool:
+        """Holder-only probe: would release(True) hand over right now?
+        True is binding-safe (waiters block); after a False probe the
+        holder must release(False) — see locks.cc."""
+        return bool(_shn_lt_can_handover(self._h, i))
 
     def release(self, i: int, handover_ok: bool = True) -> bool:
         """-> True if handed over (do NOT release the global lock)."""

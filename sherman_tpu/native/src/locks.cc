@@ -58,6 +58,22 @@ SHN_EXPORT int shn_lt_acquire(void* h, uint64_t i) {
   return l.handed_over ? 1 : 0;
 }
 
+// Holder-only probe: would release(handover_ok=1) hand the lock over right
+// now?  Lets the holder decide BEFORE its protected write step whether to
+// coalesce the global unlock into the step (no waiter) or omit it (a
+// hand-over train keeps the global lock).  The answer can only flip
+// false -> true between probe and release (ticket waiters block and only
+// the holder writes hand_time), so: probe true  -> release(1) is
+// guaranteed to hand over; probe false -> caller coalesced the global
+// unlock and must call release(0) so a late-arriving waiter is NOT handed
+// a global lock that was just released.
+SHN_EXPORT int shn_lt_can_handover(void* h, uint64_t i) {
+  auto& l = ((LockTable*)h)->locks[i];
+  uint32_t my = l.current.load(std::memory_order_relaxed);
+  uint32_t next = l.ticket.load(std::memory_order_acquire);
+  return (next != my + 1 && l.hand_time < kMaxHandOver) ? 1 : 0;
+}
+
 // Release local lock i.  handover_ok != 0 when the caller is willing to
 // pass the global lock on.  -> 1 if handed over (caller must NOT release
 // the global lock), 0 otherwise (caller releases the global lock).
