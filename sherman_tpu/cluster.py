@@ -86,7 +86,7 @@ class Cluster:
         from sherman_tpu import native
         self.local_locks = (
             native.LocalLockTable(cfg.machine_nr * cfg.locks_per_node)
-            if native.available() and not self.dsm.multihost else None)
+            if not self.dsm.multihost and native.available() else None)
         self._next_client = 0
         self.keeper.barrier("DSM-init")
 

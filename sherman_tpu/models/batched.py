@@ -90,7 +90,10 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
     addr = start
     done = ~active
 
-    def advance(addr, done):
+    def advance(addr, done, nreads):
+        # exact read accounting (DSM.cpp:17-21 counter semantics): one
+        # read op per page actually fetched — the rows still descending
+        nreads = nreads + jnp.sum((~done).astype(jnp.uint32))
         pages, ok = D.read_pages_spmd(pool, addr, cfg=cfg,
                                       axis_name=axis_name, active=~done)
         lvl = layout.h_level(pages)
@@ -101,40 +104,40 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
         step_ok = ok & ~done
         new_addr = jnp.where(step_ok & ~at_leaf, nxt, addr)
         new_done = done | (step_ok & at_leaf)
-        return new_addr, new_done
+        return new_addr, new_done, nreads
 
+    nreads = jnp.uint32(0)
     if cfg.machine_nr == 1:
         # Dynamic early exit: no collectives in the body, so a data-dependent
         # while_loop is legal; a fresh index-cache start exits after ~1 hop.
         def cond(st):
-            it, _, done = st
+            it, _, done, _ = st
             return (it < iters) & jnp.any(~done)
 
         def bodyw(st):
-            it, addr, done = st
-            addr, done = advance(addr, done)
-            return it + 1, addr, done
+            it, addr, done, nreads = st
+            addr, done, nreads = advance(addr, done, nreads)
+            return it + 1, addr, done, nreads
 
-        _, addr, done = lax.while_loop(cond, bodyw, (0, addr, done))
+        _, addr, done, nreads = lax.while_loop(
+            cond, bodyw, (0, addr, done, nreads))
     else:
         # SPMD: every node must run the same trip count (the body carries
-        # all_to_all exchanges), so the budget is static.
+        # all_to_all exchanges), so the budget is static.  Rows that are
+        # already done post inactive requests — not counted as reads.
         def body(_, st):
-            addr, done = st
             return advance(*st)
 
-        addr, done = lax.fori_loop(0, iters, body, (addr, done))
+        addr, done, nreads = lax.fori_loop(0, iters, body,
+                                           (addr, done, nreads))
 
     # one final gather yields the leaf pages for the done keys
     page, ok_f = D.read_pages_spmd(pool, addr, cfg=cfg, axis_name=axis_name,
                                    active=done & active)
+    nreads = nreads + jnp.sum((done & active).astype(jnp.uint32))
     done = done & active & ok_f
-    # read accounting: every key costs its descent depth; we charge the
-    # static budget (iters + 1 gathers issued per active key)
-    counters = counters.at[D.CNT_READ_OPS].add(
-        jnp.sum(active.astype(jnp.uint32)) * jnp.uint32(iters + 1))
-    counters = counters.at[D.CNT_READ_PAGES].add(
-        jnp.sum(active.astype(jnp.uint32)) * jnp.uint32(iters + 1))
+    counters = counters.at[D.CNT_READ_OPS].add(nreads)
+    counters = counters.at[D.CNT_READ_PAGES].add(nreads)
     return counters, addr, page, done
 
 

@@ -147,6 +147,15 @@ class Tree:
         if li is not None:
             self._llocks.release(li, False)
 
+    def _abort_held_local(self) -> None:
+        """Exception cleanup: drop EVERY held local ticket (no hand-over).
+        The global word may stay leaked — exactly the pre-local-tier
+        failure mode, where contenders hit LOCK_SPIN_LIMIT and raise a
+        diagnosable error instead of hard-spinning on a dead ticket."""
+        for la in list(self._lheld):
+            self._abort_local(la)
+        self._lpass.clear()
+
     def _lock(self, page_addr: int) -> int:
         la = self._lock_word_addr(page_addr)
         if self._acquire_local(la):
@@ -319,13 +328,24 @@ class Tree:
 
     def insert(self, key: int, value: int) -> None:
         assert C.KEY_MIN <= key <= C.KEY_MAX
-        while True:
-            addr, _, path = self._descend(key, 0)
-            if self._leaf_store(addr, key, value, path):
-                return
+        try:
+            while True:
+                addr, _, path = self._descend(key, 0)
+                if self._leaf_store(addr, key, value, path):
+                    return
+        except BaseException:
+            self._abort_held_local()
+            raise
 
     def delete(self, key: int) -> bool:
         assert C.KEY_MIN <= key <= C.KEY_MAX
+        try:
+            return self._delete(key)
+        except BaseException:
+            self._abort_held_local()
+            raise
+
+    def _delete(self, key: int) -> bool:
         while True:
             addr, _, _ = self._descend(key, 0)
             la, pg = self._lock_and_read(addr)
@@ -428,6 +448,17 @@ class Tree:
 
     def _insert_parent(self, key: int, child: int, level: int,
                        path: dict[int, int]) -> None:
+        """See :meth:`_insert_parent_inner`; wrapper drops held local
+        tickets on exceptions (called directly by the engine's
+        flush_parents outside insert()'s own cleanup scope)."""
+        try:
+            self._insert_parent_inner(key, child, level, path)
+        except BaseException:
+            self._abort_held_local()
+            raise
+
+    def _insert_parent_inner(self, key: int, child: int, level: int,
+                             path: dict[int, int]) -> None:
         """internal_page_store + root growth (Tree.cpp:980-987,116-124).
 
         Root growth always anchors the new root's leftmost pointer at the

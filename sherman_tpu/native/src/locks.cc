@@ -17,6 +17,14 @@ namespace {
 
 constexpr uint32_t kMaxHandOver = 8;  // Common.h:101 parity
 
+inline void cpu_relax() {
+#if defined(__x86_64__)
+  __builtin_ia32_pause();
+#else
+  std::atomic_signal_fence(std::memory_order_seq_cst);
+#endif
+}
+
 struct alignas(64) LocalLock {
   std::atomic<uint32_t> ticket{0};
   std::atomic<uint32_t> current{0};
@@ -53,7 +61,7 @@ SHN_EXPORT int shn_lt_acquire(void* h, uint64_t i) {
   auto& l = ((LockTable*)h)->locks[i];
   uint32_t my = l.ticket.fetch_add(1, std::memory_order_relaxed);
   while (l.current.load(std::memory_order_acquire) != my) {
-    // spin; callers on the Python side batch work, so contention is short
+    cpu_relax();  // holders run whole DSM steps; don't starve their core
   }
   return l.handed_over ? 1 : 0;
 }
@@ -104,14 +112,6 @@ SHN_EXPORT int shn_lt_release(void* h, uint64_t i, int handover_ok) {
 // ---------------------------------------------------------------------------
 
 namespace {
-
-inline void cpu_relax() {
-#if defined(__x86_64__)
-  __builtin_ia32_pause();
-#else
-  std::atomic_signal_fence(std::memory_order_seq_cst);
-#endif
-}
 
 struct WRLock {
   static constexpr uint32_t kWriter = 1u << 31;

@@ -510,6 +510,11 @@ class DSM(_HostOps):
         # Per-step request slots available to the *host* API; device kernels
         # compose dsm_step_spmd directly and have their own batches.
         self.host_slots = len(self.local_nodes) * self._host_cfg.step_capacity
+        # Host-API steps mutate self.pool/locks/counters with donated
+        # buffers; serialize them so multithreaded clients (the local
+        # lock tier's use case) can't interleave inside a step.
+        import threading
+        self._step_mutex = threading.Lock()
 
     # -- raw step ------------------------------------------------------------
 
@@ -520,7 +525,13 @@ class DSM(_HostOps):
         cover all slots.  Multi-host: a COLLECTIVE — every process calls
         with its own host-local arrays [len(local_nodes)*R] and receives
         replies for its slots only.
+
+        Thread-safe: one step at a time (the state arrays are donated).
         """
+        with self._step_mutex:
+            return self._step_locked(reqs)
+
+    def _step_locked(self, reqs: dict[str, np.ndarray]) -> Replies:
         if self.multihost:
             from jax.experimental import multihost_utils as mhu
             reqs = {k: mhu.host_local_array_to_global_array(

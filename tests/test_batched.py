@@ -164,6 +164,27 @@ def test_counters_move(eight_devices):
     assert after["write_ops"] >= before["write_ops"] + 32
 
 
+def test_descent_read_accounting_exact(eight_devices):
+    """Generic-descent read counters charge ACTUAL gathers (DSM.cpp:17-21
+    semantics), not the static iteration budget: on a quiescent tree a
+    routerless search costs exactly (height+1) loop reads + 1 final
+    leaf gather per key — on the multi-node fori path too, where done
+    rows post inactive (uncounted) requests."""
+    tree, eng = make()
+    rng = np.random.default_rng(12)
+    keys = np.unique(rng.integers(1, 1 << 40, 4000, dtype=np.uint64))
+    batched.bulk_load(tree, keys, keys * np.uint64(3))
+    assert tree._root_level >= 1
+    sample = keys[: 512]
+    before = tree.dsm.counter_snapshot()
+    _, found = eng.search(sample)
+    assert bool(found.all())
+    after = tree.dsm.counter_snapshot()
+    reads = after["read_ops"] - before["read_ops"]
+    assert reads == sample.size * (tree._root_level + 2)
+    assert (after["read_bytes"] - before["read_bytes"]) == reads * 1024
+
+
 def test_batched_delete(eight_devices):
     tree, eng = make()
     rng = np.random.default_rng(6)

@@ -29,20 +29,9 @@ COUNTER_WOFF = 200  # spare word of the root page
 def _mk_cluster():
     cfg = DSMConfig(machine_nr=1, pages_per_node=32, locks_per_node=8,
                     step_capacity=16, chunk_pages=8)
-    cluster = Cluster(cfg)
-    # The host DSM mutates shared arrays per step; serialize steps so
-    # threads interleave at the protocol level, not inside a step (a
-    # real deployment's threads each drive their own steps; the mutex
-    # stands in for that serialization on one test process).
-    mutex = threading.Lock()
-    orig = cluster.dsm._batch
-
-    def locked_batch(rows):
-        with mutex:
-            return orig(rows)
-
-    cluster.dsm._batch = locked_batch
-    return cluster
+    # threads drive the host API directly: DSM.step's own mutex is the
+    # serialization under test (donated state arrays, one step at a time)
+    return Cluster(cfg)
 
 
 def test_handover_reduces_global_cas_and_unlocks():

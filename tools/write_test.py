@@ -42,6 +42,21 @@ def main(argv=None) -> None:
     # seed the tree so inserts exercise the non-split fast path, then
     # measure a fresh upsert pass over every key
     batched.bulk_load(tree, keys, keys)
+
+    # exact read-accounting parity (DSM.cpp:17-21 counter semantics): on
+    # a quiescent tree a routerless descent costs exactly one page read
+    # per level per key — (height+1) loop reads + 1 final leaf gather
+    sample = keys[:2048]
+    c0 = dsm.counter_snapshot()
+    got, found = eng.search(sample)
+    assert bool(found.all())
+    c1 = dsm.counter_snapshot()
+    reads = c1["read_ops"] - c0["read_ops"]
+    expect = sample.size * (tree._root_level + 2)
+    assert reads == expect, f"read accounting drift: {reads} != {expect}"
+    print(f"read accounting parity: {reads:,} reads for {sample.size:,} "
+          f"keys at height {tree._root_level} (exact)")
+
     eng.attach_router()
     base = dsm.counter_snapshot()
 
