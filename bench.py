@@ -147,7 +147,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             act[:uk.shape[0]] = True
             dev_batches.append(
                 (jax.device_put(khi, shard), jax.device_put(klo, shard),
-                 jax.device_put(router.host_start(khi), shard),
+                 jax.device_put(router.host_start(khi, klo), shard),
                  jax.device_put(act, shard),
                  jax.device_put(inv.astype(np.int32), shard)))
         del uniq
@@ -186,7 +186,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         act = jax.device_put(np.ones(batch, bool), shard)
         dev_batches = [
             (jax.device_put(khi[i], shard), jax.device_put(klo[i], shard),
-             jax.device_put(router.host_start(khi[i]), shard), act)
+             jax.device_put(router.host_start(khi[i], klo[i]), shard), act)
             for i in range(n_batches)
         ]
         fn = eng._get_search(iters, with_start=True)

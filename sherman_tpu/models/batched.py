@@ -1019,7 +1019,7 @@ class BatchedEngine:
                 np.int32(self.tree._root_addr),
                 self._shard(ar), self._shard(aw)]
         if use_router:
-            args.append(self._shard(self.router.host_start(khi)))
+            args.append(self._shard(self.router.host_start(khi, klo)))
         (self.dsm.pool, self.dsm.counters, status, done_r, found,
          rvh, rvl) = fn(*args)
         status, done_r, found, rvh, rvl = self._unshard(
@@ -1121,7 +1121,7 @@ class BatchedEngine:
                 self._shard(khi), self._shard(klo),
                 np.int32(self.tree._root_addr), self._shard(active)]
         if use_router:
-            args.append(self._shard(self.router.host_start(khi)))
+            args.append(self._shard(self.router.host_start(khi, klo)))
         self.dsm.counters, done, found, vhi, vlo = fn(*args)
         done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
         done = done[:n]
@@ -1376,17 +1376,19 @@ class BatchedEngine:
             active, _ = self._pad(np.ones(idx.shape[0], bool))
             # The router is CORRECT on every round (seeds never land right
             # of a key's leaf; note_split keeps it current), and retries
-            # then land directly on freshly split leaves.  But a
-            # degenerate router (e.g. a sub-2^32 keyspace collapsing into
-            # one bucket) seeds far left of the leaf, and keys whose
-            # sibling chase exceeds the descent budget would retry
-            # FOREVER: once a round makes no progress, LATCH off the
-            # router for the rest of the chunk and use root descents
-            # (fence-guided, height-bounded) like search's straggler
-            # retry.  (The latch also avoids oscillating: resetting on
-            # progress would re-enable the same degenerate seeds every
-            # other round.)  First fallback round pays a one-time compile
-            # of the no-seed insert kernel; it is cached after that.
+            # then land directly on freshly split leaves.  But seeds that
+            # land far left of a key's leaf (a cold unseeded table deep in
+            # a tall tree, or a coarse span right after _grow_span) can
+            # cost sibling chases beyond the descent budget, and such
+            # keys would retry FOREVER: once a round makes no progress,
+            # LATCH off the router for the rest of the chunk and use root
+            # descents (fence-guided, height-bounded) like search's
+            # straggler retry.  (Sub-2^32 keyspaces used to be the main
+            # trigger; they now bucket at full resolution — the latch
+            # remains the generic no-progress backstop.  It also avoids
+            # oscillating: resetting on progress would re-enable the same
+            # seeds every other round.)  First fallback round pays a
+            # one-time compile of the no-seed insert kernel; cached after.
             if stalled > 0:
                 router_usable = False
             use_router = router_usable
@@ -1396,7 +1398,7 @@ class BatchedEngine:
                     self._shard(vhi), self._shard(vlo),
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
-                args.append(self._shard(self.router.host_start(khi)))
+                args.append(self._shard(self.router.host_start(khi, klo)))
             args.append(self._shard(fresh_np))
             self.dsm.pool, self.dsm.counters, status, log = fn(*args)
             status = self._unshard(status)[:idx.shape[0]]
@@ -1481,7 +1483,7 @@ class BatchedEngine:
                     self._shard(khi), self._shard(klo),
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
-                args.append(self._shard(self.router.host_start(khi)))
+                args.append(self._shard(self.router.host_start(khi, klo)))
             self.dsm.pool, self.dsm.counters, status = fn(*args)
             status = self._unshard(status)[:idx.shape[0]]
 

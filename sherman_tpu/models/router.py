@@ -15,12 +15,16 @@ sibling chase (``Tree.cpp:626-629``) self-heals, exactly like the
 reference's stale-cache re-descend (``Tree.cpp:430-443``).  Maintenance:
 
 - ``seed_from_leaves`` — vectorized rebuild from a bulk load's leaf
-  directory (addrs + lowest fences).
+  directory (addrs + lowest fences), adapting ``shift`` to the observed
+  key span (any span: the probe reads the full 64-bit key, so sub-2^32
+  keyspaces bucket normally).
 - ``note_split``    — on a leaf split, point every bucket whose start lies
   in [split_key, old_high) at the new right sibling (the invalidate +
   re-fill of ``IndexCache.h:209-225``, minus the epoch delay-free: entries
   are values in an immutable functional array, so there is nothing to
-  race with).
+  race with).  A split beyond the seeded span GROWS the span first
+  (``_grow_span``): the table remaps so later out-of-span keys stop
+  paying a full sibling chase.
 - ``reset``         — point everything back at the root (cold cache).
 """
 
@@ -39,21 +43,21 @@ class LeafRouter:
     page gather per key.
 
     Buckets partition the keyspace by ``lb`` bits starting at ``shift``:
-    by default the TOP bits, and :meth:`seed_from_leaves` adapts ``shift``
-    to the observed key range — keyspaces spanning (2^32, 2^64) (e.g.
-    48-bit ids) would otherwise collapse into bucket 0 and pay a
-    full-chain sibling chase per lookup.  ``shift`` never drops below 32
-    because the probe reads only the key's high word: a keyspace entirely
-    below 2^32 still degenerates to one bucket — pre-hash such keys."""
+    by default the TOP bits; :meth:`seed_from_leaves` adapts ``shift`` to
+    the observed key range, and :meth:`note_split` grows it again when
+    splits land beyond the seeded span.  The probe reads the FULL 64-bit
+    key (both int32 words), so any keyspace — including ones entirely
+    below 2^32 — buckets at full resolution."""
 
     def __init__(self, tree, log2_buckets: int):
         assert 1 <= log2_buckets <= 32
         self.tree = tree
         self.lb = log2_buckets
         self.nb = 1 << log2_buckets
-        self.shift = max(32, 64 - log2_buckets)
+        self.shift = 64 - log2_buckets
         self.table_np = np.full(self.nb, np.int32(tree._root_addr))
         self.splits_noted = 0
+        self.span_grows = 0
         tree.router = self
 
     # -- maintenance ---------------------------------------------------------
@@ -72,19 +76,38 @@ class LeafRouter:
         top-bit bucketing would put every key in bucket 0."""
         hi = int(np.max(leaf_lows)) if len(leaf_lows) else 0
         span_bits = max(1, hi.bit_length())
-        # cover [0, 2^span_bits) with 2^lb buckets, probe-limited to the
-        # key's high word (shift >= 32); keys beyond the span clip into
-        # the last bucket and self-heal rightward like any stale seed
-        self.shift = min(64 - self.lb, max(32, span_bits - self.lb))
+        # cover [0, 2^span_bits) with 2^lb buckets; keys beyond the span
+        # clip into the last bucket until a split there grows the span
+        self.shift = min(64 - self.lb, max(0, span_bits - self.lb))
         starts = (np.arange(self.nb, dtype=np.uint64)
                   << np.uint64(self.shift))
         idx = np.searchsorted(leaf_lows, starts, side="right") - 1
         self.table_np = (
             leaf_addrs[np.clip(idx, 0, len(leaf_addrs) - 1)].astype(np.int32))
 
+    def _grow_span(self, new_max: int) -> None:
+        """A split landed beyond the seeded span: re-derive ``shift`` to
+        cover it and remap the table — each new (wider) bucket adopts the
+        seed of the old bucket containing its start key, preserving the
+        lowest-fence invariant.  New buckets past the old span inherit
+        the old last bucket and self-heal rightward via note_split."""
+        span_bits = max(1, int(new_max).bit_length())
+        ns = min(64 - self.lb, max(0, span_bits - self.lb))
+        if ns <= self.shift:
+            return
+        step = ns - self.shift
+        idx = np.minimum(
+            np.arange(self.nb, dtype=np.uint64) << np.uint64(step),
+            np.uint64(self.nb - 1))
+        self.table_np = self.table_np[idx.astype(np.int64)]
+        self.shift = ns
+        self.span_grows += 1
+
     def note_split(self, split_key: int, new_addr: int,
                    old_high: int) -> None:
         """Leaf [.., old_high) split at split_key; right half -> new_addr."""
+        if (split_key >> self.shift) >= self.nb:
+            self._grow_span(split_key)
         b_lo = (split_key + (1 << self.shift) - 1) >> self.shift
         if old_high >= C.KEY_POS_INF:
             b_hi = self.nb
@@ -97,11 +120,15 @@ class LeafRouter:
 
     # -- host-side lookup (the CN cache probe, Tree.cpp:415-427) -------------
 
-    def host_start(self, khi: np.ndarray) -> np.ndarray:
-        """Start addresses for a batch: khi is the int32 high-word view of
-        the keys; returns [B] int32 page addrs (normally the leaf)."""
-        bucket = np.asarray(khi).view(np.uint32) >> np.uint32(self.shift - 32)
-        return self.table_np[np.minimum(bucket, np.uint32(self.nb - 1))]
+    def host_start(self, khi: np.ndarray, klo: np.ndarray) -> np.ndarray:
+        """Start addresses for a batch: khi/klo are the int32 word views
+        of the keys; returns [B] int32 page addrs (normally the leaf)."""
+        key = ((np.asarray(khi).view(np.uint32).astype(np.uint64)
+                << np.uint64(32))
+               | np.asarray(klo).view(np.uint32).astype(np.uint64))
+        bucket = np.minimum(key >> np.uint64(self.shift),
+                            np.uint64(self.nb - 1))
+        return self.table_np[bucket.astype(np.int64)]
 
 
 def default_log2_buckets(n_leaves: int) -> int:

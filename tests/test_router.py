@@ -82,3 +82,47 @@ def test_router_stale_after_external_splits(eight_devices):
     assert found.all()
     np.testing.assert_array_equal(
         got, np.arange(3, 401, 2, dtype=np.uint64))
+
+
+def test_router_narrow_keyspace_buckets(eight_devices):
+    """Keyspaces entirely below 2^32 bucket at full resolution (the probe
+    reads both key words); seeds must spread over many buckets, not
+    collapse into bucket 0."""
+    tree, eng = make()
+    keys = np.arange(1, 3000, dtype=np.uint64)  # 12-bit span
+    batched.bulk_load(tree, keys, keys * np.uint64(9))
+    r = eng.attach_router()
+    assert r.shift < 32, f"narrow span must probe the low word: {r.shift}"
+    # seeds spread: many distinct leaves appear in the table
+    assert np.unique(r.table_np).size > 10
+    got, found = eng.search(keys)
+    assert found.all()
+    np.testing.assert_array_equal(got, keys * np.uint64(9))
+    # and inserts (splits) keep working without the livelock latch
+    extra = np.arange(3000, 4500, dtype=np.uint64)
+    stats = eng.insert(extra, extra)
+    assert stats["applied"] == extra.size
+    got, found = eng.search(extra)
+    assert found.all()
+
+
+def test_router_grows_span_on_out_of_range_splits(eight_devices):
+    """Splits beyond the seeded span grow the table's span (remap) so
+    append-beyond-span workloads stop paying full sibling chases."""
+    tree, eng = make()
+    keys = np.arange(1, 2000, dtype=np.uint64)
+    batched.bulk_load(tree, keys, keys)
+    r = eng.attach_router()
+    s0, shift0 = r.span_grows, r.shift
+    # append far beyond the seeded span -> splits out there
+    far = np.arange(1 << 40, (1 << 40) + 3000, dtype=np.uint64)
+    eng.insert(far, far + np.uint64(2))
+    assert r.span_grows > s0, "out-of-span splits did not grow the table"
+    assert r.shift > shift0
+    # all keys (old span and new) remain reachable, seeds stay valid
+    got, found = eng.search(keys)
+    assert found.all()
+    got, found = eng.search(far)
+    assert found.all()
+    np.testing.assert_array_equal(got, far + np.uint64(2))
+    tree.check_structure()

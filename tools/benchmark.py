@@ -124,7 +124,7 @@ def main(argv=None) -> dict:
         nv_hi, nv_lo = bits.keys_to_pairs(bk ^ np.uint64(0xBEEF + salt))
         return dict(
             khi=jax.device_put(khi, shard), klo=jax.device_put(klo, shard),
-            start=jax.device_put(router.host_start(khi), shard),
+            start=jax.device_put(router.host_start(khi, klo), shard),
             vhi=jax.device_put(nv_hi, shard),
             vlo=jax.device_put(nv_lo, shard),
             act_r=(act_r if hasattr(act_r, "devices")
