@@ -1538,7 +1538,10 @@ def range_query(eng: "BatchedEngine", lo: int, hi: int
     fetched: dict[int, np.ndarray] = {}
     if eng.router is not None:
         r = eng.router
-        b_lo = lo >> r.shift
+        # clamp BOTH ends into the table: out-of-span ranges (common now
+        # that narrow keyspaces seed small shifts) start from the last
+        # bucket's seed instead of silently skipping the prefetch
+        b_lo = min(r.nb - 1, lo >> r.shift)
         b_hi = min(r.nb - 1, max(0, (hi - 1) >> r.shift))
         cand = np.unique(r.table_np[b_lo:b_hi + 1])
         if cand.size:

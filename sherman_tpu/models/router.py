@@ -123,9 +123,8 @@ class LeafRouter:
     def host_start(self, khi: np.ndarray, klo: np.ndarray) -> np.ndarray:
         """Start addresses for a batch: khi/klo are the int32 word views
         of the keys; returns [B] int32 page addrs (normally the leaf)."""
-        key = ((np.asarray(khi).view(np.uint32).astype(np.uint64)
-                << np.uint64(32))
-               | np.asarray(klo).view(np.uint32).astype(np.uint64))
+        from sherman_tpu.ops import bits
+        key = bits.pairs_to_keys(np.asarray(khi), np.asarray(klo))
         bucket = np.minimum(key >> np.uint64(self.shift),
                             np.uint64(self.nb - 1))
         return self.table_np[bucket.astype(np.int64)]

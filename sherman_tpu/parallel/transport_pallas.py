@@ -19,11 +19,15 @@ Parity/selection: ``DSMConfig.exchange_impl = "xla" | "pallas"`` switches
 the DSM step's exchanges.  The Pallas path is validated in interpreter mode
 on the virtual CPU mesh (tests); the XLA path remains the default
 (compiler-scheduled, equal-or-faster, and exempt from Mosaic toolchain
-constraints).  KNOWN COVERAGE GAP: the pre-post cluster barrier
-(``use_barrier``) only exists in compiled multi-chip programs — the
-interpreter cannot lower ``get_barrier_semaphore`` and runs devices
-sequentially, so that branch ships untested until a real multi-chip run;
-treat "pallas" as experimental on hardware.
+constraints).  COVERAGE: the pre-post cluster barrier (``use_barrier``)
+cannot run in the interpreter (it cannot lower ``get_barrier_semaphore``
+and runs devices sequentially), but the full compiled form — barrier
+included — is COMPILE-SMOKED without multi-chip hardware: the 8-device
+program is lowered for the TPU target through the Pallas->Mosaic pipeline
+over an ``AbstractMesh`` (``tests/test_transport_pallas.py::
+test_multichip_tpu_lowering_smoke``), which verifies the semaphore
+signal/wait and remote-copy lowering.  EXECUTING the barrier still needs
+real multi-chip hardware; until then treat "pallas" as experimental there.
 
 Layout contract (same as ``transport.exchange`` with tiled all_to_all):
 arrays are ``[N * C, ...]`` per node — row block ``d*C:(d+1)*C`` is the
@@ -33,6 +37,7 @@ bucket for/from peer ``d``.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +49,17 @@ try:  # pallas is TPU-oriented; CPU uses interpreter mode
 except Exception:  # pragma: no cover
     HAVE_PALLAS = False
 
-# distinct collective_id per program shape family (barrier semaphore key)
-_COLLECTIVE_ID = 11
+def _collective_id(n_nodes: int, rows: int, width: int) -> int:
+    """Barrier-semaphore key, distinct per program shape family.
+
+    Two pallas programs sharing a collective_id share a barrier
+    semaphore and could cross-credit if the runtime ever overlapped
+    them; deriving the id from (n_nodes, rows_per_peer, width) gives
+    each compiled exchange shape its own semaphore.  A hash collision
+    degrades to the shared-semaphore case, which is still safe under
+    the TPU runtime's in-launch-order execution of collectives — the
+    same contract a single fixed id relied on for ALL families."""
+    return 11 + (n_nodes * 7919 + rows * 131 + width) % 4093
 
 
 def _exchange_kernel(x_ref, out_ref, send_sem, recv_sem, *, n_nodes: int,
@@ -123,7 +137,8 @@ def exchange_pallas(x, axis_name: str, n_nodes: int, *,
         scratch_shapes=[pltpu.SemaphoreType.DMA((n_nodes,)),
                         pltpu.SemaphoreType.DMA((n_nodes,))],
         compiler_params=pltpu.CompilerParams(
-            collective_id=_COLLECTIVE_ID),
+            collective_id=_collective_id(
+                n_nodes, C, math.prod(x.shape[1:]))),
         interpret=interpret,
     )(x)
 
