@@ -6,7 +6,10 @@ beyond parity: one call snapshots everything a cluster needs to come
 back — the sharded pool (which contains every page AND the root-pointer
 meta words), the lock table, op counters, and each directory's allocator
 bump state — into a single ``.npz``; ``restore`` rebuilds a live Cluster
-on any mesh of the same ``machine_nr``.
+on any mesh of the same ``machine_nr``.  Multi-host clusters checkpoint
+collectively: one shard file per host plus a manifest from process 0
+(mirrored directory state needs no gathering), restored onto the same
+nodes-per-host partition.
 
 Client-side chunk leases (LocalAllocator tails) are deliberately NOT
 saved: clients re-register after restore and lease fresh chunks.  The
@@ -33,27 +36,65 @@ _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
                "exchange_impl")
 
 
+def _local_block(arr) -> np.ndarray:
+    """This host's contiguous block of a node-sharded array, shards
+    ordered by their global row offset."""
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
 def checkpoint(cluster, path: str) -> None:
     """Write the cluster's full state to ``path`` (.npz).
 
-    Single-process clusters only (every shard addressable from this
-    host): a multi-host deployment needs per-host shard files + a
-    gathered manifest, which is future work.
+    Multi-host clusters write one shard file per host
+    (``<path>.host<k>.npz`` with that process's node block) plus the
+    manifest at ``<path>`` from process 0 (directory/allocator state is
+    mirrored identically on every process, so the manifest needs no
+    gathering); every process must call (collective — barrier at the
+    end).  Restore requires the same machine_nr AND the same
+    nodes-per-host partition.
     """
     if not path.endswith(".npz"):
         path += ".npz"  # np.savez appends it silently; keep restore in sync
     if cluster.keeper.is_multihost:
-        raise NotImplementedError(
-            "checkpoint of a multi-host cluster is not supported yet: "
-            "the pool spans non-addressable devices; snapshot per host")
+        import jax
+        dsm = cluster.dsm
+        me = jax.process_index()
+        np.savez_compressed(
+            f"{path}.host{me}.npz",
+            pool=_local_block(dsm.pool),
+            locks=_local_block(dsm.locks),
+            counters=_local_block(dsm.counters),
+            nodes=np.asarray(list(dsm.local_nodes), np.int64),
+        )
+        # EVERY process writes the manifest (the state is mirrored, so
+        # contents are identical): each host's disk gets one, with no
+        # shared-filesystem requirement.  Atomic replace keeps same-disk
+        # processes from interleaving writes.
+        tmp = f"{path}.tmp{me}.npz"
+        np.savez_compressed(
+            tmp, multihost=np.asarray([jax.process_count()], np.int64),
+            **_manifest(cluster))
+        os.replace(tmp, path)
+        cluster.keeper.barrier("checkpoint")
+        return
     dsm = cluster.dsm
-    cfg = {f: getattr(cluster.cfg, f) for f in _CFG_FIELDS}
     np.savez_compressed(
         path,
-        cfg=np.frombuffer(json.dumps(cfg).encode(), np.uint8),
         pool=np.asarray(dsm.pool),
         locks=np.asarray(dsm.locks),
         counters=np.asarray(dsm.counters),
+        **_manifest(cluster),
+    )
+
+
+def _manifest(cluster) -> dict:
+    """Config + directory/allocator state — the part of a checkpoint that
+    is host-independent (mirrored on every process in multi-host)."""
+    cfg = {f: getattr(cluster.cfg, f) for f in _CFG_FIELDS}
+    return dict(
+        cfg=np.frombuffer(json.dumps(cfg).encode(), np.uint8),
         dir_nodes=np.asarray([d.node_id for d in cluster.directories],
                              np.int64),
         dir_next=np.asarray(
@@ -74,14 +115,40 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
         path += ".npz"
     with np.load(path) as z:
         cfg = DSMConfig(**json.loads(bytes(z["cfg"]).decode()))
+        saved_mh = int(z["multihost"][0]) if "multihost" in z else 0
         cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
         dsm = cluster.dsm
-        dsm.pool = jax.device_put(z["pool"], dsm.shard)
-        locks = z["locks"]
-        if clear_locks:
-            locks = np.zeros_like(locks)
-        dsm.locks = jax.device_put(locks, dsm.shard)
-        dsm.counters = jax.device_put(z["counters"], dsm.shard)
+        if cluster.keeper.is_multihost:
+            assert saved_mh == jax.process_count(), (
+                f"checkpoint was taken on {saved_mh} hosts; restoring on "
+                f"{jax.process_count()} needs the same node partition")
+            from jax.experimental import multihost_utils as mhu
+            from jax.sharding import PartitionSpec
+
+            from sherman_tpu.parallel.mesh import AXIS
+            me = jax.process_index()
+            spec = PartitionSpec(AXIS)
+            with np.load(f"{path}.host{me}.npz") as h:
+                assert list(h["nodes"]) == list(dsm.local_nodes), (
+                    "per-host node blocks changed since the checkpoint")
+                glob = lambda x: mhu.host_local_array_to_global_array(
+                    x, dsm.mesh, spec)
+                dsm.pool = glob(h["pool"])
+                locks = h["locks"]
+                if clear_locks:
+                    locks = np.zeros_like(locks)
+                dsm.locks = glob(locks)
+                dsm.counters = glob(h["counters"])
+        else:
+            assert saved_mh == 0, (
+                "multi-host checkpoint needs a multi-host cluster (pass "
+                "init_multihost()'s keeper on every host)")
+            dsm.pool = jax.device_put(z["pool"], dsm.shard)
+            locks = z["locks"]
+            if clear_locks:
+                locks = np.zeros_like(locks)
+            dsm.locks = jax.device_put(locks, dsm.shard)
+            dsm.counters = jax.device_put(z["counters"], dsm.shard)
         by_node = {int(n): i for i, n in enumerate(z["dir_nodes"])}
         for d in cluster.directories:
             i = by_node.get(d.node_id)

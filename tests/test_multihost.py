@@ -140,6 +140,32 @@ assert not found2.any()
 tree.check_structure()
 total_splits = keeper.sum("splits", int(stats.get("device_splits", 0)))
 assert total_splits == nproc * stats["device_splits"]  # identical streams
+
+# fused mixed step (reads + upserts share one descent) across the mesh
+kept = np.setdiff1d(keys, dropped)
+mk = kept[:64]
+newv = mk ^ np.uint64(0xABC)
+is_read = np.arange(mk.size) % 2 == 0
+ov, fnd, st = eng.mixed(mk, newv, is_read)
+assert fnd[is_read].all()
+np.testing.assert_array_equal(ov[is_read], mk[is_read] * np.uint64(3))
+
+# collective checkpoint -> fresh cluster via restore -> verify
+from sherman_tpu.utils import checkpoint as CK
+ck = os.path.join(sys.argv[4], "sherman_ck.npz")
+CK.checkpoint(cluster, ck)
+cluster2 = CK.restore(ck, keeper=keeper)
+tree2 = Tree(cluster2)
+eng2 = batched.BatchedEngine(tree2, batch_per_node=32)
+got4, found4 = eng2.search(kept)
+assert found4.all(), "restored cluster lost keys"
+exp = kept * np.uint64(3)
+w = np.isin(kept, mk[~is_read])
+exp[w] = kept[w] ^ np.uint64(0xABC)
+np.testing.assert_array_equal(got4, exp)
+_, found5 = eng2.search(dropped)
+assert not found5.any(), "restored cluster resurrected deleted keys"
+
 keeper.barrier("done")
 print(f"[{pid}] ENGINE-PASS splits={stats['device_splits']}", flush=True)
 '''
@@ -159,7 +185,7 @@ def _run_workers(tmp_path, script, timeout, tag):
     # workers override platform/flags themselves
     env.pop("XLA_FLAGS", None)
     procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(pid), "2", port],
+        [sys.executable, str(worker), str(pid), "2", port, str(tmp_path)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
         cwd=repo, text=True) for pid in range(2)]
     outs = []
