@@ -30,9 +30,44 @@ reference's stale-cache re-descend (``Tree.cpp:430-443``).  Maintenance:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from sherman_tpu import config as C
+
+
+class _PyRW:
+    """Mutex stand-in for the native WRLock (pure-Python installs):
+    serializes probes with writers — coarser, but the (shift, table)
+    pair can never be observed torn."""
+
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def rlock(self):
+        self._m.acquire()
+
+    def runlock(self):
+        self._m.release()
+
+    wlock, wunlock = rlock, runlock
+
+
+class _Held:
+    """Tiny context manager over explicit acquire/release callables."""
+
+    __slots__ = ("_acq", "_rel")
+
+    def __init__(self, acq, rel):
+        self._acq, self._rel = acq, rel
+
+    def __enter__(self):
+        self._acq()
+
+    def __exit__(self, *exc):
+        self._rel()
+        return False
 
 
 class LeafRouter:
@@ -58,13 +93,28 @@ class LeafRouter:
         self.table_np = np.full(self.nb, np.int32(tree._root_addr))
         self.splits_noted = 0
         self.span_grows = 0
+        # Writer-preference RW lock guarding (table_np, shift) against
+        # multithreaded host clients: probes read-lock, maintenance
+        # write-locks — the reference WRLock's IndexCache-guard role
+        # (WRLock.h; delay-free list guard).  A plain mutex stands in
+        # when the native lib is unavailable (serialized probes, but the
+        # shift/table pair can never be observed torn).
+        from sherman_tpu import native
+        self._rw = native.WRLock() if native.available() else _PyRW()
         tree.router = self
+
+    def _read_locked(self):
+        return _Held(self._rw.rlock, self._rw.runlock)
+
+    def _write_locked(self):
+        return _Held(self._rw.wlock, self._rw.wunlock)
 
     # -- maintenance ---------------------------------------------------------
 
     def reset(self) -> None:
         self.tree._refresh_root()
-        self.table_np = np.full(self.nb, np.int32(self.tree._root_addr))
+        with self._write_locked():
+            self.table_np = np.full(self.nb, np.int32(self.tree._root_addr))
 
     def seed_from_leaves(self, leaf_addrs: np.ndarray,
                          leaf_lows: np.ndarray) -> None:
@@ -76,14 +126,15 @@ class LeafRouter:
         top-bit bucketing would put every key in bucket 0."""
         hi = int(np.max(leaf_lows)) if len(leaf_lows) else 0
         span_bits = max(1, hi.bit_length())
-        # cover [0, 2^span_bits) with 2^lb buckets; keys beyond the span
-        # clip into the last bucket until a split there grows the span
-        self.shift = min(64 - self.lb, max(0, span_bits - self.lb))
-        starts = (np.arange(self.nb, dtype=np.uint64)
-                  << np.uint64(self.shift))
-        idx = np.searchsorted(leaf_lows, starts, side="right") - 1
-        self.table_np = (
-            leaf_addrs[np.clip(idx, 0, len(leaf_addrs) - 1)].astype(np.int32))
+        with self._write_locked():
+            # cover [0, 2^span_bits) with 2^lb buckets; keys beyond the
+            # span clip into the last bucket until a split grows the span
+            self.shift = min(64 - self.lb, max(0, span_bits - self.lb))
+            starts = (np.arange(self.nb, dtype=np.uint64)
+                      << np.uint64(self.shift))
+            idx = np.searchsorted(leaf_lows, starts, side="right") - 1
+            self.table_np = (leaf_addrs[np.clip(idx, 0, len(leaf_addrs) - 1)]
+                             .astype(np.int32))
 
     def _grow_span(self, new_max: int) -> None:
         """A split landed beyond the seeded span: re-derive ``shift`` to
@@ -106,17 +157,18 @@ class LeafRouter:
     def note_split(self, split_key: int, new_addr: int,
                    old_high: int) -> None:
         """Leaf [.., old_high) split at split_key; right half -> new_addr."""
-        if (split_key >> self.shift) >= self.nb:
-            self._grow_span(split_key)
-        b_lo = (split_key + (1 << self.shift) - 1) >> self.shift
-        if old_high >= C.KEY_POS_INF:
-            b_hi = self.nb
-        else:
-            b_hi = min(self.nb,
-                       (old_high + (1 << self.shift) - 1) >> self.shift)
-        if b_lo < b_hi:
-            self.table_np[b_lo:b_hi] = np.int32(new_addr)
-        self.splits_noted += 1
+        with self._write_locked():
+            if (split_key >> self.shift) >= self.nb:
+                self._grow_span(split_key)
+            b_lo = (split_key + (1 << self.shift) - 1) >> self.shift
+            if old_high >= C.KEY_POS_INF:
+                b_hi = self.nb
+            else:
+                b_hi = min(self.nb,
+                           (old_high + (1 << self.shift) - 1) >> self.shift)
+            if b_lo < b_hi:
+                self.table_np[b_lo:b_hi] = np.int32(new_addr)
+            self.splits_noted += 1
 
     # -- host-side lookup (the CN cache probe, Tree.cpp:415-427) -------------
 
@@ -125,9 +177,10 @@ class LeafRouter:
         of the keys; returns [B] int32 page addrs (normally the leaf)."""
         from sherman_tpu.ops import bits
         key = bits.pairs_to_keys(np.asarray(khi), np.asarray(klo))
-        bucket = np.minimum(key >> np.uint64(self.shift),
-                            np.uint64(self.nb - 1))
-        return self.table_np[bucket.astype(np.int64)]
+        with self._read_locked():
+            bucket = np.minimum(key >> np.uint64(self.shift),
+                                np.uint64(self.nb - 1))
+            return self.table_np[bucket.astype(np.int64)]
 
 
 def default_log2_buckets(n_leaves: int) -> int:

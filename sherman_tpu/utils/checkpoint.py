@@ -7,9 +7,9 @@ back — the sharded pool (which contains every page AND the root-pointer
 meta words), the lock table, op counters, and each directory's allocator
 bump state — into a single ``.npz``; ``restore`` rebuilds a live Cluster
 on any mesh of the same ``machine_nr``.  Multi-host clusters checkpoint
-collectively: one shard file per host plus a manifest from process 0
-(mirrored directory state needs no gathering), restored onto the same
-nodes-per-host partition.
+collectively: one shard file per host plus the (mirrored, identical)
+manifest written by every host, restored onto the same nodes-per-host
+partition.
 
 Client-side chunk leases (LocalAllocator tails) are deliberately NOT
 saved: clients re-register after restore and lease fresh chunks.  The
@@ -48,12 +48,15 @@ def checkpoint(cluster, path: str) -> None:
     """Write the cluster's full state to ``path`` (.npz).
 
     Multi-host clusters write one shard file per host
-    (``<path>.host<k>.npz`` with that process's node block) plus the
-    manifest at ``<path>`` from process 0 (directory/allocator state is
-    mirrored identically on every process, so the manifest needs no
-    gathering); every process must call (collective — barrier at the
-    end).  Restore requires the same machine_nr AND the same
-    nodes-per-host partition.
+    (``<path>.host<k>.npz`` with that process's node block) and EVERY
+    process writes the (identical, mirrored) manifest at ``<path>`` —
+    each host's own disk gets both files, no shared filesystem needed;
+    every process must call (collective — barrier at the end).  All
+    files are written atomically (tmp + replace) and carry a shared
+    epoch, so a crash mid-checkpoint leaves the PREVIOUS checkpoint
+    intact and restore rejects mixed-epoch shard/manifest pairs.
+    Restore requires the same machine_nr AND the same nodes-per-host
+    partition.
     """
     if not path.endswith(".npz"):
         path += ".npz"  # np.savez appends it silently; keep restore in sync
@@ -61,32 +64,44 @@ def checkpoint(cluster, path: str) -> None:
         import jax
         dsm = cluster.dsm
         me = jax.process_index()
-        np.savez_compressed(
-            f"{path}.host{me}.npz",
+        # epoch pairing shard <-> manifest: a per-process monotonic count
+        # (identical under replicated control flow) + manifest digest
+        seq = cluster.keeper.mem_fetch_and_add("checkpoint_epoch")
+        man = _manifest(cluster)
+        import zlib
+        dig = zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes()
+                                  for v in man.values()))
+        epoch = np.asarray([seq, dig], np.int64)
+        _savez_atomic(
+            f"{path}.host{me}.npz", me,
             pool=_local_block(dsm.pool),
             locks=_local_block(dsm.locks),
             counters=_local_block(dsm.counters),
             nodes=np.asarray(list(dsm.local_nodes), np.int64),
+            epoch=epoch,
         )
-        # EVERY process writes the manifest (the state is mirrored, so
-        # contents are identical): each host's disk gets one, with no
-        # shared-filesystem requirement.  Atomic replace keeps same-disk
-        # processes from interleaving writes.
-        tmp = f"{path}.tmp{me}.npz"
-        np.savez_compressed(
-            tmp, multihost=np.asarray([jax.process_count()], np.int64),
-            **_manifest(cluster))
-        os.replace(tmp, path)
+        _savez_atomic(
+            path, me,
+            multihost=np.asarray([jax.process_count()], np.int64),
+            epoch=epoch, **man)
         cluster.keeper.barrier("checkpoint")
         return
     dsm = cluster.dsm
-    np.savez_compressed(
-        path,
+    _savez_atomic(
+        path, 0,
         pool=np.asarray(dsm.pool),
         locks=np.asarray(dsm.locks),
         counters=np.asarray(dsm.counters),
         **_manifest(cluster),
     )
+
+
+def _savez_atomic(path: str, tag: int, **arrays) -> None:
+    """np.savez_compressed via tmp + atomic replace: a crash mid-write
+    never clobbers an existing checkpoint file."""
+    tmp = f"{path}.tmp{tag}.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
 
 
 def _manifest(cluster) -> dict:
@@ -131,6 +146,11 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
             with np.load(f"{path}.host{me}.npz") as h:
                 assert list(h["nodes"]) == list(dsm.local_nodes), (
                     "per-host node blocks changed since the checkpoint")
+                if "epoch" in h and "epoch" in z:
+                    assert (np.asarray(h["epoch"])
+                            == np.asarray(z["epoch"])).all(), (
+                        "shard file and manifest are from different "
+                        "checkpoints (torn/partial write?)")
                 glob = lambda x: mhu.host_local_array_to_global_array(
                     x, dsm.mesh, spec)
                 dsm.pool = glob(h["pool"])
