@@ -71,7 +71,10 @@ def checkpoint(cluster, path: str) -> None:
         import zlib
         dig = zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes()
                                   for v in man.values()))
-        epoch = np.asarray([seq, dig], np.int64)
+        # int32 throughout: restore allgathers the epoch, and jax (x64
+        # disabled) canonicalizes int64 -> int32, which would wrap an
+        # unsigned crc and break the cross-host equality check
+        epoch = np.asarray([seq, np.uint32(dig).view(np.int32)], np.int32)
         _savez_atomic(
             f"{path}.host{me}.npz", me,
             pool=_local_block(dsm.pool),
@@ -146,11 +149,24 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
             with np.load(f"{path}.host{me}.npz") as h:
                 assert list(h["nodes"]) == list(dsm.local_nodes), (
                     "per-host node blocks changed since the checkpoint")
-                if "epoch" in h and "epoch" in z:
-                    assert (np.asarray(h["epoch"])
-                            == np.asarray(z["epoch"])).all(), (
+                # epoch pairing: shard and manifest must be from the SAME
+                # checkpoint — a one-sided epoch (legacy file mixed with a
+                # new one) is itself a torn pair, not a skip case
+                assert ("epoch" in h) == ("epoch" in z), (
+                    "shard/manifest epoch mismatch: one file predates "
+                    "epoch-tagged checkpoints — torn checkpoint")
+                if "epoch" in h:
+                    ep = np.asarray(h["epoch"])
+                    assert (ep == np.asarray(z["epoch"])).all(), (
                         "shard file and manifest are from different "
                         "checkpoints (torn/partial write?)")
+                    # ... and from the SAME checkpoint on EVERY host: a
+                    # crash mid-collective leaves self-consistent pairs
+                    # at different epochs across hosts
+                    all_eps = np.asarray(mhu.process_allgather(ep))
+                    assert (all_eps == ep).all(), (
+                        "hosts hold checkpoints from different epochs "
+                        "(crashed mid-checkpoint?): refusing to mix")
                 glob = lambda x: mhu.host_local_array_to_global_array(
                     x, dsm.mesh, spec)
                 dsm.pool = glob(h["pool"])

@@ -47,6 +47,22 @@ def test_fuzz_batched_vs_model(eight_devices, seed, key_bits):
         return rng.choice(keyspace, size=n, replace=True)
 
     for round_i in range(12):
+        if round_i == 6:
+            # mid-run durability: checkpoint + restore into a fresh
+            # cluster and CONTINUE the storm against the same model —
+            # restored state must be indistinguishable (pages, root,
+            # allocator bump state all survive)
+            import tempfile
+
+            from sherman_tpu.utils import checkpoint as CK
+            with tempfile.TemporaryDirectory() as d:
+                import os
+                p = os.path.join(d, "fuzz_ck.npz")
+                CK.checkpoint(cluster, p)
+                cluster = CK.restore(p)
+            tree = Tree(cluster)
+            eng = batched.BatchedEngine(tree, batch_per_node=128)
+            eng.attach_router()
         op = rng.integers(0, 5)
         if op == 0:  # batched upsert (mix of new + existing keys, dups)
             ks = pick(200)
