@@ -122,14 +122,27 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
         _, addr, done, nreads = lax.while_loop(
             cond, bodyw, (0, addr, done, nreads))
     else:
-        # SPMD: every node must run the same trip count (the body carries
-        # all_to_all exchanges), so the budget is static.  Rows that are
-        # already done post inactive requests — not counted as reads.
-        def body(_, st):
-            return advance(*st)
+        # SPMD: every node must run the SAME trip count (the body carries
+        # all_to_all exchanges) — but it need not be the static budget:
+        # a psum of the pending count is identical on every node, so a
+        # while_loop on it exits uniformly as soon as the whole mesh is
+        # done (with router seeds that is typically round 1-2, not the
+        # full height+chase budget).  Rows already done post inactive
+        # requests — not counted as reads.
+        def pend_of(done):
+            return lax.psum(jnp.sum((~done).astype(jnp.int32)), axis_name)
 
-        addr, done, nreads = lax.fori_loop(0, iters, body,
-                                           (addr, done, nreads))
+        def cond(st):
+            it, _, _, _, pend = st
+            return (it < iters) & (pend > 0)
+
+        def body(st):
+            it, addr, done, nreads, _ = st
+            addr, done, nreads = advance(addr, done, nreads)
+            return it + 1, addr, done, nreads, pend_of(done)
+
+        _, addr, done, nreads, _ = lax.while_loop(
+            cond, body, (0, addr, done, nreads, pend_of(done)))
 
     # one final gather yields the leaf pages for the done keys
     page, ok_f = D.read_pages_spmd(pool, addr, cfg=cfg, axis_name=axis_name,

@@ -64,17 +64,25 @@ def checkpoint(cluster, path: str) -> None:
         import jax
         dsm = cluster.dsm
         me = jax.process_index()
-        # epoch pairing shard <-> manifest: a per-process monotonic count
-        # (identical under replicated control flow) + manifest digest
+        # Epoch pairing shard <-> manifest AND checkpoint <-> checkpoint:
+        # (nonce, seq, digest).  The nonce is random on process 0 and
+        # broadcast, making every checkpoint invocation globally unique —
+        # a per-process counter alone resets across restarts and the
+        # manifest digest alone is unchanged by update-in-place traffic,
+        # so (seq, dig) could collide across distinct checkpoints.
+        # int32 throughout: restore allgathers the epoch, and jax (x64
+        # disabled) canonicalizes int64 -> int32, which would wrap an
+        # unsigned crc and break the cross-host equality check.
+        from jax.experimental import multihost_utils as mhu
         seq = cluster.keeper.mem_fetch_and_add("checkpoint_epoch")
         man = _manifest(cluster)
         import zlib
         dig = zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes()
                                   for v in man.values()))
-        # int32 throughout: restore allgathers the epoch, and jax (x64
-        # disabled) canonicalizes int64 -> int32, which would wrap an
-        # unsigned crc and break the cross-host equality check
-        epoch = np.asarray([seq, np.uint32(dig).view(np.int32)], np.int32)
+        nonce = np.frombuffer(os.urandom(4), np.int32).copy()
+        nonce = np.asarray(mhu.broadcast_one_to_all(nonce))
+        epoch = np.asarray([int(nonce[0]), seq,
+                            np.uint32(dig).view(np.int32)], np.int32)
         _savez_atomic(
             f"{path}.host{me}.npz", me,
             pool=_local_block(dsm.pool),
@@ -147,26 +155,38 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
             me = jax.process_index()
             spec = PartitionSpec(AXIS)
             with np.load(f"{path}.host{me}.npz") as h:
-                assert list(h["nodes"]) == list(dsm.local_nodes), (
+                # Epoch validation, COLLECTIVE-FIRST: every host computes
+                # a local (pair_ok, epoch-or-sentinel) status, ALL hosts
+                # allgather it unconditionally, and only then assert —
+                # a host-local assert before the collective would leave
+                # the other hosts hanging in the allgather on a torn
+                # checkpoint instead of erroring cleanly everywhere.
+                EW = 3  # epoch words; sentinel -1s for legacy/odd shapes
+                ep = np.full(EW, -1, np.int32)
+                pair_ok = 1
+                if ("epoch" in h) != ("epoch" in z):
+                    # one-sided epoch (legacy file mixed with tagged one)
+                    # is itself a torn pair, not a skip case
+                    pair_ok = 0
+                elif "epoch" in h:
+                    he = np.asarray(h["epoch"]).ravel()
+                    ze = np.asarray(z["epoch"]).ravel()
+                    if he.shape != ze.shape or not (he == ze).all():
+                        pair_ok = 0
+                    else:
+                        ep[: min(EW, he.size)] = he[:EW].astype(np.int32)
+                nodes_ok = int(list(h["nodes"]) == list(dsm.local_nodes))
+                status = np.concatenate(
+                    [np.asarray([pair_ok, nodes_ok], np.int32), ep])
+                all_st = np.asarray(mhu.process_allgather(status))
+                assert (all_st[:, 0] == 1).all(), (
+                    "a host holds a torn checkpoint (shard/manifest from "
+                    "different checkpoints or mixed legacy/tagged files)")
+                assert (all_st[:, 1] == 1).all(), (
                     "per-host node blocks changed since the checkpoint")
-                # epoch pairing: shard and manifest must be from the SAME
-                # checkpoint — a one-sided epoch (legacy file mixed with a
-                # new one) is itself a torn pair, not a skip case
-                assert ("epoch" in h) == ("epoch" in z), (
-                    "shard/manifest epoch mismatch: one file predates "
-                    "epoch-tagged checkpoints — torn checkpoint")
-                if "epoch" in h:
-                    ep = np.asarray(h["epoch"])
-                    assert (ep == np.asarray(z["epoch"])).all(), (
-                        "shard file and manifest are from different "
-                        "checkpoints (torn/partial write?)")
-                    # ... and from the SAME checkpoint on EVERY host: a
-                    # crash mid-collective leaves self-consistent pairs
-                    # at different epochs across hosts
-                    all_eps = np.asarray(mhu.process_allgather(ep))
-                    assert (all_eps == ep).all(), (
-                        "hosts hold checkpoints from different epochs "
-                        "(crashed mid-checkpoint?): refusing to mix")
+                assert (all_st[:, 2:] == all_st[0, 2:]).all(), (
+                    "hosts hold checkpoints from different epochs "
+                    "(crashed mid-checkpoint?): refusing to mix")
                 glob = lambda x: mhu.host_local_array_to_global_array(
                     x, dsm.mesh, spec)
                 dsm.pool = glob(h["pool"])
