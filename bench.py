@@ -65,7 +65,6 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     from sherman_tpu.models import batched
     from sherman_tpu.models.btree import Tree
     from sherman_tpu.ops import bits
-    from sherman_tpu.parallel.mesh import AXIS
     from sherman_tpu.workload.zipf import ZipfGen, uniform_ranks
 
     # pool sizing: leaves at bulk fill + internal overhead + chunk slack
@@ -127,8 +126,6 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     root = np.int32(tree._root_addr)
     pool, counters = tree.dsm.pool, tree.dsm.counters
     iters = eng._iters()
-    spec = jax.sharding.PartitionSpec(AXIS)
-    rep = jax.sharding.PartitionSpec()
 
     if combine:
         uniq = [(uk0, inv0)] + [
@@ -155,28 +152,12 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
               f"(dev batch {dev_b}, {batch / max_u:.1f}x); "
               "per-request fan-out on device in-step", file=sys.stderr)
 
-        # The timed kernel: routed descent over the unique set + the
-        # per-request fan-out (answers for ALL `batch` client ops land in
-        # HBM inside the step — no deferred host work).  TPU gathers are
-        # per-ROW latency-bound (~7 ns/row regardless of width — measured
-        # here: 3 separate [B] gathers 165 ms, one packed [B,4] 28 ms),
-        # so the three answer lanes pack into ONE [U,4] table and fan out
-        # with a single take_along_axis.
-        def kernel(pool, counters, khi, klo, root, active, start, inv):
-            counters, done, found, vhi, vlo = batched.search_routed_spmd(
-                pool, counters, khi, klo, root, active, start,
-                cfg=cfg, iters=iters)
-            ans = jnp.stack([found.astype(jnp.int32), vhi, vlo,
-                             jnp.zeros_like(vhi)], axis=-1)      # [U, 4]
-            safe = jnp.clip(inv, 0, khi.shape[0] - 1)
-            out = jnp.take_along_axis(ans, safe[:, None], axis=0)  # [B, 4]
-            return counters, done, out[:, 0].astype(bool), out[:, 1], out[:, 2]
-
-        fn = jax.jit(jax.shard_map(
-            kernel, mesh=cluster.dsm.mesh,
-            in_specs=(spec, spec, spec, spec, rep, spec, spec, spec),
-            out_specs=(spec, spec, spec, spec, spec), check_vma=False),
-            donate_argnums=(1,))
+        # The timed kernel is the ENGINE's combined-search fan-out kernel
+        # (BatchedEngine._get_search_fanout): routed descent over the
+        # unique set + the per-request packed fan-out, so answers for ALL
+        # `batch` client ops land in HBM inside the step — no deferred
+        # host work.
+        fn = eng._get_search_fanout(iters)
     else:
         dev_b = batch
         n_uniq = [batch] * n_batches

@@ -139,78 +139,107 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
 
     if not path.endswith(".npz") and not os.path.exists(path):
         path += ".npz"
+    if keeper is not None and keeper.is_multihost:
+        return _restore_multihost(path, mesh, keeper, clear_locks)
     with np.load(path) as z:
         cfg = DSMConfig(**json.loads(bytes(z["cfg"]).decode()))
         saved_mh = int(z["multihost"][0]) if "multihost" in z else 0
+        assert saved_mh == 0, (
+            "multi-host checkpoint needs a multi-host cluster (pass "
+            "init_multihost()'s keeper on every host)")
         cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
         dsm = cluster.dsm
-        if cluster.keeper.is_multihost:
-            assert saved_mh == jax.process_count(), (
-                f"checkpoint was taken on {saved_mh} hosts; restoring on "
-                f"{jax.process_count()} needs the same node partition")
-            from jax.experimental import multihost_utils as mhu
-            from jax.sharding import PartitionSpec
+        dsm.pool = jax.device_put(z["pool"], dsm.shard)
+        locks = z["locks"]
+        if clear_locks:
+            locks = np.zeros_like(locks)
+        dsm.locks = jax.device_put(locks, dsm.shard)
+        dsm.counters = jax.device_put(z["counters"], dsm.shard)
+        _restore_directories(cluster, z)
+    return cluster
 
-            from sherman_tpu.parallel.mesh import AXIS
-            me = jax.process_index()
-            spec = PartitionSpec(AXIS)
-            with np.load(f"{path}.host{me}.npz") as h:
-                # Epoch validation, COLLECTIVE-FIRST: every host computes
-                # a local (pair_ok, epoch-or-sentinel) status, ALL hosts
-                # allgather it unconditionally, and only then assert —
-                # a host-local assert before the collective would leave
-                # the other hosts hanging in the allgather on a torn
-                # checkpoint instead of erroring cleanly everywhere.
-                EW = 3  # epoch words; sentinel -1s for legacy/odd shapes
-                ep = np.full(EW, -1, np.int32)
-                pair_ok = 1
-                if ("epoch" in h) != ("epoch" in z):
-                    # one-sided epoch (legacy file mixed with tagged one)
-                    # is itself a torn pair, not a skip case
-                    pair_ok = 0
-                elif "epoch" in h:
-                    he = np.asarray(h["epoch"]).ravel()
-                    ze = np.asarray(z["epoch"]).ravel()
-                    if he.shape != ze.shape or not (he == ze).all():
-                        pair_ok = 0
-                    else:
-                        ep[: min(EW, he.size)] = he[:EW].astype(np.int32)
-                nodes_ok = int(list(h["nodes"]) == list(dsm.local_nodes))
-                status = np.concatenate(
-                    [np.asarray([pair_ok, nodes_ok], np.int32), ep])
-                all_st = np.asarray(mhu.process_allgather(status))
-                assert (all_st[:, 0] == 1).all(), (
-                    "a host holds a torn checkpoint (shard/manifest from "
-                    "different checkpoints or mixed legacy/tagged files)")
-                assert (all_st[:, 1] == 1).all(), (
-                    "per-host node blocks changed since the checkpoint")
-                assert (all_st[:, 2:] == all_st[0, 2:]).all(), (
-                    "hosts hold checkpoints from different epochs "
-                    "(crashed mid-checkpoint?): refusing to mix")
-                glob = lambda x: mhu.host_local_array_to_global_array(
-                    x, dsm.mesh, spec)
-                dsm.pool = glob(h["pool"])
-                locks = h["locks"]
-                if clear_locks:
-                    locks = np.zeros_like(locks)
-                dsm.locks = glob(locks)
-                dsm.counters = glob(h["counters"])
-        else:
-            assert saved_mh == 0, (
-                "multi-host checkpoint needs a multi-host cluster (pass "
-                "init_multihost()'s keeper on every host)")
-            dsm.pool = jax.device_put(z["pool"], dsm.shard)
-            locks = z["locks"]
-            if clear_locks:
-                locks = np.zeros_like(locks)
-            dsm.locks = jax.device_put(locks, dsm.shard)
-            dsm.counters = jax.device_put(z["counters"], dsm.shard)
-        by_node = {int(n): i for i, n in enumerate(z["dir_nodes"])}
-        for d in cluster.directories:
-            i = by_node.get(d.node_id)
-            if i is None:
-                continue  # node had no directory in the saved cluster
-            d.allocator._next = int(z["dir_next"][i])
-            d.root_ptr = int(z["dir_root"][i][0])
-            d.root_level = int(z["dir_root"][i][1])
+
+def _restore_directories(cluster, man) -> None:
+    by_node = {int(n): i for i, n in enumerate(man["dir_nodes"])}
+    for d in cluster.directories:
+        i = by_node.get(d.node_id)
+        if i is None:
+            continue  # node had no directory in the saved cluster
+        d.allocator._next = int(man["dir_next"][i])
+        d.root_ptr = int(man["dir_root"][i][0])
+        d.root_level = int(man["dir_root"][i][1])
+
+
+def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
+    """Multi-host restore, COLLECTIVE-FIRST: every host resolves ALL its
+    fallible local work (file loads, epoch pairing) into a status vector,
+    every host allgathers it unconditionally, and only then asserts — a
+    host-local failure before the collective would leave the other hosts
+    hanging in it (or in the Cluster constructor's own collectives)
+    instead of erroring cleanly everywhere."""
+    import jax
+    from jax.experimental import multihost_utils as mhu
+    from jax.sharding import PartitionSpec
+
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.parallel.mesh import AXIS
+
+    me = jax.process_index()
+    EW = 3  # epoch words; sentinel -1s for legacy/odd shapes
+    man = shard = None
+    err = ""
+    try:
+        with np.load(path) as z:
+            man = {k: np.asarray(z[k]) for k in z.files}
+        with np.load(f"{path}.host{me}.npz") as h:
+            shard = {k: np.asarray(h[k]) for k in h.files}
+    except Exception as e:  # missing/torn file: report via the gather
+        err = f"{type(e).__name__}: {e}"
+    loads_ok = int(man is not None and shard is not None and "cfg" in man)
+    pair_ok, saved_mh = 1, -1
+    ep = np.full(EW, -1, np.int32)
+    if loads_ok:
+        saved_mh = int(man["multihost"][0]) if "multihost" in man else 0
+        if ("epoch" in shard) != ("epoch" in man):
+            pair_ok = 0  # mixed legacy/tagged files = torn pair
+        elif "epoch" in shard:
+            he = np.asarray(shard["epoch"]).ravel()
+            ze = np.asarray(man["epoch"]).ravel()
+            if he.shape != ze.shape or not (he == ze).all():
+                pair_ok = 0
+            else:
+                ep[: min(EW, he.size)] = he[:EW].astype(np.int32)
+    status = np.concatenate(
+        [np.asarray([loads_ok, pair_ok, saved_mh], np.int32), ep])
+    all_st = np.asarray(mhu.process_allgather(status))
+    assert (all_st[:, 0] == 1).all(), (
+        f"a host failed to load its checkpoint files ({err or 'other host'})")
+    assert (all_st[:, 1] == 1).all(), (
+        "a host holds a torn checkpoint (shard/manifest from different "
+        "checkpoints or mixed legacy/tagged files)")
+    assert (all_st[:, 2] == jax.process_count()).all(), (
+        f"checkpoint host count {sorted(set(all_st[:, 2].tolist()))} != "
+        f"{jax.process_count()} restoring processes")
+    assert (all_st[:, 3:] == all_st[0, 3:]).all(), (
+        "hosts hold checkpoints from different epochs (crashed "
+        "mid-checkpoint?): refusing to mix")
+
+    # all hosts validated: collectives are now safe
+    cfg = DSMConfig(**json.loads(bytes(man["cfg"]).decode()))
+    cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
+    dsm = cluster.dsm
+    nodes_ok = int(list(shard["nodes"]) == list(dsm.local_nodes))
+    all_nodes = np.asarray(mhu.process_allgather(
+        np.asarray([nodes_ok], np.int32)))
+    assert (all_nodes == 1).all(), (
+        "per-host node blocks changed since the checkpoint")
+    spec = PartitionSpec(AXIS)
+    glob = lambda x: mhu.host_local_array_to_global_array(x, dsm.mesh, spec)
+    dsm.pool = glob(shard["pool"])
+    locks = shard["locks"]
+    if clear_locks:
+        locks = np.zeros_like(locks)
+    dsm.locks = glob(locks)
+    dsm.counters = glob(shard["counters"])
+    _restore_directories(cluster, man)
     return cluster

@@ -284,3 +284,31 @@ def test_search_combined_duplicates(eight_devices):
     exp_v, exp_f = eng.search(reqs)
     np.testing.assert_array_equal(found, exp_f)
     np.testing.assert_array_equal(got[found], exp_v[found])
+
+
+def test_search_combined_device_fanout(eight_devices):
+    """Single-node engine: search_combined runs the in-step device
+    fan-out (the bench kernel) and matches per-request semantics."""
+    tree, eng = make(nr=1, B=512)
+    rng = np.random.default_rng(13)
+    keys = np.unique(rng.integers(1, 1 << 40, 2000, dtype=np.uint64))
+    batched.bulk_load(tree, keys, keys * np.uint64(3))
+    eng.attach_router()
+    # draw from a subset so uk.size <= B and the DEVICE path is taken
+    reqs = rng.choice(keys[:300], 1500, replace=True)     # heavy duplicates
+    missing = np.setdiff1d(
+        np.array([2, 4, 6], np.uint64), keys)
+    reqs = np.concatenate([reqs, missing, reqs[:10]])
+    assert np.unique(reqs).size <= eng.B  # guard: device path engaged
+    vals, found = eng.search_combined(reqs)
+    exp_f = np.isin(reqs, keys)
+    np.testing.assert_array_equal(found, exp_f)
+    np.testing.assert_array_equal(vals[exp_f], reqs[exp_f] * np.uint64(3))
+    # multi-node engines fall back to the host fan-out path
+    tree4, eng4 = make(nr=4, B=128)
+    keys4 = np.arange(1, 800, dtype=np.uint64)
+    batched.bulk_load(tree4, keys4, keys4)
+    eng4.attach_router()
+    v4, f4 = eng4.search_combined(np.repeat(keys4[:100], 3))
+    assert f4.all()
+    np.testing.assert_array_equal(v4, np.repeat(keys4[:100], 3))
