@@ -93,7 +93,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     assert keys.shape[0] == n_keys
     vals = keys ^ np.uint64(0xDEADBEEF)
     stats = batched.bulk_load(tree, keys, vals, fill=fill)
-    router = eng.attach_router()
+    lb_env = os.environ.get("SHERMAN_BENCH_LB")
+    router = eng.attach_router(int(lb_env) if lb_env else None)
     print(f"# bulk_load {time.time() - t0:.1f}s {stats} "
           f"router_lb={router.lb}", file=sys.stderr)
 

@@ -188,9 +188,15 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     EW = 3  # epoch words; sentinel -1s for legacy/odd shapes
     man = shard = None
     err = ""
+    # materialize only the keys this path uses: a mistakenly-pointed-at
+    # single-host checkpoint carries the full pool in its manifest, and
+    # eagerly decompressing gigabytes just to fail the host-count check
+    # below would be wasteful
+    MAN_KEYS = ("cfg", "multihost", "epoch", "dir_nodes", "dir_next",
+                "dir_root")
     try:
         with np.load(path) as z:
-            man = {k: np.asarray(z[k]) for k in z.files}
+            man = {k: np.asarray(z[k]) for k in z.files if k in MAN_KEYS}
         with np.load(f"{path}.host{me}.npz") as h:
             shard = {k: np.asarray(h[k]) for k in h.files}
     except Exception as e:  # missing/torn file: report via the gather
