@@ -20,6 +20,12 @@ Environment knobs:
   SHERMAN_BENCH_COMBINE  1/0 force read-combining on/off (default: auto —
                          on when the workload's duplicate ratio makes it
                          pay, i.e. skewed zipf batches)
+  SHERMAN_BENCH_LB       router table log2(buckets) override (default:
+                         router.default_log2_buckets — keep >= ~20
+                         buckets/leaf; a starved table feeds the
+                         straggler loop, see BENCHMARKS.md)
+  SHERMAN_BENCH_LAT_BLOCK  steps per latency-measurement block (default
+                         16; set 1 on a co-located host for exact spans)
 
 Read combining: a zipf-0.99 batch of 4 M ops contains ~1-2 M distinct
 keys (~2-4x dedup depending on keyspace size).  The engine already

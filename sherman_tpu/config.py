@@ -73,6 +73,14 @@ class DSMConfig:
     def __post_init__(self):
         assert 1 <= self.machine_nr <= MAX_MACHINE
         assert self.pages_per_node <= (1 << ADDR_PAGE_BITS)
+        # Per-node pools are flat-indexed in int32 words on device (the
+        # TPU-native word size): one node's partition must stay under
+        # 2^31 words = 8 GB.  Larger clusters scale by adding NODES —
+        # each node's HBM shard is addressed independently, which is the
+        # architecture's scaling axis anyway (symmetric partitioning).
+        assert self.pages_per_node * PAGE_WORDS < (1 << 31), (
+            f"pages_per_node={self.pages_per_node} exceeds the 8 GB "
+            "per-node pool limit (int32 word indexing); add nodes instead")
         assert self.exchange_impl in ("xla", "pallas")
 
 

@@ -115,11 +115,16 @@ def _savez_atomic(path: str, tag: int, **arrays) -> None:
     os.replace(tmp, path)
 
 
+# The manifest schema (one source of truth: _manifest() must emit exactly
+# these keys; _restore_multihost materializes exactly these + extras).
+_MANIFEST_FIELDS = ("cfg", "dir_nodes", "dir_next", "dir_root")
+
+
 def _manifest(cluster) -> dict:
     """Config + directory/allocator state — the part of a checkpoint that
     is host-independent (mirrored on every process in multi-host)."""
     cfg = {f: getattr(cluster.cfg, f) for f in _CFG_FIELDS}
-    return dict(
+    out = dict(
         cfg=np.frombuffer(json.dumps(cfg).encode(), np.uint8),
         dir_nodes=np.asarray([d.node_id for d in cluster.directories],
                              np.int64),
@@ -129,6 +134,8 @@ def _manifest(cluster) -> dict:
             [[d.root_ptr, d.root_level] for d in cluster.directories],
             np.int64),
     )
+    assert set(out) == set(_MANIFEST_FIELDS)
+    return out
 
 
 def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
@@ -188,15 +195,15 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     EW = 3  # epoch words; sentinel -1s for legacy/odd shapes
     man = shard = None
     err = ""
-    # materialize only the keys this path uses: a mistakenly-pointed-at
-    # single-host checkpoint carries the full pool in its manifest, and
-    # eagerly decompressing gigabytes just to fail the host-count check
-    # below would be wasteful
-    MAN_KEYS = ("cfg", "multihost", "epoch", "dir_nodes", "dir_next",
-                "dir_root")
+    # materialize only the manifest keys (the _manifest schema + the
+    # multihost extras): a mistakenly-pointed-at single-host checkpoint
+    # carries the full pool in its manifest file, and eagerly
+    # decompressing gigabytes just to fail the host-count check below
+    # would be wasteful
+    man_keys = set(_MANIFEST_FIELDS) | {"multihost", "epoch"}
     try:
         with np.load(path) as z:
-            man = {k: np.asarray(z[k]) for k in z.files if k in MAN_KEYS}
+            man = {k: np.asarray(z[k]) for k in z.files if k in man_keys}
         with np.load(f"{path}.host{me}.npz") as h:
             shard = {k: np.asarray(h[k]) for k in h.files}
     except Exception as e:  # missing/torn file: report via the gather
