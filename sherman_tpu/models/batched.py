@@ -876,6 +876,19 @@ class BatchedEngine:
         # cluster.host_dsm, and the device batch shards over the
         # process-spanning mesh).  _check_replicated enforces it.
         self._mh = self.dsm.multihost
+        # Compiled-step launches mutate the same donated pool/locks/
+        # counters handles as the host-API steps, so concurrent host
+        # threads (Tree clients taking locks/splitting — the reference's
+        # 26-thread axis, benchmark.cpp:285-287) would race the engine on
+        # the handle swap: an engine step built from a pre-host-step pool
+        # handle writes back a result that LOSES the host step wholesale.
+        # Sharing the DSM's step mutex for the read-handles -> launch ->
+        # write-handles window makes every step atomic at the handle
+        # level; cross-step consistency is then the lock/version
+        # protocol's job, exactly as in the reference.  Launch-only:
+        # dispatch is async, so the mutex is held microseconds and never
+        # across a host DSM op (threading.Lock is not reentrant).
+        self._step_mutex = self.dsm._step_mutex
 
     def _iters(self) -> int:
         # STATIC descent budget: max height + chase slack.  Deliberately
@@ -1033,15 +1046,20 @@ class BatchedEngine:
         aw, _ = self._pad(~is_read)
         use_router = self.router is not None
         fn = self._get_mixed(self._iters(), use_router)
-        args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                self._shard(khi), self._shard(klo),
+        # batch prep (router probe, host->device transfers) OUTSIDE the
+        # step mutex — only the handle read -> launch -> handle write is
+        # locked (see __init__); holding it across prep would stall
+        # concurrent host clients for the whole transfer
+        args = [self._shard(khi), self._shard(klo),
                 self._shard(vhi), self._shard(vlo),
                 np.int32(self.tree._root_addr),
                 self._shard(ar), self._shard(aw)]
         if use_router:
             args.append(self._shard(self.router.host_start(khi, klo)))
-        (self.dsm.pool, self.dsm.counters, status, done_r, found,
-         rvh, rvl) = fn(*args)
+        with self._step_mutex:
+            (self.dsm.pool, self.dsm.counters, status, done_r, found,
+             rvh, rvl) = fn(self.dsm.pool, self.dsm.locks,
+                            self.dsm.counters, *args)
         status, done_r, found, rvh, rvl = self._unshard(
             status, done_r, found, rvh, rvl)
         status = np.array(status[:n])  # writable: retry outcomes land here
@@ -1137,12 +1155,13 @@ class BatchedEngine:
         # retries (depth > 0) bypass the index cache and descend from root
         use_router = self.router is not None and _depth == 0
         fn = self._get_search(self._iters(), use_router)
-        args = [self.dsm.pool, self.dsm.counters,
-                self._shard(khi), self._shard(klo),
+        args = [self._shard(khi), self._shard(klo),
                 np.int32(self.tree._root_addr), self._shard(active)]
         if use_router:
             args.append(self._shard(self.router.host_start(khi, klo)))
-        self.dsm.counters, done, found, vhi, vlo = fn(*args)
+        with self._step_mutex:  # launch-only (prep above)
+            self.dsm.counters, done, found, vhi, vlo = fn(
+                self.dsm.pool, self.dsm.counters, *args)
         done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
         done = done[:n]
         if not done.all():
@@ -1159,28 +1178,39 @@ class BatchedEngine:
         return bits.pairs_to_keys(vhi[:n], vlo[:n]), found[:n]
 
     def _get_search_fanout(self, iters: int):
-        """Single-node kernel: routed search over the unique-key set +
-        packed IN-STEP fan-out of every client request's answer.
+        """Search over the unique-key set + packed IN-STEP fan-out of
+        every client request's answer.
 
         TPU gathers are per-row latency-bound regardless of width, so the
         three answer lanes (found, vhi, vlo) pack into ONE [U, 4] table
         and fan out to the [B_client] request slots with a single
         take_along_axis — the client-ops throughput of a combined batch
         is then fully earned on device (nothing deferred to the host).
-        jit re-specializes per (unique-width, client-width) shape pair.
+        Multi-node: the fan-out runs AFTER the reply exchange — each node
+        all-gathers the [U, 4] answer table once, then its client slots
+        take locally (``inv`` holds GLOBAL unique indices).  jit
+        re-specializes per (unique-width, client-width) shape pair.
         """
         fn = self._search_cache.get(("fanout", iters))
         if fn is None:
-            assert self.cfg.machine_nr == 1
             spec, rep = self._spec, self._rep
+            N = self.cfg.machine_nr
 
             def kernel(pool, counters, khi, klo, root, active, start, inv):
-                counters, done, found, vhi, vlo = search_routed_spmd(
-                    pool, counters, khi, klo, root, active, start,
-                    cfg=self.cfg, iters=iters)
+                if N == 1:
+                    counters, done, found, vhi, vlo = search_routed_spmd(
+                        pool, counters, khi, klo, root, active, start,
+                        cfg=self.cfg, iters=iters)
+                else:
+                    counters, done, found, vhi, vlo = search_spmd(
+                        pool, counters, khi, klo, root, active, start,
+                        cfg=self.cfg, iters=iters)
                 ans = jnp.stack([found.astype(jnp.int32), vhi, vlo,
-                                 jnp.zeros_like(vhi)], axis=-1)    # [U, 4]
-                safe = jnp.clip(inv, 0, khi.shape[0] - 1)
+                                 jnp.zeros_like(vhi)], axis=-1)  # [U_loc, 4]
+                if N > 1:
+                    ans = lax.all_gather(ans, AXIS, axis=0,
+                                         tiled=True)            # [U, 4]
+                safe = jnp.clip(inv, 0, ans.shape[0] - 1)
                 out = jnp.take_along_axis(ans, safe[:, None], axis=0)
                 return (counters, done, out[:, 0].astype(bool),
                         out[:, 1], out[:, 2])
@@ -1199,18 +1229,19 @@ class BatchedEngine:
 
         The read-side symmetric of the insert step's same-key dedup (its
         intra-step linearization — see :func:`leaf_apply_spmd`): the
-        device batch is the unique-key set.  On a single-node mesh with
-        the router attached, the per-request answer fan-out runs ON
-        DEVICE inside the same step (:meth:`_get_search_fanout`);
-        otherwise it is a host vectorized gather.  Semantically identical
-        to :meth:`search` (combined duplicates read the same snapshot, a
-        legal concurrent schedule); ~2-10x fewer device rows on
-        zipf-skewed batches.  Returns (values uint64 [n], found [n]).
+        device batch is the unique-key set.  With the router attached,
+        the per-request answer fan-out runs ON DEVICE inside the same
+        step (:meth:`_get_search_fanout`) on any mesh size — multi-node
+        fans out after the reply exchange via an answer-table all-gather;
+        without a router it is a host vectorized gather.  Semantically
+        identical to :meth:`search` (combined duplicates read the same
+        snapshot, a legal concurrent schedule); ~2-10x fewer device rows
+        on zipf-skewed batches.  Returns (values uint64 [n], found [n]).
         """
         keys = np.asarray(keys, np.uint64)
         uk, inv = np.unique(keys, return_inverse=True)
-        use_device = (self.cfg.machine_nr == 1 and self.router is not None
-                      and 0 < uk.size <= self.B)
+        use_device = (self.router is not None
+                      and 0 < uk.size <= self.B * self.cfg.machine_nr)
         if not use_device:
             vals, found = self.search(uk)
             return vals[inv], found[inv]
@@ -1222,24 +1253,28 @@ class BatchedEngine:
         active, _ = self._pad(np.ones(uk.size, bool))
         # bucket the CLIENT width so varying request counts reuse one
         # compiled program per quantum (unique width is already fixed at
-        # B); pad rows fan out slot 0 and are sliced off below
+        # N*B); pad rows fan out slot 0 and are sliced off below.  The
+        # quantum is a machine_nr multiple so the client array shards
+        # evenly over the node mesh.
         n = keys.size
-        quantum = 8192
+        quantum = 8192 * self.cfg.machine_nr
         n_pad = -(-n // quantum) * quantum
         inv_p = np.zeros(n_pad, np.int32)
         inv_p[:n] = inv.astype(np.int32)
         fn = self._get_search_fanout(self._iters())
-        self.dsm.counters, done, found, vhi, vlo = fn(
-            self.dsm.pool, self.dsm.counters, self._shard(khi),
-            self._shard(klo), np.int32(self.tree._root_addr),
-            self._shard(active), self._shard(self.router.host_start(khi, klo)),
-            jax.device_put(inv_p, self.dsm.shard))
-        if not bool(np.asarray(done)[: uk.size].all()):
+        args = [self._shard(khi), self._shard(klo),
+                np.int32(self.tree._root_addr), self._shard(active),
+                self._shard(self.router.host_start(khi, klo)),
+                self._shard(inv_p)]
+        with self._step_mutex:  # launch-only (prep above)
+            self.dsm.counters, done, found, vhi, vlo = fn(
+                self.dsm.pool, self.dsm.counters, *args)
+        done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
+        if not bool(done[: uk.size].all()):
             # straggler rescue (stale seeds / growth): host fan-out path
             vals, fnd = self.search(uk)
             return vals[inv], fnd[inv]
-        return (bits.pairs_to_keys(np.asarray(vhi)[:n], np.asarray(vlo)[:n]),
-                np.asarray(found)[:n])
+        return (bits.pairs_to_keys(vhi[:n], vlo[:n]), found[:n])
 
     def insert(self, keys, values, max_rounds: int | None = None) -> dict:
         """Batched upsert with host fallback for splits.
@@ -1255,7 +1290,8 @@ class BatchedEngine:
         self._check_replicated(keys, values)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
-        stats = {"applied": 0, "superseded": 0, "host_path": 0, "rounds": 0}
+        stats = {"applied": 0, "superseded": 0, "host_path": 0, "rounds": 0,
+                 "st_locked": 0}
         for i in range(0, n, total):
             self._insert_chunk(keys[i:i + total], values[i:i + total],
                                max_rounds, stats)
@@ -1290,10 +1326,11 @@ class BatchedEngine:
         (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
         active, _ = self._pad(np.ones(n, bool))
         fn = self._get_parent_descend(self._iters())
-        self.dsm.counters, addr, _, done = fn(
-            self.dsm.pool, self.dsm.counters, self._shard(khi),
-            self._shard(klo), np.int32(self.tree._root_addr),
-            self._shard(active))
+        args = [self._shard(khi), self._shard(klo),
+                np.int32(self.tree._root_addr), self._shard(active)]
+        with self._step_mutex:  # launch-only (prep above)
+            self.dsm.counters, addr, _, done = fn(
+                self.dsm.pool, self.dsm.counters, *args)
         addr, done = self._unshard(addr, done)
         return addr[:n], done[:n]
 
@@ -1479,19 +1516,25 @@ class BatchedEngine:
                 router_usable = False
             use_router = router_usable
             fn = self._get_insert(self._iters(), use_router)
-            args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                    self._shard(khi), self._shard(klo),
+            args = [self._shard(khi), self._shard(klo),
                     self._shard(vhi), self._shard(vlo),
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
                 args.append(self._shard(self.router.host_start(khi, klo)))
             args.append(self._shard(fresh_np))
-            self.dsm.pool, self.dsm.counters, status, log = fn(*args)
+            with self._step_mutex:  # launch-only (prep above)
+                self.dsm.pool, self.dsm.counters, status, log = fn(
+                    self.dsm.pool, self.dsm.locks, self.dsm.counters,
+                    *args)
             status = self._unshard(status)[:idx.shape[0]]
             if dbg:
                 import collections as _c
                 print(f"[ins] status {dict(_c.Counter(status.tolist()))} "
                       f"t={_t.time():.1f}", flush=True)
+            # host-held page locks surface as ST_LOCKED retries (the
+            # protocol linchpin under concurrent host writers); count them
+            # so drivers/tests can assert the interleaving really happened
+            stats["st_locked"] += int((status == ST_LOCKED).sum())
             self._drain_split_log(log, stats)
             if self._pending_parents:
                 # flush between rounds: parents keep descent paths short —
@@ -1565,12 +1608,14 @@ class BatchedEngine:
             active, _ = self._pad(np.ones(idx.shape[0], bool))
             use_router = self.router is not None and round_i == 0
             fn = self._get_delete(self._iters(), use_router)
-            args = [self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                    self._shard(khi), self._shard(klo),
+            args = [self._shard(khi), self._shard(klo),
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
                 args.append(self._shard(self.router.host_start(khi, klo)))
-            self.dsm.pool, self.dsm.counters, status = fn(*args)
+            with self._step_mutex:  # launch-only (prep above)
+                self.dsm.pool, self.dsm.counters, status = fn(
+                    self.dsm.pool, self.dsm.locks, self.dsm.counters,
+                    *args)
             status = self._unshard(status)[:idx.shape[0]]
 
             found_out[idx[status == ST_APPLIED]] = True
@@ -1636,8 +1681,9 @@ def range_query(eng: "BatchedEngine", lo: int, hi: int
                 pages = tree.dsm.read_pages([int(a) for a in cand])
             else:
                 rows = _addr_rows(cand, cfg.pages_per_node)
-                pages = np.asarray(_gather_rows(eng.dsm.pool,
-                                                jnp.asarray(rows)))
+                with eng._step_mutex:  # pool handle read vs donating steps
+                    got = _gather_rows(eng.dsm.pool, jnp.asarray(rows))
+                pages = np.asarray(got)
             for a, p in zip(cand.tolist(), pages):
                 if int(p[C.W_LEVEL]) == 0:   # stale entries may be internal
                     fetched[int(a) & 0xFFFFFFFF] = p

@@ -224,8 +224,17 @@ class Tree:
             return
         decided = self._lpass.pop(lock_addr, False)
         passed = self._llocks.release(li, decided)
-        if decided and not passed:  # unreachable (waiters block); belt
+        if decided and not passed:
+            # A decided hand-over that did not pass means locks.cc broke
+            # its contract (waiters block, so a True can_handover probe is
+            # binding).  Repair the global word so the cluster stays
+            # unwedged for diagnosis, then surface the protocol violation
+            # instead of silently masking it.
             self.dsm.write_word(lock_addr, 0, 0, space=D.SPACE_LOCK)
+            raise RuntimeError(
+                f"local-lock hand-over invariant violated on {lock_addr:#x}"
+                ": can_handover said True but release did not pass the "
+                "lock (locks.cc contract breach)")
 
     def _unlock(self, lock_addr: int) -> None:
         rows = self._unlock_rows(lock_addr)

@@ -123,7 +123,6 @@ def _load() -> None:
     _sig("shn_rw_runlock", None, [P])
     _sig("shn_rw_wlock", None, [P])
     _sig("shn_rw_wunlock", None, [P])
-    _sig("shn_rw_try_rlock", I32, [P])
 
 
 def available() -> bool:
@@ -311,9 +310,6 @@ class WRLock:
 
     def runlock(self) -> None:
         _shn_rw_runlock(self._h)
-
-    def try_rlock(self) -> bool:
-        return bool(_shn_rw_try_rlock(self._h))
 
     def wlock(self) -> None:
         _shn_rw_wlock(self._h)

@@ -156,15 +156,3 @@ SHN_EXPORT void shn_rw_wlock(void* h) {
 SHN_EXPORT void shn_rw_wunlock(void* h) {
   ((WRLock*)h)->state.store(0, std::memory_order_release);
 }
-
-SHN_EXPORT int shn_rw_try_rlock(void* h) {
-  auto& s = ((WRLock*)h)->state;
-  uint32_t v = s.load(std::memory_order_relaxed);
-  // retry while the CAS loses to concurrent READERS — failure must mean
-  // "writer active", not "another reader raced me"
-  while (!(v & WRLock::kWriter)) {
-    if (s.compare_exchange_weak(v, v + 1, std::memory_order_acquire))
-      return 1;
-  }
-  return 0;
-}

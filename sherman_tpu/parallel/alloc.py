@@ -21,6 +21,8 @@ meta words; addr 0 doubles as NULL).
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from sherman_tpu.config import ADDR_PAGE_BITS, DSMConfig
@@ -38,6 +40,10 @@ class GlobalAllocator:
         self.chunk_pages = chunk_pages
         self._next = reserved
         self._limit = pages_per_node
+        # Concurrent host clients (the reference's 26-thread axis) lease
+        # chunks from shared directories; the bump must be atomic or two
+        # clients get the same chunk (silent page aliasing).
+        self._mu = threading.Lock()
 
     def alloc_chunk(self) -> tuple[int, int]:
         """-> (first page index, size) of a fresh chunk; raises when
@@ -45,14 +51,15 @@ class GlobalAllocator:
         reserved page 0 makes partitions non-multiples of chunk_pages, so
         insisting on full chunks would strand the tail — e.g. a
         single-chunk partition would be unusable)."""
-        size = min(self.chunk_pages, self._limit - self._next)
-        if size <= 0:
-            raise MemoryError(
-                f"node {self.node_id}: DSM partition exhausted "
-                f"({self._limit} pages)")
-        start = self._next
-        self._next += size
-        return start, size
+        with self._mu:
+            size = min(self.chunk_pages, self._limit - self._next)
+            if size <= 0:
+                raise MemoryError(
+                    f"node {self.node_id}: DSM partition exhausted "
+                    f"({self._limit} pages)")
+            start = self._next
+            self._next += size
+            return start, size
 
     @property
     def pages_used(self) -> int:

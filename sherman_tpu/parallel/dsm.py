@@ -680,6 +680,7 @@ class ReplicatedDSM(_HostOps):
     local_nodes = property(lambda s: s._dsm.local_nodes)
     host_slots = property(lambda s: s._dsm.host_slots)
     _host_cfg = property(lambda s: s._dsm._host_cfg)
+    _step_mutex = property(lambda s: s._dsm._step_mutex)
 
     def counter_snapshot(self) -> dict[str, int]:
         return self._dsm.counter_snapshot()

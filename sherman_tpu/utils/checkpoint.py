@@ -83,6 +83,20 @@ def checkpoint(cluster, path: str) -> None:
         nonce = np.asarray(mhu.broadcast_one_to_all(nonce))
         epoch = np.asarray([int(nonce[0]), seq,
                             np.uint32(dig).view(np.int32)], np.int32)
+        # Save-time epoch agreement, BEFORE any file write: seq is a
+        # process-local counter and dig hashes the (supposedly mirrored)
+        # manifest — if the replicated-driver invariant was ever violated,
+        # hosts would diverge here, every os.replace would still succeed,
+        # and the previous good checkpoint would be overwritten by a set
+        # restore rejects as mixed-epoch (losing BOTH).  Abort loudly with
+        # the prior files untouched instead.
+        all_ep = np.asarray(mhu.process_allgather(epoch))
+        if not (all_ep == all_ep[0]).all():
+            raise RuntimeError(
+                "checkpoint aborted before writing: hosts disagree on the "
+                f"checkpoint epoch {all_ep.tolist()} (divergent checkpoint "
+                "counts or manifests — the replicated-driver invariant is "
+                "broken); the previous checkpoint is left intact")
         _savez_atomic(
             f"{path}.host{me}.npz", me,
             pool=_local_block(dsm.pool),
@@ -151,9 +165,10 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
     with np.load(path) as z:
         cfg = DSMConfig(**json.loads(bytes(z["cfg"]).decode()))
         saved_mh = int(z["multihost"][0]) if "multihost" in z else 0
-        assert saved_mh == 0, (
-            "multi-host checkpoint needs a multi-host cluster (pass "
-            "init_multihost()'s keeper on every host)")
+        if saved_mh != 0:  # durability check: must survive python -O
+            raise RuntimeError(
+                "multi-host checkpoint needs a multi-host cluster (pass "
+                "init_multihost()'s keeper on every host)")
         cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
         dsm = cluster.dsm
         dsm.pool = jax.device_put(z["pool"], dsm.shard)
@@ -225,17 +240,23 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     status = np.concatenate(
         [np.asarray([loads_ok, pair_ok, saved_mh], np.int32), ep])
     all_st = np.asarray(mhu.process_allgather(status))
-    assert (all_st[:, 0] == 1).all(), (
-        f"a host failed to load its checkpoint files ({err or 'other host'})")
-    assert (all_st[:, 1] == 1).all(), (
-        "a host holds a torn checkpoint (shard/manifest from different "
-        "checkpoints or mixed legacy/tagged files)")
-    assert (all_st[:, 2] == jax.process_count()).all(), (
-        f"checkpoint host count {sorted(set(all_st[:, 2].tolist()))} != "
-        f"{jax.process_count()} restoring processes")
-    assert (all_st[:, 3:] == all_st[0, 3:]).all(), (
-        "hosts hold checkpoints from different epochs (crashed "
-        "mid-checkpoint?): refusing to mix")
+    # durability-critical validation: explicit raises (a bare assert is
+    # stripped under python -O and would silently restore torn state)
+    if not (all_st[:, 0] == 1).all():
+        raise RuntimeError("a host failed to load its checkpoint files "
+                           f"({err or 'other host'})")
+    if not (all_st[:, 1] == 1).all():
+        raise RuntimeError(
+            "a host holds a torn checkpoint (shard/manifest from different "
+            "checkpoints or mixed legacy/tagged files)")
+    if not (all_st[:, 2] == jax.process_count()).all():
+        raise RuntimeError(
+            f"checkpoint host count {sorted(set(all_st[:, 2].tolist()))} != "
+            f"{jax.process_count()} restoring processes")
+    if not (all_st[:, 3:] == all_st[0, 3:]).all():
+        raise RuntimeError(
+            "hosts hold checkpoints from different epochs (crashed "
+            "mid-checkpoint?): refusing to mix")
 
     # all hosts validated: collectives are now safe
     cfg = DSMConfig(**json.loads(bytes(man["cfg"]).decode()))
@@ -244,8 +265,9 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     nodes_ok = int(list(shard["nodes"]) == list(dsm.local_nodes))
     all_nodes = np.asarray(mhu.process_allgather(
         np.asarray([nodes_ok], np.int32)))
-    assert (all_nodes == 1).all(), (
-        "per-host node blocks changed since the checkpoint")
+    if not (all_nodes == 1).all():
+        raise RuntimeError("per-host node blocks changed since the "
+                           "checkpoint")
     spec = PartitionSpec(AXIS)
     glob = lambda x: mhu.host_local_array_to_global_array(x, dsm.mesh, spec)
     dsm.pool = glob(shard["pool"])

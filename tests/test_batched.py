@@ -304,11 +304,29 @@ def test_search_combined_device_fanout(eight_devices):
     exp_f = np.isin(reqs, keys)
     np.testing.assert_array_equal(found, exp_f)
     np.testing.assert_array_equal(vals[exp_f], reqs[exp_f] * np.uint64(3))
-    # multi-node engines fall back to the host fan-out path
+    assert ("fanout", eng._iters()) in eng._search_cache
+
+
+def test_search_combined_multinode_device_fanout(eight_devices):
+    """Multi-node search_combined runs the device fan-out too: the
+    unique-key answers are all-gathered after the reply exchange and
+    every client slot takes its answer on device — the round-2
+    single-node-only limitation, closed."""
     tree4, eng4 = make(nr=4, B=128)
-    keys4 = np.arange(1, 800, dtype=np.uint64)
-    batched.bulk_load(tree4, keys4, keys4)
+    rng = np.random.default_rng(17)
+    keys4 = np.unique(rng.integers(1, 1 << 40, 900, dtype=np.uint64))
+    batched.bulk_load(tree4, keys4, keys4 * np.uint64(5))
     eng4.attach_router()
-    v4, f4 = eng4.search_combined(np.repeat(keys4[:100], 3))
-    assert f4.all()
-    np.testing.assert_array_equal(v4, np.repeat(keys4[:100], 3))
+    reqs = np.concatenate([
+        np.repeat(keys4[:50], 10),                  # hot duplicates
+        rng.choice(keys4, 400),                     # warm tail
+        np.array([3, (1 << 41) + 7], np.uint64),    # misses
+    ])
+    rng.shuffle(reqs)
+    assert np.unique(reqs).size <= eng4.B * 4  # device path engaged
+    v4, f4 = eng4.search_combined(reqs)
+    exp_f = np.isin(reqs, keys4)
+    np.testing.assert_array_equal(f4, exp_f)
+    np.testing.assert_array_equal(v4[exp_f], reqs[exp_f] * np.uint64(5))
+    # the DEVICE fan-out kernel (not the host gather) answered
+    assert ("fanout", eng4._iters()) in eng4._search_cache

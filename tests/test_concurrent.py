@@ -1,0 +1,243 @@
+"""Concurrent drivers on ONE tree: multithreaded host Tree writers
+interleaved with engine batched steps.
+
+The reference's correctness story is 26 threads x 8 coroutines mutating
+through locks concurrently (``test/benchmark.cpp:285-287``,
+``Tree.cpp:205-242``).  The TPU build's equivalent axis is host ``Tree``
+clients (taking global locks, splitting pages through the host path)
+running in threads WHILE the main driver pushes batched device steps on
+the same cluster.  The protocol linchpin is the ST_LOCKED / fence-recheck
+machinery in ``batched.leaf_apply_spmd``: device applies must respect
+host-held page locks and retry, and host writers must never be lost under
+interleaved engine steps.  These tests exercise exactly that — first
+deterministically (a held lock MUST surface as ST_LOCKED), then under a
+free-running interleaving verified against a merged model.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.ops import bits
+
+
+def make(B=256, pages=8192, step_capacity=1024):
+    cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
+                    locks_per_node=4096, step_capacity=step_capacity,
+                    chunk_pages=128)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B)
+    return cluster, tree, eng
+
+
+def _raw_insert_step(eng, keys, vals):
+    """ONE device insert step, no engine retry — statuses observable."""
+    n = keys.shape[0]
+    khi, klo = bits.keys_to_pairs(keys)
+    vhi, vlo = bits.keys_to_pairs(vals)
+    (khi, _), (klo, _) = eng._pad(khi), eng._pad(klo)
+    (vhi, _), (vlo, _) = eng._pad(vhi), eng._pad(vlo)
+    active, _ = eng._pad(np.ones(n, bool))
+    fresh = np.zeros(eng.cfg.machine_nr * eng.split_slots, np.int32)
+    fn = eng._get_insert(eng._iters(), False)
+    dsm = eng.dsm
+    with eng._step_mutex:
+        dsm.pool, dsm.counters, status, _log = fn(
+            dsm.pool, dsm.locks, dsm.counters,
+            eng._shard(khi), eng._shard(klo), eng._shard(vhi),
+            eng._shard(vlo), np.int32(eng.tree._root_addr),
+            eng._shard(active), eng._shard(fresh))
+    return eng._unshard(status)[:n]
+
+
+def test_host_held_lock_forces_st_locked(eight_devices):
+    """Deterministic core of the protocol: while a host client holds a
+    page's global lock, a device apply targeting that page MUST report
+    ST_LOCKED and leave the page untouched; after the unlock the same
+    step applies."""
+    _, tree, eng = make()
+    keys = np.arange(1, 3001, dtype=np.uint64) * 5
+    batched.bulk_load(tree, keys, keys)
+
+    victim = int(keys[1500])
+    leaf_addr, _, _ = tree._descend(victim, 0)
+    # the update batch: victim's neighbors (same leaf) + far keys
+    upd = keys[1495:1505]
+    vals = upd + np.uint64(7)
+    leaf_of = np.array([tree._descend(int(k), 0)[0] for k in upd])
+    same_leaf = leaf_of == leaf_addr
+    assert same_leaf.any(), "test setup: no key maps to the locked leaf"
+
+    la = tree._lock(leaf_addr)
+    try:
+        st = _raw_insert_step(eng, upd, vals)
+        assert (st[same_leaf] == batched.ST_LOCKED).all(), (
+            f"device apply ignored a host-held lock: {st[same_leaf]}")
+        # off-leaf keys are unaffected by the lock
+        assert (st[~same_leaf] == batched.ST_APPLIED).all()
+        # locked page content unchanged (old values still there)
+        got, found = eng.search(upd[same_leaf])
+        assert found.all()
+        np.testing.assert_array_equal(got, upd[same_leaf])
+    finally:
+        tree._unlock(la)
+
+    st = _raw_insert_step(eng, upd, vals)
+    ok = (st == batched.ST_APPLIED) | (st == batched.ST_SUPERSEDED)
+    assert ok.all(), f"post-unlock apply failed: {st}"
+    got, found = eng.search(upd)
+    assert found.all()
+    np.testing.assert_array_equal(got, vals)
+
+
+def test_engine_retries_through_host_lock_window(eight_devices):
+    """Engine-level retry: a background host client holds the victim
+    leaf's lock for a window; ``eng.insert`` must spin ST_LOCKED rounds
+    (counted in stats) and land every key once the lock is released —
+    no host fallback, nothing lost."""
+    cluster, tree, eng = make()
+    keys = np.arange(1, 3001, dtype=np.uint64) * 9
+    batched.bulk_load(tree, keys, keys)
+
+    # warm the insert kernel before the lock window (first compile would
+    # eat the whole window)
+    warm = keys[:4]
+    eng.insert(warm, warm)
+
+    victim = int(keys[2000])
+    leaf_addr, _, _ = tree._descend(victim, 0)
+    holder_tree = Tree(cluster)
+    held = threading.Event()
+    errs = []
+
+    def holder():
+        try:
+            la = holder_tree._lock(leaf_addr)
+            held.set()
+            time.sleep(0.5)
+            holder_tree._unlock(la)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+            held.set()
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(timeout=30)
+    upd = keys[1995:2005]
+    vals = upd + np.uint64(3)
+    stats = eng.insert(upd, vals, max_rounds=400)
+    t.join(timeout=30)
+    assert not t.is_alive() and not errs, errs
+    assert stats["st_locked"] > 0, (
+        f"lock window never surfaced as ST_LOCKED retries: {stats}")
+    assert stats["host_path"] == 0, f"fell back to host path: {stats}"
+    assert stats["applied"] == upd.size
+    got, found = eng.search(upd)
+    assert found.all()
+    np.testing.assert_array_equal(got, vals)
+
+
+@pytest.mark.slow
+def test_host_writers_interleaved_with_engine_steps(eight_devices):
+    """Free-running interleaving: host threads insert/delete through the
+    locking host path (splitting leaves) while the main thread drives
+    engine insert/search/delete rounds on the same tree.  Writers own
+    disjoint key classes (outcomes deterministic) but share leaves
+    (lock/apply interleavings real).  Verified against a merged model +
+    check_structure()."""
+    cluster, tree, eng = make(B=512, pages=32768)
+    # base: multiples of 8 — every writer's keys interleave into the
+    # same leaves
+    base = np.arange(1, 4001, dtype=np.uint64) * 8
+    batched.bulk_load(tree, base, base)
+    eng.attach_router()
+
+    n_host = 3
+    host_trees = [Tree(cluster) for _ in range(n_host)]
+    per_thread = 260
+    rng = np.random.default_rng(2)
+    host_keys = [base[rng.choice(base.size, per_thread, replace=False)]
+                 + np.uint64(t + 1) for t in range(n_host)]
+    errs = []
+
+    def host_worker(t):
+        htree, hk = host_trees[t], host_keys[t]
+        try:
+            for i, k in enumerate(hk.tolist()):
+                htree.insert(int(k), int(k) ^ 0xABC)
+                if i % 3 == 2:  # delete an earlier own key
+                    htree.delete(int(hk[i - 2]))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=host_worker, args=(t,))
+               for t in range(n_host)]
+    for t in threads:
+        t.start()
+
+    # engine rounds while the host writers run
+    eng_keys = base + np.uint64(5)
+    eng_del = eng_keys[1::4]
+    st_locked_seen = 0
+    chunk = 500
+    i = 0
+    while any(t.is_alive() for t in threads):
+        lo = (i * chunk) % eng_keys.size
+        ks = eng_keys[lo:lo + chunk]
+        stats = eng.insert(ks, ks ^ np.uint64(0xDEF))
+        st_locked_seen += stats["st_locked"]  # recorded, not asserted:
+        # the deterministic tests above own that assertion
+        eng.search(base[:256])  # reads interleave too
+        i += 1
+        if i > 400:  # safety: don't loop forever if a thread hangs
+            break
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "host writer hung (lock leak?)"
+    assert not errs, errs
+    # final engine pass: every engine key present, then delete some
+    eng.insert(eng_keys, eng_keys ^ np.uint64(0xDEF))
+    deleted = eng.delete(eng_del)
+    assert deleted.all()
+
+    # merged model: base + exact replay of each writer's op sequence
+    # (key classes are disjoint, so replay order across writers is
+    # irrelevant — that's what makes the expected state deterministic)
+    model = {int(k): int(k) for k in base}
+    for t in range(n_host):
+        hk = host_keys[t]
+        mdl_ops = {}
+        for i, k in enumerate(hk.tolist()):
+            mdl_ops[int(k)] = int(k) ^ 0xABC
+            if i % 3 == 2:
+                mdl_ops.pop(int(hk[i - 2]), None)
+        for k in hk.tolist():
+            if int(k) in mdl_ops:
+                model[int(k)] = mdl_ops[int(k)]
+            else:
+                model.pop(int(k), None)
+    for k in eng_keys.tolist():
+        model[int(k)] = int(k) ^ 0xDEF
+    for k in eng_del.tolist():
+        model.pop(int(k), None)
+
+    all_keys = np.array(sorted(model), np.uint64)
+    got, found = eng.search(all_keys)
+    assert found.all(), f"{(~found).sum()} model keys missing"
+    np.testing.assert_array_equal(
+        got, np.array([model[int(k)] for k in all_keys], np.uint64))
+    gone = np.array([k for t in range(n_host)
+                     for k in host_keys[t].tolist()
+                     if int(k) not in model] + eng_del.tolist(), np.uint64)
+    if gone.size:
+        _, found = eng.search(np.unique(gone))
+        assert not found.any(), "deleted keys resurrected"
+    info = tree.check_structure()
+    assert info["keys"] == len(model)

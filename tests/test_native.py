@@ -269,14 +269,17 @@ def test_wrlock_writer_preference_and_counts():
         import pytest
         pytest.skip(native.load_error())
     rw = native.WRLock()
-    # readers share
+    # readers share: a second rlock must not block under a held rlock
     rw.rlock()
-    assert rw.try_rlock()
-    rw.runlock()
+    done = []
+    t2 = threading.Thread(target=lambda: (rw.rlock(), done.append(1),
+                                          rw.runlock()))
+    t2.start()
+    t2.join(timeout=5)
+    assert done, "second reader blocked under a held read lock"
     rw.runlock()
     # writer excludes readers
     rw.wlock()
-    assert not rw.try_rlock()
     seen = []
 
     def reader():

@@ -93,3 +93,25 @@ def test_benchmark_driver_multinode_read_combine(eight_devices, capsys):
                         "--combine", "on"])
     assert r["peak_ops"] > 0
     assert "combine" in capsys.readouterr().out
+
+
+def test_benchmark_driver_combined_mixed_fanout(eight_devices, capsys):
+    # combined 50/50 mix: read answers AND write statuses fan out to
+    # every client slot on device inside the timed step
+    import benchmark
+    r = benchmark.main(["1", "50", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5",
+                        "--combine", "on"])
+    assert r["peak_ops"] > 0
+    assert "fan-out" in capsys.readouterr().out
+
+
+def test_benchmark_driver_combined_read_multinode(eight_devices, capsys):
+    # multi-node pure-read combining uses the engine's fused fan-out
+    # kernel (all-gathered answer table) — no host fan-out anywhere
+    import benchmark
+    r = benchmark.main(["4", "100", "1", "--keys", "20000", "--secs", "1",
+                        "--ops-per-coro", "8", "--window", "0.5",
+                        "--combine", "on"])
+    assert r["peak_ops"] > 0
+    assert "in-step fan-out" in capsys.readouterr().out

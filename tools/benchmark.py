@@ -138,9 +138,14 @@ def main(argv=None) -> dict:
     # later duplicates are ST_SUPERSEDED), applied at prep.  Reads and
     # writes dedup separately; a key in both classes keeps per-request
     # semantics (the read sees the pre-step snapshot, the write applies
-    # at the boundary — the step's serial order).  Write combining is
-    # single-node only (the mixed [reads | writes] layout is per-node
-    # static); pure-read combining works on any mesh.
+    # at the boundary — the step's serial order).  EVERY client request's
+    # answer (value or status) is fanned out ON DEVICE inside the timed
+    # step — pure-read via the engine's fused fan-out kernel, mixed via a
+    # packed take_along_axis after the step — so combined client-ops
+    # throughput is fully earned in-step (round-2's deferred-fan-out
+    # accounting gap, closed).  Write combining is single-node only (the
+    # mixed [reads | writes] layout is per-node static); pure-read
+    # combining works on any mesh.
     can_combine = n_nodes == 1 or a.kReadRatio == 100
     if a.combine == "on" and not can_combine:
         notify_info("[bench] --combine on ignored: multi-node write "
@@ -159,31 +164,43 @@ def main(argv=None) -> dict:
 
     batches = []
     if combine:
-        # per batch: unique reads, unique writes
-        ur = [np.unique(bkeys[i][:n_read]) for i in range(n_batches)]
-        uw = [np.unique(bkeys[i][n_read:]) for i in range(n_batches)]
-        r_cap = _cap([u.shape[0] for u in ur], n_read)
-        w_cap = _cap([u.shape[0] for u in uw], total_batch - n_read)
+        # per batch: unique reads, unique writes (+ inverse maps for the
+        # in-step per-request answer fan-out)
+        ur = [np.unique(bkeys[i][:n_read], return_inverse=True)
+              for i in range(n_batches)]
+        uw = [np.unique(bkeys[i][n_read:], return_inverse=True)
+              for i in range(n_batches)]
+        r_cap = _cap([u.shape[0] for u, _ in ur], n_read)
+        w_cap = _cap([u.shape[0] for u, _ in uw], total_batch - n_read)
         if a.combine == "auto" and (r_cap + w_cap) * 2 > total_batch:
             combine = False  # not enough duplication to pay
         else:
             dev_batch = r_cap + w_cap
             write_lo = r_cap
             notify_info("[bench] combine: %d ops -> dev %d "
-                        "(reads %d cap %d, writes %d cap %d)",
+                        "(reads %d cap %d, writes %d cap %d); "
+                        "per-request fan-out on device in-step",
                         total_batch, dev_batch,
-                        max((u.shape[0] for u in ur), default=0), r_cap,
-                        max((u.shape[0] for u in uw), default=0), w_cap)
+                        max((u.shape[0] for u, _ in ur), default=0), r_cap,
+                        max((u.shape[0] for u, _ in uw), default=0), w_cap)
             for i in range(n_batches):
                 bk = np.zeros(dev_batch, np.uint64)
                 act_r = np.zeros(dev_batch, bool)
                 act_w = np.zeros(dev_batch, bool)
-                nr, nw = ur[i].shape[0], uw[i].shape[0]
-                bk[:nr] = ur[i]
+                (ukr, invr), (ukw, invw) = ur[i], uw[i]
+                nr, nw = ukr.shape[0], ukw.shape[0]
+                bk[:nr] = ukr
                 act_r[:nr] = True
-                bk[r_cap:r_cap + nw] = uw[i]
+                bk[r_cap:r_cap + nw] = ukw
                 act_w[r_cap:r_cap + nw] = True
-                batches.append(pack_batch(bk, act_r, act_w, i))
+                b = pack_batch(bk, act_r, act_w, i)
+                # client slot j's answer row in the unique table: reads
+                # first (their inverse), then writes offset by r_cap
+                inv = np.concatenate([
+                    invr.astype(np.int32),
+                    (r_cap + invw).astype(np.int32)])
+                b["inv"] = jax.device_put(inv, shard)
+                batches.append(b)
             del ur, uw
     if not combine:
         # Per-NODE [reads | writes] layout: the mesh shards dim 0
@@ -212,24 +229,52 @@ def main(argv=None) -> dict:
     dsm = tree.dsm
     hist = native.LatencyHistogram() if native.available() else None
     mixed = 0 < n_read < total_batch
+    # pure-read combined uses the engine's FUSED fan-out kernel (descent
+    # over uniques + per-request answer fan-out in ONE program, any mesh
+    # size); combined mixed/write-only steps append a packed
+    # take_along_axis fan-out program inside the same timed step
+    ffn = (eng._get_search_fanout(eng._iters())
+           if combine and not mixed and n_read else None)
     mfn = (eng._get_mixed(eng._iters(), True, write_lo=write_lo)
            if mixed else None)
     sfn = (eng._get_search(eng._iters(), True)
-           if not mixed and n_read else None)
+           if not mixed and n_read and ffn is None else None)
     wfn = (eng._get_insert(eng._iters(), True)
            if not mixed and n_read < total_batch else None)
     fresh_zero = (jax.device_put(
         np.zeros(n_nodes * eng.split_slots, np.int32), shard)
         if wfn is not None else None)
 
+    @jax.jit
+    def fan(found, vh, vl, status, inv):
+        # per-request fan-out for combined mixed/write-only steps: ONE
+        # packed [dev_batch, 4] table, one take — every client slot's
+        # (found, value, status) lands in HBM inside the timed step
+        ans = jnp.stack([found.astype(jnp.int32), vh, vl, status], axis=-1)
+        out = jnp.take_along_axis(ans, inv[:, None], axis=0)
+        return out[:, 0].astype(bool), out[:, 1], out[:, 2], out[:, 3]
+
+    zero_dev = (jax.device_put(np.zeros(dev_batch, np.int32), shard)
+                if combine and wfn is not None else None)
+
     def one_step(i):
         b = batches[i % n_batches]
+        if ffn is not None:
+            # combined pure-read: fused descent + in-step fan-out; the
+            # returned found/values are CLIENT-width
+            dsm.counters, done, found, vh, vl = ffn(
+                dsm.pool, dsm.counters, b["khi"], b["klo"], root,
+                b["act_r"], b["start"], b["inv"])
+            return found
         if mfn is not None:
             # fused step: searches and upserts share one descent
             (dsm.pool, dsm.counters, status, done_r, found, vh, vl) = mfn(
                 dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
                 b["vhi"], b["vlo"], root, b["act_r"], b["act_w"],
                 b["start"])
+            if combine:
+                _, _, _, cst = fan(found, vh, vl, status, b["inv"])
+                return cst
             return status
         if sfn is not None:
             dsm.counters, done, found, vh, vl = sfn(
@@ -242,6 +287,10 @@ def main(argv=None) -> dict:
         dsm.pool, dsm.counters, status, _log = wfn(
             dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
             b["vhi"], b["vlo"], root, b["act_w"], b["start"], fresh_zero)
+        if combine:
+            _, _, _, cst = fan(zero_dev, zero_dev, zero_dev, status,
+                               b["inv"])
+            return cst
         return status
 
     # Multi-node meshes must drain every step: two queued SPMD programs can
@@ -325,15 +374,16 @@ def main(argv=None) -> dict:
                 f"cluster tp {tp_cluster / 1e6:.2f} Mops/s, "
                 f"reads/op {reads / max(ops, 1):.2f}")
         if combine:
-            # distinct metrics so combined client-ops and raw device-row
-            # throughput can't be conflated (client tp counts each
-            # duplicate request; the device executes dev_batch rows/step
-            # and the per-request fan-out is NOT part of this driver's
-            # timed loop — bench.py's headline kernel does fan out
-            # in-step and is the number to quote)
+            # both metrics so combined client-ops and raw device-row
+            # throughput can't be conflated: client tp counts each
+            # duplicate request AND its answer is materialized on device
+            # inside the timed step (the in-step fan-out above), so the
+            # client number is fully earned; dev rows is the conservative
+            # unique-row denominator
             dev_tp = blocks * steps_per_block * dev_batch / elapsed
             line += (f", dev rows {dev_tp / 1e6:.2f} M/s "
-                     f"(combine {total_batch / dev_batch:.1f}x)")
+                     f"(combine {total_batch / dev_batch:.1f}x, "
+                     "in-step fan-out)")
         if a.scans:
             line += (f", scans {a.scans} x {scan_entries // max(a.scans, 1)} "
                      f"entries @ {scan_ns / max(a.scans, 1) / 1e6:.1f} ms")
@@ -346,9 +396,17 @@ def main(argv=None) -> dict:
     last_b = batches[(step_i - 1) % n_batches]
     if mfn is not None or wfn is not None:
         st = np.asarray(out)
-        okw = np.isin(st[np.asarray(last_b["act_w"])],
-                      (batched.ST_APPLIED, batched.ST_SUPERSEDED))
+        if combine:
+            # client-width fanned statuses: write slots are [n_read:]
+            okw = np.isin(st[n_read:],
+                          (batched.ST_APPLIED, batched.ST_SUPERSEDED))
+        else:
+            okw = np.isin(st[np.asarray(last_b["act_w"])],
+                          (batched.ST_APPLIED, batched.ST_SUPERSEDED))
         assert okw.mean() > 0.99, f"write fast-path misses: {1-okw.mean():.3%}"
+    elif ffn is not None:
+        # client-width fanned lookups: every request key is warm
+        assert bool(np.asarray(out).all()), "combined searches missed keys"
     elif sfn is not None:
         found = np.asarray(out)[np.asarray(last_b["act_r"])]
         assert bool(found.all()), "searches missed warm keys"
