@@ -298,6 +298,12 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     # latency-sensitive deployments see the measured per-op floor, not
     # just the batched step spans.
     loops = 20
+    # warm each host path once first: the first lock/search/insert
+    # compiles its host step program (seconds over the remote-compile
+    # path) and would otherwise swamp the 20-op means
+    tree.lock_bench(12345, loops=1)
+    tree.search(int(keys[0]))
+    tree.insert(int(keys[0]), int(vals[0]))
     host_lock_us = tree.lock_bench(12345, loops=loops) / 1e3
     t1 = time.time_ns()
     for k in keys[:loops].tolist():

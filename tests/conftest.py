@@ -45,3 +45,42 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs
+
+
+def run_insert_kernel(eng, keys, vals, *, use_router=None, with_fresh=True,
+                      update_only=False):
+    """Drive ONE raw insert step (no engine retry) -> status [n].
+
+    Shared by the kernel-semantics tests (test_batched) and the
+    concurrency tests (test_concurrent): statuses are observable because
+    the engine's retry loop is bypassed.
+    """
+    import numpy as np
+
+    from sherman_tpu.ops import bits
+    if use_router is None:
+        use_router = eng.router is not None
+    n = keys.shape[0]
+    khi, klo = bits.keys_to_pairs(keys)
+    vhi, vlo = bits.keys_to_pairs(vals)
+    (khi, _), (klo, _) = eng._pad(khi), eng._pad(klo)
+    (vhi, _), (vlo, _) = eng._pad(vhi), eng._pad(vlo)
+    active, _ = eng._pad(np.ones(n, bool))
+    fn = eng._get_insert(eng._iters(), use_router, with_fresh=with_fresh,
+                         update_only=update_only)
+    dsm = eng.dsm
+    args = [eng._shard(khi), eng._shard(klo), eng._shard(vhi),
+            eng._shard(vlo), np.int32(eng.tree._root_addr),
+            eng._shard(active)]
+    if use_router:
+        args.append(eng._shard(eng.router.host_start(khi, klo)))
+    with eng._step_mutex:
+        if with_fresh:
+            args.append(eng._shard(np.zeros(
+                eng.cfg.machine_nr * eng.split_slots, np.int32)))
+            dsm.pool, dsm.counters, st, _log = fn(
+                dsm.pool, dsm.locks, dsm.counters, *args)
+        else:
+            dsm.pool, dsm.counters, st = fn(
+                dsm.pool, dsm.locks, dsm.counters, *args)
+    return eng._unshard(st)[:n]

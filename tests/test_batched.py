@@ -330,3 +330,59 @@ def test_search_combined_multinode_device_fanout(eight_devices):
     np.testing.assert_array_equal(v4[exp_f], reqs[exp_f] * np.uint64(5))
     # the DEVICE fan-out kernel (not the host gather) answered
     assert ("fanout", eng4._iters()) in eng4._search_cache
+
+
+from conftest import run_insert_kernel as _run_insert_kernel
+
+
+def test_update_only_kernel_semantics(eight_devices):
+    """The steady-state update-only apply: existing keys update in place
+    (4-word write-back), duplicates supersede to the winner, ABSENT keys
+    escalate with ST_FULL (nothing written) — the driver contract for
+    the YCSB update benches."""
+    tree, eng = make(nr=1, B=512)
+    keys = np.arange(1, 2001, 2, dtype=np.uint64)   # odd keys exist
+    batched.bulk_load(tree, keys, keys)
+    eng.attach_router()
+
+    present = keys[:50]
+    dups = keys[:10]                 # later same-key requests
+    absent = np.arange(2, 42, 2, dtype=np.uint64)   # evens: not in tree
+    batch = np.concatenate([present, dups, absent])
+    vals = batch ^ np.uint64(0x55)
+    st = _run_insert_kernel(eng, batch, vals, with_fresh=False,
+                            update_only=True)
+    assert (st[:50] == batched.ST_APPLIED).all()
+    assert (st[50:60] == batched.ST_SUPERSEDED).all()
+    assert (st[60:] == batched.ST_FULL).all(), st[60:]
+
+    got, found = eng.search(present)
+    assert found.all()
+    np.testing.assert_array_equal(got, present ^ np.uint64(0x55))
+    _, found = eng.search(absent)
+    assert not found.any(), "update-only kernel must not insert"
+    tree.check_structure()
+
+
+def test_update_only_matches_general_kernel(eight_devices):
+    """Differential: the same update batch through the update-only and
+    general kernels produces identical tree state and statuses."""
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 32, 3000, dtype=np.uint64))
+    batch = rng.choice(keys, 800)                    # duplicates included
+    vals = batch ^ np.uint64(0xF0F0)
+
+    results = []
+    for update_only in (False, True):
+        tree, eng = make(nr=1, B=1024)
+        batched.bulk_load(tree, keys, keys)
+        eng.attach_router()
+        st = _run_insert_kernel(eng, batch, vals, with_fresh=False,
+                                update_only=update_only)
+        got, found = eng.search(keys)
+        results.append((st, got, found))
+    st0, got0, f0 = results[0]
+    st1, got1, f1 = results[1]
+    np.testing.assert_array_equal(st0, st1)
+    np.testing.assert_array_equal(f0, f1)
+    np.testing.assert_array_equal(got0, got1)

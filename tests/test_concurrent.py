@@ -24,7 +24,6 @@ from sherman_tpu.cluster import Cluster
 from sherman_tpu.config import DSMConfig
 from sherman_tpu.models import batched
 from sherman_tpu.models.btree import Tree
-from sherman_tpu.ops import bits
 
 
 def make(B=256, pages=8192, step_capacity=1024):
@@ -37,24 +36,12 @@ def make(B=256, pages=8192, step_capacity=1024):
     return cluster, tree, eng
 
 
+from conftest import run_insert_kernel
+
+
 def _raw_insert_step(eng, keys, vals):
     """ONE device insert step, no engine retry — statuses observable."""
-    n = keys.shape[0]
-    khi, klo = bits.keys_to_pairs(keys)
-    vhi, vlo = bits.keys_to_pairs(vals)
-    (khi, _), (klo, _) = eng._pad(khi), eng._pad(klo)
-    (vhi, _), (vlo, _) = eng._pad(vhi), eng._pad(vlo)
-    active, _ = eng._pad(np.ones(n, bool))
-    fresh = np.zeros(eng.cfg.machine_nr * eng.split_slots, np.int32)
-    fn = eng._get_insert(eng._iters(), False)
-    dsm = eng.dsm
-    with eng._step_mutex:
-        dsm.pool, dsm.counters, status, _log = fn(
-            dsm.pool, dsm.locks, dsm.counters,
-            eng._shard(khi), eng._shard(klo), eng._shard(vhi),
-            eng._shard(vlo), np.int32(eng.tree._root_addr),
-            eng._shard(active), eng._shard(fresh))
-    return eng._unshard(status)[:n]
+    return run_insert_kernel(eng, keys, vals, use_router=False)
 
 
 def test_host_held_lock_forces_st_locked(eight_devices):

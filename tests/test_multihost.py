@@ -171,6 +171,65 @@ print(f"[{pid}] ENGINE-PASS splits={stats['device_splits']}", flush=True)
 '''
 
 
+_SPLIT_STORM_WORKER = r'''
+import os, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["SHERMAN_COORD"] = f"localhost:{port}"
+os.environ["SHERMAN_NPROC"] = str(nproc)
+os.environ["SHERMAN_PROC_ID"] = str(pid)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.parallel import bootstrap
+
+keeper = bootstrap.init_multihost()
+
+# Split storm across the process-spanning mesh: hundreds of device-side
+# leaf splits whose parent entries flush through ReplicatedDSM's CHUNKED
+# collective path (host_step_capacity=16 forces many small collective
+# steps per flush — the cost bound round 2 flagged as untested).
+cfg = DSMConfig(machine_nr=4, pages_per_node=1024, locks_per_node=256,
+                step_capacity=256, host_step_capacity=16, chunk_pages=16)
+cluster = Cluster(cfg, keeper=keeper)
+tree = Tree(cluster)
+eng = batched.BatchedEngine(tree, batch_per_node=128)
+
+base = np.arange(1, 401, dtype=np.uint64) * 1000
+batched.bulk_load(tree, base, base)
+eng.attach_router()
+
+rng = np.random.default_rng(5)
+dense = np.unique((base[:, None] + rng.integers(
+    1, 1000, (400, 8), dtype=np.uint64)).reshape(-1))
+stats = eng.insert(dense, dense ^ np.uint64(0xF00))
+assert stats["device_splits"] >= 100, f"storm too small: {stats}"
+assert stats["host_path"] == 0, f"storm spilled to host path: {stats}"
+# bounded convergence: the progress-adaptive retry loop must drain a
+# split-heavy load without running away (rounds sum over all chunks)
+assert stats["rounds"] <= 80, f"unbounded retry: {stats}"
+
+got, found = eng.search(dense)
+assert found.all(), f"missing {int((~found).sum())} dense keys"
+np.testing.assert_array_equal(got, dense ^ np.uint64(0xF00))
+got, found = eng.search(base)
+assert found.all()
+np.testing.assert_array_equal(got, base)
+info = tree.check_structure()
+assert info["keys"] == base.size + dense.size
+total = keeper.sum("splits", int(stats["device_splits"]))
+assert total == nproc * stats["device_splits"]  # identical streams
+keeper.barrier("done")
+print(f"[{pid}] STORM-PASS splits={stats['device_splits']} "
+      f"rounds={stats['rounds']}", flush=True)
+'''
+
+
 def _run_workers(tmp_path, script, timeout, tag):
     import socket
 
@@ -211,3 +270,10 @@ def test_two_process_engine(tmp_path):
     bulk_load spread over all nodes (cross-host MALLOC), batched insert
     with device-side splits, search, delete, structure check."""
     _run_workers(tmp_path, _ENGINE_WORKER, 900, "ENGINE-PASS")
+
+
+def test_two_process_split_storm(tmp_path):
+    """Split-heavy insert (>= 100 device splits) across 2 processes:
+    flush_parents' chunked collective path under load, bounded
+    convergence, nothing lost."""
+    _run_workers(tmp_path, _SPLIT_STORM_WORKER, 1500, "STORM-PASS")
