@@ -1637,12 +1637,24 @@ class BatchedEngine:
             stats["host_path"] += 1
 
     def range_query(self, lo: int, hi: int) -> tuple[np.ndarray, np.ndarray]:
-        """All (k, v) with lo <= k < hi, sorted.  See module-level
-        :func:`range_query`."""
+        """All (k, v) with lo <= k < hi, sorted.  See
+        :meth:`range_query_many`."""
+        return self.range_query_many([(lo, hi)])[0]
+
+    def range_query_many(self, ranges) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Batched scans: ONE device gather prefetches the candidate
+        leaves of EVERY range, then each range walks its chain over the
+        shared prefetch.  The multi-scan analogue of the reference's
+        kParaFetch window (Tree.cpp:501-522): where it pipelines 32
+        fetches within one scan, the batched server amortizes the whole
+        scan SET into one step.  ranges: iterable of (lo, hi); returns
+        [(keys, vals)] per range, each sorted by key."""
+        ranges = [(int(lo), int(hi)) for lo, hi in ranges]
         # replication guard: the chain walk issues a data-dependent number
         # of collective host reads — divergent bounds would desync them
-        self._check_replicated(np.asarray([lo, hi], np.uint64))
-        return range_query(self, lo, hi)
+        self._check_replicated(
+            np.asarray([b for r in ranges for b in r], np.uint64))
+        return range_query_many(self, ranges)
 
     def delete(self, keys, max_rounds: int | None = None) -> np.ndarray:
         """Batched delete (``Tree::del`` parity).  Returns found bool [n]
@@ -1714,33 +1726,38 @@ def _gather_rows(pool, rows):
     return pool[rows]
 
 
-def range_query(eng: "BatchedEngine", lo: int, hi: int
-                ) -> tuple[np.ndarray, np.ndarray]:
-    """All (k, v) with lo <= k < hi, sorted by key.
+def range_query_many(eng: "BatchedEngine", ranges
+                     ) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Batched scans: all (k, v) with lo <= k < hi per range, sorted.
 
     TPU-native shape of the reference's pipelined scan
     (``Tree.cpp:461-522``): the index cache (router table) yields the
-    candidate leaf set for the range in O(1); ONE device gather fetches all
-    candidate pages at once (beating the reference's 32-deep fetch window);
-    the host walks the B-link chain over the prefetched pages and only
-    touches the DSM again for chain gaps (stale cache), mirroring the
-    re-descend fallback.  Returns (keys u64 [n], values u64 [n]).
+    candidate leaf set of EVERY range in O(1); ONE device gather fetches
+    the union of candidate pages (beating the reference's 32-deep fetch
+    window, and amortizing the host<->device round trip over the whole
+    scan set); each range then walks its B-link chain over the shared
+    prefetch and only touches the DSM again for chain gaps (stale
+    cache), mirroring the re-descend fallback.
     """
     tree = eng.tree
     cfg = eng.cfg
-    lo = int(lo); hi = int(hi)
-    assert C.KEY_MIN <= lo and hi <= C.KEY_POS_INF and lo < hi
+    for lo, hi in ranges:
+        assert C.KEY_MIN <= lo and hi <= C.KEY_POS_INF and lo < hi
 
-    # -- candidate prefetch from the router table ---------------------------
+    # -- candidate prefetch from the router table (union of all ranges) ----
     fetched: dict[int, np.ndarray] = {}
-    if eng.router is not None:
+    if eng.router is not None and ranges:
         r = eng.router
-        # clamp BOTH ends into the table: out-of-span ranges (common now
-        # that narrow keyspaces seed small shifts) start from the last
-        # bucket's seed instead of silently skipping the prefetch
-        b_lo = min(r.nb - 1, lo >> r.shift)
-        b_hi = min(r.nb - 1, max(0, (hi - 1) >> r.shift))
-        cand = np.unique(r.table_np[b_lo:b_hi + 1])
+        cand_parts = []
+        with r._read_locked():
+            for lo, hi in ranges:
+                # clamp BOTH ends into the table: out-of-span ranges
+                # (common with narrow-keyspace seeds) start from the last
+                # bucket's seed instead of silently skipping the prefetch
+                b_lo = min(r.nb - 1, lo >> r.shift)
+                b_hi = min(r.nb - 1, max(0, (hi - 1) >> r.shift))
+                cand_parts.append(r.table_np[b_lo:b_hi + 1])
+        cand = np.unique(np.concatenate(cand_parts))
         if cand.size:
             if eng._mh:
                 # replicated host reads (chunked collective steps)
@@ -1754,43 +1771,71 @@ def range_query(eng: "BatchedEngine", lo: int, hi: int
                 if int(p[C.W_LEVEL]) == 0:   # stale entries may be internal
                     fetched[int(a) & 0xFFFFFFFF] = p
 
+    # pages fetched during chain walks (router misses) join `extras` so
+    # later ranges starting inside them skip the re-descend
+    extras: dict[int, np.ndarray] = {}
+
     def get_page(addr: int) -> np.ndarray:
         p = fetched.get(addr & 0xFFFFFFFF)
         if p is None:
             p = tree.dsm.read_page(addr)
             fetched[addr & 0xFFFFFFFF] = p
+            extras[addr & 0xFFFFFFFF] = p
         return p
 
-    # -- find the first leaf containing lo ----------------------------------
-    start = None
-    for a, p in fetched.items():
-        if layout.np_lowest(p) <= lo < layout.np_highest(p):
-            start = a
-            break
-    if start is None:
-        start, _, _ = tree._descend(lo, 0)
+    # sorted (lowest -> addr) index over the prefetch: start-leaf lookup
+    # per range is a binary search, not a scan of every fetched page
+    if fetched:
+        f_addrs = np.fromiter(fetched.keys(), np.int64, len(fetched))
+        f_lows = np.array([layout.np_lowest(fetched[int(a)])
+                           for a in f_addrs], np.uint64)
+        f_highs = np.array([layout.np_highest(fetched[int(a)])
+                            for a in f_addrs], np.uint64)
+        f_order = np.argsort(f_lows)
+        f_addrs, f_lows, f_highs = (f_addrs[f_order], f_lows[f_order],
+                                    f_highs[f_order])
+    else:
+        f_addrs = np.zeros(0, np.int64)
+        f_lows = f_highs = np.zeros(0, np.uint64)
 
-    # -- walk the chain -----------------------------------------------------
-    addr = start
-    chain_pages = []
-    hops = 0
-    while True:
-        pg = get_page(addr)
-        chain_pages.append(pg)
-        if layout.np_highest(pg) >= hi:
-            break
-        sib = int(pg[C.W_SIBLING])
-        if bits.addr_is_null(sib):
-            break
-        addr = sib
-        hops += 1
-        assert hops < cfg.machine_nr * cfg.pages_per_node, "chain runaway"
-    pages = np.stack(chain_pages)
-    keys, vals, live = layout.np_leaf_entries_batch(pages)
-    m = live & (keys >= np.uint64(lo)) & (keys < np.uint64(hi))
-    out_k, out_v = keys[m], vals[m]
-    order = np.argsort(out_k)
-    return out_k[order], out_v[order]
+    out: list[tuple[np.ndarray, np.ndarray]] = []
+    for lo, hi in ranges:
+        # -- find the first leaf containing lo ------------------------------
+        start = None
+        i = int(np.searchsorted(f_lows, np.uint64(lo), side="right")) - 1
+        if i >= 0 and lo < int(f_highs[i]):
+            start = int(f_addrs[i])
+        if start is None:
+            for a, p in extras.items():   # walk-fetched pages, few
+                if layout.np_lowest(p) <= lo < layout.np_highest(p):
+                    start = a
+                    break
+        if start is None:
+            start, _, _ = tree._descend(lo, 0)
+
+        # -- walk the chain -------------------------------------------------
+        addr = start
+        chain_pages = []
+        hops = 0
+        while True:
+            pg = get_page(addr)
+            chain_pages.append(pg)
+            if layout.np_highest(pg) >= hi:
+                break
+            sib = int(pg[C.W_SIBLING])
+            if bits.addr_is_null(sib):
+                break
+            addr = sib
+            hops += 1
+            assert hops < cfg.machine_nr * cfg.pages_per_node, \
+                "chain runaway"
+        pages = np.stack(chain_pages)
+        keys, vals, live = layout.np_leaf_entries_batch(pages)
+        m = live & (keys >= np.uint64(lo)) & (keys < np.uint64(hi))
+        out_k, out_v = keys[m], vals[m]
+        order = np.argsort(out_k)
+        out.append((out_k[order], out_v[order]))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -386,3 +386,31 @@ def test_update_only_matches_general_kernel(eight_devices):
     np.testing.assert_array_equal(st0, st1)
     np.testing.assert_array_equal(f0, f1)
     np.testing.assert_array_equal(got0, got1)
+
+
+def test_range_query_many_matches_singles(eight_devices):
+    """Batched multi-range scans (one shared candidate prefetch) return
+    exactly what per-range range_query returns — including overlapping
+    ranges, empty ranges, and ranges crossing split boundaries."""
+    tree, eng = make(nr=1, B=256)
+    rng = np.random.default_rng(21)
+    keys = np.unique(rng.integers(1, 1 << 32, 4000, dtype=np.uint64))
+    batched.bulk_load(tree, keys, keys * np.uint64(7))
+    eng.attach_router()
+    # splits after bulk load so some router entries go stale
+    extra = np.setdiff1d(keys + np.uint64(1), keys)[:600]
+    eng.insert(extra, extra)
+
+    spans = []
+    for _ in range(6):
+        i0 = int(rng.integers(0, keys.size - 200))
+        spans.append((int(keys[i0]), int(keys[i0 + 150])))
+    spans.append((int(keys[10]), int(keys[12])))      # tiny
+    spans.append((3, 4))                              # likely empty
+    spans.append((int(keys[0]), int(keys[300])))      # overlaps span 0?
+    many = eng.range_query_many(spans)
+    assert len(many) == len(spans)
+    for (lo, hi), (mk, mv) in zip(spans, many):
+        sk, sv = eng.range_query(lo, hi)
+        np.testing.assert_array_equal(mk, sk)
+        np.testing.assert_array_equal(mv, sv)
