@@ -1741,6 +1741,8 @@ def range_query_many(eng: "BatchedEngine", ranges
     """
     tree = eng.tree
     cfg = eng.cfg
+    # materialize + coerce: callers may pass generators or numpy scalars
+    ranges = [(int(lo), int(hi)) for lo, hi in ranges]
     for lo, hi in ranges:
         assert C.KEY_MIN <= lo and hi <= C.KEY_POS_INF and lo < hi
 

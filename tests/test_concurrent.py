@@ -182,6 +182,16 @@ def test_host_writers_interleaved_with_engine_steps(eight_devices):
         st_locked_seen += stats["st_locked"]  # recorded, not asserted:
         # the deterministic tests above own that assertion
         eng.search(base[:256])  # reads interleave too
+        if i % 3 == 1:
+            # scans during host splits: the prefetch + B-link walk must
+            # stay coherent (results are in-flux, so no value asserts —
+            # check_structure at the end owns the invariants)
+            eng.range_query(int(base[100]), int(base[400]))
+        if i % 4 == 3:
+            # engine deletes of engine-owned keys mid-storm; the final
+            # full insert pass below re-adds them, so the merged model
+            # is unaffected
+            eng.delete(ks[: chunk // 4])
         i += 1
         if i > 400:  # safety: don't loop forever if a thread hangs
             break
