@@ -192,6 +192,16 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
     straggler loop as described in :func:`search_routed_spmd`.  Returns
     (counters, done, addr, found, vhi, vlo): ``addr`` is the key's leaf
     page (for owner-side applies), found/vhi/vlo its lookup result.
+
+    The stragglers are compacted ONCE after round 1 and the loop runs
+    entirely in the compacted [S] space (the set only shrinks — a row
+    that resolved in round 1 never becomes a straggler later), with a
+    single scatter of results back to [B] after the loop.  The previous
+    shape re-compacted and scattered [B]-wide EVERY round, which
+    measured ~41 ms of the 68 ms step at 2 M rows — 60% of the read
+    path spent resolving ~3% of rows.  Rows beyond the S-slot buffer
+    (cold-router floods) stay not-done; callers retry them through the
+    full-descent path, same contract as the round budget.
     """
     B = khi.shape[0]
     P = pool.shape[0]
@@ -230,32 +240,47 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
     vlo = jnp.where(found, vl, 0)
     addr = jnp.where(ok & chase, layout.h_sibling(pg), start)
 
+    # one-time compaction; fill rows (sidx == B) start done
+    sidx = jnp.nonzero(~done, size=S, fill_value=B)[0].astype(jnp.int32)
+    valid = sidx < B
+    ci = jnp.clip(sidx, 0, B - 1)
+    s_kh, s_kl = khi[ci], klo[ci]
+    s_addr = addr[ci]
+    s_done = ~valid
+    s_f = jnp.zeros(S, bool)
+    s_vh = jnp.zeros(S, jnp.int32)
+    s_vl = jnp.zeros(S, jnp.int32)
+
     def cond(st):
-        it, done = st[0], st[1]
-        return (it < max_rounds) & jnp.any(~done)
+        it, s_done = st[0], st[1]
+        return (it < max_rounds) & jnp.any(~s_done)
 
     def body(st):
-        it, done, addr, found, vhi, vlo, loop_reads = st
-        sidx = jnp.nonzero(~done, size=S, fill_value=B)[0].astype(jnp.int32)
-        valid = sidx < B
-        ci = jnp.clip(sidx, 0, B - 1)
-        sa, skh, skl = addr[ci], khi[ci], klo[ci]
-        pg, ok = read(sa)
-        ok = ok & valid
-        at_leaf, nxt, f, vh, vl = advance(pg, ok, skh, skl)
+        it, s_done, s_addr, s_f, s_vh, s_vl, loop_reads = st
+        loop_reads = loop_reads + jnp.sum((~s_done).astype(jnp.uint32))
+        pg, ok = read(s_addr)
+        ok = ok & ~s_done
+        at_leaf, nxt, f, vh, vl = advance(pg, ok, s_kh, s_kl)
         fin = ok & at_leaf
-        tgt = jnp.where(fin, sidx, B)
-        done = done.at[tgt].set(True, mode="drop")
-        found = found.at[tgt].set(f & fin, mode="drop")
-        vhi = vhi.at[tgt].set(jnp.where(f & fin, vh, 0), mode="drop")
-        vlo = vlo.at[tgt].set(jnp.where(f & fin, vl, 0), mode="drop")
-        adv = jnp.where(ok & ~at_leaf, sidx, B)
-        addr = addr.at[adv].set(nxt, mode="drop")
-        loop_reads = loop_reads + jnp.sum(valid.astype(jnp.uint32))
-        return it + 1, done, addr, found, vhi, vlo, loop_reads
+        s_f = jnp.where(fin, f, s_f)
+        s_vh = jnp.where(fin & f, vh, s_vh)
+        s_vl = jnp.where(fin & f, vl, s_vl)
+        s_done = s_done | fin
+        s_addr = jnp.where(ok & ~at_leaf, nxt, s_addr)
+        return it + 1, s_done, s_addr, s_f, s_vh, s_vl, loop_reads
 
-    _, done, addr, found, vhi, vlo, loop_reads = lax.while_loop(
-        cond, body, (1, done, addr, found, vhi, vlo, jnp.uint32(0)))
+    _, s_done, s_addr, s_f, s_vh, s_vl, loop_reads = lax.while_loop(
+        cond, body,
+        (1, s_done, s_addr, s_f, s_vh, s_vl, jnp.uint32(0)))
+
+    # single scatter of the compacted results back to [B]
+    res = valid & s_done
+    tgt = jnp.where(res, sidx, B)
+    done = done.at[tgt].set(True, mode="drop")
+    found = found.at[tgt].set(s_f, mode="drop")
+    vhi = vhi.at[tgt].set(jnp.where(s_f, s_vh, 0), mode="drop")
+    vlo = vlo.at[tgt].set(jnp.where(s_f, s_vl, 0), mode="drop")
+    addr = addr.at[tgt].set(s_addr, mode="drop")
 
     # round-1 gather (one page per active key) + every straggler-loop row
     n_reads = jnp.sum(active.astype(jnp.uint32)) + loop_reads

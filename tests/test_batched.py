@@ -414,3 +414,38 @@ def test_range_query_many_matches_singles(eight_devices):
         sk, sv = eng.range_query(lo, hi)
         np.testing.assert_array_equal(mk, sk)
         np.testing.assert_array_equal(mv, sv)
+
+
+def test_straggler_overflow_rescue(eight_devices):
+    """Cold-router flood: with every seed pointing at the ROOT, all B
+    rows straggle past the once-compacted S-slot buffer (S = B//16 for
+    B > 16K; here forced via reset).  Overflow rows stay not-done and
+    every caller must rescue them through its full-descent retry —
+    nothing lost, exact results on search, insert, and combined
+    search."""
+    tree, eng = make(nr=1, B=4096, pages=8192, cap=4096)
+    rng = np.random.default_rng(77)
+    keys = np.unique(rng.integers(1, 1 << 40, 6000, dtype=np.uint64))
+    batched.bulk_load(tree, keys, keys * np.uint64(9))
+    eng.attach_router()
+    eng.router.reset()   # cold: B=4096 stragglers > S=1024
+
+    probe = keys[:4096]
+    got, found = eng.search(probe)
+    assert found.all(), f"{int((~found).sum())} overflow rows lost"
+    np.testing.assert_array_equal(got, probe * np.uint64(9))
+
+    eng.router.reset()
+    reqs = np.repeat(keys[:500], 9)
+    got, found = eng.search_combined(reqs)
+    assert found.all()
+    np.testing.assert_array_equal(got, reqs * np.uint64(9))
+
+    eng.router.reset()
+    upd = keys[:3000]
+    stats = eng.insert(upd, upd)
+    assert stats["applied"] + stats["superseded"] == upd.size, stats
+    got, found = eng.search(upd)
+    assert found.all()
+    np.testing.assert_array_equal(got, upd)
+    tree.check_structure()
