@@ -356,7 +356,9 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     # liveness, find, versions); the barrier stops XLA rematerializing
     # the gather into consumer fusions (net-neutral at the 131 K-page
     # scale, insurance at larger pools where a duplicated gather costs
-    # the full per-row latency again)
+    # the full per-row latency again).  Reusing the descent's round-1
+    # pages here instead was measured SLOWER (+24 ms at 2 M rows — the
+    # materialized [B, PW] hint buffer costs more than the re-gather).
     pg = lax.optimization_barrier(pool[safe_page])         # [M, PW] snapshot
 
     lock_idx = bits.lock_index(inc["addr"], cfg.locks_per_node)
@@ -725,9 +727,13 @@ def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
     :func:`leaf_apply_spmd`).  Returns (pool, counters, status [B]) per
     this node's key shard — plus the log when ``fresh`` is given.
     """
+    # NOTE: threading the descent's round-1 pages into the apply (to skip
+    # its snapshot gather) was measured SLOWER (+24 ms at 2 M rows):
+    # materializing the [B, PW] round-1 pages costs more than the
+    # duplicate gather, which XLA fuses into the apply's consumers.
     counters, done, addr, _, _, _ = _resolve_leaves(
-        pool, counters, khi, klo, root, active, start, cfg=cfg, iters=iters,
-        axis_name=axis_name)
+        pool, counters, khi, klo, root, active, start, cfg=cfg,
+        iters=iters, axis_name=axis_name)
     apply_fn = functools.partial(leaf_apply_spmd, fresh=fresh,
                                  update_only=update_only)
     pool, counters, status, log = _route_and_apply(
