@@ -317,34 +317,43 @@ class _HostOps:
     def _batch(self, rows: list[dict]) -> Replies:  # pragma: no cover
         raise NotImplementedError
 
+    @staticmethod
+    def _require_ok(ok, what: str) -> None:
+        """Host-API ops must not fail silently: a refused row (bad
+        address, routing overflow) indicates a protocol bug or an
+        undersized step, and a bare assert would be stripped under
+        python -O — masking lost writes as success."""
+        if not bool(np.all(ok)):
+            raise RuntimeError(f"host DSM op failed: {what}")
+
     def read_page(self, addr: int) -> np.ndarray:
         r = self._batch([{"op": OP_READ, "addr": addr}])
-        assert r.ok[0]
+        self._require_ok(r.ok[0], "read_page (bad address?)")
         return r.data[0]
 
     def read_pages(self, addrs) -> np.ndarray:
         rows = [{"op": OP_READ, "addr": int(a)} for a in addrs]
         r = self._batch(rows)
-        assert r.ok.all(), "read overflow: raise step_capacity"
+        self._require_ok(r.ok, "read_pages overflow: raise step_capacity")
         return r.data
 
     def write_page(self, addr: int, words: np.ndarray):
         r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": 0,
                           "nw": PAGE_WORDS, "payload": words}])
-        assert r.ok[0]
+        self._require_ok(r.ok[0], "write_page (bad address?)")
 
     def write_words(self, addr: int, woff: int, words: np.ndarray):
         words = np.asarray(words, np.int32)
         r = self._batch([{"op": OP_WRITE, "addr": addr, "woff": woff,
                           "nw": words.shape[0], "payload": words}])
-        assert r.ok[0]
+        self._require_ok(r.ok[0], "write_words (bad address/range?)")
 
     def write_rows(self, rows: list[dict]):
         """Batched writes in ONE step — the write_batch/doorbell analogue
         (Operation.cpp:351-380): all writes in a step become visible
         atomically at the step boundary."""
         r = self._batch(rows)
-        assert r.ok.all()
+        self._require_ok(r.ok, "write_rows (bad address or overflow)")
 
     def cas(self, addr: int, woff: int, expected: int, desired: int,
             space: int = SPACE_POOL) -> tuple[int, bool]:
@@ -356,20 +365,20 @@ class _HostOps:
             space: int = SPACE_POOL) -> int:
         r = self._batch([{"op": OP_FAA, "addr": addr, "woff": woff,
                           "arg0": delta, "space": space}])
-        assert r.ok[0], "faa failed (bad address?)"
+        self._require_ok(r.ok[0], "faa (bad address?)")
         return int(r.old[0])
 
     def read_word(self, addr: int, woff: int, space: int = SPACE_POOL) -> int:
         r = self._batch([{"op": OP_READ_WORD, "addr": addr, "woff": woff,
                           "space": space}])
-        assert r.ok[0], "read_word failed (bad address?)"
+        self._require_ok(r.ok[0], "read_word (bad address?)")
         return int(r.old[0])
 
     def write_word(self, addr: int, woff: int, value: int,
                    space: int = SPACE_POOL):
         r = self._batch([{"op": OP_WRITE_WORD, "addr": addr, "woff": woff,
                           "arg1": value, "space": space}])
-        assert r.ok[0]
+        self._require_ok(r.ok[0], "write_word (bad address?)")
 
     def masked_cas(self, addr: int, woff: int, expected: int, desired: int,
                    mask: int, space: int = SPACE_POOL) -> tuple[int, bool]:
@@ -414,7 +423,7 @@ class _HostOps:
              "arg0": expected, "arg1": desired, "space": cas_space},
             {"op": OP_READ, "addr": read_addr},
         ])
-        assert r.ok[1], "cas_read: bad page address"
+        self._require_ok(r.ok[1], "cas_read: bad page address")
         return int(r.old[0]), bool(r.ok[0]), r.data[1]
 
     def write_cas(self, waddr: int, woff: int, payload: np.ndarray,
@@ -430,7 +439,7 @@ class _HostOps:
             {"op": OP_CAS, "addr": cas_addr, "woff": cas_woff,
              "arg0": expected, "arg1": desired, "space": cas_space},
         ])
-        assert r.ok[0], "write_cas: bad write address"
+        self._require_ok(r.ok[0], "write_cas: bad write address")
         return bool(r.ok[1])
 
     def write_faa(self, waddr: int, woff: int, payload: np.ndarray,
@@ -445,7 +454,7 @@ class _HostOps:
             {"op": OP_FAA, "addr": faa_addr, "woff": faa_woff,
              "arg0": delta, "space": faa_space},
         ])
-        assert r.ok[0] and r.ok[1], "write_faa: bad address"
+        self._require_ok(r.ok[0] and r.ok[1], "write_faa: bad address")
         return int(r.old[1])
 
 
