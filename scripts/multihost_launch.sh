@@ -11,6 +11,11 @@
 # The script exports SHERMAN_COORD/SHERMAN_NPROC/SHERMAN_PROC_ID; the driver
 # calls sherman_tpu.parallel.bootstrap.init_multihost() which reads them (or
 # pass explicitly).  On TPU pods with auto-init, all three may be omitted.
+#
+# Failure detection knobs (utils/failure.py): SHERMAN_HEARTBEAT_S tunes
+# peer-death detection latency (survivors are terminated with a diagnostic
+# instead of hanging); SHERMAN_COLLECTIVE_TIMEOUT_S arms a fail-fast
+# watchdog around collective checkpoint/restore.
 set -euo pipefail
 if [ "$#" -lt 4 ]; then
   echo "usage: $0 <coordinator_ip:port> <num_hosts> <host_id> <script> [args...]" >&2

@@ -58,10 +58,12 @@ class Keeper:
 
     # -- collectives (DSMKeeper.cpp:148-176) ---------------------------------
 
-    def barrier(self, name: str) -> None:
+    def barrier(self, name: str, timeout_s: float | None = None) -> None:
         """Named cluster barrier.  In single-process SPMD every node's work
         is already serialized through one driver, so arrival==completion;
-        the fetch-add bookkeeping is kept for interface parity."""
+        the fetch-add bookkeeping is kept for interface parity.
+        ``timeout_s`` is accepted for interface parity with the guarded
+        multihost barrier (trivially met here)."""
         self.mem_fetch_and_add("barrier:" + name, 1)
 
     def sum(self, name: str, value: int) -> int:
@@ -75,7 +77,9 @@ class Keeper:
 
 def init_multihost(coordinator_address: str | None = None,
                    num_processes: int | None = None,
-                   process_id: int | None = None) -> "DistributedKeeper":
+                   process_id: int | None = None,
+                   heartbeat_timeout_s: int | None = None
+                   ) -> "DistributedKeeper":
     """Join a multi-host deployment and return its Keeper.
 
     The memcached bootstrap role (``Keeper.cpp:28-56``): every host calls
@@ -86,6 +90,15 @@ def init_multihost(coordinator_address: str | None = None,
     (auto-detected on TPU pods when omitted).  ``scripts/
     multihost_launch.sh`` passes them via SHERMAN_COORD / SHERMAN_NPROC /
     SHERMAN_PROC_ID, read here when the args are omitted.
+
+    ``heartbeat_timeout_s`` (env ``SHERMAN_HEARTBEAT_S``) tunes the
+    coordination service's DEATH-detection latency: when a process stops
+    heartbeating for this long, every surviving process is terminated
+    with a diagnostic instead of hanging in its next collective — the
+    crash-only "fail fast" half of the failure story (utils/failure.py;
+    the reference hangs forever, SURVEY.md §5).  Default follows jax
+    (100 s).  Stalled-but-alive peers are the other half: guarded
+    ``barrier(..., timeout_s=...)`` raises a catchable PeerFailure.
     """
     import os
 
@@ -102,13 +115,31 @@ def init_multihost(coordinator_address: str | None = None,
                 num_processes = int(nproc)
             if process_id is None and pid:
                 process_id = int(pid)
+    if heartbeat_timeout_s is None:
+        hb = os.environ.get("SHERMAN_HEARTBEAT_S")
+        if hb:
+            heartbeat_timeout_s = int(hb)
     if coordinator_address is not None:
         # Must run before ANY jax computation or backend query — even
         # jax.process_count() initializes the backends and would make
         # this raise.  Omit coordinator_address if jax.distributed was
         # already initialized out-of-band (e.g. TPU pod auto-init).
+        kw = {}
+        if heartbeat_timeout_s is not None:
+            kw["heartbeat_timeout_seconds"] = heartbeat_timeout_s
         jax.distributed.initialize(coordinator_address, num_processes,
-                                   process_id)
+                                   process_id, **kw)
+    elif heartbeat_timeout_s is not None:
+        # auto-init path (e.g. TPU pod pre-initialized out-of-band):
+        # jax.distributed is already up, the knob cannot be applied —
+        # say so instead of letting the operator believe death
+        # detection runs at the requested latency
+        import warnings
+        warnings.warn(
+            f"heartbeat_timeout_s={heartbeat_timeout_s} ignored: "
+            "jax.distributed was initialized outside init_multihost "
+            "(auto-init); death detection keeps the pre-configured "
+            "timeout", RuntimeWarning, stacklevel=2)
     return DistributedKeeper()
 
 
@@ -135,9 +166,48 @@ class DistributedKeeper(Keeper):
     def server_enter(self) -> int:
         return self._jax.process_index()
 
-    def barrier(self, name: str) -> None:
+    def barrier(self, name: str, timeout_s: float | None = None) -> None:
+        """Named cluster barrier.
+
+        Default (``timeout_s=None``): a global DEVICE sync — flushes
+        queued device work everywhere, the strongest form.  Like the
+        reference's memcached spin (``DSMKeeper.cpp:148-161``) it hangs
+        forever if a peer died.
+
+        Guarded (``timeout_s`` set): a host-level barrier with a
+        deadline through the coordination service's heartbeat tracking;
+        raises :class:`sherman_tpu.utils.failure.PeerFailure` naming the
+        missing processes instead of hanging (the failure-detection
+        surface the reference lacks — SURVEY.md §5 "failed nodes hang
+        the system").  Control-plane only: does not flush device queues.
+        """
+        if timeout_s is not None:
+            from sherman_tpu.utils import failure
+            key = "guarded_barrier:" + name
+            with self._lock:
+                attempt = self._counters[key]
+            used = attempt
+            try:
+                used = failure.barrier_guarded(name, timeout_s,
+                                               attempt=attempt)
+            except failure.PeerFailure as e:
+                used = e.attempt
+                raise
+            finally:
+                # advance past the attempt actually consumed (success OR
+                # burned-by-timeout) so a retry after PeerFailure — and
+                # the stalled peer's own late call, via the burn marker —
+                # land on a fresh, matching barrier id
+                with self._lock:
+                    self._counters[key] = max(self._counters[key], used + 1)
+            return
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
+
+    def live_processes(self) -> list[int]:
+        """Heartbeat-based liveness probe (see utils.failure)."""
+        from sherman_tpu.utils import failure
+        return failure.live_processes(self.machine_nr)
 
     def sum(self, name: str, value: int) -> int:
         import numpy as np

@@ -61,55 +61,16 @@ def checkpoint(cluster, path: str) -> None:
     if not path.endswith(".npz"):
         path += ".npz"  # np.savez appends it silently; keep restore in sync
     if cluster.keeper.is_multihost:
-        import jax
-        dsm = cluster.dsm
-        me = jax.process_index()
-        # Epoch pairing shard <-> manifest AND checkpoint <-> checkpoint:
-        # (nonce, seq, digest).  The nonce is random on process 0 and
-        # broadcast, making every checkpoint invocation globally unique —
-        # a per-process counter alone resets across restarts and the
-        # manifest digest alone is unchanged by update-in-place traffic,
-        # so (seq, dig) could collide across distinct checkpoints.
-        # int32 throughout: restore allgathers the epoch, and jax (x64
-        # disabled) canonicalizes int64 -> int32, which would wrap an
-        # unsigned crc and break the cross-host equality check.
-        from jax.experimental import multihost_utils as mhu
-        seq = cluster.keeper.mem_fetch_and_add("checkpoint_epoch")
-        man = _manifest(cluster)
-        import zlib
-        dig = zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes()
-                                  for v in man.values()))
-        nonce = np.frombuffer(os.urandom(4), np.int32).copy()
-        nonce = np.asarray(mhu.broadcast_one_to_all(nonce))
-        epoch = np.asarray([int(nonce[0]), seq,
-                            np.uint32(dig).view(np.int32)], np.int32)
-        # Save-time epoch agreement, BEFORE any file write: seq is a
-        # process-local counter and dig hashes the (supposedly mirrored)
-        # manifest — if the replicated-driver invariant was ever violated,
-        # hosts would diverge here, every os.replace would still succeed,
-        # and the previous good checkpoint would be overwritten by a set
-        # restore rejects as mixed-epoch (losing BOTH).  Abort loudly with
-        # the prior files untouched instead.
-        all_ep = np.asarray(mhu.process_allgather(epoch))
-        if not (all_ep == all_ep[0]).all():
-            raise RuntimeError(
-                "checkpoint aborted before writing: hosts disagree on the "
-                f"checkpoint epoch {all_ep.tolist()} (divergent checkpoint "
-                "counts or manifests — the replicated-driver invariant is "
-                "broken); the previous checkpoint is left intact")
-        _savez_atomic(
-            f"{path}.host{me}.npz", me,
-            pool=_local_block(dsm.pool),
-            locks=_local_block(dsm.locks),
-            counters=_local_block(dsm.counters),
-            nodes=np.asarray(list(dsm.local_nodes), np.int64),
-            epoch=epoch,
-        )
-        _savez_atomic(
-            path, me,
-            multihost=np.asarray([jax.process_count()], np.int64),
-            epoch=epoch, **man)
-        cluster.keeper.barrier("checkpoint")
+        from sherman_tpu.utils import failure
+
+        # a peer dying mid-protocol would hang every other host inside
+        # the broadcast/allgather/barrier below; the env-gated watchdog
+        # (SHERMAN_COLLECTIVE_TIMEOUT_S) turns that into a fail-fast
+        # exit so the launcher can restart from the previous checkpoint
+        with failure.Watchdog.maybe(
+                what="collective checkpoint save",
+                diagnostics=lambda: cluster.dsm.counter_snapshot()):
+            _checkpoint_multihost(cluster, path)
         return
     dsm = cluster.dsm
     _savez_atomic(
@@ -119,6 +80,58 @@ def checkpoint(cluster, path: str) -> None:
         counters=np.asarray(dsm.counters),
         **_manifest(cluster),
     )
+
+
+def _checkpoint_multihost(cluster, path: str) -> None:
+    import jax
+    dsm = cluster.dsm
+    me = jax.process_index()
+    # Epoch pairing shard <-> manifest AND checkpoint <-> checkpoint:
+    # (nonce, seq, digest).  The nonce is random on process 0 and
+    # broadcast, making every checkpoint invocation globally unique —
+    # a per-process counter alone resets across restarts and the
+    # manifest digest alone is unchanged by update-in-place traffic,
+    # so (seq, dig) could collide across distinct checkpoints.
+    # int32 throughout: restore allgathers the epoch, and jax (x64
+    # disabled) canonicalizes int64 -> int32, which would wrap an
+    # unsigned crc and break the cross-host equality check.
+    from jax.experimental import multihost_utils as mhu
+    seq = cluster.keeper.mem_fetch_and_add("checkpoint_epoch")
+    man = _manifest(cluster)
+    import zlib
+    dig = zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes()
+                              for v in man.values()))
+    nonce = np.frombuffer(os.urandom(4), np.int32).copy()
+    nonce = np.asarray(mhu.broadcast_one_to_all(nonce))
+    epoch = np.asarray([int(nonce[0]), seq,
+                        np.uint32(dig).view(np.int32)], np.int32)
+    # Save-time epoch agreement, BEFORE any file write: seq is a
+    # process-local counter and dig hashes the (supposedly mirrored)
+    # manifest — if the replicated-driver invariant was ever violated,
+    # hosts would diverge here, every os.replace would still succeed,
+    # and the previous good checkpoint would be overwritten by a set
+    # restore rejects as mixed-epoch (losing BOTH).  Abort loudly with
+    # the prior files untouched instead.
+    all_ep = np.asarray(mhu.process_allgather(epoch))
+    if not (all_ep == all_ep[0]).all():
+        raise RuntimeError(
+            "checkpoint aborted before writing: hosts disagree on the "
+            f"checkpoint epoch {all_ep.tolist()} (divergent checkpoint "
+            "counts or manifests — the replicated-driver invariant is "
+            "broken); the previous checkpoint is left intact")
+    _savez_atomic(
+        f"{path}.host{me}.npz", me,
+        pool=_local_block(dsm.pool),
+        locks=_local_block(dsm.locks),
+        counters=_local_block(dsm.counters),
+        nodes=np.asarray(list(dsm.local_nodes), np.int64),
+        epoch=epoch,
+    )
+    _savez_atomic(
+        path, me,
+        multihost=np.asarray([jax.process_count()], np.int64),
+        epoch=epoch, **man)
+    cluster.keeper.barrier("checkpoint")
 
 
 def _savez_atomic(path: str, tag: int, **arrays) -> None:
@@ -161,7 +174,9 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
     if not path.endswith(".npz") and not os.path.exists(path):
         path += ".npz"
     if keeper is not None and keeper.is_multihost:
-        return _restore_multihost(path, mesh, keeper, clear_locks)
+        from sherman_tpu.utils import failure
+        with failure.Watchdog.maybe(what="collective checkpoint restore"):
+            return _restore_multihost(path, mesh, keeper, clear_locks)
     with np.load(path) as z:
         cfg = DSMConfig(**json.loads(bytes(z["cfg"]).decode()))
         saved_mh = int(z["multihost"][0]) if "multihost" in z else 0

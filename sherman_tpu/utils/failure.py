@@ -1,0 +1,256 @@
+"""Failure detection + fail-fast guards (beyond-reference subsystem).
+
+The reference has NO failure handling (SURVEY.md §5): cluster membership
+is join-only monotonic (``Keeper.cpp:87-113``), verb errors print and
+``sleep(5)`` (``Operation.cpp:13-25``), and a dead peer leaves every
+other node spinning forever inside a memcached barrier or a CQ poll —
+"failed nodes hang the system".  This module gives the TPU build a
+crash-only failure story instead:
+
+Two distinct failure classes, two detectors:
+
+  peer DEATH   (process gone, heartbeats stop) — detected by the
+               coordination service's heartbeat tracking: every
+               survivor is TERMINATED with a diagnostic ("another task
+               died") within ``heartbeat_timeout_s`` of the death
+               (``bootstrap.init_multihost`` exposes the knob; jax
+               default 100 s) instead of hanging in its next
+               collective.  Termination, not an exception: the error
+               poller fires from a C++ thread, so death detection is
+               crash-only BY DESIGN — which is sound here, because
+               device steps are atomic (a step either completed or the
+               process died with it; there is no partial-step state).
+  peer STALL   (process alive — heartbeats fine — but stuck: deadlock,
+               livelock, wedged I/O) — heartbeats cannot see this.
+               ``DistributedKeeper.barrier(name, timeout_s=...)`` bounds
+               the wait and raises a catchable :class:`PeerFailure`
+               naming the peers that never arrived, letting the
+               survivor choose: keep serving reads, retry, or exit.
+               (If those peers were in fact dead, heartbeat detection
+               terminates this process moments later — so a PeerFailure
+               the program gets to HANDLE means the peers are alive.)
+  fail fast    :class:`Watchdog` — a host-side deadline around any
+               blocking section (device-step sync, collective
+               checkpoint).  A wedged XLA collective cannot be
+               cancelled from Python, so on expiry the watchdog dumps
+               diagnostics and exits the process rather than hanging
+               the job; the launcher restarts it.
+  recover      relaunch + ``utils.checkpoint.restore``: collective
+               checkpoints are atomic, nonce-tagged and
+               epoch-validated, so the relaunched cluster resumes from
+               the last completed checkpoint.
+
+The end-to-end drills (peer killed -> survivor terminated fast with the
+diagnostic -> fresh cluster restores the pre-crash checkpoint and
+verifies; peer stalled -> survivor catches PeerFailure within the
+deadline -> both resume) are ``tests/test_failure.py``.
+
+Scope note: detection and fail-fast are host/control-plane mechanisms.
+Data-plane steps already queued on devices either complete or die with
+the process — there is no partial-step state to repair, which is what
+makes crash-only recovery sound (step atomicity).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import threading
+import time
+
+
+class PeerFailure(RuntimeError):
+    """A guarded collective's deadline expired because peers never
+    arrived (dead OR stalled — the deadline cannot tell; if they are
+    dead, the runtime's heartbeat detection will terminate this process
+    shortly anyway, so a *caught* PeerFailure in practice means a stall).
+
+    ``missing`` holds the process indices that never arrived, parsed
+    from the coordination service's timeout report; empty when the
+    service could not attribute the failure.  ``attempt`` is the barrier
+    attempt number that timed out (see :func:`barrier_guarded`).
+    """
+
+    def __init__(self, msg: str, missing=(), attempt: int = -1):
+        super().__init__(msg)
+        self.missing = sorted(int(p) for p in missing)
+        self.attempt = attempt
+
+
+def coordination_client():
+    """The jax.distributed coordination-service client, or None when not
+    running multihost (single-process clusters have nothing to probe)."""
+    from jax._src import distributed
+    return distributed.global_state.client
+
+
+def live_processes(num_processes: int, client=None) -> list[int]:
+    """Collective liveness roll call: process indices the coordination
+    service considers live.
+
+    COLLECTIVE semantics (like the service API underneath): every live
+    process must call this together — replicated control flow's natural
+    shape, e.g. a periodic health check between engine steps.  A
+    unilateral call blocks until the absent peers either join or are
+    declared dead, so do NOT use it to diagnose a peer that may be
+    stalled; :class:`PeerFailure.missing` already names never-arrived
+    peers without any extra probe.
+
+    Returns all indices when no coordination client exists (single
+    process: trivially live).
+    """
+    if client is None:
+        client = coordination_client()
+    if client is None:
+        return list(range(num_processes))
+    alive = client.get_live_nodes(list(range(num_processes)))
+    return sorted(int(p) for p in alive)
+
+
+class Watchdog:
+    """Deadline for a blocking host section — fail fast instead of hang.
+
+    Usage::
+
+        with Watchdog(120, what="collective checkpoint",
+                      diagnostics=lambda: dsm.counter_snapshot()):
+            ck.checkpoint(cluster, path)
+
+    If the body does not finish within ``timeout_s`` the watchdog thread
+    fires: it prints ``what`` + the diagnostics callback's result to
+    stderr and invokes ``action`` — by default ``os._exit(86)``, because
+    a Python thread cannot interrupt a C-level blocking collective; the
+    only sound move is to kill the process and let the launcher restart
+    it (recovery = restore the last checkpoint).  Pass ``action`` to
+    override (tests record instead of exiting).
+
+    ``timeout_s <= 0`` disarms entirely (zero-cost no-op), which is what
+    :meth:`maybe` returns when its env knob is unset.
+    """
+
+    EXIT_CODE = 86  # distinct, grep-able "watchdog fired" status
+
+    def __init__(self, timeout_s: float, what: str = "blocking section",
+                 action=None, diagnostics=None):
+        self.timeout_s = float(timeout_s)
+        self.what = what
+        self.action = action
+        self.diagnostics = diagnostics
+        self.fired = False
+        self._timer: threading.Timer | None = None
+
+    @classmethod
+    def maybe(cls, env: str = "SHERMAN_COLLECTIVE_TIMEOUT_S",
+              what: str = "blocking section", diagnostics=None) -> "Watchdog":
+        """Env-gated watchdog: armed only when ``env`` is set to a
+        positive number of seconds (deployments opt in per-site — a
+        sound default deadline depends on pool size and interconnect).
+
+        A malformed value is a configuration error on a safety knob:
+        raise with a message naming the knob rather than silently
+        disarming the protection the operator asked for."""
+        raw = os.environ.get(env, 0) or 0
+        try:
+            timeout_s = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"{env}={raw!r} is not a number of seconds; fix the env "
+                "var (e.g. '120') or unset it to disarm the watchdog"
+            ) from None
+        return cls(timeout_s, what=what, diagnostics=diagnostics)
+
+    def _fire(self):
+        self.fired = True
+        msg = (f"[sherman watchdog] '{self.what}' exceeded "
+               f"{self.timeout_s:g}s deadline")
+        try:
+            if self.diagnostics is not None:
+                msg += f"\n[sherman watchdog] diagnostics: {self.diagnostics()}"
+        except Exception as e:  # diagnostics must never mask the timeout
+            msg += f"\n[sherman watchdog] diagnostics failed: {e!r}"
+        print(msg, file=sys.stderr, flush=True)
+        if self.action is not None:
+            self.action()
+        else:
+            os._exit(self.EXIT_CODE)
+
+    def __enter__(self) -> "Watchdog":
+        if self.timeout_s > 0:
+            self._timer = threading.Timer(self.timeout_s, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+def barrier_guarded(name: str, timeout_s: float, *,
+                    attempt: int, client=None) -> int:
+    """Host-level named barrier with a deadline (the memcached
+    fetch-add-and-spin barrier of ``DSMKeeper.cpp:148-161``, with the
+    spin bounded).  Returns the attempt number actually used.
+
+    A barrier instance (id) is burned once its deadline fires, so each
+    use needs a fresh id.  ``attempt`` is the caller's local use count
+    for this name; under replicated control flow every process passes
+    the same count and the ids line up.  After a timeout they would NOT
+    line up anymore (the survivor advanced, the stalled peer did not),
+    so the timeout path publishes the burned attempt in the
+    coordination KV and every caller fast-forwards past it on entry —
+    a survivor's RETRY and a recovered peer's late first call land on
+    the same fresh id.  Raises :class:`PeerFailure` (carrying the
+    attempt and the never-arrived peers parsed from the service's
+    report) on deadline expiry; any non-deadline coordination error
+    (invalid id, lost connection, ...) propagates untouched — those are
+    not peer failures and retrying them as stalls would mask real bugs.
+
+    Control-plane only: unlike the default ``DistributedKeeper.barrier``
+    (a global DEVICE sync), this does not flush queued device work —
+    callers guarding a device-step boundary want a :class:`Watchdog`
+    around the blocking sync instead.
+    """
+    if client is None:
+        client = coordination_client()
+    if client is None:
+        return attempt  # single process: arrival == completion
+    burn_key = f"sherman:barrier-burned:{name}"
+    burned = -1
+    try:
+        burned = int(client.key_value_try_get(burn_key))
+    except Exception:
+        pass  # no burn marker yet (NOT_FOUND): first-ever failure-free use
+    eff = max(attempt, burned + 1)
+    bid = f"sherman:barrier:{name}:{eff}"
+    t0 = time.monotonic()
+    try:
+        client.wait_at_barrier(bid, int(timeout_s * 1000))
+        return eff
+    except Exception as e:
+        msg = str(e)
+        if "DEADLINE_EXCEEDED" not in msg and "timed out" not in msg:
+            raise  # not a peer failure: configuration/connection error
+        waited = time.monotonic() - t0
+        # burn this attempt so every side's next use aligns at eff+1
+        try:
+            client.key_value_set(burn_key, str(eff), allow_overwrite=True)
+        except Exception:
+            pass  # marker is best-effort; worst case one extra timeout
+        # The service's timeout report names the tasks that never
+        # arrived ("Some timed out task names: .../task:N").  Parse it
+        # rather than probing live_processes(), which is itself a
+        # collective and must not be entered unilaterally from an
+        # error path.
+        missing: list[int] = []
+        m = re.search(r"timed out task names:(.*)", msg, re.S)
+        if m:
+            missing = sorted(
+                {int(t) for t in re.findall(r"task:(\d+)", m.group(1))})
+        raise PeerFailure(
+            f"barrier '{name}' timed out after {waited:.1f}s "
+            f"(deadline {timeout_s:g}s, attempt {eff}); never arrived: "
+            f"{missing or 'unknown'}: {msg}",
+            missing=missing, attempt=eff) from e
