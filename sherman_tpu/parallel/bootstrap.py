@@ -118,7 +118,13 @@ def init_multihost(coordinator_address: str | None = None,
     if heartbeat_timeout_s is None:
         hb = os.environ.get("SHERMAN_HEARTBEAT_S")
         if hb:
-            heartbeat_timeout_s = int(hb)
+            try:
+                heartbeat_timeout_s = int(hb)
+            except ValueError:
+                raise ValueError(
+                    f"SHERMAN_HEARTBEAT_S={hb!r} is not a whole number of "
+                    "seconds; fix the env var (e.g. '10') or unset it to "
+                    "keep jax's default") from None
     if coordinator_address is not None:
         # Must run before ANY jax computation or backend query — even
         # jax.process_count() initializes the backends and would make

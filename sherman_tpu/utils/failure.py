@@ -35,6 +35,12 @@ Two distinct failure classes, two detectors:
                cancelled from Python, so on expiry the watchdog dumps
                diagnostics and exits the process rather than hanging
                the job; the launcher restarts it.
+  PREEMPTION   (eviction SIGTERM with notice — the one failure you see
+               coming) — :class:`PreemptionGuard`: the notice on any
+               one host flips ``should_act(step)`` on EVERY host at
+               the same step, so the cluster checkpoints collectively
+               at a clean boundary and exits instead of becoming a
+               peer-death event seconds later.
   recover      relaunch + ``utils.checkpoint.restore``: collective
                checkpoints are atomic, nonce-tagged and
                epoch-validated, so the relaunched cluster resumes from
@@ -55,6 +61,7 @@ from __future__ import annotations
 
 import os
 import re
+import signal
 import sys
 import threading
 import time
@@ -160,15 +167,34 @@ class Watchdog:
             ) from None
         return cls(timeout_s, what=what, diagnostics=diagnostics)
 
+    DIAG_DEADLINE_S = 5.0
+
     def _fire(self):
         self.fired = True
         msg = (f"[sherman watchdog] '{self.what}' exceeded "
                f"{self.timeout_s:g}s deadline")
-        try:
-            if self.diagnostics is not None:
-                msg += f"\n[sherman watchdog] diagnostics: {self.diagnostics()}"
-        except Exception as e:  # diagnostics must never mask the timeout
-            msg += f"\n[sherman watchdog] diagnostics failed: {e!r}"
+        if self.diagnostics is not None:
+            # The diagnostics callback may itself touch the wedged
+            # runtime (e.g. a device-to-host counter transfer queued
+            # behind the stuck collective) and block forever — which
+            # would defeat the fail-fast exit.  Run it on its own
+            # daemon thread with a short deadline and abandon it if it
+            # doesn't come back.
+            box: list = []
+
+            def run():
+                try:
+                    box.append(f"diagnostics: {self.diagnostics()}")
+                except Exception as e:
+                    box.append(f"diagnostics failed: {e!r}")
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            th.join(self.DIAG_DEADLINE_S)
+            msg += "\n[sherman watchdog] " + (
+                box[0] if box else
+                f"diagnostics hung > {self.DIAG_DEADLINE_S:g}s (wedged "
+                "runtime?); abandoned")
         print(msg, file=sys.stderr, flush=True)
         if self.action is not None:
             self.action()
@@ -186,6 +212,72 @@ class Watchdog:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+
+
+class PreemptionGuard:
+    """Checkpoint-on-preemption: turn an eviction SIGTERM into a clean
+    collective checkpoint + exit instead of a dead cluster.
+
+    Cloud TPU VMs receive SIGTERM shortly before preemption or
+    maintenance.  The reference has no story — a preempted node is a
+    dead node and the cluster hangs (SURVEY.md §5).  Here:
+
+    - single-process: a Python signal handler latches a flag the driver
+      polls between steps (:meth:`should_act`).
+    - multi-host: jax's preemption sync manager (coordination service).
+      The preempted host's notice propagates to every host, and
+      ``reached_sync_point(step)`` turns True on ALL hosts at the SAME
+      step — so the collective checkpoint that follows is entered in
+      lock-step, preserving the replicated-driver invariant.  The
+      manager's own SIGTERM notifier does the catching; no Python
+      handler is installed.
+
+    Driver shape (see ``tools/benchmark.py --preempt-ckpt``)::
+
+        guard = PreemptionGuard(keeper)
+        for step in ...:
+            run_step()
+            if guard.should_act(step):
+                checkpoint(cluster, path)
+                break   # exit cleanly; relaunch restores
+
+    ``should_act`` must be called with a monotonically increasing step
+    on every host each iteration (replicated control flow — the same
+    contract every other collective here relies on).
+    """
+
+    def __init__(self, keeper=None, signals=(signal.SIGTERM,)):
+        self._flag = False
+        self._prev: dict[int, object] = {}
+        self._multihost = keeper is not None and keeper.is_multihost
+        if self._multihost:
+            from jax._src import distributed
+            if distributed.global_state.preemption_sync_manager is None:
+                distributed.global_state.initialize_preemption_sync_manager()
+            self._psm = distributed.global_state.preemption_sync_manager
+            if self._psm is None:
+                raise RuntimeError(
+                    "preemption sync manager unavailable (jax config "
+                    "jax_enable_preemption_service is off)")
+        else:
+            for s in signals:
+                self._prev[s] = signal.signal(s, self._latch)
+
+    def _latch(self, signum, frame):
+        self._flag = True
+
+    def should_act(self, step: int) -> bool:
+        """True when this (and, multihost, EVERY) process should stop
+        after the current step and checkpoint."""
+        if self._multihost:
+            return bool(self._psm.reached_sync_point(int(step)))
+        return self._flag
+
+    def close(self) -> None:
+        """Restore the signal handlers this guard installed."""
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
 
 
 def barrier_guarded(name: str, timeout_s: float, *,

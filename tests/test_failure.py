@@ -14,6 +14,7 @@ tests prove the TPU build's beyond-reference story end to end:
 """
 
 import os
+import re
 import subprocess
 import sys
 import time
@@ -72,6 +73,24 @@ def test_watchdog_maybe_env_gating(monkeypatch):
     monkeypatch.setenv("SHERMAN_COLLECTIVE_TIMEOUT_S", "2m")
     with pytest.raises(ValueError, match="SHERMAN_COLLECTIVE_TIMEOUT_S"):
         failure.Watchdog.maybe()
+
+
+def test_preemption_guard_single_process_latch():
+    """SIGTERM latches the guard; the driver drains the current step and
+    checkpoints instead of dying mid-protocol.  close() restores the
+    previous handler."""
+    import signal as sg
+
+    prev = sg.getsignal(sg.SIGTERM)
+    guard = failure.PreemptionGuard()
+    try:
+        assert not guard.should_act(0)
+        sg.raise_signal(sg.SIGTERM)  # delivered to our latch, not default
+        assert guard.should_act(1)
+        assert guard.should_act(2), "latch must stay set"
+    finally:
+        guard.close()
+    assert sg.getsignal(sg.SIGTERM) is prev
 
 
 def test_peer_failure_surface():
@@ -184,6 +203,44 @@ elif phase == "stall":
     keeper.barrier("stalled-peer", timeout_s=60)
     print(f"[{pid}] RESUME-PASS", flush=True)
     os._exit(0)
+elif phase == "preempt":
+    # PREEMPTION drill: SIGTERM lands on ONE host mid-run; the sync
+    # manager propagates the notice and flips should_act on EVERY host
+    # at the SAME step, so the collective checkpoint that follows keeps
+    # the replicated-driver invariant.  Both processes stay alive.
+    keeper = bootstrap.init_multihost()
+    cfg = DSMConfig(machine_nr=4, pages_per_node=128, locks_per_node=64,
+                    step_capacity=32, host_step_capacity=16, chunk_pages=8)
+    cluster = Cluster(cfg, keeper=keeper)
+    tree = Tree(cluster)
+    batched.bulk_load(tree, keys, keys * np.uint64(3))
+    eng = batched.BatchedEngine(tree, batch_per_node=16)
+    guard = failure.PreemptionGuard(keeper)
+    keeper.barrier("loop-start")
+    open(os.path.join(tmp, f"loop{pid}"), "w").close()  # runner's cue
+    sync_at = -1
+    for step in range(600):
+        got, found = eng.search(keys[:32])
+        assert found.all()
+        if guard.should_act(step):
+            sync_at = step
+            break
+        time.sleep(0.05)
+    assert sync_at >= 0, "preemption notice never propagated"
+    pck = ck + ".preempt.npz"
+    CK.checkpoint(cluster, pck)
+    # prove every host stopped at the SAME step (sum == nproc * local)
+    total = keeper.sum("sync_at", sync_at)
+    assert total == nproc * sync_at, f"split boundary: {total} vs {sync_at}"
+    # same-incarnation restore + verify (all processes still alive)
+    c2 = CK.restore(pck, keeper=keeper)
+    eng2 = batched.BatchedEngine(Tree(c2), batch_per_node=16)
+    got, found = eng2.search(keys)
+    assert found.all(), "checkpointed state lost keys"
+    np.testing.assert_array_equal(got, keys * np.uint64(3))
+    keeper.barrier("preempt-done")
+    print(f"[{pid}] PREEMPT-PASS step={sync_at}", flush=True)
+    os._exit(0)
 else:  # phase == "recover": fresh incarnation restores the checkpoint
     keeper = bootstrap.init_multihost()
     cluster = CK.restore(ck, keeper=keeper)
@@ -260,6 +317,42 @@ def test_death_detect_then_recover(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"recover worker {pid}:\n{out[-4000:]}"
         assert f"[{pid}] RECOVER-PASS" in out
+
+
+@pytest.mark.slow
+def test_preemption_checkpoint_sync(tmp_path):
+    """SIGTERM on ONE host: the preemption sync manager must flip
+    should_act on BOTH hosts at the same step; they checkpoint
+    collectively, restore in-place, and exit cleanly."""
+    import signal as sg
+
+    procs = _spawn(tmp_path, "preempt", _free_port())
+    # wait for both workers to reach their step loop (sentinel files),
+    # then deliver the preemption signal to the NON-coordinator host
+    deadline = time.monotonic() + 240
+    cues = [tmp_path / "loop0", tmp_path / "loop1"]
+    while not all(c.exists() for c in cues):
+        assert time.monotonic() < deadline, "workers never reached the loop"
+        assert all(p.poll() is None for p in procs), "a worker died early"
+        time.sleep(0.5)
+    time.sleep(1)  # a few steps into the loop
+    os.kill(procs[1].pid, sg.SIGTERM)
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    steps = []
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"preempt worker {pid}:\n{out[-4000:]}"
+        m = re.search(rf"\[{pid}\] PREEMPT-PASS step=(\d+)", out)
+        assert m, out[-4000:]
+        steps.append(int(m.group(1)))
+    assert steps[0] == steps[1], f"hosts stopped at different steps: {steps}"
 
 
 @pytest.mark.slow

@@ -62,6 +62,12 @@ def parse_args(argv=None):
                         "traversal, Tree.cpp:461-522)")
     p.add_argument("--scan-span", type=int, default=1000,
                    help="target entries per range scan")
+    p.add_argument("--preempt-ckpt", default=None, metavar="PATH",
+                   help="graceful preemption: on SIGTERM (single process) "
+                        "or a cluster preemption notice (multihost sync "
+                        "manager), checkpoint the cluster to PATH at the "
+                        "next block boundary and stop "
+                        "(utils.failure.PreemptionGuard)")
     return p.parse_args(argv)
 
 
@@ -332,6 +338,11 @@ def main(argv=None) -> dict:
     windows = max(1, int(a.secs / a.window))
     notify_info("[bench] est step %.1f ms -> %d steps/block",
                 est * 1e3, steps_per_block)
+    guard = None
+    if a.preempt_ckpt:
+        from sherman_tpu.utils import failure
+        guard = failure.PreemptionGuard(cluster.keeper)
+    preempted = False
     results = []
     step_i = 0
     c_prev = dsm.counter_snapshot()
@@ -351,6 +362,22 @@ def main(argv=None) -> dict:
             if hist is not None:
                 hist.record_batch(int(span / steps_per_block * 1e9),
                                   total_batch * steps_per_block)
+            # block boundary = the agreed stopping granularity: in
+            # multihost every process polls with the same step_i
+            # (replicated control flow) and the sync manager flips them
+            # all at the SAME boundary
+            if guard is not None and guard.should_act(step_i):
+                preempted = True
+                break
+        if preempted:
+            # the eviction clock is ticking (SIGTERM-to-SIGKILL notice is
+            # ~seconds): checkpoint FIRST, skip scans and reporting
+            from sherman_tpu.utils import checkpoint as CK
+            CK.checkpoint(cluster, a.preempt_ckpt)
+            print(f"[bench] preemption notice: checkpointed to "
+                  f"{a.preempt_ckpt} at step {step_i}; stopping",
+                  flush=True)
+            break
         elapsed = time.time() - w0
         # range scans (config 5: mixed + range-scan — sibling-link
         # traversal over the cache-seeded prefetch, Tree.cpp:461-522).
@@ -419,10 +446,10 @@ def main(argv=None) -> dict:
         found = np.asarray(out)[np.asarray(last_b["act_r"])]
         assert bool(found.all()), "searches missed warm keys"
 
-    best = max(results)
+    best = max(results, default=0)  # empty when preempted in window 0
     print(f"[bench] peak cluster throughput {best / 1e6:.2f} Mops/s "
           f"({a.kReadRatio}% read, theta={a.theta})")
-    return {"peak_ops": best, "windows": results}
+    return {"peak_ops": best, "windows": results, "preempted": preempted}
 
 
 if __name__ == "__main__":
