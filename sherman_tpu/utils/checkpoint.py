@@ -98,13 +98,9 @@ def _checkpoint_multihost(cluster, path: str) -> None:
     from jax.experimental import multihost_utils as mhu
     seq = cluster.keeper.mem_fetch_and_add("checkpoint_epoch")
     man = _manifest(cluster)
-    import zlib
-    dig = zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes()
-                              for v in man.values()))
     nonce = np.frombuffer(os.urandom(4), np.int32).copy()
     nonce = np.asarray(mhu.broadcast_one_to_all(nonce))
-    epoch = np.asarray([int(nonce[0]), seq,
-                        np.uint32(dig).view(np.int32)], np.int32)
+    epoch = make_epoch(man, seq, nonce=int(nonce[0]))
     # Save-time epoch agreement, BEFORE any file write: seq is a
     # process-local counter and dig hashes the (supposedly mirrored)
     # manifest — if the replicated-driver invariant was ever violated,
@@ -132,6 +128,21 @@ def _checkpoint_multihost(cluster, path: str) -> None:
         multihost=np.asarray([jax.process_count()], np.int64),
         epoch=epoch, **man)
     cluster.keeper.barrier("checkpoint")
+
+
+def make_epoch(man: dict, seq: int, nonce: int | None = None) -> np.ndarray:
+    """The (nonce, seq, manifest-crc) epoch triple pairing shard files
+    with their manifest — ONE construction shared by the collective
+    checkpoint save and the offline resharder (utils/reshard.py), so
+    emitted checkpoints always satisfy restore's pairing rules.  int32
+    throughout: restore allgathers the epoch under jax's x64-disabled
+    canonicalization (see the save path's comment)."""
+    import zlib
+    dig = zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes()
+                              for v in man.values()))
+    if nonce is None:
+        nonce = int(np.frombuffer(os.urandom(4), np.int32)[0])
+    return np.asarray([nonce, seq, np.uint32(dig).view(np.int32)], np.int32)
 
 
 def _savez_atomic(path: str, tag: int, **arrays) -> None:

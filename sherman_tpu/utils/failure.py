@@ -274,9 +274,12 @@ class PreemptionGuard:
         return self._flag
 
     def close(self) -> None:
-        """Restore the signal handlers this guard installed."""
+        """Restore the signal handlers this guard installed.  A handler
+        installed from C (signal.signal returned None) cannot be
+        re-installed from Python; leave it to the latch in that case."""
         for s, prev in self._prev.items():
-            signal.signal(s, prev)
+            if prev is not None:
+                signal.signal(s, prev)
         self._prev.clear()
 
 

@@ -1,0 +1,252 @@
+"""Elastic cluster resize: transform a checkpoint onto a different node count.
+
+Beyond-reference capability (SURVEY.md §5 lists "elastic recovery: none" —
+the reference's membership is join-only and its address space is fixed at
+cluster birth).  A Sherman-style tree bakes packed ``{node, page}``
+addresses into every internal entry, sibling link and the root meta word,
+so scaling a live dataset from N to M nodes is not a data copy — it is an
+address-space rewrite.  This module does that rewrite OFFLINE on a
+checkpoint, vectorized in numpy:
+
+1. identify the live page rows of every old node (the bump allocators'
+   ``dir_next`` high-water marks from the manifest; page 0 per node is
+   reserved),
+2. repack them contiguously onto the new node partition (block
+   assignment, page 1 upward per new node),
+3. rewrite every pointer word through the old->new address map — header
+   ``leftmost``/``sibling`` of every page, the valid ``InternalEntry``
+   ptr slots (slots >= nkeys are dead and never dereferenced), and the
+   root meta word — leaving leaf key/value words untouched (they are
+   user data, not addresses),
+4. emit a fresh checkpoint (single-process format, or multi-host format
+   with per-host shard files when ``hosts > 1``) whose manifest carries
+   the new DSMConfig and per-node allocator high-water marks, ready for
+   ``utils.checkpoint.restore`` on the new mesh.
+
+The workflow is crash-only elastic scaling: checkpoint -> reshard ->
+relaunch at the new size -> restore.  Locks are emitted cleared (restore
+clears them anyway: no client of the old incarnation survives) and op
+counters keep their cluster totals on node 0.
+
+CLI: ``python tools/reshard.py <src> <dst> --nodes M [--hosts H]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.parallel.dsm import N_COUNTERS
+from sherman_tpu.utils.checkpoint import (_MANIFEST_FIELDS, _savez_atomic,
+                                          make_epoch)
+
+_PTR_HEADER_WORDS = (C.W_LEFTMOST, C.W_SIBLING)
+
+
+def _load_checkpoint(path: str):
+    """-> (manifest dict, pool [N*P, PW], locks, counters) with multihost
+    shard files reassembled in node order when the source is one."""
+    if not path.endswith(".npz") and not os.path.exists(path):
+        path += ".npz"
+    with np.load(path) as z:
+        man = {k: np.asarray(z[k]) for k in z.files}
+    saved_mh = int(man["multihost"][0]) if "multihost" in man else 0
+    if saved_mh == 0:
+        pool = man.pop("pool")
+        locks = man.pop("locks")
+        counters = man.pop("counters")
+        return man, pool, locks, counters
+    blocks = []
+    for h in range(saved_mh):
+        with np.load(f"{path}.host{h}.npz") as z:
+            blk = {k: np.asarray(z[k]) for k in z.files}
+        # same torn-pair rule as checkpoint._restore_multihost: a
+        # mixed legacy/tagged pair IS torn — skipping the comparison
+        # would launder state from two different checkpoints into a
+        # consistently-tagged output that restore then accepts
+        if ("epoch" in man) != ("epoch" in blk):
+            raise RuntimeError(
+                f"host {h} shard and the manifest disagree on epoch "
+                "tagging (mixed legacy/tagged files = torn checkpoint)")
+        if "epoch" in blk and not np.array_equal(
+                blk["epoch"].ravel(), man["epoch"].ravel()):
+            raise RuntimeError(
+                f"host {h} shard is from a different checkpoint epoch "
+                "than the manifest (torn checkpoint)")
+        blocks.append(blk)
+    blocks.sort(key=lambda b: int(b["nodes"][0]))
+    nodes = np.concatenate([b["nodes"] for b in blocks])
+    if not np.array_equal(nodes, np.arange(nodes.size)):
+        raise RuntimeError(f"host shards do not cover nodes 0..N-1: {nodes}")
+    return (man,
+            np.concatenate([b["pool"] for b in blocks]),
+            np.concatenate([b["locks"] for b in blocks]),
+            np.concatenate([b["counters"] for b in blocks]))
+
+
+def _map_ptrs(ptrs: np.ndarray, amap: np.ndarray, P_old: int,
+              what: str) -> np.ndarray:
+    """Rewrite packed addresses through the old->new map; NULL stays NULL.
+    Raises if a nonzero pointer targets a page outside the live set
+    (dangling address = corrupted source checkpoint)."""
+    u = ptrs.view(np.uint32) if ptrs.dtype == np.int32 else \
+        ptrs.astype(np.uint32)
+    node = (u >> np.uint32(C.ADDR_PAGE_BITS)).astype(np.int64)
+    page = (u & np.uint32(C.ADDR_PAGE_MASK)).astype(np.int64)
+    live = ptrs != 0
+    # validate BOTH address fields: a page >= P_old would alias into the
+    # next node's map region and rewrite to an unrelated live page
+    N_old = amap.size // P_old
+    oob = live & ((node >= N_old) | (page >= P_old))
+    if oob.any():
+        raise RuntimeError(
+            f"{what}: {int(oob.sum())} pointer(s) outside the source "
+            f"address space (e.g. {ptrs[oob][:4].tolist()})")
+    mapped = amap[np.clip(node * P_old + page, 0, amap.size - 1)]
+    if (live & (mapped == 0)).any():
+        bad = ptrs[live & (mapped == 0)][:4]
+        raise RuntimeError(
+            f"{what}: {int((live & (mapped == 0)).sum())} pointer(s) target "
+            f"pages outside the live set (e.g. {bad.tolist()}) — source "
+            "checkpoint is corrupt or allocator marks are wrong")
+    return np.where(live, mapped, 0).astype(np.int32)
+
+
+def reshard(src: str, dst: str, machine_nr: int, *,
+            pages_per_node: int | None = None,
+            locks_per_node: int | None = None,
+            hosts: int = 1) -> dict:
+    """Rewrite checkpoint ``src`` for a ``machine_nr``-node cluster into
+    ``dst``.  -> summary dict (live_pages, per-node occupancy, geometry).
+
+    ``pages_per_node`` defaults to preserving the total pool size
+    (``old_total // machine_nr``).  ``hosts > 1`` emits the multi-host
+    checkpoint format (``machine_nr`` must divide evenly; restore with
+    one process per host).  The source may be either format.
+    """
+    man, pool, locks, counters = _load_checkpoint(src)
+    cfg_dict = json.loads(bytes(man["cfg"]).decode())
+    old_cfg = DSMConfig(**cfg_dict)
+    N_old, P_old = old_cfg.machine_nr, old_cfg.pages_per_node
+    if pool.shape != (N_old * P_old, C.PAGE_WORDS):
+        raise RuntimeError(f"pool shape {pool.shape} does not match the "
+                           f"manifest config ({N_old}x{P_old} pages)")
+
+    # 1. live rows per old node: [1, dir_next) — the bump allocators never
+    # reuse, so the high-water mark bounds every allocated page (leased-
+    # but-unused chunk tails ride along as zero pages, same bounded waste
+    # as the reference's no-op free)
+    next_by_node = np.ones(N_old, np.int64)
+    for nid, nxt in zip(man["dir_nodes"], man["dir_next"]):
+        next_by_node[int(nid)] = int(nxt)
+    rows = np.concatenate([
+        n * P_old + np.arange(1, next_by_node[n], dtype=np.int64)
+        for n in range(N_old)]) if N_old else np.zeros(0, np.int64)
+    L = rows.size
+
+    # 2. new geometry + block assignment (page 0 per new node reserved)
+    per_new = -(-L // machine_nr) if L else 0
+    if pages_per_node is None:
+        pages_per_node = max((N_old * P_old) // machine_nr, per_new + 1)
+    new_cfg = DSMConfig(**{**cfg_dict,
+                           "machine_nr": machine_nr,
+                           "pages_per_node": pages_per_node,
+                           **({"locks_per_node": locks_per_node}
+                              if locks_per_node else {})})
+    if per_new + 1 > pages_per_node:
+        raise ValueError(
+            f"{L} live pages need {per_new} pages/node on {machine_nr} "
+            f"nodes; pages_per_node={pages_per_node} is too small")
+    idx = np.arange(L, dtype=np.int64)
+    new_node = idx // max(per_new, 1)
+    new_page = idx - new_node * per_new + 1
+    amap = np.zeros(N_old * P_old, np.int32)
+    amap[rows] = ((new_node << C.ADDR_PAGE_BITS) | new_page).astype(np.int32)
+
+    # 3. repack + rewrite every address word through the map
+    new_pool = np.zeros((machine_nr * pages_per_node, C.PAGE_WORDS), np.int32)
+    dst_rows = new_node * pages_per_node + new_page
+    sub = pool[rows].copy()
+    for w in _PTR_HEADER_WORDS:
+        sub[:, w] = _map_ptrs(sub[:, w], amap, P_old, f"header word {w}")
+    internal = sub[:, C.W_LEVEL] > 0
+    ptrs = sub[:, C.I_PTR_W:C.I_PTR_W + C.INTERNAL_CAP]
+    valid = (internal[:, None]
+             & (np.arange(C.INTERNAL_CAP)[None, :] < sub[:, C.W_NKEYS][:, None]))
+    # dead slots (>= nkeys) may hold stale addresses; they are never
+    # dereferenced (internal_pick_child masks by nkeys) — leave them.
+    # ptrs is a VIEW of sub: the fancy assignment writes through
+    ptrs[valid] = _map_ptrs(ptrs[valid], amap, P_old, "internal entry")
+    new_pool[dst_rows] = sub
+
+    # root meta word (reserved page 0 of node 0 in both address spaces)
+    old_root = int(pool[0, C.META_ROOT_ADDR_W])
+    new_root = 0
+    root_level = -1
+    if old_root:
+        new_root = int(_map_ptrs(np.asarray([old_root], np.int32), amap,
+                                 P_old, "root meta")[0])
+        u = np.uint32(np.int64(old_root) & 0xFFFFFFFF)
+        root_level = int(pool[int(u >> C.ADDR_PAGE_BITS) * P_old
+                              + int(u & C.ADDR_PAGE_MASK), C.W_LEVEL])
+    new_pool[0, C.META_ROOT_ADDR_W] = new_root
+
+    # 4. fresh locks (cleared — no client of the old incarnation survives),
+    # counters keep their cluster totals on node 0
+    new_locks = np.zeros(machine_nr * new_cfg.locks_per_node, np.int32)
+    new_counters = np.zeros(machine_nr * N_COUNTERS, np.uint32)
+    new_counters[:N_COUNTERS] = (
+        counters.reshape(-1, N_COUNTERS).astype(np.uint64).sum(0)
+        & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+    counts = np.bincount(new_node, minlength=machine_nr) if L else \
+        np.zeros(machine_nr, np.int64)
+    cfg_json = {f: getattr(new_cfg, f) for f in (
+        "machine_nr", "pages_per_node", "locks_per_node", "step_capacity",
+        "host_step_capacity", "chunk_pages", "exchange_impl")}
+    new_man = dict(
+        cfg=np.frombuffer(json.dumps(cfg_json).encode(), np.uint8),
+        dir_nodes=np.arange(machine_nr, dtype=np.int64),
+        dir_next=(counts + 1).astype(np.int64),
+        dir_root=np.asarray([[new_root, root_level]] * machine_nr, np.int64),
+    )
+    assert set(new_man) == set(_MANIFEST_FIELDS)
+
+    if not dst.endswith(".npz"):
+        dst += ".npz"
+    if hosts == 1:
+        _savez_atomic(dst, 0, pool=new_pool, locks=new_locks,
+                      counters=new_counters, **new_man)
+    else:
+        if machine_nr % hosts:
+            raise ValueError(f"hosts={hosts} must divide machine_nr="
+                             f"{machine_nr} (contiguous node blocks)")
+        nph = machine_nr // hosts
+        epoch = make_epoch(new_man, 0)
+        for h in range(hosts):
+            nodes = np.arange(h * nph, (h + 1) * nph, dtype=np.int64)
+            sl = slice(h * nph * pages_per_node, (h + 1) * nph * pages_per_node)
+            _savez_atomic(
+                f"{dst}.host{h}.npz", h,
+                pool=new_pool[sl],
+                locks=new_locks[h * nph * new_cfg.locks_per_node:
+                                (h + 1) * nph * new_cfg.locks_per_node],
+                counters=new_counters[h * nph * N_COUNTERS:
+                                      (h + 1) * nph * N_COUNTERS],
+                nodes=nodes, epoch=epoch)
+        _savez_atomic(dst, 0, multihost=np.asarray([hosts], np.int64),
+                      epoch=epoch, **new_man)
+
+    return {
+        "live_pages": int(L),
+        "old": {"machine_nr": N_old, "pages_per_node": P_old},
+        "new": {"machine_nr": machine_nr, "pages_per_node": pages_per_node,
+                "hosts": hosts},
+        "pages_per_new_node": counts.tolist(),
+        "root": new_root,
+        "root_level": root_level,
+    }

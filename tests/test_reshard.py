@@ -1,0 +1,200 @@
+"""Elastic resize (utils/reshard.py): checkpoint -> N-node rewrite -> restore.
+
+The reference's address space is fixed at cluster birth (join-only
+membership); these tests prove the beyond-reference elastic workflow:
+build a tree on N nodes (with device splits, deletes, root growth),
+checkpoint, reshard the checkpoint to M nodes (up AND down), restore on
+an M-node mesh, and verify every key, the structure walk, and that the
+restored cluster keeps WORKING (fresh inserts lease chunks from the
+rewritten allocator marks, splits included).
+"""
+
+import numpy as np
+import pytest
+
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.utils import checkpoint as CK
+from sherman_tpu.utils.reshard import reshard
+
+
+def _build_source(tmp_path, machine_nr=4):
+    """A 4-node cluster with splits, root growth and deletes, checkpointed."""
+    from sherman_tpu.cluster import Cluster
+
+    cfg = DSMConfig(machine_nr=machine_nr, pages_per_node=256,
+                    locks_per_node=128, step_capacity=128, chunk_pages=16)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=64)
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 48, 3000, dtype=np.uint64))[:2500]
+    vals = keys * np.uint64(5)
+    batched.bulk_load(tree, keys[:1500], vals[:1500])
+    eng.attach_router()
+    stats = eng.insert(keys[1500:], vals[1500:])
+    assert stats.get("device_splits", 0) > 0, stats
+    dropped = keys[::7]
+    eng.delete(dropped)
+    kept = np.setdiff1d(keys, dropped)
+    src = str(tmp_path / "src.npz")
+    CK.checkpoint(cluster, src)
+    return src, kept, dict(zip(keys.tolist(), vals.tolist()))
+
+
+def _verify_restored(dst, n_nodes, kept, val_of):
+    cluster = CK.restore(dst)
+    assert cluster.cfg.machine_nr == n_nodes
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=64)
+    eng.attach_router()
+    got, found = eng.search(kept)
+    assert found.all(), f"lost {int((~found).sum())} keys in reshard"
+    np.testing.assert_array_equal(
+        got, np.asarray([val_of[int(k)] for k in kept], np.uint64))
+    info = tree.check_structure()
+    assert info["keys"] == kept.size
+    # scans traverse the rewritten sibling chain end to end
+    lo, hi = int(kept[10]), int(kept[200])
+    ks, vs = eng.range_query(lo, hi + 1)
+    exp = kept[(kept >= lo) & (kept <= hi)]
+    np.testing.assert_array_equal(np.sort(ks), exp)
+    # the restored cluster must keep WORKING: fresh inserts lease chunks
+    # from the rewritten allocator marks and split into fresh pages
+    rng = np.random.default_rng(9)
+    fresh = np.unique(rng.integers(1 << 50, 1 << 51, 450,
+                                   dtype=np.uint64))[:400]
+    stats = eng.insert(fresh, fresh ^ np.uint64(0xAB))
+    got2, found2 = eng.search(fresh)
+    assert found2.all()
+    np.testing.assert_array_equal(got2, fresh ^ np.uint64(0xAB))
+    tree.check_structure()
+    return stats
+
+
+@pytest.fixture(scope="module")
+def source(tmp_path_factory):
+    return _build_source(tmp_path_factory.mktemp("reshard"))
+
+
+def test_reshard_up(source, tmp_path):
+    """4 nodes -> 8 nodes: live pages spread over twice the partitions."""
+    src, kept, val_of = source
+    dst = str(tmp_path / "up.npz")
+    # explicit pages_per_node: the default preserves TOTAL pool size
+    # (128/node here), which leaves little headroom for the post-restore
+    # insert phase below
+    out = reshard(src, dst, 8, pages_per_node=256)
+    assert out["new"]["machine_nr"] == 8
+    assert sum(out["pages_per_new_node"]) == out["live_pages"]
+    _verify_restored(dst, 8, kept, val_of)
+
+
+def test_reshard_down(source, tmp_path):
+    """4 nodes -> 2 nodes: repacking must fit (default preserves the
+    total pool size)."""
+    src, kept, val_of = source
+    dst = str(tmp_path / "down.npz")
+    out = reshard(src, dst, 2)
+    _verify_restored(dst, 2, kept, val_of)
+
+
+def test_reshard_identity_roundtrip(source, tmp_path):
+    """N -> N is a pure repack (defragmentation): everything survives."""
+    src, kept, val_of = source
+    dst = str(tmp_path / "same.npz")
+    reshard(src, dst, 4)
+    _verify_restored(dst, 4, kept, val_of)
+
+
+def test_reshard_too_small_rejected(source, tmp_path):
+    src, _, _ = source
+    with pytest.raises(ValueError, match="too small"):
+        reshard(src, str(tmp_path / "x.npz"), 2, pages_per_node=64)
+
+
+_MH_WORKER = r'''
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; tmp = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["SHERMAN_COORD"] = f"localhost:{port}"
+os.environ["SHERMAN_NPROC"] = "2"
+os.environ["SHERMAN_PROC_ID"] = str(pid)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.parallel import bootstrap
+from sherman_tpu.utils import checkpoint as CK
+
+keeper = bootstrap.init_multihost()
+with np.load(os.path.join(tmp, "expect.npz")) as z:
+    kept, vals = z["kept"], z["vals"]
+cluster = CK.restore(os.path.join(tmp, "mh.npz"), keeper=keeper)
+tree = Tree(cluster)
+eng = batched.BatchedEngine(tree, batch_per_node=64)
+got, found = eng.search(kept)
+assert found.all(), f"lost {int((~found).sum())} keys"
+np.testing.assert_array_equal(got, vals)
+tree.check_structure()
+keeper.barrier("done")
+print(f"[{pid}] MH-RESHARD-PASS", flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_reshard_to_multihost_format(source, tmp_path):
+    """hosts=2 output: a single-process 4-node checkpoint becomes a
+    2-process multi-host checkpoint (per-host shard files + epoch-tagged
+    manifest) that a real 2-process cluster restores and verifies."""
+    import socket
+    import subprocess
+    import sys
+
+    src, kept, val_of = source
+    out = reshard(src, str(tmp_path / "mh.npz"), 4, hosts=2)
+    assert out["new"]["hosts"] == 2
+    np.savez(tmp_path / "expect.npz", kept=kept,
+             vals=np.asarray([val_of[int(k)] for k in kept], np.uint64))
+    worker = tmp_path / "w.py"
+    worker.write_text(_MH_WORKER)
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    import os as _os
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = repo + _os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), port, str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=repo, text=True) for pid in range(2)]
+    for pid, p in enumerate(procs):
+        try:
+            outp, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker {pid}:\n{outp[-4000:]}"
+        assert f"[{pid}] MH-RESHARD-PASS" in outp
+
+
+def test_reshard_cli(source, tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    src, kept, val_of = source
+    dst = str(tmp_path / "cli.npz")
+    p = subprocess.run(
+        [sys.executable, "tools/reshard.py", src, dst, "--nodes", "8"],
+        capture_output=True, text=True, cwd=__file__.rsplit("/tests", 1)[0])
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["new"]["machine_nr"] == 8
+    _verify_restored(dst, 8, kept, val_of)

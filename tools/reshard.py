@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Elastic cluster resize CLI — rewrite a checkpoint for a new node count.
+
+    python tools/reshard.py <src.npz> <dst.npz> --nodes M [--hosts H]
+        [--pages-per-node P] [--locks-per-node L]
+
+Offline transform (numpy only, no devices needed): repacks the live pages
+of an N-node checkpoint onto M nodes and rewrites every packed address
+(internal entries, sibling links, root meta) through the old->new map.
+See sherman_tpu/utils/reshard.py for the mechanics.  Restore the output
+with utils.checkpoint.restore on an M-node mesh (H processes when
+--hosts H > 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.add_argument("--nodes", type=int, required=True,
+                   help="target machine_nr")
+    p.add_argument("--hosts", type=int, default=1,
+                   help="emit multi-host format for this many processes")
+    p.add_argument("--pages-per-node", type=int, default=None)
+    p.add_argument("--locks-per-node", type=int, default=None)
+    a = p.parse_args(argv)
+
+    from sherman_tpu.utils.reshard import reshard
+    out = reshard(a.src, a.dst, a.nodes, pages_per_node=a.pages_per_node,
+                  locks_per_node=a.locks_per_node, hosts=a.hosts)
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()
