@@ -41,8 +41,8 @@ import numpy as np
 from sherman_tpu import config as C
 from sherman_tpu.config import DSMConfig
 from sherman_tpu.parallel.dsm import N_COUNTERS
-from sherman_tpu.utils.checkpoint import (_MANIFEST_FIELDS, _savez_atomic,
-                                          make_epoch)
+from sherman_tpu.utils.checkpoint import (_CFG_FIELDS, _MANIFEST_FIELDS,
+                                          _savez_atomic, make_epoch)
 
 _PTR_HEADER_WORDS = (C.W_LEFTMOST, C.W_SIBLING)
 
@@ -170,7 +170,7 @@ def reshard(src: str, dst: str, machine_nr: int, *,
     # 3. repack + rewrite every address word through the map
     new_pool = np.zeros((machine_nr * pages_per_node, C.PAGE_WORDS), np.int32)
     dst_rows = new_node * pages_per_node + new_page
-    sub = pool[rows].copy()
+    sub = pool[rows]  # fancy indexing: already a fresh writable array
     for w in _PTR_HEADER_WORDS:
         sub[:, w] = _map_ptrs(sub[:, w], amap, P_old, f"header word {w}")
     internal = sub[:, C.W_LEVEL] > 0
@@ -205,9 +205,7 @@ def reshard(src: str, dst: str, machine_nr: int, *,
 
     counts = np.bincount(new_node, minlength=machine_nr) if L else \
         np.zeros(machine_nr, np.int64)
-    cfg_json = {f: getattr(new_cfg, f) for f in (
-        "machine_nr", "pages_per_node", "locks_per_node", "step_capacity",
-        "host_step_capacity", "chunk_pages", "exchange_impl")}
+    cfg_json = {f: getattr(new_cfg, f) for f in _CFG_FIELDS}
     new_man = dict(
         cfg=np.frombuffer(json.dumps(cfg_json).encode(), np.uint8),
         dir_nodes=np.arange(machine_nr, dtype=np.int64),

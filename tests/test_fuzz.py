@@ -63,6 +63,28 @@ def test_fuzz_batched_vs_model(eight_devices, seed, key_bits):
             tree = Tree(cluster)
             eng = batched.BatchedEngine(tree, batch_per_node=128)
             eng.attach_router()
+        if round_i == 9:
+            # mid-run elasticity: checkpoint -> reshard to a DIFFERENT
+            # node count -> restore -> continue the storm against the
+            # same model.  The address-space rewrite (utils/reshard.py)
+            # must be invisible to every subsequent op, including on
+            # trees with lazy parent maintenance in flight and the
+            # degenerate narrow keyspace.
+            import os
+            import tempfile
+
+            from sherman_tpu.utils import checkpoint as CK
+            from sherman_tpu.utils.reshard import reshard
+            new_n = 8 if seed % 2 == 0 else 2
+            with tempfile.TemporaryDirectory() as d:
+                p = os.path.join(d, "a.npz")
+                q = os.path.join(d, "b.npz")
+                CK.checkpoint(cluster, p)
+                reshard(p, q, new_n)
+                cluster = CK.restore(q)
+            tree = Tree(cluster)
+            eng = batched.BatchedEngine(tree, batch_per_node=128)
+            eng.attach_router()
         op = rng.integers(0, 5)
         if op == 0:  # batched upsert (mix of new + existing keys, dups)
             ks = pick(200)
