@@ -184,6 +184,43 @@ def test_reshard_to_multihost_format(source, tmp_path):
         assert f"[{pid}] MH-RESHARD-PASS" in outp
 
 
+@pytest.mark.slow
+def test_reshard_scale(tmp_path):
+    """Mid-scale resize (~13k live pages, 4-level tree): 1 node -> 4
+    nodes.  Catches anything the tiny fixtures can't — multiple internal
+    levels, many chunks, full-width vectorized rewrite."""
+    from sherman_tpu.cluster import Cluster
+
+    cfg = DSMConfig(machine_nr=1, pages_per_node=65536, locks_per_node=1024,
+                    step_capacity=4096, chunk_pages=256)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    rng = np.random.default_rng(11)
+    keys = np.unique(rng.integers(1, 1 << 60, 440_000,
+                                  dtype=np.uint64))[:400_000]
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0x5A5A))
+    src = str(tmp_path / "big.npz")
+    CK.checkpoint(cluster, src)
+
+    dst = str(tmp_path / "big4.npz")
+    out = reshard(src, dst, 4)
+    assert out["live_pages"] > 10_000, out
+
+    c2 = CK.restore(dst)
+    t2 = Tree(c2)
+    e2 = batched.BatchedEngine(t2, batch_per_node=4096)
+    e2.attach_router()
+    # verification is by batched search over EVERY key (the host-side
+    # structure walk reads one page per step and would take tens of
+    # minutes at this page count on the CPU mesh; the structural
+    # invariants are walked at small scale in the other tests)
+    got, found = e2.search(keys)
+    assert found.all(), f"lost {int((~found).sum())} keys at scale"
+    np.testing.assert_array_equal(got, keys ^ np.uint64(0x5A5A))
+    ks, _ = e2.range_query(int(keys[1000]), int(keys[1400]) + 1)
+    np.testing.assert_array_equal(ks, keys[1000:1401])
+
+
 def test_reshard_cli(source, tmp_path):
     import json
     import subprocess
