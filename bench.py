@@ -105,6 +105,16 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     router = eng.attach_router(int(lb_env) if lb_env else None)
     print(f"# bulk_load {time.time() - t0:.1f}s {stats} "
           f"router_lb={router.lb}", file=sys.stderr)
+    if os.environ.get("SHERMAN_BENCH_VALIDATE"):
+        # one-step device structure validation of the full benchmark
+        # tree (every invariant, all pages — models/validate.py); raises
+        # on any violation
+        from sherman_tpu.models.validate import check_structure_device
+        t1 = time.time()
+        info = check_structure_device(tree)
+        print(f"# structure valid in {time.time() - t1:.1f}s: {info}",
+              file=sys.stderr)
+        assert info["keys"] == n_keys
 
     # Pregenerate zipf batches (rank 0 hottest -> random key via shuffle
     # already implicit: keys are sorted uniques of random draws, so rank i

@@ -1,0 +1,197 @@
+"""Device-side batched structure validation — the whole tree in one step.
+
+The reference's structural sanity tool is a host walk
+(``print_and_check_tree``, Tree.cpp:151-203) reading one page per round
+trip; our host twin (``Tree.check_structure``) shares that shape —
+O(pages) device steps, fine for unit fixtures but unusable at benchmark
+scale (tens of minutes for 10^4 pages on the CPU mesh, unthinkable at
+10^8).  This module validates the WHOLE tree in O(1) jitted device
+steps: every invariant is a vectorized predicate over the full pool plus
+a handful of single-word gathers.
+
+Checks (a superset of the host walk's):
+
+1. version pairs consistent (front == rear) on every live page.
+2. fences strictly ordered (lowest < highest) on every active page.
+3. every live leaf slot's key inside the page's [lowest, highest) fence.
+4. internal entries strictly ascending (sorted-page invariant).
+5. per-link B-link continuity for EVERY page with a sibling: sibling is
+   live, same level, and sibling.lowest == my highest (no fence gaps).
+6. leaf-chain global shape WITHOUT walking it: exactly one head
+   (in-degree 0, lowest == NEG_INF), exactly one tail (sibling == NULL,
+   highest == POS_INF), in-degree <= 1 everywhere.  Together with 2.
+   and 5. this PROVES one gap-free chain covering the keyspace: fences
+   strictly increase along links (so no disjoint cycle can hide — its
+   fences would have to wrap), every leaf has out-degree <= 1, and
+   exactly one head/tail exist — the same conclusion the host walk
+   reaches by O(leaves) round trips.
+7. parent/child coherence (beyond the host walk): every valid internal
+   entry's child is live with level == parent-1 and lowest == the entry
+   key; the leftmost child's lowest == the page's own lowest.
+
+Retired pages are excluded: bulk_load poisons the replaced root
+(highest := NEG_INF, sibling := the new root) so stale handles chase
+into the new tree — ``highest == NEG_INF`` cannot occur on a reachable
+page, so it doubles as the retirement marker.
+
+Usable at any scale, including the real-chip benchmark tree
+(``SHERMAN_BENCH_VALIDATE=1`` in bench.py) and the multihost mesh (the
+jit auto-partitions the sharded pool; every process calls collectively).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu.ops import bits
+
+_STATS = ("keys", "leaves", "internal_pages", "retired", "bad_version",
+          "bad_fence", "bad_leaf_slot", "bad_internal_order",
+          "bad_sibling", "heads", "bad_head", "tails", "bad_tail",
+          "multi_indegree", "bad_leftmost", "bad_child")
+
+
+@functools.partial(jax.jit, static_argnames=("P", "N"))
+def _validate_kernel(pool, next_by_node, P: int, N: int):
+    import jax.numpy as jnp
+
+    rows = N * P
+    ridx = jnp.arange(rows, dtype=jnp.int32)
+    pg_i = ridx % P
+    nd_i = ridx // P
+    allocated = (pg_i >= 1) & (pg_i < next_by_node[nd_i])
+
+    def col(w):
+        return pool[:, w]
+
+    fv = col(C.W_FRONT_VER)
+    live = allocated & (fv != 0)
+    hi_hi, hi_lo = col(C.W_HIGH_HI), col(C.W_HIGH_LO)
+    lo_hi, lo_lo = col(C.W_LOW_HI), col(C.W_LOW_LO)
+    retired = live & (hi_hi == 0) & (hi_lo == 0)
+    act = live & ~retired
+    lvl = col(C.W_LEVEL)
+    leaf = act & (lvl == 0)
+    internal = act & (lvl > 0)
+    bad_ver = act & (fv != col(C.W_REAR_VER))
+    # every active page's fences must be strictly ordered.  Beyond local
+    # sanity this closes the chain proof: with lowest < highest on every
+    # page and sibling.lowest == highest per link, fences strictly
+    # increase along a chain, so a disjoint leaf CYCLE (whose members
+    # would all have in-degree 1 — invisible to the head/tail counts)
+    # cannot exist
+    bad_fence = act & ~bits.key_lt(lo_hi, lo_lo, hi_hi, hi_lo)
+
+    # -- 2. leaf slots inside fences + key count -----------------------------
+    LC = C.LEAF_CAP
+    sfv = pool[:, C.L_FVER_W:C.L_FVER_W + LC]
+    srv = pool[:, C.L_RVER_W:C.L_RVER_W + LC]
+    skh = pool[:, C.L_KHI_W:C.L_KHI_W + LC]
+    skl = pool[:, C.L_KLO_W:C.L_KLO_W + LC]
+    s_live = (sfv == srv) & (sfv != 0)
+    in_f = (bits.key_le(lo_hi[:, None], lo_lo[:, None], skh, skl)
+            & bits.key_lt(skh, skl, hi_hi[:, None], hi_lo[:, None]))
+    leaf_slots = leaf[:, None] & s_live
+    bad_slot = (leaf_slots & ~in_f).sum()
+    n_keys = leaf_slots.sum()
+
+    # -- 3. internal entries strictly ascending ------------------------------
+    IC = C.INTERNAL_CAP
+    ikh = pool[:, C.I_KHI_W:C.I_KHI_W + IC]
+    ikl = pool[:, C.I_KLO_W:C.I_KLO_W + IC]
+    nk = col(C.W_NKEYS)
+    pos = jnp.arange(IC, dtype=jnp.int32)
+    asc = bits.key_lt(ikh[:, :-1], ikl[:, :-1], ikh[:, 1:], ikl[:, 1:])
+    pair_valid = internal[:, None] & (pos[None, 1:] < nk[:, None])
+    bad_order = (pair_valid & ~asc).sum()
+
+    # -- addr -> pool row (single-word gathers only) -------------------------
+    def rows_of(addr):
+        u = addr.astype(jnp.uint32)
+        node = (u >> C.ADDR_PAGE_BITS).astype(jnp.int32)
+        page = (u & C.ADDR_PAGE_MASK).astype(jnp.int32)
+        # BOTH fields bounds-checked: a page >= P would alias into the
+        # next node's row range and validate an unrelated page
+        ok = (addr != 0) & (node < N) & (page < P)
+        return jnp.clip(node * P + page, 0, rows - 1), ok
+
+    def is_act(rowv):  # target-page liveness (act recomputed by gather)
+        return act[rowv]
+
+    # -- 4. B-link continuity per link ---------------------------------------
+    sib = col(C.W_SIBLING)
+    srow, s_in_range = rows_of(sib)
+    has_sib = act & (sib != 0)
+    bad_sib = has_sib & (
+        ~s_in_range | ~is_act(srow) | (lvl[srow] != lvl)
+        | (lo_hi[srow] != hi_hi) | (lo_lo[srow] != hi_lo))
+
+    # -- 5. leaf-chain shape via in-degrees ----------------------------------
+    link_src = leaf & has_sib
+    indeg = jnp.zeros(rows, jnp.int32).at[
+        jnp.where(link_src, srow, rows)].add(1, mode="drop")
+    heads = leaf & (indeg == 0)
+    bad_head = heads & ~((lo_hi == 0) & (lo_lo == 0))
+    tails = leaf & (sib == 0)
+    inf_hi, inf_lo = bits.key_to_pair(C.KEY_POS_INF)
+    bad_tail = tails & ~((hi_hi == inf_hi) & (hi_lo == inf_lo))
+    multi_in = leaf & (indeg > 1)
+
+    # -- 6. parent/child coherence -------------------------------------------
+    lm = col(C.W_LEFTMOST)
+    lmrow, lm_ok = rows_of(lm)
+    bad_lm = internal & (
+        (lm == 0) | ~lm_ok | ~is_act(lmrow) | (lvl[lmrow] != lvl - 1)
+        | (lo_hi[lmrow] != lo_hi) | (lo_lo[lmrow] != lo_lo))
+    iptr = pool[:, C.I_PTR_W:C.I_PTR_W + IC]
+    crow, c_ok = rows_of(iptr)
+    e_valid = internal[:, None] & (pos[None, :] < nk[:, None])
+    bad_child = e_valid & (
+        ~c_ok | ~is_act(crow) | (lvl[crow] != (lvl - 1)[:, None])
+        | (lo_hi[crow] != ikh) | (lo_lo[crow] != ikl))
+
+    # int32 counts are ample (< 2^31 pages/keys per cluster by
+    # construction; jax x64 is disabled anyway)
+    return jnp.stack([
+        n_keys.astype(jnp.int32),
+        leaf.sum(), internal.sum(), retired.sum(), bad_ver.sum(),
+        bad_fence.sum(), bad_slot.astype(jnp.int32),
+        bad_order.astype(jnp.int32),
+        bad_sib.sum(), heads.sum(), bad_head.sum(),
+        tails.sum(), bad_tail.sum(), multi_in.sum(), bad_lm.sum(),
+        bad_child.sum()])
+
+
+def check_structure_device(tree) -> dict:
+    """Validate the whole tree on device.  -> stats dict (keys, leaves,
+    internal_pages, levels, retired); raises RuntimeError listing every
+    violated invariant.  Collective in multihost deployments (every
+    process calls; the jit partitions the sharded pool)."""
+    import jax.numpy as jnp
+
+    tree._refresh_root()
+    cfg = tree.dsm.cfg
+    nxt = np.ones(cfg.machine_nr, np.int64)
+    for d in tree.cluster.directories:
+        nxt[d.node_id] = d.allocator.pages_used
+    out = np.asarray(_validate_kernel(
+        tree.dsm.pool, jnp.asarray(nxt, jnp.int32),
+        P=cfg.pages_per_node, N=cfg.machine_nr))
+    s = dict(zip(_STATS, out.tolist()))
+    problems = [f"{k}={s[k]}" for k in (
+        "bad_version", "bad_fence", "bad_leaf_slot", "bad_internal_order",
+        "bad_sibling", "bad_head", "bad_tail", "multi_indegree",
+        "bad_leftmost", "bad_child") if s[k]]
+    if s["heads"] != 1:
+        problems.append(f"heads={s['heads']} (want exactly 1)")
+    if s["tails"] != 1:
+        problems.append(f"tails={s['tails']} (want exactly 1)")
+    if problems:
+        raise RuntimeError("tree structure invalid: " + ", ".join(problems))
+    return {"keys": s["keys"], "leaves": s["leaves"],
+            "internal_pages": s["internal_pages"],
+            "levels": tree._root_level + 1, "retired": s["retired"]}

@@ -137,9 +137,14 @@ def test_fuzz_batched_vs_model(eight_devices, seed, key_bits):
             np.testing.assert_array_equal(
                 vs, np.array([model[k] for k in exp], np.uint64))
 
-    # structural invariants after the storm
+    # structural invariants after the storm: host walk AND the one-step
+    # device validator must agree
     info = tree.check_structure()
     assert info["leaves"] >= 1
+    from sherman_tpu.models.validate import check_structure_device
+    dev = check_structure_device(tree)
+    assert dev["keys"] == info["keys"] == len(model)
+    assert dev["leaves"] == info["leaves"]
     # final full verification
     all_keys = np.array(sorted(model), np.uint64)
     v, f = eng.search(all_keys)

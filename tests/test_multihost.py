@@ -137,7 +137,12 @@ assert fnd.all()
 got2, found2 = eng.search(dropped)
 assert not found2.any()
 
-tree.check_structure()
+info = tree.check_structure()
+# device validator is collective too: the jit partitions the
+# process-spanning pool; every process calls with identical args
+from sherman_tpu.models.validate import check_structure_device
+dev = check_structure_device(tree)
+assert dev["keys"] == info["keys"] and dev["leaves"] == info["leaves"]
 total_splits = keeper.sum("splits", int(stats.get("device_splits", 0)))
 assert total_splits == nproc * stats["device_splits"]  # identical streams
 

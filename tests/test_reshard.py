@@ -210,15 +210,18 @@ def test_reshard_scale(tmp_path):
     t2 = Tree(c2)
     e2 = batched.BatchedEngine(t2, batch_per_node=4096)
     e2.attach_router()
-    # verification is by batched search over EVERY key (the host-side
-    # structure walk reads one page per step and would take tens of
-    # minutes at this page count on the CPU mesh; the structural
-    # invariants are walked at small scale in the other tests)
+    # batched search over EVERY key + the DEVICE structure validator
+    # (the host-side walk reads one page per step and would take tens of
+    # minutes at this page count on the CPU mesh; the device validator
+    # checks every invariant in one jitted step)
     got, found = e2.search(keys)
     assert found.all(), f"lost {int((~found).sum())} keys at scale"
     np.testing.assert_array_equal(got, keys ^ np.uint64(0x5A5A))
     ks, _ = e2.range_query(int(keys[1000]), int(keys[1400]) + 1)
     np.testing.assert_array_equal(ks, keys[1000:1401])
+    from sherman_tpu.models.validate import check_structure_device
+    info = check_structure_device(t2)
+    assert info["keys"] == keys.size
 
 
 def test_reshard_cli(source, tmp_path):

@@ -1,0 +1,111 @@
+"""Device-side batched structure validation (models/validate.py).
+
+Agreement with the host walk on legal trees (splits, deletes, root
+growth, bulk-load root poisoning), and detection: corrupting any guarded
+invariant directly in the pool must raise, naming the check.
+"""
+
+import numpy as np
+import pytest
+
+from sherman_tpu import config as C
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.models.validate import check_structure_device
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import dsm as D
+
+
+@pytest.fixture()
+def grown_tree(eight_devices):
+    cfg = DSMConfig(machine_nr=4, pages_per_node=256, locks_per_node=128,
+                    step_capacity=128, chunk_pages=16)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=64)
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 48, 2600, dtype=np.uint64))[:2400]
+    batched.bulk_load(tree, keys[:1500], keys[:1500])
+    eng.attach_router()
+    eng.insert(keys[1500:], keys[1500:])
+    eng.delete(keys[::6])
+    return tree, eng
+
+
+def test_agrees_with_host_walk(grown_tree):
+    tree, _ = grown_tree
+    host = tree.check_structure()
+    dev = check_structure_device(tree)
+    for f in ("keys", "leaves", "levels", "internal_pages"):
+        assert dev[f] == host[f], f
+    # the bulk-load root poisoning leaves exactly one retired page, which
+    # the validator excludes rather than flags
+    assert dev["retired"] == 1
+
+
+def test_fresh_empty_tree(eight_devices):
+    cfg = DSMConfig(machine_nr=2, pages_per_node=64, locks_per_node=64,
+                    step_capacity=32, chunk_pages=8)
+    tree = Tree(Cluster(cfg))
+    dev = check_structure_device(tree)
+    assert dev == {"keys": 0, "leaves": 1, "internal_pages": 0,
+                   "levels": 1, "retired": 0}
+
+
+def _poke(tree, addr, woff, value):
+    tree.dsm.write_word(addr, woff, value)
+
+
+def test_detects_key_outside_fence(grown_tree):
+    tree, eng = grown_tree
+    # pick a real leaf via the router's directory and break one live slot
+    addr = int(tree._bulk_leaf_dir[0][3])
+    pg = tree.dsm.read_page(addr)
+    slot = next(s for s in range(C.LEAF_CAP)
+                if pg[C.L_FVER_W + s] == pg[C.L_RVER_W + s] != 0)
+    _poke(tree, addr, C.L_KHI_W + slot, 0x7FFFFFFF)  # far above any fence
+    with pytest.raises(RuntimeError, match="bad_leaf_slot"):
+        check_structure_device(tree)
+
+
+def test_detects_broken_sibling_link(grown_tree):
+    tree, _ = grown_tree
+    addr = int(tree._bulk_leaf_dir[0][5])
+    _poke(tree, addr, C.W_SIBLING, bits.make_addr(0, 1))  # bogus target
+    with pytest.raises(RuntimeError, match="bad_sibling|heads|bad_child"):
+        check_structure_device(tree)
+
+
+def test_detects_torn_version(grown_tree):
+    tree, _ = grown_tree
+    addr = int(tree._bulk_leaf_dir[0][7])
+    pg = tree.dsm.read_page(addr)
+    _poke(tree, addr, C.W_FRONT_VER, int(pg[C.W_FRONT_VER]) + 1)
+    with pytest.raises(RuntimeError, match="bad_version"):
+        check_structure_device(tree)
+
+
+def test_detects_unsorted_internal(grown_tree):
+    tree, _ = grown_tree
+    # find any internal page with >= 2 entries via a host pool scan
+    pool = np.asarray(tree.dsm.pool)
+    P = tree.dsm.cfg.pages_per_node
+    cand = np.nonzero((pool[:, C.W_LEVEL] > 0) & (pool[:, C.W_NKEYS] >= 2)
+                      & (pool[:, C.W_FRONT_VER] != 0))[0]
+    assert cand.size, "no internal page with >= 2 entries"
+    row = int(cand[0])
+    addr = bits.make_addr(row // P, row % P)
+    pg = pool[row]
+    # swap the first two entry keys' high words to break ordering
+    k0, k1 = int(pg[C.I_KHI_W]), int(pg[C.I_KHI_W + 1])
+    tree.dsm.write_rows([
+        {"op": D.OP_WRITE_WORD, "addr": addr, "woff": C.I_KHI_W,
+         "arg1": k1},
+        {"op": D.OP_WRITE_WORD, "addr": addr, "woff": C.I_KHI_W + 1,
+         "arg1": k0},
+    ])
+    with pytest.raises(RuntimeError,
+                       match="bad_internal_order|bad_child|bad_leftmost"):
+        check_structure_device(tree)
