@@ -968,17 +968,24 @@ class BatchedEngine:
         # multi-node fori pays it only on CPU test meshes.
         return self.tcfg.max_level + self.tcfg.sibling_chase_budget
 
-    def attach_router(self, log2_buckets: int | None = None):
+    def attach_router(self, log2_buckets: int | None = None,
+                      scan: bool = True):
         """Create + seed the device index cache (see router.py).  Uses the
-        bulk-load leaf directory when available; otherwise starts cold at
-        the root and is refined by split notifications."""
+        bulk-load leaf directory when available; otherwise (a restored or
+        host-built tree) enumerates the live leaves in one device step
+        (``validate.leaf_directory``) so the router is warm AND correctly
+        sized from the first batch.  ``scan=False`` forces the cold
+        root-seeded table (refined only by split notifications)."""
         from sherman_tpu.models.router import LeafRouter, default_log2_buckets
         leaf_dir = getattr(self.tree, "_bulk_leaf_dir", None)
+        if leaf_dir is None and scan:
+            from sherman_tpu.models.validate import leaf_directory
+            leaf_dir = leaf_directory(self.tree)
         if log2_buckets is None:
             n_leaves = len(leaf_dir[0]) if leaf_dir else 1024
             log2_buckets = default_log2_buckets(n_leaves)
         r = LeafRouter(self.tree, log2_buckets)
-        if leaf_dir is not None:
+        if leaf_dir is not None and len(leaf_dir[0]):
             r.seed_from_leaves(*leaf_dir)
         self.router = r
         return r

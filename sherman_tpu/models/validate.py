@@ -166,6 +166,59 @@ def _validate_kernel(pool, next_by_node, P: int, N: int):
         bad_child.sum()])
 
 
+@functools.partial(jax.jit, static_argnames=("P", "N"))
+def _leaf_scan_kernel(pool, next_by_node, P: int, N: int):
+    import jax.numpy as jnp
+
+    ridx = jnp.arange(N * P, dtype=jnp.int32)
+    pg_i = ridx % P
+    allocated = (pg_i >= 1) & (pg_i < next_by_node[ridx // P])
+    fv = pool[:, C.W_FRONT_VER]
+    hi_hi, hi_lo = pool[:, C.W_HIGH_HI], pool[:, C.W_HIGH_LO]
+    act = allocated & (fv != 0) & ~((hi_hi == 0) & (hi_lo == 0))
+    leaf = act & (pool[:, C.W_LEVEL] == 0)
+    return leaf, pool[:, C.W_LOW_HI], pool[:, C.W_LOW_LO]
+
+
+def leaf_directory(tree) -> tuple[np.ndarray, np.ndarray]:
+    """Enumerate every live leaf in ONE device step: -> (addrs int64,
+    lows uint64), sorted by key — the exact shape of the bulk-load leaf
+    directory (``tree._bulk_leaf_dir``), computed for ANY tree.
+
+    This is what makes a RESTORED (or host-built) tree's router warm
+    from step one: without it, ``attach_router`` on a tree that never
+    bulk-loaded starts cold at the root with a table sized for nothing,
+    and the first steps funnel the whole batch through the straggler
+    loop.  Collective in multihost deployments (every process calls;
+    the assembled directory is identical everywhere).
+    """
+    cfg = tree.dsm.cfg
+    nxt = np.ones(cfg.machine_nr, np.int64)
+    for d in tree.cluster.directories:
+        nxt[d.node_id] = d.allocator.pages_used
+    import jax.numpy as jnp
+    out = _leaf_scan_kernel(tree.dsm.pool, jnp.asarray(nxt, jnp.int32),
+                            P=cfg.pages_per_node, N=cfg.machine_nr)
+    if tree.dsm.multihost:
+        from jax.experimental import multihost_utils as mhu
+        blocks = []
+        for x in out:
+            shards = sorted(x.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            blocks.append(np.concatenate([np.asarray(s.data)
+                                          for s in shards]))
+        leaf, lh, ll = (np.asarray(g) for g in
+                        mhu.process_allgather(tuple(blocks), tiled=True))
+    else:
+        leaf, lh, ll = (np.asarray(x) for x in out)
+    rows = np.nonzero(leaf)[0]
+    P = cfg.pages_per_node
+    addrs = ((rows // P).astype(np.int64) << C.ADDR_PAGE_BITS) | (rows % P)
+    lows = bits.pairs_to_keys(lh[rows], ll[rows])
+    order = np.argsort(lows)
+    return addrs[order], lows[order]
+
+
 def check_structure_device(tree) -> dict:
     """Validate the whole tree on device.  -> stats dict (keys, leaves,
     internal_pages, levels, retired); raises RuntimeError listing every
