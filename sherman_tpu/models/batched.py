@@ -28,6 +28,7 @@ how the reference falls out of its fast path into lock-and-split code
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -157,8 +158,8 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
 def search_routed_spmd(pool, counters, khi, klo, root, active, start, *,
                        cfg: DSMConfig, iters: int,
                        axis_name: str = AXIS):
-    """Single-node cache-hit search: one full-batch leaf read, then a
-    COMPACTED straggler loop.
+    """Cache-hit search: one full-batch leaf read, then a COMPACTED
+    straggler loop (any mesh size).
 
     ``start`` is the per-key seed address from the host index-cache probe
     (router.host_start): with a warm cache ~90%+ of keys finish in round 1
@@ -166,9 +167,6 @@ def search_routed_spmd(pool, counters, khi, klo, root, active, start, *,
     chases, stale entries) are compacted into a small fixed buffer so later
     rounds gather S rows instead of B — full-batch rounds are what make a
     naive descent loop pay the whole batch's bandwidth per level.
-
-    Single-node only (no routing exchange); the generic ``search_spmd``
-    remains the multi-node / no-cache path.
 
     Perf notes (measured on v5e): the page gather is per-row latency-bound
     (~20-25 ns/row regardless of row width), so the step does exactly ONE
@@ -179,14 +177,15 @@ def search_routed_spmd(pool, counters, khi, klo, root, active, start, *,
     (cold router) fall into the compacted loop, which runs the full
     descent logic on S rows only.
     """
-    assert cfg.machine_nr == 1
     counters, done, addr, found, vhi, vlo = _routed_resolve(
-        pool, counters, khi, klo, active, start, iters=iters)
+        pool, counters, khi, klo, active, start, iters=iters, cfg=cfg,
+        axis_name=axis_name)
     return counters, done, found, vhi, vlo
 
 
-def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
-    """Walk every active key from its cache seed to its leaf (single-node).
+def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int,
+                    cfg: DSMConfig, axis_name: str = AXIS):
+    """Walk every active key from its cache seed to its leaf.
 
     Shared core of the routed search and mixed steps: round 1 + compacted
     straggler loop as described in :func:`search_routed_spmd`.  Returns
@@ -202,16 +201,33 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
     path spent resolving ~3% of rows.  Rows beyond the S-slot buffer
     (cold-router floods) stay not-done; callers retry them through the
     full-descent path, same contract as the round budget.
+
+    Multi-node meshes run the SAME shape per node shard: pages come
+    through the bucket-routed read exchange (``D.read_pages_spmd``) —
+    round 1 at the full step capacity, the straggler loop at an
+    S-capacity exchange so straggler cost scales with miss count, not
+    batch width (the reference's cache-hit path is O(1) reads per op at
+    any cluster size, ``IndexCache.h:134-184``) — and the loop exits on
+    a psum'd pending count so every node leaves together.
     """
     B = khi.shape[0]
     P = pool.shape[0]
+    N = cfg.machine_nr
     S = max(min(1024, B), B // 16)
     max_rounds = iters * 4
 
-    def read(addrs):
-        page = bits.addr_page(addrs)
-        ok = (page >= 0) & (page < P)
-        return pool[jnp.clip(page, 0, P - 1)], ok
+    if N == 1:
+        def read(addrs, act, loop: bool):
+            page = bits.addr_page(addrs)
+            ok = act & (page >= 0) & (page < P)
+            return pool[jnp.clip(page, 0, P - 1)], ok
+    else:
+        loop_cfg = dataclasses.replace(cfg, step_capacity=S)
+
+        def read(addrs, act, loop: bool):
+            return D.read_pages_spmd(
+                pool, addrs, cfg=loop_cfg if loop else cfg,
+                axis_name=axis_name, active=act)
 
     def advance(pg, ok, kh, kl):
         lvl = layout.h_level(pg)
@@ -225,7 +241,7 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
     # round 1: full batch from the cache-seeded start; leaf-only logic
     # (no internal_pick_child on the full batch — stragglers descend in
     # the compacted loop below)
-    pg, ok = read(start)
+    pg, ok = read(start, active, False)
     # NO optimization_barrier here: materializing the [B, PW] round-1
     # gather costs ~+10 ms at 2 M rows vs letting XLA fuse it into the
     # chase/level/find consumers (measured; the opposite tradeoff from
@@ -251,14 +267,23 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
     s_vh = jnp.zeros(S, jnp.int32)
     s_vl = jnp.zeros(S, jnp.int32)
 
+    if N == 1:
+        def pend_of(s_done):
+            return jnp.sum((~s_done).astype(jnp.int32))
+    else:
+        # uniform exit: every node sees the same cluster-wide pending
+        # count (the loop body carries all_to_all exchanges)
+        def pend_of(s_done):
+            return lax.psum(jnp.sum((~s_done).astype(jnp.int32)), axis_name)
+
     def cond(st):
-        it, s_done = st[0], st[1]
-        return (it < max_rounds) & jnp.any(~s_done)
+        it, pend = st[0], st[-1]
+        return (it < max_rounds) & (pend > 0)
 
     def body(st):
-        it, s_done, s_addr, s_f, s_vh, s_vl, loop_reads = st
+        it, s_done, s_addr, s_f, s_vh, s_vl, loop_reads, _ = st
         loop_reads = loop_reads + jnp.sum((~s_done).astype(jnp.uint32))
-        pg, ok = read(s_addr)
+        pg, ok = read(s_addr, ~s_done, True)
         ok = ok & ~s_done
         at_leaf, nxt, f, vh, vl = advance(pg, ok, s_kh, s_kl)
         fin = ok & at_leaf
@@ -267,11 +292,13 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int):
         s_vl = jnp.where(fin & f, vl, s_vl)
         s_done = s_done | fin
         s_addr = jnp.where(ok & ~at_leaf, nxt, s_addr)
-        return it + 1, s_done, s_addr, s_f, s_vh, s_vl, loop_reads
+        return (it + 1, s_done, s_addr, s_f, s_vh, s_vl, loop_reads,
+                pend_of(s_done))
 
-    _, s_done, s_addr, s_f, s_vh, s_vl, loop_reads = lax.while_loop(
+    (_, s_done, s_addr, s_f, s_vh, s_vl, loop_reads, _) = lax.while_loop(
         cond, body,
-        (1, s_done, s_addr, s_f, s_vh, s_vl, jnp.uint32(0)))
+        (1, s_done, s_addr, s_f, s_vh, s_vl, jnp.uint32(0),
+         pend_of(s_done)))
 
     # single scatter of the compacted results back to [B]
     res = valid & s_done
@@ -660,13 +687,14 @@ def _leaf_split_apply(pool, counters, inc, splitter, fidx, fresh,
 def _resolve_leaves(pool, counters, khi, klo, root, active, start, *,
                     cfg: DSMConfig, iters: int, axis_name: str):
     """Walk every active key to its leaf, picking the best descent:
-    cache-seeded compacted loop on a single node, generic full-batch
-    descent otherwise.  -> (counters, done, addr, found, vhi, vlo);
-    callers that only need addresses let XLA drop the lookup outputs.
+    cache-seeded compacted loop when seeds exist (any mesh size),
+    generic full-batch descent otherwise.  -> (counters, done, addr,
+    found, vhi, vlo); callers that only need addresses let XLA drop the
+    lookup outputs.
     """
-    if cfg.machine_nr == 1 and start is not None:
+    if start is not None:
         return _routed_resolve(pool, counters, khi, klo, active, start,
-                               iters=iters)
+                               iters=iters, cfg=cfg, axis_name=axis_name)
     counters, addr, page, done = descend_spmd(
         pool, counters, khi, klo, root, active, cfg=cfg, iters=iters,
         axis_name=axis_name, start=start)
@@ -975,7 +1003,14 @@ class BatchedEngine:
         host-built tree) enumerates the live leaves in one device step
         (``validate.leaf_directory``) so the router is warm AND correctly
         sized from the first batch.  ``scan=False`` forces the cold
-        root-seeded table (refined only by split notifications)."""
+        root-seeded table (refined only by split notifications).
+
+        COLLECTIVE in multihost deployments when ``scan=True`` and no
+        bulk-load directory exists: the leaf scan does a
+        ``process_allgather``, so EVERY process must call attach_router
+        with the same arguments at the same point (calling it on a subset,
+        or conditionally, deadlocks).  ``scan=False`` is process-local and
+        safe to call unilaterally."""
         from sherman_tpu.models.router import LeafRouter, default_log2_buckets
         leaf_dir = getattr(self.tree, "_bulk_leaf_dir", None)
         if leaf_dir is None and scan:
@@ -998,7 +1033,7 @@ class BatchedEngine:
             in_specs = [spec, spec, spec, spec, rep, spec]
             if with_start:
                 in_specs.append(spec)
-            if with_start and self.cfg.machine_nr == 1:
+            if with_start:
                 kernel = functools.partial(search_routed_spmd, cfg=self.cfg,
                                            iters=iters)
             else:
@@ -1162,7 +1197,17 @@ class BatchedEngine:
             out_vals[miss_r], found[miss_r] = v2, f2
         miss_w = ~is_read & np.isin(status, (ST_FULL, ST_RETRY, ST_LOCKED))
         if miss_w.any():
-            self.insert(keys[miss_w], values[miss_w])
+            st = self.insert(keys[miss_w], values[miss_w])
+            # The rewrite below depends on insert()'s postcondition: every
+            # request ends APPLIED, SUPERSEDED by a same-batch duplicate,
+            # or applied through the host path — nothing stays pending
+            # (insert raises on st_locked exhaustion rather than dropping
+            # rows).  Assert it so a future relaxation of that guarantee
+            # cannot silently turn these synthesized statuses into lies.
+            resolved = (st["applied"] + st["superseded"] + st["host_path"])
+            assert resolved == int(miss_w.sum()), (
+                f"insert() postcondition broken: {st} resolved != "
+                f"{int(miss_w.sum())} retried writes")
             # per-request outcomes match the fast path's dedup semantics:
             # the first-ordered request of a key applies, later duplicates
             # are superseded by it (insert linearizes them the same way)
@@ -1287,14 +1332,9 @@ class BatchedEngine:
             N = self.cfg.machine_nr
 
             def kernel(pool, counters, khi, klo, root, active, start, inv):
-                if N == 1:
-                    counters, done, found, vhi, vlo = search_routed_spmd(
-                        pool, counters, khi, klo, root, active, start,
-                        cfg=self.cfg, iters=iters)
-                else:
-                    counters, done, found, vhi, vlo = search_spmd(
-                        pool, counters, khi, klo, root, active, start,
-                        cfg=self.cfg, iters=iters)
+                counters, done, found, vhi, vlo = search_routed_spmd(
+                    pool, counters, khi, klo, root, active, start,
+                    cfg=self.cfg, iters=iters)
                 ans = jnp.stack([found.astype(jnp.int32), vhi, vlo,
                                  jnp.zeros_like(vhi)], axis=-1)  # [U_loc, 4]
                 if N > 1:

@@ -117,6 +117,15 @@ def _load() -> None:
     _sig("shn_lt_acquire", I32, [P, U64])
     _sig("shn_lt_can_handover", I32, [P, U64])
     _sig("shn_lt_release", I32, [P, U64, I32])
+    I64, PI32, PU8 = ct.c_int64, ct.POINTER(ct.c_int32), ct.POINTER(ct.c_uint8)
+    _sig("shn_prep_new", P, [U64, F64, U64, U64, U64, U64])
+    _sig("shn_prep_free", None, [P])
+    _sig("shn_prep_run_keys", I64,
+         [P, PU64, U64, PI32, U64, ct.c_uint32, ct.c_int32,
+          PI32, PI32, PI32, PU8, PI32])
+    _sig("shn_prep_run_zipf", I64,
+         [P, PU64, PU64, PI32, U64, ct.c_uint32, ct.c_int32,
+          PI32, PI32, PI32, PU8, PI32])
     _sig("shn_rw_new", P, [])
     _sig("shn_rw_free", None, [P])
     _sig("shn_rw_rlock", None, [P])
@@ -292,6 +301,151 @@ class IndexCache:
         if h and f:
             f(h)
             self._h = None
+
+
+class PrepBuffers:
+    """One reusable output buffer set for :class:`BatchPrep` — hold two and
+    alternate to double-buffer host prep against device steps."""
+
+    __slots__ = ("khi", "klo", "start", "active", "inv", "keys", "n_uniq")
+
+    def __init__(self, batch: int, capacity: int, with_keys: bool = False):
+        self.khi = np.empty(capacity, np.int32)
+        self.klo = np.empty(capacity, np.int32)
+        self.start = np.empty(capacity, np.int32)
+        self.active = np.empty(capacity, np.uint8)
+        self.inv = np.empty(batch, np.int32)
+        self.keys = np.empty(batch, np.uint64) if with_keys else None
+        self.n_uniq = 0
+
+
+class BatchPrep:
+    """Fused single-pass batch prep: zipf sample -> keyspace gather ->
+    unique+inverse (epoch-tagged hash table) -> router-table probe.
+
+    The native replacement for the numpy prep pipeline (sort-based
+    ``np.unique`` + separate router gather); see ``src/prep.cc``.  The
+    reference's clients do this work inline in the open benchmark loop
+    (``test/benchmark.cpp:159-188``); this class makes the batched engine's
+    equivalent cheap enough to sit inside the timed serving loop.
+
+    ``capacity`` bounds the unique keys per batch (the padded device batch
+    width); ``run_*`` raises :class:`PrepOverflow` when a batch exceeds it
+    so the caller can re-plan with a wider buffer set.
+    """
+
+    def __init__(self, batch: int, capacity: int, n_keys: int = 0,
+                 theta: float = 0.0, seed: int = 0, salt: int = 0):
+        """``salt`` != 0 enables the synthetic rank->key mode: the client
+        key for zipf rank r is ``mix64(r ^ salt)`` computed arithmetically
+        (build the matching tree keyspace with :func:`synthetic_keyspace`),
+        so no keyspace gather sits in the serving loop — the reference
+        benchmark's own convention (its key IS the zipf rank)."""
+        _require()
+        self.batch, self.capacity = int(batch), int(capacity)
+        self._h = _shn_prep_new(int(n_keys), float(theta), int(seed),
+                                int(batch), int(capacity), int(salt))
+        if not self._h:
+            raise MemoryError("prep_new failed")
+
+    def buffers(self, with_keys: bool = False) -> PrepBuffers:
+        return PrepBuffers(self.batch, self.capacity, with_keys)
+
+    @staticmethod
+    def _table_args(table: np.ndarray | None, shift: int, default_start: int):
+        if table is None:
+            return None, 0, 0, np.int32(default_start)
+        t = np.ascontiguousarray(table, np.int32)
+        return (t.ctypes.data_as(ct.POINTER(ct.c_int32)), t.size,
+                int(shift), np.int32(default_start))
+
+    def _finish(self, n: int, buf: PrepBuffers) -> PrepBuffers:
+        if n == -1:
+            raise PrepOverflow(
+                f"batch exceeded unique capacity {self.capacity}")
+        if n < 0:
+            raise ValueError("bad prep arguments")
+        buf.n_uniq = int(n)
+        return buf
+
+    def run_keys(self, keys: np.ndarray, buf: PrepBuffers,
+                 table: np.ndarray | None, shift: int = 0,
+                 default_start: int = 0) -> PrepBuffers:
+        """Dedup + probe an explicit key batch (<= batch keys)."""
+        k = np.ascontiguousarray(keys, np.uint64)
+        tp, nb, sh, ds = self._table_args(table, shift, default_start)
+        i32 = ct.POINTER(ct.c_int32)
+        n = _shn_prep_run_keys(
+            self._h, _u64p(k), k.size, tp, nb, sh, ds,
+            buf.khi.ctypes.data_as(i32), buf.klo.ctypes.data_as(i32),
+            buf.start.ctypes.data_as(i32),
+            buf.active.ctypes.data_as(ct.POINTER(ct.c_uint8)),
+            buf.inv.ctypes.data_as(i32))
+        return self._finish(n, buf)
+
+    def run_zipf(self, keyspace: np.ndarray | None, buf: PrepBuffers,
+                 table: np.ndarray | None, shift: int = 0,
+                 default_start: int = 0,
+                 want_keys: bool = False) -> PrepBuffers:
+        """Sample `batch` zipf ops over ``keyspace`` (or the synthetic map
+        when constructed with a salt — pass ``keyspace=None``) and prep
+        them; with ``want_keys`` the raw client keys land in ``buf.keys``
+        (skipped by default: the extra batch*8-byte memcpy is pure waste
+        in a timed serving loop)."""
+        ksp = None
+        if keyspace is not None:
+            ks = np.ascontiguousarray(keyspace, np.uint64)
+            ksp = _u64p(ks)
+        tp, nb, sh, ds = self._table_args(table, shift, default_start)
+        i32 = ct.POINTER(ct.c_int32)
+        okp = (_u64p(buf.keys) if want_keys and buf.keys is not None
+               else None)
+        n = _shn_prep_run_zipf(
+            self._h, ksp, okp, tp, nb, sh, ds,
+            buf.khi.ctypes.data_as(i32), buf.klo.ctypes.data_as(i32),
+            buf.start.ctypes.data_as(i32),
+            buf.active.ctypes.data_as(ct.POINTER(ct.c_uint8)),
+            buf.inv.ctypes.data_as(i32))
+        return self._finish(n, buf)
+
+    def __del__(self):
+        h, f = getattr(self, "_h", None), globals().get("_shn_prep_free")
+        if h and f:
+            f(h)
+            self._h = None
+
+
+class PrepOverflow(RuntimeError):
+    """A batch's unique-key count exceeded the planned device width."""
+
+
+def mix64(x) -> np.ndarray:
+    """Vectorized splitmix64 finalizer — bit-exact with prep.cc's mix64."""
+    x = np.asarray(x, np.uint64).copy()
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def synthetic_keyspace(n_keys: int, salt: int):
+    """The sorted tree keyspace matching BatchPrep's synthetic mode: rank
+    r's client key is ``mix64(r ^ salt)``.  Returns (sorted_keys,
+    rank_to_key) where rank_to_key[r] is rank r's key.  mix64 is a
+    bijection, so distinct ranks never collide; the only failure mode is
+    an out-of-range key (0 or KEY_POS_INF), which is CERTAIN for key 0
+    when ``salt < n_keys`` (rank == salt maps to mix64(0) == 0) — pick a
+    salt with bits above the rank range and the retry loop is one-shot."""
+    from sherman_tpu import config as C
+    rank_to_key = mix64(np.arange(n_keys, dtype=np.uint64)
+                        ^ np.uint64(salt))
+    keys = np.sort(rank_to_key)
+    if (np.diff(keys) == 0).any() or keys[0] < C.KEY_MIN \
+            or keys[-1] > C.KEY_MAX:
+        raise ValueError(f"salt {salt} collides; pick another")
+    return keys, rank_to_key
 
 
 class WRLock:

@@ -1,60 +1,16 @@
-// Zipfian rank sampler — native workload generator.
+// Zipfian rank sampler — native workload generator (C ABI over zipf.h).
 //
-// Role parity: the reference benchmark's zipf generator (test/zipf.h,
-// mehcached_zipf_init/next) feeding the YCSB driver (test/benchmark.cpp).
-// Distinct design: classical Gray/Jain rejection-free inverse-CDF
-// approximation with an exact zeta(n, theta) partial sum computed once at
-// construction (chunked so 100M-key spaces init in ~a second), and a bulk
-// fill API so Python fetches millions of ranks per call.
-#include <cmath>
+// The samplers themselves live in zipf.h so the fused batch-prep pipeline
+// (prep.cc) inlines them into its streaming loop.
 #include <new>
 
-#include "common.h"
+#include "zipf.h"
+
+using shn::UniformGen;
+using shn::Zipf;
 
 namespace {
-
-struct Zipf {
-  uint64_t n;
-  double theta;
-  double zetan;     // sum_{i=1..n} 1/i^theta
-  double alpha;     // 1 / (1 - theta)
-  double eta;
-  double half_pow;  // 1 + 0.5^theta
-  shn::Rng rng;
-
-  Zipf(uint64_t n_, double theta_, uint64_t seed)
-      : n(n_), theta(theta_), rng(seed) {
-    double z = 0.0;
-    for (uint64_t i = 1; i <= n; ++i) z += std::pow((double)i, -theta);
-    zetan = z;
-    double zeta2 = 1.0 + std::pow(2.0, -theta);
-    alpha = 1.0 / (1.0 - theta);
-    eta = (1.0 - std::pow(2.0 / (double)n, 1.0 - theta)) /
-          (1.0 - zeta2 / zetan);
-    half_pow = 1.0 + std::pow(0.5, theta);
-  }
-
-  inline uint64_t next() {
-    double u = rng.next_double();
-    double uz = u * zetan;
-    if (uz < 1.0) return 0;
-    if (uz < half_pow) return 1;
-    uint64_t r =
-        (uint64_t)((double)n * std::pow(eta * u - eta + 1.0, alpha));
-    return r >= n ? n - 1 : r;
-  }
-};
-
-struct Uniform {
-  uint64_t n;
-  shn::Rng rng;
-  Uniform(uint64_t n_, uint64_t seed) : n(n_), rng(seed) {}
-  inline uint64_t next() {
-    // Lemire-style rejection-free enough for workload gen: 128-bit multiply.
-    return (uint64_t)(((__uint128_t)rng.next() * n) >> 64);
-  }
-};
-
+using Uniform = UniformGen;
 }  // namespace
 
 SHN_EXPORT void* shn_zipf_new(uint64_t n, double theta, uint64_t seed) {

@@ -283,6 +283,45 @@ class PreemptionGuard:
         self._prev.clear()
 
 
+def _error_status(e: Exception) -> str:
+    """Best-effort status text of a coordination-service error: the UNION
+    of any structured code/status attributes (grpc/absl expose one on
+    some exception types) and the message — absl status strings lead
+    with the code name.  Matching against the union means a numeric or
+    unrelated ``code`` attribute (e.g. an integer gRPC code) can never
+    mask the message fallback."""
+    parts = []
+    for attr in ("code", "status"):
+        v = getattr(e, attr, None)
+        if v is not None:
+            try:
+                s = str(v() if callable(v) else v)
+            except Exception:
+                continue
+            if s:
+                parts.append(s)
+    parts.append(str(e))
+    return " ".join(parts).upper()
+
+
+def _is_deadline_error(e: Exception) -> bool:
+    s = _error_status(e)
+    return "DEADLINE_EXCEEDED" in s or "TIMED OUT" in s
+
+
+def _read_burn_marker(client, key: str) -> int:
+    """Last burned attempt for a barrier name, -1 when none exists.  Only
+    a not-found answer means 'no marker'; any other coordination error
+    (lost connection, auth) propagates — treating those as 'no marker'
+    would silently break attempt realignment."""
+    try:
+        return int(client.key_value_try_get(key))
+    except Exception as e:
+        if "NOT_FOUND" in _error_status(e):
+            return -1
+        raise
+
+
 def barrier_guarded(name: str, timeout_s: float, *,
                     attempt: int, client=None) -> int:
     """Host-level named barrier with a deadline (the memcached
@@ -313,39 +352,52 @@ def barrier_guarded(name: str, timeout_s: float, *,
     if client is None:
         return attempt  # single process: arrival == completion
     burn_key = f"sherman:barrier-burned:{name}"
-    burned = -1
-    try:
-        burned = int(client.key_value_try_get(burn_key))
-    except Exception:
-        pass  # no burn marker yet (NOT_FOUND): first-ever failure-free use
-    eff = max(attempt, burned + 1)
-    bid = f"sherman:barrier:{name}:{eff}"
-    t0 = time.monotonic()
-    try:
-        client.wait_at_barrier(bid, int(timeout_s * 1000))
-        return eff
-    except Exception as e:
-        msg = str(e)
-        if "DEADLINE_EXCEEDED" not in msg and "timed out" not in msg:
-            raise  # not a peer failure: configuration/connection error
-        waited = time.monotonic() - t0
-        # burn this attempt so every side's next use aligns at eff+1
+    retried = False
+    while True:
+        burned = _read_burn_marker(client, burn_key)
+        eff = max(attempt, burned + 1)
+        bid = f"sherman:barrier:{name}:{eff}"
+        t0 = time.monotonic()
         try:
-            client.key_value_set(burn_key, str(eff), allow_overwrite=True)
-        except Exception:
-            pass  # marker is best-effort; worst case one extra timeout
-        # The service's timeout report names the tasks that never
-        # arrived ("Some timed out task names: .../task:N").  Parse it
-        # rather than probing live_processes(), which is itself a
-        # collective and must not be entered unilaterally from an
-        # error path.
-        missing: list[int] = []
-        m = re.search(r"timed out task names:(.*)", msg, re.S)
-        if m:
-            missing = sorted(
-                {int(t) for t in re.findall(r"task:(\d+)", m.group(1))})
-        raise PeerFailure(
-            f"barrier '{name}' timed out after {waited:.1f}s "
-            f"(deadline {timeout_s:g}s, attempt {eff}); never arrived: "
-            f"{missing or 'unknown'}: {msg}",
-            missing=missing, attempt=eff) from e
+            client.wait_at_barrier(bid, int(timeout_s * 1000))
+            return eff
+        except Exception as e:
+            # Burn-marker race: a survivor may have burned `eff` between
+            # our marker read and our arrival (we joined an
+            # already-burned id — depending on the service that surfaces
+            # as a non-deadline error or a wasted timeout).  Re-read the
+            # marker and realign ONCE at the fast-forwarded id before
+            # classifying the failure.
+            if not retried:
+                try:
+                    burned2 = _read_burn_marker(client, burn_key)
+                except Exception:
+                    burned2 = -1  # coordination layer failing: keep `e`
+                if burned2 >= eff:
+                    retried = True
+                    continue
+            if not _is_deadline_error(e):
+                raise  # not a peer failure: configuration/connection error
+            msg = str(e)
+            waited = time.monotonic() - t0
+            # burn this attempt so every side's next use aligns at eff+1
+            try:
+                client.key_value_set(burn_key, str(eff),
+                                     allow_overwrite=True)
+            except Exception:
+                pass  # marker is best-effort; worst case one extra timeout
+            # The service's timeout report names the tasks that never
+            # arrived ("Some timed out task names: .../task:N").  Parse it
+            # rather than probing live_processes(), which is itself a
+            # collective and must not be entered unilaterally from an
+            # error path.
+            missing: list[int] = []
+            m = re.search(r"timed out task names:(.*)", msg, re.S)
+            if m:
+                missing = sorted(
+                    {int(t) for t in re.findall(r"task:(\d+)", m.group(1))})
+            raise PeerFailure(
+                f"barrier '{name}' timed out after {waited:.1f}s "
+                f"(deadline {timeout_s:g}s, attempt {eff}); never arrived: "
+                f"{missing or 'unknown'}: {msg}",
+                missing=missing, attempt=eff) from e

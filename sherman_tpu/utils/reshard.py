@@ -146,6 +146,15 @@ def reshard(src: str, dst: str, machine_nr: int, *,
     rows = np.concatenate([
         n * P_old + np.arange(1, next_by_node[n], dtype=np.int64)
         for n in range(N_old)]) if N_old else np.zeros(0, np.int64)
+    # drop leased-but-never-written chunk-tail pages (W_FRONT_VER == 0,
+    # the same liveness test the leaf scan uses — every written page has a
+    # nonzero front version, layout.py:215): repacking them as occupied
+    # rows would permanently inflate live_pages and the minimum
+    # pages_per_node of every subsequent reshard.  dir_next for the new
+    # checkpoint comes from the packed counts below, so dropped rows
+    # return to the allocatable tail.
+    if rows.size:
+        rows = rows[pool[rows, C.W_FRONT_VER] != 0]
     L = rows.size
 
     # 2. new geometry + block assignment (page 0 per new node reserved)

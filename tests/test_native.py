@@ -295,3 +295,113 @@ def test_wrlock_writer_preference_and_counts():
     rw.wunlock()
     t.join(timeout=5)
     assert seen and seen[0] >= t0
+
+
+# -- BatchPrep (the fused serving-loop prep pipeline, src/prep.cc) ------------
+
+def _rebuild_keys(buf, n):
+    return ((buf.khi[:n].view(np.uint32).astype(np.uint64) << np.uint64(32))
+            | buf.klo[:n].view(np.uint32).astype(np.uint64))
+
+
+def test_prep_keys_matches_numpy_unique():
+    rng = np.random.default_rng(5)
+    keys = rng.integers(1, 5000, 100_000, dtype=np.uint64)
+    table = rng.integers(1, 1 << 20, 1 << 14, dtype=np.int64).astype(np.int32)
+    shift = 50
+    prep = native.BatchPrep(batch=100_000, capacity=8192)
+    buf = prep.run_keys(keys, prep.buffers(), table, shift=shift,
+                        default_start=3)
+    n = buf.n_uniq
+    uk = _rebuild_keys(buf, n)
+    ref = np.unique(keys)
+    assert n == ref.size
+    np.testing.assert_array_equal(np.sort(uk), ref)  # same unique SET
+    # inverse fans every client op back to its own key
+    np.testing.assert_array_equal(uk[buf.inv], keys)
+    # active exactly covers the unique prefix
+    assert buf.active[:n].all() and not buf.active[n:].any()
+    # router probe matches the host_start formula (min(key>>shift, nb-1))
+    b = np.minimum(uk >> np.uint64(shift), np.uint64(table.size - 1))
+    np.testing.assert_array_equal(buf.start[:n], table[b.astype(np.int64)])
+    # pad rows carry the default start seed
+    assert (buf.start[n:] == 3).all()
+
+
+def test_prep_overflow_raises():
+    prep = native.BatchPrep(batch=1000, capacity=8)
+    keys = np.arange(1, 1001, dtype=np.uint64)  # 1000 uniques > 8
+    with pytest.raises(native.PrepOverflow):
+        prep.run_keys(keys, prep.buffers(), None)
+
+
+def test_prep_epoch_isolation_across_batches():
+    """Batch k's dedup state must not leak into batch k+1 (epoch tags)."""
+    prep = native.BatchPrep(batch=1000, capacity=1000)
+    buf = prep.buffers()
+    a = np.arange(1, 501, dtype=np.uint64).repeat(2)
+    prep.run_keys(a, buf, None)
+    assert buf.n_uniq == 500
+    # same keys again: they must count as fresh uniques, not stale dups
+    prep.run_keys(a, buf, None)
+    assert buf.n_uniq == 500
+    np.testing.assert_array_equal(np.sort(_rebuild_keys(buf, 500)),
+                                  np.arange(1, 501, dtype=np.uint64))
+
+
+def test_prep_zipf_synthetic_mode():
+    """Synthetic rank->key mode: keys come from mix64(rank ^ salt); the
+    recorded client keys must dedup consistently and land inside the
+    synthetic keyspace."""
+    n_keys, batch, salt = 1 << 20, 65_536, 0x5E17_AB1E_5A17
+    keyspace, rank_to_key = native.synthetic_keyspace(n_keys, salt)
+    prep = native.BatchPrep(batch=batch, capacity=batch, n_keys=n_keys,
+                            theta=0.99, seed=7, salt=salt)
+    buf = prep.buffers(with_keys=True)
+    prep.run_zipf(None, buf, None, want_keys=True)
+    n = buf.n_uniq
+    assert 0 < n < batch  # zipf 0.99 must combine substantially
+    uk = _rebuild_keys(buf, n)
+    np.testing.assert_array_equal(uk[buf.inv], buf.keys)
+    # every sampled key is a member of the synthetic keyspace
+    assert np.isin(buf.keys[:1000], keyspace).all()
+    # hot head: rank 0's key must dominate any cold key's count
+    head_key = rank_to_key[0]
+    assert (buf.keys == head_key).sum() > batch // 100
+
+
+def test_prep_zipf_keyspace_gather_mode():
+    """Explicit-keyspace mode gathers keys[rank] with internal lookahead."""
+    n_keys, batch = 1 << 18, 32_768
+    rng = np.random.default_rng(2)
+    keyspace = np.sort(rng.choice(1 << 40, n_keys, replace=False)
+                       .astype(np.uint64))
+    prep = native.BatchPrep(batch=batch, capacity=batch, n_keys=n_keys,
+                            theta=0.99, seed=7)
+    buf = prep.buffers(with_keys=True)
+    prep.run_zipf(keyspace, buf, None, want_keys=True)
+    assert np.isin(buf.keys, keyspace).all()
+    uk = _rebuild_keys(buf, buf.n_uniq)
+    np.testing.assert_array_equal(uk[buf.inv], buf.keys)
+
+
+def test_prep_zipf_distribution_matches_exact_sampler():
+    """The AVX-512 fast-pow sampler must track the exact inverse-CDF:
+    compare head-rank shares against ZipfGen (std::pow) on 200k draws."""
+    n_keys, batch, salt = 1 << 22, 200_000, 0x5E17_AB1E_5A17
+    prep = native.BatchPrep(batch=batch, capacity=batch, n_keys=n_keys,
+                            theta=0.99, seed=11, salt=salt)
+    buf = prep.buffers(with_keys=True)
+    prep.run_zipf(None, buf, None, want_keys=True)
+    lut_n = 1 << 12
+    r2k = native.mix64(np.arange(lut_n, dtype=np.uint64) ^ np.uint64(salt))
+    exact = native.ZipfGen(n_keys, 0.99, seed=23).sample(batch)
+    for rank in (0, 1, 10):
+        fast_share = (buf.keys == r2k[rank]).mean()
+        exact_share = (exact == rank).mean()
+        assert abs(fast_share - exact_share) < 0.004, (
+            rank, fast_share, exact_share)
+    # share of the hot head (top 4096 ranks) within 2% absolute
+    fast_head = np.isin(buf.keys, r2k).mean()
+    exact_head = (exact < lut_n).mean()
+    assert abs(fast_head - exact_head) < 0.02, (fast_head, exact_head)

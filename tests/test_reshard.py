@@ -111,7 +111,30 @@ def test_reshard_identity_roundtrip(source, tmp_path):
 def test_reshard_too_small_rejected(source, tmp_path):
     src, _, _ = source
     with pytest.raises(ValueError, match="too small"):
-        reshard(src, str(tmp_path / "x.npz"), 2, pages_per_node=64)
+        reshard(src, str(tmp_path / "x.npz"), 2, pages_per_node=16)
+
+
+def test_reshard_drops_unwritten_lease_tails(source, tmp_path):
+    """Leased-but-never-written chunk-tail pages (front version 0) must
+    not survive the repack: live_pages counts only written pages, so
+    repeated reshards cannot compound allocator waste."""
+    src, _, _ = source
+    out = reshard(src, str(tmp_path / "packed.npz"), 4)
+    import numpy as np
+    with np.load(src) as z:
+        src_span = int(np.sum(z["dir_next"] - 1))
+    # the source allocator high-water marks include leased tails; the
+    # repack must be strictly tighter than the raw [1, dir_next) span
+    assert out["live_pages"] < src_span
+    from sherman_tpu import config as C
+    with np.load(str(tmp_path / "packed.npz")) as z:
+        pool = z["pool"]
+        nxt = z["dir_next"]
+        ppn = pool.shape[0] // 4
+        for n in range(4):
+            rows = pool[n * ppn + 1: n * ppn + int(nxt[n])]
+            assert (rows[:, C.W_FRONT_VER] != 0).all(), \
+                f"node {n} repacked an unwritten page"
 
 
 _MH_WORKER = r'''

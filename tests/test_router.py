@@ -234,3 +234,28 @@ def test_router_seed_invariant_randomized():
         seeds = r.host_start(khi, klo)
         for k, a in zip(keys.tolist(), seeds.tolist()):
             assert low_of.get(int(a), C.KEY_NEG_INF) <= int(k)
+
+
+def test_multinode_straggler_compaction_read_parity(eight_devices):
+    """The cache-hit fast path must be O(1) reads per op at ANY cluster
+    size (the reference's IndexCache.h:134-184 contract): with a warm
+    router, a 4-node mesh's read-op count for the same workload must be
+    within ~1.2x of single-node — stragglers resolve in an S-compacted
+    loop, not full-batch descent rounds."""
+    rng = np.random.default_rng(4)
+    keys = np.unique(rng.integers(1, 1 << 48, 6000, dtype=np.uint64))[:5000]
+    q = rng.choice(keys, 2048, replace=False)
+
+    reads = {}
+    for nr in (1, 4):
+        tree, eng = make(nr=nr, B=2048 // nr)
+        batched.bulk_load(tree, keys, keys * np.uint64(3))
+        eng.attach_router()
+        before = tree.dsm.counter_snapshot()["read_ops"]
+        got, found = eng.search(q)
+        assert found.all()
+        np.testing.assert_array_equal(got, q * np.uint64(3))
+        reads[nr] = tree.dsm.counter_snapshot()["read_ops"] - before
+    assert reads[4] <= reads[1] * 1.2 + 64, reads
+    # and both are ~1 read/op (cache-hit contract), not height * ops
+    assert reads[1] <= int(q.size * 1.2) + 64, reads
