@@ -350,21 +350,21 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     ``update_only`` (static) compiles the steady-state fast kernel:
     requests whose key is NOT already present report ST_FULL (escalate
     to the general kernel with grants) instead of inserting, which drops
-    the insert-rank/split machinery and shrinks the write-back to the 4
-    words an update actually changes (fver, vhi, vlo, rver) — the
-    update-heavy YCSB shape runs ~20% faster.
+    the insert-rank/split machinery and shrinks the write-back to the 3
+    words an update actually changes (packed version pair, vhi, vlo) —
+    the update-heavy YCSB shape runs ~20% faster.
 
     Mirrors ``leaf_page_store`` (Tree.cpp:828-921): in-place update of an
     existing key, or insert into a free slot, with the single-entry
-    write-back (only the touched 6-word entry + version words are
-    written).  Same-key requests are deduped (stable request order:
-    lowest (source, slot) wins) — the intra-step linearization that
-    replaces local-lock hand-over.
+    write-back (only the touched 5-word entry is written).  Same-key
+    requests are deduped (stable request order: lowest (source, slot)
+    wins) — the intra-step linearization that replaces local-lock
+    hand-over.
 
     Splits (Tree.cpp:922-963, TPU-shaped): the first overflowing insert
     winner of a page (its in-page rank equals the page's free-slot count)
     becomes the page's *splitter* and is granted a fresh page; the owner
-    sorts the 41 slots + pending entry, writes the upper half to the
+    sorts the LEAF_CAP slots + pending entry, writes the upper half to the
     fresh right sibling and rewrites the left page with fences/sibling
     updated — the B-link makes the split correct before any parent knows
     (the log lets the host insert parent entries lazily, which is why
@@ -516,12 +516,15 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
         slot = jnp.where(found, fslot, islot)
 
     # --- single-entry write-back scatter -----------------------------------
-    # one-hot extract of the slot's old fver (take_along_axis is slow on TPU)
-    fver_blk = pg[:, C.L_FVER_W:C.L_FVER_W + C.LEAF_CAP]
+    # one-hot extract of the slot's old packed version pair
+    # (take_along_axis is slow on TPU)
+    ver_blk = pg[:, C.L_VER_W:C.L_VER_W + C.LEAF_CAP]
     slot_oh = jnp.arange(C.LEAF_CAP)[None, :] == slot[:, None]
-    old_fv = jnp.sum(jnp.where(slot_oh, fver_blk, 0), axis=-1)
-    new_ver = (old_fv + 1) & 0x7FFFFFFF
+    old_fv = (jnp.sum(jnp.where(slot_oh, ver_blk, 0), axis=-1)
+              >> 16) & C.ENTRY_VER_MASK
+    new_ver = (old_fv + 1) & C.ENTRY_VER_MASK
     new_ver = jnp.where(new_ver == 0, 1, new_ver)
+    new_pair = layout.ver_pack(new_ver)
 
     # ONE fused scatter pass of exactly the entry words that change — the
     # reference single-entry write-back (Tree.cpp:914-921) writes the
@@ -529,18 +532,19 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     # rewrites (splits, internal rebuilds), not per-entry updates, and
     # the entry's own fver/rver pair carries the write's visibility.
     # Scatter cost is ~13.5 ms per word lane at 2 M rows on v5e, so lane
-    # count is the write path's #1 knob: updates touch 4 words (versions
-    # + value); inserts also write the 2 key words.
+    # count is the write path's #1 knob: the 16/16-packed version pair
+    # makes updates touch 3 words (version pair + value); inserts also
+    # write the 2 key words.
     if update_only:
-        ent = jnp.stack([new_ver, inc["vhi"], inc["vlo"], new_ver],
-                        axis=-1)                           # [M, 4]
-        field_w = jnp.asarray([C.L_FVER_W, C.L_VHI_W, C.L_VLO_W,
-                               C.L_RVER_W], jnp.int32)
+        ent = jnp.stack([new_pair, inc["vhi"], inc["vlo"]],
+                        axis=-1)                           # [M, 3]
+        field_w = jnp.asarray([C.L_VER_W, C.L_VHI_W, C.L_VLO_W],
+                              jnp.int32)
     else:
-        ent = jnp.stack([new_ver, khi, klo, inc["vhi"], inc["vlo"],
-                         new_ver], axis=-1)                # [M, 6]
-        field_w = jnp.asarray([C.L_FVER_W, C.L_KHI_W, C.L_KLO_W,
-                               C.L_VHI_W, C.L_VLO_W, C.L_RVER_W],
+        ent = jnp.stack([new_pair, khi, klo, inc["vhi"], inc["vlo"]],
+                        axis=-1)                           # [M, 5]
+        field_w = jnp.asarray([C.L_VER_W, C.L_KHI_W, C.L_KLO_W,
+                               C.L_VHI_W, C.L_VLO_W],
                               jnp.int32)
     idx = (safe_page * _PW)[:, None] + field_w[None, :] + slot[:, None]
     idx = jnp.where(applied[:, None], idx, P * _PW)
@@ -566,7 +570,7 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     u32 = lambda m: jnp.sum(m.astype(jnp.uint32))
     counters = counters.at[D.CNT_WRITE_OPS].add(u32(applied))
     counters = counters.at[D.CNT_WRITE_WORDS].add(
-        u32(applied) * jnp.uint32(4 if update_only
+        u32(applied) * jnp.uint32(3 if update_only
                                   else C.LEAF_ENTRY_WORDS))
     if fresh is not None:
         return pool, counters, status, log
@@ -589,9 +593,8 @@ def _leaf_pages(blk_khi, blk_klo, blk_vhi, blk_vlo, blk_live, ver, low_hi,
     page = page.at[:, C.W_LOW_LO].set(low_lo)
     page = page.at[:, C.W_HIGH_HI].set(high_hi)
     page = page.at[:, C.W_HIGH_LO].set(high_lo)
-    lv = blk_live.astype(jnp.int32)
-    page = page.at[:, C.L_FVER_W:C.L_FVER_W + CAP].set(lv)
-    page = page.at[:, C.L_RVER_W:C.L_RVER_W + CAP].set(lv)
+    lv = blk_live.astype(jnp.int32) * jnp.int32(layout.ver_pack(1))
+    page = page.at[:, C.L_VER_W:C.L_VER_W + CAP].set(lv)
     z = lambda b: jnp.where(blk_live, b, 0)
     page = page.at[:, C.L_KHI_W:C.L_KHI_W + CAP].set(z(blk_khi))
     page = page.at[:, C.L_KLO_W:C.L_KLO_W + CAP].set(z(blk_klo))
@@ -627,7 +630,7 @@ def _leaf_split_apply(pool, counters, inc, splitter, fidx, fresh,
     right_row = jnp.clip(bits.addr_page(new_addr), 0, P - 1)
     valid = valid & (new_addr != 0)
 
-    # sort the 41 slots + pending entry by key; dead slots sort last
+    # sort the LEAF_CAP slots + pending entry by key; dead slots sort last
     sv = layout.leaf_slots_view(spg)
     live = jnp.concatenate(
         [layout.leaf_slot_used(spg), jnp.ones((F, 1), bool)], axis=1)
@@ -808,19 +811,14 @@ def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     applied = ok_req & found
     safe_slot = jnp.clip(slot, 0, C.LEAF_CAP - 1)
 
-    # ONE fused scatter pass: zero the slot's version pair — the slot
-    # becomes free.  Like the insert write-back, page front/rear versions
-    # move only on structural rewrites (reference parity: Tree::del
-    # writes the entry, not the page header).
-    zero = jnp.zeros(M, jnp.int32)
-    vals = jnp.stack([zero, zero], axis=-1)                   # [M, 2]
-    idx = jnp.stack([
-        safe_page * _PW + C.L_FVER_W + safe_slot,
-        safe_page * _PW + C.L_RVER_W + safe_slot,
-    ], axis=-1)                                               # [M, 2]
-    idx = jnp.where(applied[:, None], idx, P * _PW)
+    # ONE scatter: zero the slot's packed version word — the slot becomes
+    # free.  Like the insert write-back, page front/rear versions move
+    # only on structural rewrites (reference parity: Tree::del writes the
+    # entry, not the page header).
+    idx = jnp.where(applied, safe_page * _PW + C.L_VER_W + safe_slot,
+                    P * _PW)
     flat = pool.reshape(-1)
-    flat = flat.at[idx.reshape(-1)].set(vals.reshape(-1), mode="drop")
+    flat = flat.at[idx].set(0, mode="drop")
     pool = flat.reshape(P, _PW)
 
     status = jnp.full(M, ST_INVALID, jnp.int32)
@@ -831,8 +829,8 @@ def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
 
     u32 = lambda m: jnp.sum(m.astype(jnp.uint32))
     counters = counters.at[D.CNT_WRITE_OPS].add(u32(applied))
-    # the slot's fver/rver pair
-    counters = counters.at[D.CNT_WRITE_WORDS].add(u32(applied) * jnp.uint32(2))
+    # the slot's packed version word
+    counters = counters.at[D.CNT_WRITE_WORDS].add(u32(applied))
     return pool, counters, status
 
 
@@ -946,7 +944,8 @@ class BatchedEngine:
     """
 
     def __init__(self, tree, batch_per_node: int = 1024,
-                 tcfg: TreeConfig | None = None):
+                 tcfg: TreeConfig | None = None,
+                 split_slots: int | None = None):
         self.tree = tree
         self.dsm = tree.dsm
         self.cfg = tree.cfg
@@ -954,10 +953,25 @@ class BatchedEngine:
         self.B = batch_per_node
         # device-split grant slots per node per insert round; unused grants
         # are cached host-side and re-offered (free() is a no-op, so
-        # abandoning them would leak pages every round)
-        self.split_slots = min(256, batch_per_node)
+        # abandoning them would leak pages every round).  The default
+        # suits steady-state workloads; split-storm drivers (fresh-key
+        # bulk insertion into a near-full tree) raise it so one round can
+        # split tens of thousands of leaves (tools/insert_bench.py).
+        self.split_slots = (min(256, batch_per_node) if split_slots is None
+                            else min(split_slots, batch_per_node))
+        # Mid-chunk parent-flush trigger: flush when the pending backlog
+        # reaches this many entries (insert() always flushes at the end
+        # regardless).  1 = every round (default, tightest chains); a
+        # split-storm driver raises it to ~split_slots — the router's
+        # note_split keeps descents short between flushes, and each flush
+        # pass costs several host round trips (expensive over an access
+        # tunnel).
+        self.parent_flush_threshold = 1
         self._fresh_cache: dict[int, list[int]] = {}
         self._pending_parents: list[tuple[int, int]] = []
+        # empty-leaf reclamation bookkeeping (reclaim_empty_leaves)
+        self._reclaim_state: dict = {"round": 0, "quarantine": [],
+                                     "pending_parent": []}
         self._parent_descend_cache: dict = {}
         self.router = None
         self._search_cache: dict = {}
@@ -1057,7 +1071,7 @@ class BatchedEngine:
         steady-state update benchmark) compile the leaner variant — the
         splitter ranking, split-page detection and split-apply machinery
         drop out of the program entirely (~30 ms/step at 2 M rows).
-        ``update_only`` additionally compiles the 4-word write-back
+        ``update_only`` additionally compiles the 3-word write-back
         steady-state kernel (absent keys escalate, see leaf_apply_spmd)."""
         assert not (update_only and with_fresh)
         key = (iters, with_start, with_fresh, update_only)
@@ -1428,34 +1442,36 @@ class BatchedEngine:
         self.flush_parents()
         return stats
 
-    def _get_parent_descend(self, iters: int):
-        fn = self._parent_descend_cache.get(iters)
+    def _get_parent_descend(self, iters: int, stop_level: int = 1):
+        key = (iters, stop_level)
+        fn = self._parent_descend_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
             sm = jax.shard_map(
                 functools.partial(descend_spmd, cfg=self.cfg, iters=iters,
-                                  stop_level=1),
+                                  stop_level=stop_level),
                 mesh=self.dsm.mesh,
                 in_specs=(spec, spec, spec, spec, rep, spec),
                 out_specs=(spec, spec, spec, spec),
                 check_vma=False)
             fn = jax.jit(sm, donate_argnums=(1,))
-            self._parent_descend_cache[iters] = fn
+            self._parent_descend_cache[key] = fn
         return fn
 
-    def _descend_level1(self, keys: np.ndarray):
-        """Batched root -> level-1 descent.  -> (addrs [n], done [n])."""
+    def _descend_to_level(self, keys: np.ndarray, level: int = 1):
+        """Batched root -> level-``level`` descent.  -> (addrs [n],
+        done [n])."""
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
         if n > total:
-            parts = [self._descend_level1(keys[i:i + total])
+            parts = [self._descend_to_level(keys[i:i + total], level)
                      for i in range(0, n, total)]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
         khi, klo = bits.keys_to_pairs(keys)
         (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
         active, _ = self._pad(np.ones(n, bool))
-        fn = self._get_parent_descend(self._iters())
+        fn = self._get_parent_descend(self._iters(), level)
         args = [self._shard(khi), self._shard(klo),
                 np.int32(self.tree._root_addr), self._shard(active)]
         with self._step_mutex:  # launch-only (prep above)
@@ -1466,13 +1482,17 @@ class BatchedEngine:
 
     def flush_parents(self) -> int:
         """Insert deferred parent entries for device-side splits — the
-        internal_page_store ascent (Tree.cpp:980-987), BATCHED: one
-        device descent to level 1 for every pending key, one step that
-        lock+reads every touched parent page (coalesced cas_read rows),
-        a host-side sorted merge, and one step writing every rebuilt
-        page together with all unlocks.  Searches are correct without
-        this — the B-link covers the new pages — it only trims sibling
-        chases.  Returns the number of entries flushed."""
+        internal_page_store ascent (Tree.cpp:980-987), BATCHED at every
+        level: per pending level, one device descent to that level, one
+        step that lock+reads every touched internal page (coalesced
+        cas_read rows), a host-side sorted merge — overflowing pages
+        split IN the batch (both halves coalesce into the write step;
+        the promoted middle entries become next attempt's pending set,
+        one level up) — and one step writing every rebuilt page together
+        with all unlocks.  Root growth is the only per-key host-path
+        remnant (once per tree level, not per entry).  Searches are
+        correct without any of this — the B-link covers the new pages —
+        it only trims sibling chases.  Returns the entries flushed."""
         import collections
         import os
         import time as _t
@@ -1481,75 +1501,139 @@ class BatchedEngine:
         total = len(self._pending_parents)
         if not total:
             return 0
-        pend = self._pending_parents
+        # legacy 2-tuples target level 1
+        pend = [t if len(t) == 3 else (t[0], t[1], 1)
+                for t in self._pending_parents]
         self._pending_parents = []
         tree, dsm = self.tree, self.dsm
-        for _attempt in range(8):
+        for _attempt in range(12):
             if not pend:
                 break
             if dbg:
                 print(f"[flush] attempt {_attempt} pend={len(pend)} "
                       f"t={_t.time():.1f}", flush=True)
             tree._refresh_root()
-            if tree._root_level < 1:
-                break  # root is a leaf: the host path grows it
-            keysu = np.array([k for k, _ in pend], np.uint64)
-            addrs, done = self._descend_level1(keysu)
+            # entries above the current root grow the tree on the host
+            # path (rare: once per new level)
+            grow = [t for t in pend if t[2] > tree._root_level]
+            pend = [t for t in pend if t[2] <= tree._root_level]
+            for k, c, lv in grow:
+                tree._insert_parent(int(k), int(c), int(lv), {})
+            if not pend:
+                continue
 
-            # lock + read every unique parent page in ONE step; two pages
-            # hashing to one lock word -> second CAS loses -> next attempt
-            uaddr = [int(a) for a in np.unique(addrs[done])]
-            rows = []
-            for a in uaddr:
-                la = tree._lock_word_addr(a)
-                rows.append({"op": D.OP_CAS, "addr": la, "woff": 0,
-                             "arg0": 0, "arg1": tree.ctx.tag,
-                             "space": D.SPACE_LOCK})
-                rows.append({"op": D.OP_READ, "addr": a})
-            rep = dsm._batch(rows)
-            pages, unlock_rows = {}, []
-            for i, a in enumerate(uaddr):
-                if bool(rep.ok[2 * i]):
-                    pages[a] = np.array(rep.data[2 * i + 1])
-                    unlock_rows.append(tree._unlock_row(
-                        tree._lock_word_addr(a)))
-
-            group = collections.defaultdict(list)
             next_pend = []
-            for (k, c), a, d in zip(pend, addrs, done):
-                if d and int(a) in pages:
-                    group[int(a)].append((int(k), int(c)))
-                else:
-                    next_pend.append((k, c))
+            for lv in sorted({t[2] for t in pend}):
+                at_lv = [t for t in pend if t[2] == lv]
+                keysu = np.array([k for k, _, _ in at_lv], np.uint64)
+                t_d0 = _t.time()
+                addrs, done = self._descend_to_level(keysu, lv)
+                t_d1 = _t.time()
 
-            write_rows, host_fb = [], []
-            for a, ents_new in group.items():
-                pg = pages[a]
-                lo, hi = layout.np_lowest(pg), layout.np_highest(pg)
-                stay = [(k, c) for k, c in ents_new if lo <= k < hi]
-                next_pend += [(k, c) for k, c in ents_new
-                              if not (lo <= k < hi)]  # fence moved: redo
-                if not stay:
-                    continue
-                ents = sorted(set(layout.np_internal_entries(pg) + stay))
-                if len(ents) > C.INTERNAL_CAP:
-                    host_fb += stay  # internal split needed: per-key path
-                    continue
-                newpg = layout.np_internal_rebuild(pg, ents, 1)
-                write_rows.append({"op": D.OP_WRITE, "addr": a, "woff": 0,
-                                   "nw": C.PAGE_WORDS, "payload": newpg})
-            if write_rows or unlock_rows:
-                dsm.write_rows(write_rows + unlock_rows)
-            if dbg:
-                print(f"[flush] wrote={len(write_rows)} host_fb={len(host_fb)} "
-                      f"next={len(next_pend)} t={_t.time():.1f}", flush=True)
-            for k, c in host_fb:
-                tree._insert_parent(k, c, 1, {})
+                # lock + read every unique target page in ONE step; two
+                # pages hashing to one lock word -> second CAS loses ->
+                # next attempt
+                uaddr = [int(a) for a in np.unique(addrs[done])]
+                rows = []
+                for a in uaddr:
+                    la = tree._lock_word_addr(a)
+                    rows.append({"op": D.OP_CAS, "addr": la, "woff": 0,
+                                 "arg0": 0, "arg1": tree.ctx.tag,
+                                 "space": D.SPACE_LOCK})
+                    rows.append({"op": D.OP_READ, "addr": a})
+                rep = dsm._batch(rows)
+                t_l1 = _t.time()
+                pages, unlock_rows = {}, []
+                for i, a in enumerate(uaddr):
+                    if bool(rep.ok[2 * i]):
+                        pages[a] = np.array(rep.data[2 * i + 1])
+                        unlock_rows.append(tree._unlock_row(
+                            tree._lock_word_addr(a)))
+
+                group = collections.defaultdict(list)
+                for (k, c, _), a, d in zip(at_lv, addrs, done):
+                    if d and int(a) in pages:
+                        group[int(a)].append((int(k), int(c)))
+                    else:
+                        next_pend.append((k, c, lv))
+
+                write_rows, host_fb = [], []
+                n_split = 0
+                for a, ents_new in group.items():
+                    pg = pages[a]
+                    lo, hi = layout.np_lowest(pg), layout.np_highest(pg)
+                    stay = [(k, c) for k, c in ents_new if lo <= k < hi]
+                    next_pend += [(k, c, lv) for k, c in ents_new
+                                  if not (lo <= k < hi)]  # fence moved
+                    if not stay:
+                        continue
+                    ents = sorted(set(layout.np_internal_entries(pg)
+                                      + stay))
+                    if len(ents) <= C.INTERNAL_CAP:
+                        newpg = layout.np_internal_rebuild(pg, ents, lv)
+                        write_rows.append({"op": D.OP_WRITE, "addr": a,
+                                           "woff": 0, "nw": C.PAGE_WORDS,
+                                           "payload": newpg})
+                        continue
+                    if len(ents) > 2 * C.INTERNAL_CAP:
+                        host_fb += stay  # needs >1 split (rare)
+                        continue
+                    # BATCHED internal split: the page is already locked,
+                    # so split it HERE and coalesce both halves into the
+                    # same write step (the old per-key fallback cost
+                    # seconds of tunnel round trips per entry under a
+                    # split storm — 398 fallbacks measured on one 131k-op
+                    # chunk).  Mirrors Tree._insert_parent_inner
+                    # (internal_page_store's split, Tree.cpp:980-987);
+                    # the promoted middle entry joins next attempt's
+                    # pending set one level up, flushed through this same
+                    # batched path.
+                    try:
+                        sib_addr = tree.ctx.alloc.alloc()
+                    except MemoryError:
+                        host_fb += stay
+                        continue
+                    m = len(ents) // 2
+                    up_key, up_child = ents[m]
+                    old_high = layout.np_highest(pg)
+                    old_sib = int(pg[C.W_SIBLING])
+                    ver = ((int(pg[C.W_FRONT_VER]) + 1) & 0x7FFFFFFF) or 1
+                    right = layout.np_empty_page(lv, up_key, old_high,
+                                                 sibling=old_sib,
+                                                 leftmost=up_child)
+                    for i, (k2, c2) in enumerate(ents[m + 1:]):
+                        layout.np_internal_set_entry(right, i, k2, c2)
+                    right[C.W_NKEYS] = len(ents) - m - 1
+                    left = layout.np_empty_page(
+                        lv, lo, up_key, sibling=sib_addr,
+                        leftmost=int(pg[C.W_LEFTMOST]), version=ver)
+                    for i, (k2, c2) in enumerate(ents[:m]):
+                        layout.np_internal_set_entry(left, i, k2, c2)
+                    left[C.W_NKEYS] = m
+                    write_rows.append({"op": D.OP_WRITE, "addr": sib_addr,
+                                       "woff": 0, "nw": C.PAGE_WORDS,
+                                       "payload": right})
+                    write_rows.append({"op": D.OP_WRITE, "addr": a,
+                                       "woff": 0, "nw": C.PAGE_WORDS,
+                                       "payload": left})
+                    next_pend.append((up_key, sib_addr, lv + 1))
+                    n_split += 1
+                t_m1 = _t.time()
+                if write_rows or unlock_rows:
+                    dsm.write_rows(write_rows + unlock_rows)
+                if dbg:
+                    print(f"[flush] lv={lv} wrote={len(write_rows)} "
+                          f"splits={n_split} host_fb={len(host_fb)} "
+                          f"descend={t_d1 - t_d0:.1f}s "
+                          f"lock={t_l1 - t_d1:.1f}s merge={t_m1 - t_l1:.1f}s "
+                          f"write={_t.time() - t_m1:.1f}s", flush=True)
+                for k, c in host_fb:
+                    tree._insert_parent(k, c, lv, {})
             pend = next_pend
         if dbg and pend:
             print(f"[flush] per-key fallback for {len(pend)}", flush=True)
-        for k, c in pend:
-            tree._insert_parent(int(k), int(c), 1, {})
+        for k, c, lv in pend:
+            tree._insert_parent(int(k), int(c), int(lv), {})
         return total
 
     def _fill_fresh(self, grant: bool) -> np.ndarray:
@@ -1588,10 +1672,11 @@ class BatchedEngine:
         for nd, lst in self._fresh_cache.items():
             self._fresh_cache[nd] = [a for a in lst if a not in consumed]
         stats["device_splits"] = stats.get("device_splits", 0) + len(sk)
+        if self.router is not None:
+            # one vectorized table update for the whole split log (the
+            # per-split path costs seconds at storm volume)
+            self.router.note_splits_batch(sk, new_addr, oh)
         for i in range(len(sk)):
-            if self.router is not None:
-                self.router.note_split(int(sk[i]), int(new_addr[i]),
-                                       int(oh[i]))
             # parent entries are deferred (flush_parents): the B-link
             # keeps the tree correct meanwhile, and retries reach the new
             # pages through the refreshed router seeds
@@ -1680,11 +1765,13 @@ class BatchedEngine:
             stats["st_locked"] += int((status == ST_LOCKED).sum())
             if log is not None:
                 self._drain_split_log(log, stats)
-            if self._pending_parents:
+            if len(self._pending_parents) >= self.parent_flush_threshold:
                 # flush between rounds: parents keep descent paths short —
                 # deferring across many split rounds can grow a B-link
                 # chain past the static descent budget, spilling the batch
-                # tail to the per-key host path
+                # tail to the per-key host path.  (With a router attached,
+                # note_split already retargets the affected buckets, so
+                # storm drivers raise the threshold and flush per chunk.)
                 self.flush_parents()
 
             stats["applied"] += int((status == ST_APPLIED).sum())
@@ -1713,6 +1800,230 @@ class BatchedEngine:
         for j in np.nonzero(pending)[0]:
             self.tree.insert(int(keys[j]), int(values[j]))
             stats["host_path"] += 1
+
+    def reclaim_empty_leaves(self, quarantine_rounds: int = 2) -> dict:
+        """Unlink EMPTY leaves from the B-link chain and recycle their
+        pages — beyond-reference: ``free()`` is a no-op in the reference
+        (``DSM.h:226``, ``LocalAllocator.h:45-47``), so delete/churn
+        workloads leak the pool dry.  Single-process meshes only (a local
+        maintenance pass; multihost reclamation would need a replicated
+        drive and is out of scope).
+
+        Protocol, per (left, empty) adjacent leaf pair:
+
+        1. one jitted pool scan finds candidates (``leaf_chain_info``):
+           an ACTIVE leaf with zero live slots whose chain predecessor
+           exists (the leftmost leaf is never reclaimed — bounded waste,
+           it is the chain's sentinel);
+        2. lock left+empty (global CAS words; a shared hash word locks
+           once), re-verify under the locks (left.sibling == empty, still
+           empty, fences abut), then ONE atomic step rewrites left's
+           header (sibling/highest bypass the empty leaf, front/rear
+           version bump — a structural rewrite) and RETIRES the empty
+           leaf: ``highest := 0`` refuses reads and writes structurally
+           (every fence check fails), and ``sibling := left`` sends stale
+           readers BACK to the absorbing leaf, which now owns the range;
+        3. the retired leaf's parent entry is removed (lock + rebuild,
+           the flush_parents merge protocol) — required before reuse: a
+           stale parent entry must keep resolving to the RETIRED page
+           (which self-heals via its back-sibling), never to a reused
+           one; pages whose parent cleanup fails stay quarantined and
+           retry on the next call;
+        4. quarantine: cleaned pages return to their node's allocator
+           free pool only after ``quarantine_rounds`` further calls — the
+           grace period for concurrent host clients still holding
+           pre-unlink addresses (steps are serialized, so in-flight
+           device work cannot straddle the boundary; the window is host
+           threads mid-descent).
+
+        Returns {"unlinked", "freed", "quarantined", "candidates"}.
+        """
+        assert self.cfg.machine_nr == 1 or not self._mh, \
+            "reclaim_empty_leaves is a single-process maintenance pass"
+        from sherman_tpu.models.validate import leaf_chain_info
+        tree, dsm = self.tree, self.dsm
+        st = self._reclaim_state
+        st["round"] += 1
+        stats = {"unlinked": 0, "freed": 0, "candidates": 0,
+                 "quarantined": len(st["quarantine"])}
+
+        addrs, lows, highs, sibs, n_live = leaf_chain_info(tree)
+        tree._refresh_root()
+        quarantined = {a for _, a in st["quarantine"]}
+        # adjacent pairs with chain continuity; greedy-alternate so a
+        # pair's left member is never itself unlinked this round
+        pairs = []
+        taken = set()
+        for i in range(1, addrs.size):
+            L, E = int(addrs[i - 1]), int(addrs[i])
+            if (n_live[i] == 0 and sibs[i - 1] == E and E not in taken
+                    and L not in taken and E not in quarantined
+                    and E != tree._root_addr):
+                pairs.append((L, E, int(lows[i]), int(highs[i])))
+                taken.add(E)
+                taken.add(L)
+        stats["candidates"] = len(pairs)
+
+        # Two host steps for ALL pairs (the flush_parents coalescing
+        # pattern — per-pair round trips would cost seconds each over an
+        # access tunnel): one step CAS-locks every pair's word(s) and
+        # reads both pages; one step writes every verified unlink plus
+        # every unlock.  Pairs sharing a lock word with an earlier pair
+        # are deferred to the next call (CAS outcomes would be ambiguous
+        # across pairs).
+        seen_words: set = set()
+        plan = []
+        for L, E, e_low, e_high in pairs:
+            la, ea = tree._lock_word_addr(L), tree._lock_word_addr(E)
+            words = (la,) if la == ea else (la, ea)
+            if any(w in seen_words for w in words):
+                continue
+            seen_words.update(words)
+            plan.append((L, E, e_low, e_high, words))
+        rows = []
+        base = {}
+        for L, E, e_low, e_high, words in plan:
+            base[E] = len(rows)
+            for w in words:
+                rows.append({"op": D.OP_CAS, "addr": w, "woff": 0,
+                             "arg0": 0, "arg1": tree.ctx.tag,
+                             "space": D.SPACE_LOCK})
+            rows.append({"op": D.OP_READ, "addr": L})
+            rows.append({"op": D.OP_READ, "addr": E})
+        rep = dsm._batch(rows) if rows else None
+        w1 = lambda a, w, v: {"op": D.OP_WRITE, "addr": a, "woff": w,
+                              "nw": 1, "payload": np.array([v], np.int32)}
+        out_rows = []
+        mapping: dict[int, int] = {}
+        for L, E, e_low, e_high, words in plan:
+            i0 = base[E]
+            got = [bool(rep.ok[i0 + j]) for j in range(len(words))]
+            held = [w for w, g in zip(words, got) if g]
+            if not all(got):
+                out_rows += [tree._unlock_row(w) for w in held]
+                continue
+            lpg = np.array(rep.data[i0 + len(words)])
+            epg = np.array(rep.data[i0 + len(words) + 1])
+            ok = (int(lpg[C.W_SIBLING]) & 0xFFFFFFFF) == (E & 0xFFFFFFFF) \
+                and layout.np_highest(lpg) == e_low \
+                and layout.np_lowest(epg) == e_low \
+                and layout.np_highest(epg) == e_high \
+                and not layout.np_leaf_entries(epg)
+            if not ok:
+                out_rows += [tree._unlock_row(w) for w in held]
+                continue
+            ver = ((int(lpg[C.W_FRONT_VER]) + 1) & 0x7FFFFFFF) or 1
+            hh, hl = bits.key_to_pair(e_high)
+            out_rows += [
+                # left absorbs the range: highest/sibling bypass E
+                w1(L, C.W_HIGH_HI, hh), w1(L, C.W_HIGH_LO, hl),
+                w1(L, C.W_SIBLING, int(epg[C.W_SIBLING])),
+                w1(L, C.W_FRONT_VER, ver), w1(L, C.W_REAR_VER, ver),
+                # E retires: highest=0 refuses every fence check; sibling
+                # points BACK at the absorber so stale readers self-heal
+                w1(E, C.W_HIGH_HI, 0), w1(E, C.W_HIGH_LO, 0),
+                w1(E, C.W_SIBLING, np.int32(np.uint32(L & 0xFFFFFFFF)
+                                            .view(np.int32))),
+            ] + [tree._unlock_row(w) for w in held]
+            st["pending_parent"].append((E, e_low, L))
+            mapping[E] = L
+            stats["unlinked"] += 1
+            if tree.index_cache is not None:
+                tree.index_cache.invalidate(e_low)
+        if out_rows:
+            dsm._batch(out_rows)
+        if mapping and self.router is not None:
+            self.router.remap_addrs(mapping)
+
+        # parent-entry removal for unlinked pages (flush-style); only
+        # cleaned pages advance to quarantine
+        if st["pending_parent"]:
+            st["pending_parent"] = self._remove_parent_entries(
+                st["pending_parent"], st)
+
+        # release quarantine
+        ready = [(r, a) for r, a in st["quarantine"]
+                 if st["round"] - r >= quarantine_rounds]
+        st["quarantine"] = [(r, a) for r, a in st["quarantine"]
+                            if st["round"] - r < quarantine_rounds]
+        by_node: dict[int, list[int]] = {}
+        for _, a in ready:
+            by_node.setdefault(bits.addr_node(a), []).append(
+                bits.addr_page(a))
+        for nd, pgs in by_node.items():
+            d = self.tree.ctx.alloc._by_node.get(nd)
+            if d is None:
+                # non-local node: keep quarantined rather than leak
+                st["quarantine"].extend((st["round"], bits.make_addr(nd, p))
+                                        for p in pgs)
+                continue
+            d.allocator.reclaim(pgs)
+            stats["freed"] += len(pgs)
+        stats["quarantined"] = len(st["quarantine"])
+        return stats
+
+    def _remove_parent_entries(self, pend, st) -> list:
+        """Remove retired pages' parent entries (lock + rebuild, the
+        flush_parents merge protocol).  Cleaned pages enter quarantine;
+        failures stay pending for the next reclaim call."""
+        tree, dsm = self.tree, self.dsm
+        tree._refresh_root()
+        if tree._root_level < 1:
+            # root is a leaf: no parents exist; straight to quarantine
+            for e, _k, _l in pend:
+                st["quarantine"].append((st["round"], e))
+            return []
+        keysu = np.array([k for _, k, _ in pend], np.uint64)
+        # descend by the retired page's OLD low fence: its parent entry
+        # (if any) lives on that path's level-1 page
+        paddrs, done = self._descend_to_level(keysu, 1)
+        group: dict[int, list[tuple[int, int, int]]] = {}
+        nxt: list = []
+        for (e, k, ab), a, d_ok in zip(pend, paddrs, done):
+            if d_ok:
+                group.setdefault(int(a), []).append((e, k, ab))
+            else:
+                nxt.append((e, k, ab))
+        for pa, items in group.items():
+            la = tree._lock_word_addr(pa)
+            rep = dsm._batch([
+                {"op": D.OP_CAS, "addr": la, "woff": 0, "arg0": 0,
+                 "arg1": tree.ctx.tag, "space": D.SPACE_LOCK},
+                {"op": D.OP_READ, "addr": pa},
+            ])
+            if not bool(rep.ok[0]):
+                nxt.extend(items)
+                continue
+            pg = np.array(rep.data[1])
+            drop = {e & 0xFFFFFFFF for e, _, _ in items}
+            absorber = {e & 0xFFFFFFFF: ab for e, _, ab in items}
+            if int(pg[C.W_LEVEL]) != 1:
+                # fence moved / wrong page: retry next round
+                dsm.write_word(la, 0, 0, space=D.SPACE_LOCK)
+                nxt.extend(items)
+                continue
+            ents = [(k, c) for k, c in layout.np_internal_entries(pg)
+                    if (c & 0xFFFFFFFF) not in drop]
+            kept = {c & 0xFFFFFFFF for _, c in ents}
+            newpg = layout.np_internal_rebuild(pg, ents, 1)
+            lm = int(pg[C.W_LEFTMOST]) & 0xFFFFFFFF
+            if lm in drop:
+                # the retired page is this parent's leftmost child: point
+                # at its absorber instead (the back-sibling target) so no
+                # reference survives into reuse
+                newpg[C.W_LEFTMOST] = np.int32(
+                    np.uint32(absorber[lm] & 0xFFFFFFFF).view(np.int32))
+            dsm._batch([
+                {"op": D.OP_WRITE, "addr": pa, "woff": 0,
+                 "nw": C.PAGE_WORDS, "payload": newpg},
+                tree._unlock_row(la),
+            ])
+            for e, k, ab in items:
+                if (e & 0xFFFFFFFF) in kept:  # entry elsewhere: retry
+                    nxt.append((e, k, ab))
+                else:
+                    st["quarantine"].append((st["round"], e))
+        return nxt
 
     def range_query(self, lo: int, hi: int) -> tuple[np.ndarray, np.ndarray]:
         """All (k, v) with lo <= k < hi, sorted.  See

@@ -121,9 +121,7 @@ class Tree:
 
     def _lock_word_addr(self, page_addr: int) -> int:
         node = bits.addr_node(page_addr)
-        idx = int(np.asarray(bits.lock_index(
-            np.int32(np.uint32(page_addr & 0xFFFFFFFF).view(np.int32)),
-            self.cfg.locks_per_node)))
+        idx = bits.lock_index_host(page_addr, self.cfg.locks_per_node)
         return bits.make_addr(node, idx)
 
     def _acquire_local(self, la: int) -> bool:
@@ -365,16 +363,13 @@ class Tree:
             if slot < 0:
                 self._unlock(la)
                 return False
-            # clear the slot's version words: fver==rver==0 marks it free
-            # (SoA layout: the six fields live in separate blocks, but only
-            # the version pair decides liveness)
-            wf, _, _, _, _, wr = layout.leaf_slot_words(slot)
-            zero = np.zeros(1, np.int32)
+            # clear the slot's packed version word: fver==rver==0 marks it
+            # free (SoA layout: the five fields live in separate blocks,
+            # but only the version pair decides liveness)
+            wv = layout.leaf_slot_words(slot)[0]
             self._write_and_unlock([
-                {"op": D.OP_WRITE, "addr": addr, "woff": wf, "nw": 1,
-                 "payload": zero},
-                {"op": D.OP_WRITE, "addr": addr, "woff": wr, "nw": 1,
-                 "payload": zero},
+                {"op": D.OP_WRITE, "addr": addr, "woff": wv, "nw": 1,
+                 "payload": np.zeros(1, np.int32)},
             ], la)
             return True
 
@@ -411,10 +406,11 @@ class Tree:
             # in-place update / free-slot insert: write ONE entry + unlock
             # in one step (single-entry write-back, Tree.cpp:914-921).
             words = layout.leaf_slot_words(slot)
-            ver = (int(pg[words[0]]) + 1) & 0x7FFFFFFF or 1
+            old_fv = (int(pg[words[0]]) >> 16) & C.ENTRY_VER_MASK
+            ver = (old_fv + 1) & C.ENTRY_VER_MASK or 1
             khi_, klo_ = bits.key_to_pair(key)
             vhi_, vlo_ = bits.key_to_pair(value)
-            vals = (ver, khi_, klo_, vhi_, vlo_, ver)
+            vals = (layout.ver_pack_np(ver), khi_, klo_, vhi_, vlo_)
             rows = [
                 {"op": D.OP_WRITE, "addr": addr, "woff": w, "nw": 1,
                  "payload": np.array([v], np.int32)}

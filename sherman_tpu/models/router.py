@@ -170,6 +170,62 @@ class LeafRouter:
                 self.table_np[b_lo:b_hi] = np.int32(new_addr)
             self.splits_noted += 1
 
+    def note_splits_batch(self, split_keys, new_addrs, old_highs) -> None:
+        """Vectorized :meth:`note_split` for a whole device split log —
+        the per-split python path costs ~0.1 ms each, which at a
+        100k-split storm round is seconds of pure table maintenance.
+        Splits touch disjoint bucket ranges (distinct leaves), so order
+        is irrelevant; out-of-span splits grow the span first (rare)."""
+        sk = np.asarray(split_keys, np.uint64)
+        na = np.asarray(new_addrs, np.int64)
+        oh = np.asarray(old_highs, np.uint64)
+        if not sk.size:
+            return
+        with self._write_locked():
+            mx = int(sk.max())
+            if (mx >> self.shift) >= self.nb:
+                self._grow_span(mx)
+            # overflow-safe ceil-div (keys span the full uint64 range, so
+            # the scalar path's `(k + 2^shift - 1) >> shift` form would
+            # WRAP here and repoint unrelated buckets)
+            sh = np.uint64(self.shift)
+            frac = np.uint64((1 << self.shift) - 1)
+            b_lo = ((sk >> sh) + ((sk & frac) != 0)).astype(np.int64)
+            b_hi = np.where(oh >= np.uint64(C.KEY_POS_INF), self.nb,
+                            np.minimum((oh >> sh) + ((oh & frac) != 0),
+                                       self.nb)).astype(np.int64)
+            b_lo = np.minimum(b_lo, self.nb)
+            n = np.maximum(b_hi - b_lo, 0)
+            tgt = np.repeat(na.astype(np.int32), n)
+            idx = (np.repeat(b_lo, n)
+                   + (np.arange(tgt.size) - np.repeat(np.cumsum(n) - n, n)))
+            self.table_np[idx] = tgt
+            self.splits_noted += int(sk.size)
+
+    def remap_addrs(self, old_to_new: dict[int, int]) -> None:
+        """Repoint every bucket seeded at a reclaimed page to its
+        absorber (reclaim_empty_leaves maintenance).  The absorber's
+        ``lowest`` fence is <= every key of the remapped buckets (it
+        absorbed exactly that range), preserving the router invariant.
+        ONE vectorized pass over the table regardless of entry count
+        (a per-entry scan would be O(entries x table) under the write
+        lock — minutes at a 2^26-bucket table and thousands of
+        reclaimed leaves)."""
+        if not old_to_new:
+            return
+        to_i32 = lambda v: np.uint32(v & 0xFFFFFFFF).astype(np.uint32) \
+            .view(np.int32)
+        olds = np.array([int(o) for o in old_to_new], np.uint64)
+        news = np.array([int(n) for n in old_to_new.values()], np.uint64)
+        o32, n32 = to_i32(olds), to_i32(news)
+        order = np.argsort(o32)
+        o32, n32 = o32[order], n32[order]
+        with self._write_locked():
+            pos = np.searchsorted(o32, self.table_np)
+            pos_c = np.minimum(pos, o32.size - 1)
+            hit = o32[pos_c] == self.table_np
+            self.table_np[hit] = n32[pos_c[hit]]
+
     # -- host-side lookup (the CN cache probe, Tree.cpp:415-427) -------------
 
     def host_start(self, khi: np.ndarray, klo: np.ndarray) -> np.ndarray:

@@ -47,7 +47,7 @@ import jax
 import numpy as np
 
 from sherman_tpu import config as C
-from sherman_tpu.ops import bits
+from sherman_tpu.ops import bits, layout
 
 _STATS = ("keys", "leaves", "internal_pages", "retired", "bad_version",
           "bad_fence", "bad_leaf_slot", "bad_internal_order",
@@ -88,8 +88,7 @@ def _validate_kernel(pool, next_by_node, P: int, N: int):
 
     # -- 2. leaf slots inside fences + key count -----------------------------
     LC = C.LEAF_CAP
-    sfv = pool[:, C.L_FVER_W:C.L_FVER_W + LC]
-    srv = pool[:, C.L_RVER_W:C.L_RVER_W + LC]
+    sfv, srv = layout.ver_unpack(pool[:, C.L_VER_W:C.L_VER_W + LC])
     skh = pool[:, C.L_KHI_W:C.L_KHI_W + LC]
     skl = pool[:, C.L_KLO_W:C.L_KLO_W + LC]
     s_live = (sfv == srv) & (sfv != 0)
@@ -217,6 +216,48 @@ def leaf_directory(tree) -> tuple[np.ndarray, np.ndarray]:
     lows = bits.pairs_to_keys(lh[rows], ll[rows])
     order = np.argsort(lows)
     return addrs[order], lows[order]
+
+
+@functools.partial(jax.jit, static_argnames=("P", "N"))
+def _leaf_chain_kernel(pool, next_by_node, P: int, N: int):
+    import jax.numpy as jnp
+
+    ridx = jnp.arange(N * P, dtype=jnp.int32)
+    pg_i = ridx % P
+    allocated = (pg_i >= 1) & (pg_i < next_by_node[ridx // P])
+    fv = pool[:, C.W_FRONT_VER]
+    hi_hi, hi_lo = pool[:, C.W_HIGH_HI], pool[:, C.W_HIGH_LO]
+    act = allocated & (fv != 0) & ~((hi_hi == 0) & (hi_lo == 0))
+    leaf = act & (pool[:, C.W_LEVEL] == 0)
+    n_live = jnp.sum(layout.leaf_slot_used(pool), axis=-1)
+    return (leaf, pool[:, C.W_LOW_HI], pool[:, C.W_LOW_LO], hi_hi, hi_lo,
+            pool[:, C.W_SIBLING], n_live.astype(jnp.int32))
+
+
+def leaf_chain_info(tree):
+    """One jitted scan over the pool: every ACTIVE leaf's (addr, low,
+    high, sibling, n_live), sorted by low — the reclaim scanner's view of
+    the B-link chain (single-process meshes; reclamation is a local
+    maintenance pass)."""
+    import jax.numpy as jnp
+
+    cfg = tree.dsm.cfg
+    nxt = np.ones(cfg.machine_nr, np.int64)
+    for d in tree.cluster.directories:
+        nxt[d.node_id] = d.allocator.pages_used
+    leaf, lh, ll, hh, hl, sib, nl = (np.asarray(x) for x in
+                                     _leaf_chain_kernel(
+        tree.dsm.pool, jnp.asarray(nxt, jnp.int32),
+        P=cfg.pages_per_node, N=cfg.machine_nr))
+    rows = np.nonzero(leaf)[0]
+    P = cfg.pages_per_node
+    addrs = ((rows // P).astype(np.int64) << C.ADDR_PAGE_BITS) | (rows % P)
+    lows = bits.pairs_to_keys(lh[rows], ll[rows])
+    highs = bits.pairs_to_keys(hh[rows], hl[rows])
+    order = np.argsort(lows)
+    return (addrs[order], lows[order], highs[order],
+            sib[rows][order].astype(np.int64) & 0xFFFFFFFF,
+            nl[rows][order])
 
 
 def check_structure_device(tree) -> dict:

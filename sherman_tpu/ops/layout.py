@@ -112,21 +112,44 @@ def _lf(page, start):
     return page[..., start:start + C.LEAF_CAP]
 
 
+def ver_unpack(v):
+    """Packed entry version word -> (fver, rver); works for jnp and np."""
+    return (v >> 16) & C.ENTRY_VER_MASK, v & C.ENTRY_VER_MASK
+
+
+def ver_pack(x):
+    """Consistent entry version pair from one 16-bit value.  (jnp int32
+    shifts wrap two's-complement, so device use is bit-exact; host code
+    building np.int32 words must go through :func:`ver_pack_np`.)"""
+    return (x << 16) | x
+
+
+def ver_pack_np(x) -> np.int32:
+    """Host scalar packer: the int32 BIT PATTERN of (x << 16) | x."""
+    p = ver_pack(int(x) & C.ENTRY_VER_MASK) & 0xFFFFFFFF
+    return np.int32(p - (1 << 32) if p >= (1 << 31) else p)
+
+
 def leaf_slots_view(page):
-    """-> dict of [..., LEAF_CAP] arrays: fver, khi, klo, vhi, vlo, rver."""
+    """-> dict of [..., LEAF_CAP] arrays: ver (packed pair), khi, klo,
+    vhi, vlo, plus derived fver/rver halves."""
+    ver = _lf(page, C.L_VER_W)
+    fv, rv = ver_unpack(ver)
     return {
-        "fver": _lf(page, C.L_FVER_W),
+        "ver": ver,
+        "fver": fv,
+        "rver": rv,
         "khi": _lf(page, C.L_KHI_W),
         "klo": _lf(page, C.L_KLO_W),
         "vhi": _lf(page, C.L_VHI_W),
         "vlo": _lf(page, C.L_VLO_W),
-        "rver": _lf(page, C.L_RVER_W),
     }
 
 
 def leaf_slot_used(page):
-    """A slot is live iff fver == rver != 0 (two-level version rule)."""
-    fv, rv = _lf(page, C.L_FVER_W), _lf(page, C.L_RVER_W)
+    """A slot is live iff fver == rver != 0 (two-level version rule,
+    on the packed pair)."""
+    fv, rv = ver_unpack(_lf(page, C.L_VER_W))
     return (fv == rv) & (fv != 0)
 
 
@@ -187,19 +210,18 @@ def np_empty_page(level: int, lowest: int, highest: int,
 
 
 def leaf_slot_words(slot):
-    """Word offsets of one leaf slot's six fields (SoA blocks):
-    (fver, khi, klo, vhi, vlo, rver)."""
-    return (C.L_FVER_W + slot, C.L_KHI_W + slot, C.L_KLO_W + slot,
-            C.L_VHI_W + slot, C.L_VLO_W + slot, C.L_RVER_W + slot)
+    """Word offsets of one leaf slot's five fields (SoA blocks):
+    (ver, khi, klo, vhi, vlo) — ver holds the packed fver/rver pair."""
+    return (C.L_VER_W + slot, C.L_KHI_W + slot, C.L_KLO_W + slot,
+            C.L_VHI_W + slot, C.L_VLO_W + slot)
 
 
 def np_leaf_set_entry(pg: np.ndarray, slot: int, key: int, value: int,
                       ver: int = 1) -> None:
-    wf, wkh, wkl, wvh, wvl, wr = leaf_slot_words(slot)
-    pg[wf] = ver
+    wv, wkh, wkl, wvh, wvl = leaf_slot_words(slot)
+    pg[wv] = ver_pack_np(ver)
     pg[wkh], pg[wkl] = bits.key_to_pair(key)
     pg[wvh], pg[wvl] = bits.key_to_pair(value)
-    pg[wr] = ver
 
 
 def np_leaf_clear_entry(pg: np.ndarray, slot: int) -> None:
@@ -228,10 +250,10 @@ def np_internal_set_entry(pg: np.ndarray, slot: int, key: int, child: int) -> No
 
 
 def np_slot_live(pg: np.ndarray, slot: int) -> bool:
-    """Host-side two-level version liveness rule: fver == rver != 0.
-    (Single source of truth for host code; `leaf_slot_used` is the
-    vectorized device twin.)"""
-    fv, rv = pg[C.L_FVER_W + slot], pg[C.L_RVER_W + slot]
+    """Host-side two-level version liveness rule: fver == rver != 0 on
+    the packed pair.  (Single source of truth for host code;
+    `leaf_slot_used` is the vectorized device twin.)"""
+    fv, rv = ver_unpack(int(pg[C.L_VER_W + slot]) & 0xFFFFFFFF)
     return bool(fv == rv and fv != 0)
 
 
@@ -252,8 +274,8 @@ def np_leaf_entries_batch(pages: np.ndarray):
 
     Returns (keys u64 [W, CAP], vals u64 [W, CAP], live bool [W, CAP]).
     """
-    fv = pages[:, C.L_FVER_W:C.L_FVER_W + C.LEAF_CAP]
-    rv = pages[:, C.L_RVER_W:C.L_RVER_W + C.LEAF_CAP]
+    fv, rv = ver_unpack(
+        pages[:, C.L_VER_W:C.L_VER_W + C.LEAF_CAP].view(np.uint32))
     live = (fv == rv) & (fv != 0)
     keys = bits.pairs_to_keys(pages[:, C.L_KHI_W:C.L_KHI_W + C.LEAF_CAP],
                               pages[:, C.L_KLO_W:C.L_KLO_W + C.LEAF_CAP])

@@ -40,6 +40,11 @@ class GlobalAllocator:
         self.chunk_pages = chunk_pages
         self._next = reserved
         self._limit = pages_per_node
+        # Reclaimed single pages (beyond-reference: the reference's free()
+        # is a no-op, DSM.h:226).  Fed by the engine's quarantined
+        # empty-leaf reclaim (BatchedEngine.reclaim_empty_leaves); served
+        # before fresh bump space for page-grain allocations.
+        self._free: list[int] = []
         # Concurrent host clients (the reference's 26-thread axis) lease
         # chunks from shared directories; the bump must be atomic or two
         # clients get the same chunk (silent page aliasing).
@@ -60,6 +65,29 @@ class GlobalAllocator:
             start = self._next
             self._next += size
             return start, size
+
+    def reclaim(self, pages) -> None:
+        """Return page indices to this node's free pool.  Callers own the
+        safety argument (quarantine): a returned page must be unreachable
+        from the tree AND past any stale reader's grace period."""
+        with self._mu:
+            self._free.extend(int(p) for p in pages)
+
+    def pop_free_page(self) -> int:
+        """-> one reclaimed page index, or -1 when the free pool is empty."""
+        with self._mu:
+            return self._free.pop() if self._free else -1
+
+    @property
+    def pages_free(self) -> int:
+        with self._mu:
+            return len(self._free)
+
+    @property
+    def free_pages_list(self) -> list[int]:
+        """Snapshot of the reclaimed-page pool (checkpoint manifest)."""
+        with self._mu:
+            return list(self._free)
 
     @property
     def pages_used(self) -> int:
@@ -124,9 +152,15 @@ class LocalAllocator:
         """Allocate npages *contiguous* pages; -> packed addr of the first.
 
         Target node round-robins per call unless pinned (DSM.h:200-203).
+        Page-grain allocations are served from the node's reclaimed-page
+        pool first (beyond-reference; empty when reclamation is unused).
         """
         d = self._pick(node)
         nid = d.node_id
+        if npages == 1:
+            pg = d.allocator.pop_free_page()
+            if pg >= 0:
+                return bits.make_addr(nid, pg)
         nxt, end = self._cur.get(nid, (0, 0))
         if nxt + npages > end:
             base_addr, chunk_pages = d.malloc_chunk()

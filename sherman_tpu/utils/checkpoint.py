@@ -29,11 +29,38 @@ import os
 
 import numpy as np
 
+from sherman_tpu import config as _C
 from sherman_tpu.config import DSMConfig
 
 _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
                "step_capacity", "host_step_capacity", "chunk_pages",
                "exchange_impl")
+
+# Page-layout fingerprint stamped into every checkpoint: the pool is raw
+# words, so restoring across a layout change (e.g. round 4's packed
+# 16/16 entry version pair, 41 -> 49 leaf slots) would silently
+# misinterpret every page.  Missing tag = pre-stamp checkpoint, also
+# rejected.
+LAYOUT_TAG = (f"pw{_C.PAGE_WORDS}"
+              f"+leaf{_C.LEAF_ENTRY_WORDS}x{_C.LEAF_CAP}"
+              f"+int{_C.INTERNAL_ENTRY_WORDS}x{_C.INTERNAL_CAP}")
+
+
+def cfg_to_json(cfg) -> bytes:
+    d = {f: getattr(cfg, f) for f in _CFG_FIELDS}
+    d["_layout"] = LAYOUT_TAG
+    return json.dumps(d).encode()
+
+
+def cfg_from_json(raw) -> DSMConfig:
+    d = json.loads(bytes(raw).decode())
+    tag = d.pop("_layout", None)
+    if tag != LAYOUT_TAG:
+        raise RuntimeError(
+            f"checkpoint page layout {tag or 'unstamped'!r} does not match "
+            f"this build's {LAYOUT_TAG!r}; re-create the checkpoint (raw "
+            "page words cannot be reinterpreted across layouts)")
+    return DSMConfig(**d)
 
 
 def _local_block(arr) -> np.ndarray:
@@ -155,15 +182,23 @@ def _savez_atomic(path: str, tag: int, **arrays) -> None:
 
 # The manifest schema (one source of truth: _manifest() must emit exactly
 # these keys; _restore_multihost materializes exactly these + extras).
-_MANIFEST_FIELDS = ("cfg", "dir_nodes", "dir_next", "dir_root")
+_MANIFEST_FIELDS = ("cfg", "dir_nodes", "dir_next", "dir_root", "dir_free")
 
 
 def _manifest(cluster) -> dict:
     """Config + directory/allocator state — the part of a checkpoint that
-    is host-independent (mirrored on every process in multi-host)."""
-    cfg = {f: getattr(cluster.cfg, f) for f in _CFG_FIELDS}
+    is host-independent (mirrored on every process in multi-host).
+    ``dir_free`` carries each allocator's reclaimed-page pool as packed
+    addresses (reclaim_empty_leaves output): those pages sit below the
+    bump high-water mark with nonzero versions, so without this field a
+    restore would permanently re-leak everything reclamation freed."""
+    from sherman_tpu.ops import bits as _bits
+    free = []
+    for d in cluster.directories:
+        free += [_bits.make_addr(d.node_id, p) & 0xFFFFFFFF
+                 for p in d.allocator.free_pages_list]
     out = dict(
-        cfg=np.frombuffer(json.dumps(cfg).encode(), np.uint8),
+        cfg=np.frombuffer(cfg_to_json(cluster.cfg), np.uint8),
         dir_nodes=np.asarray([d.node_id for d in cluster.directories],
                              np.int64),
         dir_next=np.asarray(
@@ -171,6 +206,7 @@ def _manifest(cluster) -> dict:
         dir_root=np.asarray(
             [[d.root_ptr, d.root_level] for d in cluster.directories],
             np.int64),
+        dir_free=np.asarray(sorted(free), np.int64),
     )
     assert set(out) == set(_MANIFEST_FIELDS)
     return out
@@ -189,7 +225,7 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
         with failure.Watchdog.maybe(what="collective checkpoint restore"):
             return _restore_multihost(path, mesh, keeper, clear_locks)
     with np.load(path) as z:
-        cfg = DSMConfig(**json.loads(bytes(z["cfg"]).decode()))
+        cfg = cfg_from_json(z["cfg"])
         saved_mh = int(z["multihost"][0]) if "multihost" in z else 0
         if saved_mh != 0:  # durability check: must survive python -O
             raise RuntimeError(
@@ -208,7 +244,13 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
 
 
 def _restore_directories(cluster, man) -> None:
+    from sherman_tpu.ops import bits as _bits
     by_node = {int(n): i for i, n in enumerate(man["dir_nodes"])}
+    free_by_node: dict[int, list[int]] = {}
+    if "dir_free" in man:
+        for a in np.asarray(man["dir_free"]).tolist():
+            free_by_node.setdefault(_bits.addr_node(int(a)), []).append(
+                _bits.addr_page(int(a)))
     for d in cluster.directories:
         i = by_node.get(d.node_id)
         if i is None:
@@ -216,6 +258,8 @@ def _restore_directories(cluster, man) -> None:
         d.allocator._next = int(man["dir_next"][i])
         d.root_ptr = int(man["dir_root"][i][0])
         d.root_level = int(man["dir_root"][i][1])
+        if free_by_node.get(d.node_id):
+            d.allocator.reclaim(free_by_node[d.node_id])
 
 
 def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
@@ -285,7 +329,7 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
             "mid-checkpoint?): refusing to mix")
 
     # all hosts validated: collectives are now safe
-    cfg = DSMConfig(**json.loads(bytes(man["cfg"]).decode()))
+    cfg = cfg_from_json(man["cfg"])
     cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
     dsm = cluster.dsm
     nodes_ok = int(list(shard["nodes"]) == list(dsm.local_nodes))

@@ -33,7 +33,6 @@ CLI: ``python tools/reshard.py <src> <dst> --nodes M [--hosts H]``.
 
 from __future__ import annotations
 
-import json
 import os
 
 import numpy as np
@@ -42,7 +41,8 @@ from sherman_tpu import config as C
 from sherman_tpu.config import DSMConfig
 from sherman_tpu.parallel.dsm import N_COUNTERS
 from sherman_tpu.utils.checkpoint import (_CFG_FIELDS, _MANIFEST_FIELDS,
-                                          _savez_atomic, make_epoch)
+                                          _savez_atomic, cfg_from_json,
+                                          cfg_to_json, make_epoch)
 
 _PTR_HEADER_WORDS = (C.W_LEFTMOST, C.W_SIBLING)
 
@@ -129,8 +129,8 @@ def reshard(src: str, dst: str, machine_nr: int, *,
     one process per host).  The source may be either format.
     """
     man, pool, locks, counters = _load_checkpoint(src)
-    cfg_dict = json.loads(bytes(man["cfg"]).decode())
-    old_cfg = DSMConfig(**cfg_dict)
+    old_cfg = cfg_from_json(man["cfg"])  # raises on layout mismatch
+    cfg_dict = {f: getattr(old_cfg, f) for f in _CFG_FIELDS}
     N_old, P_old = old_cfg.machine_nr, old_cfg.pages_per_node
     if pool.shape != (N_old * P_old, C.PAGE_WORDS):
         raise RuntimeError(f"pool shape {pool.shape} does not match the "
@@ -155,6 +155,14 @@ def reshard(src: str, dst: str, machine_nr: int, *,
     # return to the allocatable tail.
     if rows.size:
         rows = rows[pool[rows, C.W_FRONT_VER] != 0]
+    # also drop the reclaimed-page free pool (dir_free): those pages have
+    # nonzero versions but are unreachable from the tree; repacking them
+    # would resurrect them as permanent dead weight
+    if rows.size and "dir_free" in man and np.asarray(man["dir_free"]).size:
+        fa = np.asarray(man["dir_free"]).astype(np.int64)
+        fnode = (fa >> C.ADDR_PAGE_BITS) & 0xFF
+        fpage = fa & C.ADDR_PAGE_MASK
+        rows = rows[~np.isin(rows, fnode * P_old + fpage)]
     L = rows.size
 
     # 2. new geometry + block assignment (page 0 per new node reserved)
@@ -214,12 +222,14 @@ def reshard(src: str, dst: str, machine_nr: int, *,
 
     counts = np.bincount(new_node, minlength=machine_nr) if L else \
         np.zeros(machine_nr, np.int64)
-    cfg_json = {f: getattr(new_cfg, f) for f in _CFG_FIELDS}
     new_man = dict(
-        cfg=np.frombuffer(json.dumps(cfg_json).encode(), np.uint8),
+        cfg=np.frombuffer(cfg_to_json(new_cfg), np.uint8),
         dir_nodes=np.arange(machine_nr, dtype=np.int64),
         dir_next=(counts + 1).astype(np.int64),
         dir_root=np.asarray([[new_root, root_level]] * machine_nr, np.int64),
+        # the repack compacts live pages contiguously: the old free pool
+        # is simply not carried (its space returns to the bump tail)
+        dir_free=np.zeros(0, np.int64),
     )
     assert set(new_man) == set(_MANIFEST_FIELDS)
 

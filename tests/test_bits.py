@@ -57,3 +57,16 @@ def test_lock_index_range():
     assert (li >= 0).all() and (li < 16384).all()
     # decently spread
     assert len(np.unique(li)) > 900
+
+
+def test_lock_index_host_matches_device():
+    """The host scalar lock hash must be bit-exact with the jnp one — a
+    mismatch would lock DIFFERENT words on the two paths (silent mutual
+    exclusion failure between host clients and device steps)."""
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    addrs = rng.integers(0, 1 << 32, 500, dtype=np.uint64).astype(np.uint32)
+    dev = np.asarray(bits.lock_index(addrs.view(np.int32), 65536))
+    for a, d in zip(addrs.tolist(), dev.tolist()):
+        assert bits.lock_index_host(a, 65536) == d

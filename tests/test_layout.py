@@ -97,7 +97,29 @@ def test_fence_checks():
 
 def test_capacities():
     assert C.INTERNAL_CAP == 82
-    assert C.LEAF_CAP == 41
+    assert C.LEAF_CAP == 49  # 5 words/slot: packed 16/16 entry version pair
     # last entry words must fit before rear version word
     assert C.W_ENTRIES + C.INTERNAL_CAP * C.INTERNAL_ENTRY_WORDS <= C.W_REAR_VER
     assert C.W_ENTRIES + C.LEAF_CAP * C.LEAF_ENTRY_WORDS <= C.W_REAR_VER
+
+
+def test_packed_entry_version_pair():
+    """The 16/16 version pack round-trips, wraps past 16 bits, and the
+    liveness rule reads the halves (a torn pair is dead)."""
+    assert int(layout.ver_pack_np(1)) == 0x00010001
+    assert int(layout.ver_pack_np(0xFFFF)) == np.int32(
+        np.uint32(0xFFFFFFFF).view(np.int32))
+    fv, rv = layout.ver_unpack(int(layout.ver_pack(0x8001)) & 0xFFFFFFFF)
+    assert fv == rv == 0x8001
+    pg = layout.np_empty_page(0, 0, 1 << 40)
+    layout.np_leaf_set_entry(pg, 3, 77, 99, ver=0x9AB3)
+    assert layout.np_slot_live(pg, 3)
+    assert layout.np_leaf_find(pg, 77) == (3, 99)
+    # torn pair (halves differ) -> dead
+    pg[C.L_VER_W + 3] = np.int32(0x00020001)
+    assert not layout.np_slot_live(pg, 3)
+    # device twin agrees
+    j = jnp.asarray(pg)
+    assert not bool(layout.leaf_slot_used(j)[3])
+    layout.np_leaf_clear_entry(pg, 3)
+    assert not layout.np_slot_live(pg, 3)

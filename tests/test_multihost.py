@@ -110,9 +110,9 @@ tree = Tree(cluster)
 eng = batched.BatchedEngine(tree, batch_per_node=32)
 
 rng = np.random.default_rng(7)
-keys = np.unique(rng.integers(1, 1 << 48, 800, dtype=np.uint64))[:700]
+keys = np.unique(rng.integers(1, 1 << 48, 1700, dtype=np.uint64))[:1500]
 vals = keys * np.uint64(3)
-bulk, rest = keys[:400], keys[400:]
+bulk, rest = keys[:1100], keys[1100:]
 
 # bulk load on the shared tree; cross-host MALLOC: the mirrored
 # round-robin allocators must spread leaves over ALL nodes (DSM::alloc

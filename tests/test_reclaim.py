@@ -1,0 +1,170 @@
+"""Empty-leaf reclamation (BatchedEngine.reclaim_empty_leaves).
+
+Beyond-reference: the reference's ``free()`` is a no-op (``DSM.h:226``),
+so a churn workload with keyspace drift (delete a window of old keys,
+insert a window of new ones) leaks leaf pages until the pool is dry.
+These tests prove the reclaim pass (1) unlinks empty leaves correctly —
+every surviving key readable, structure valid, retired pages
+self-healing for stale readers — and (2) actually bounds the pool: a
+drifting churn that exhausts the pool without reclamation runs
+indefinitely with it.
+"""
+
+import numpy as np
+import pytest
+
+from sherman_tpu import config as C
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+
+
+def make(pages=2048, chunk_pages=32, B=512):
+    cfg = DSMConfig(machine_nr=1, pages_per_node=pages, locks_per_node=512,
+                    step_capacity=B, chunk_pages=chunk_pages)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B)
+    return cluster, tree, eng
+
+
+def test_reclaim_unlink_correctness(eight_devices):
+    """Delete a contiguous key band -> its leaves empty -> reclaim must
+    unlink them, keep every surviving key, and pass structure checks."""
+    cluster, tree, eng = make()
+    keys = np.arange(1, 4001, dtype=np.uint64) * np.uint64(7)
+    batched.bulk_load(tree, keys, keys + np.uint64(1), fill=0.9)
+    eng.attach_router()
+    # kill two bands -> several wholly-empty leaves each
+    dead = keys[(keys > 700) & (keys < 2100) | (keys > 20000) & (keys < 23000)]
+    eng.delete(dead)
+    st1 = eng.reclaim_empty_leaves()
+    assert st1["unlinked"] > 0, st1
+    kept = np.setdiff1d(keys, dead)
+    got, found = eng.search(kept)
+    assert found.all()
+    np.testing.assert_array_equal(got, kept + np.uint64(1))
+    # deleted keys must stay gone (and descend through the rewritten
+    # chain without tripping)
+    _, f2 = eng.search(dead[:500])
+    assert not f2.any()
+    info = tree.check_structure()
+    assert info["keys"] == kept.size
+    # range scan across the unlinked region traverses the bypass links
+    lo, hi = 1, 30000
+    ks, _ = eng.range_query(lo, hi)
+    exp = kept[(kept >= lo) & (kept < hi)]
+    np.testing.assert_array_equal(np.sort(ks), exp)
+    # quarantined pages become allocatable after the grace rounds
+    st2 = eng.reclaim_empty_leaves()
+    st3 = eng.reclaim_empty_leaves()
+    freed = st1["freed"] + st2["freed"] + st3["freed"]
+    assert freed >= st1["unlinked"], (st1, st2, st3)
+
+
+def test_reclaim_stale_router_seed_self_heals(eight_devices):
+    """A router still seeding a RETIRED page must self-heal: the retired
+    page's back-sibling sends the reader to the absorber."""
+    cluster, tree, eng = make()
+    keys = np.arange(1, 3001, dtype=np.uint64) * np.uint64(5)
+    batched.bulk_load(tree, keys, keys, fill=0.9)
+    eng.attach_router()
+    dead = keys[(keys > 4000) & (keys < 7000)]
+    eng.delete(dead)
+    stale_table = tree.router.table_np.copy()  # pre-reclaim seeds
+    st = eng.reclaim_empty_leaves()
+    assert st["unlinked"] > 0
+    # force the stale seeds back in (a concurrent client's view)
+    with tree.router._write_locked():
+        tree.router.table_np = stale_table
+    kept = np.setdiff1d(keys, dead)
+    got, found = eng.search(kept)
+    assert found.all(), "stale seeds at retired pages must self-heal"
+    np.testing.assert_array_equal(got, kept)
+
+
+@pytest.mark.slow
+def test_reclaim_bounds_drifting_churn(eight_devices):
+    """Keyspace-drift churn on a bounded pool: without reclaim the pool
+    exhausts; with periodic reclaim it runs 3x past that point."""
+    window = 1500
+    step = 500
+
+    def churn(eng, reclaim: bool, iters: int):
+        lo = 0
+        base = np.arange(1, window + 1, dtype=np.uint64) * np.uint64(11)
+        batched.bulk_load(eng.tree, base, base, fill=0.9)
+        eng.attach_router()
+        for it in range(iters):
+            fresh = (np.arange(1, step + 1, dtype=np.uint64)
+                     + np.uint64(window + lo)) * np.uint64(11)
+            eng.insert(fresh, fresh)
+            old = (np.arange(1, step + 1, dtype=np.uint64)
+                   + np.uint64(lo)) * np.uint64(11)
+            eng.delete(old)
+            lo += step
+            if reclaim and it % 2 == 1:
+                eng.reclaim_empty_leaves()
+        return lo
+
+    # control: find the no-reclaim exhaustion point on this pool
+    cluster, tree, eng = make(pages=1024, chunk_pages=16)
+    with pytest.raises(MemoryError):
+        churn(eng, reclaim=False, iters=200)
+
+    # with reclaim: the same pool survives the full 200 iterations and
+    # the data is intact
+    cluster, tree, eng = make(pages=1024, chunk_pages=16)
+    lo = churn(eng, reclaim=True, iters=200)
+    live = (np.arange(1, window + 1, dtype=np.uint64)
+            + np.uint64(lo)) * np.uint64(11)
+    got, found = eng.search(live)
+    assert found.all(), f"churn lost {int((~found).sum())} live keys"
+    np.testing.assert_array_equal(got, live)
+    tree.check_structure()
+
+
+def test_reclaim_free_pool_survives_checkpoint(eight_devices, tmp_path):
+    """The reclaimed-page pool must persist: checkpoint -> restore keeps
+    freed pages allocatable, and reshard drops them from the repack
+    (compacted away, not resurrected as dead weight)."""
+    import os
+
+    from sherman_tpu.utils import checkpoint as CK
+    from sherman_tpu.utils.reshard import reshard
+
+    cluster, tree, eng = make()
+    keys = np.arange(1, 4001, dtype=np.uint64) * np.uint64(7)
+    batched.bulk_load(tree, keys, keys, fill=0.9)
+    eng.attach_router()
+    dead = keys[(keys > 700) & (keys < 4000)]
+    eng.delete(dead)
+    for _ in range(3):  # unlink + clean + pass quarantine
+        eng.reclaim_empty_leaves()
+    d0 = cluster.directories[0]
+    n_free = d0.allocator.pages_free
+    assert n_free > 0
+    src = str(tmp_path / "c.npz")
+    CK.checkpoint(cluster, src)
+
+    c2 = CK.restore(src)
+    assert c2.directories[0].allocator.pages_free == n_free, \
+        "restore dropped the reclaimed-page pool"
+    # restored pool serves page-grain allocations
+    from sherman_tpu.models.btree import Tree
+    t2 = Tree(c2)
+    a = t2.ctx.alloc.alloc(node=0)
+    assert a != 0
+
+    out = reshard(src, str(tmp_path / "r.npz"), 1)
+    with np.load(str(tmp_path / "r.npz")) as z:
+        assert z["dir_free"].size == 0
+    kept = np.setdiff1d(keys, dead)
+    c3 = CK.restore(str(tmp_path / "r.npz"))
+    t3 = Tree(c3)
+    e3 = batched.BatchedEngine(t3, batch_per_node=512)
+    e3.attach_router()
+    got, found = e3.search(kept)
+    assert found.all() and (got == kept).all()
+    assert out["live_pages"] < 4000

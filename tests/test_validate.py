@@ -14,7 +14,7 @@ from sherman_tpu.config import DSMConfig
 from sherman_tpu.models import batched
 from sherman_tpu.models.btree import Tree
 from sherman_tpu.models.validate import check_structure_device
-from sherman_tpu.ops import bits
+from sherman_tpu.ops import bits, layout
 from sherman_tpu.parallel import dsm as D
 
 
@@ -107,7 +107,7 @@ def test_detects_key_outside_fence(grown_tree):
     addr = int(tree._bulk_leaf_dir[0][3])
     pg = tree.dsm.read_page(addr)
     slot = next(s for s in range(C.LEAF_CAP)
-                if pg[C.L_FVER_W + s] == pg[C.L_RVER_W + s] != 0)
+                if layout.np_slot_live(pg, s))
     _poke(tree, addr, C.L_KHI_W + slot, 0x7FFFFFFF)  # far above any fence
     with pytest.raises(RuntimeError, match="bad_leaf_slot"):
         check_structure_device(tree)

@@ -187,18 +187,18 @@ def main(argv=None) -> dict:
     def onehot_loop(pool, rows, slot, reps):
         def body(i, acc):
             pg = pool[(rows + i) % P]
-            blk = pg[:, C.L_FVER_W:C.L_FVER_W + C.LEAF_CAP]
+            blk = pg[:, C.L_VER_W:C.L_VER_W + C.LEAF_CAP]
             oh = jnp.arange(C.LEAF_CAP)[None, :] == slot[:, None]
             return acc + jnp.sum(jnp.where(oh, blk, 0), axis=-1)
         return lax.fori_loop(0, reps, body, jnp.zeros(M, jnp.int32))
 
     slot_d = d(rng.integers(0, C.LEAF_CAP, M).astype(np.int32))
-    chain_cost("gather_plus_onehot_fver", onehot_loop, dsm.pool, rows_d,
+    chain_cost("gather_plus_onehot_ver", onehot_loop, dsm.pool, rows_d,
                slot_d)
 
-    field_w = np.array([C.L_FVER_W, C.L_KHI_W, C.L_KLO_W, C.L_VHI_W,
-                        C.L_VLO_W, C.L_RVER_W, C.W_FRONT_VER,
-                        C.W_REAR_VER], np.int32)
+    field_w = np.array([C.L_VER_W, C.L_KHI_W, C.L_KLO_W, C.L_VHI_W,
+                        C.L_VLO_W, C.W_FRONT_VER, C.W_REAR_VER,
+                        C.W_NKEYS], np.int32)
 
     def scatter_loop_w(width):
         idx = d((rows_np[:, None] * C.PAGE_WORDS
