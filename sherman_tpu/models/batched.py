@@ -969,9 +969,14 @@ class BatchedEngine:
         self.parent_flush_threshold = 1
         self._fresh_cache: dict[int, list[int]] = {}
         self._pending_parents: list[tuple[int, int]] = []
-        # empty-leaf reclamation bookkeeping (reclaim_empty_leaves)
+        # empty-leaf reclamation bookkeeping (reclaim_empty_leaves).
+        # "parked" holds retired pages still referenced as some parent's
+        # LEFTMOST child — they stay retired forever (self-healing via
+        # their back-sibling) rather than risking a dangling reference
+        # into a reused page; bounded at ~1/INTERNAL_CAP of reclaimable
+        # leaves.
         self._reclaim_state: dict = {"round": 0, "quarantine": [],
-                                     "pending_parent": []}
+                                     "pending_parent": [], "parked": set()}
         self._parent_descend_cache: dict = {}
         self.router = None
         self._search_cache: dict = {}
@@ -1827,8 +1832,14 @@ class BatchedEngine:
            the flush_parents merge protocol) — required before reuse: a
            stale parent entry must keep resolving to the RETIRED page
            (which self-heals via its back-sibling), never to a reused
-           one; pages whose parent cleanup fails stay quarantined and
-           retry on the next call;
+           one.  A retired page referenced as a parent's LEFTMOST child
+           is PARKED instead (retired forever, never freed — repointing
+           the leftmost would dangle once its target is itself reused;
+           bounded at ~1/INTERNAL_CAP of reclaimable leaves).  Cleanup
+           failures stay pending and retry on the next call; retired
+           strays found by the scan (e.g. in-flight state lost at a
+           checkpoint/restore boundary) re-enter this path, so reclaim
+           is crash-recoverable;
         4. quarantine: cleaned pages return to their node's allocator
            free pool only after ``quarantine_rounds`` further calls — the
            grace period for concurrent host clients still holding
@@ -1845,11 +1856,33 @@ class BatchedEngine:
         st = self._reclaim_state
         st["round"] += 1
         stats = {"unlinked": 0, "freed": 0, "candidates": 0,
-                 "quarantined": len(st["quarantine"])}
+                 "quarantined": len(st["quarantine"]),
+                 "parked": len(st["parked"])}
 
-        addrs, lows, highs, sibs, n_live = leaf_chain_info(tree)
+        (addrs, lows, highs, sibs, n_live,
+         retired_addrs, retired_lows) = leaf_chain_info(tree)
         tree._refresh_root()
         quarantined = {a for _, a in st["quarantine"]}
+        # sweep retired strays: pages unlinked by a PREVIOUS incarnation
+        # (in-flight quarantine/cleanup state is engine-local and not
+        # checkpointed) re-enter the parent-cleanup -> quarantine path
+        # here, so a restored cluster's reclaim calls recover them.
+        # `known` MUST also cover pages already RELEASED — the allocator
+        # free pools and the engine's cached split grants — because a
+        # freed page still LOOKS retired until its next write; sweeping
+        # one would double-free it into the pool (the same page granted
+        # twice = silent aliasing).
+        known = (quarantined | st["parked"]
+                 | {e for e, _, _ in st["pending_parent"]})
+        for nd, d in self.tree.ctx.alloc._by_node.items():
+            for p in d.allocator.free_pages_list:
+                known.add((nd << C.ADDR_PAGE_BITS) | p)
+        for lst in self._fresh_cache.values():
+            for a in lst:
+                known.add(int(a) & 0xFFFFFFFF)
+        for ra, rl in zip(retired_addrs.tolist(), retired_lows.tolist()):
+            if ra not in known:
+                st["pending_parent"].append((int(ra), int(rl), 0))
         # adjacent pairs with chain continuity; greedy-alternate so a
         # pair's left member is never itself unlinked this round
         pairs = []
@@ -1960,6 +1993,7 @@ class BatchedEngine:
             d.allocator.reclaim(pgs)
             stats["freed"] += len(pgs)
         stats["quarantined"] = len(st["quarantine"])
+        stats["parked"] = len(st["parked"])
         return stats
 
     def _remove_parent_entries(self, pend, st) -> list:
@@ -1996,7 +2030,6 @@ class BatchedEngine:
                 continue
             pg = np.array(rep.data[1])
             drop = {e & 0xFFFFFFFF for e, _, _ in items}
-            absorber = {e & 0xFFFFFFFF: ab for e, _, ab in items}
             if int(pg[C.W_LEVEL]) != 1:
                 # fence moved / wrong page: retry next round
                 dsm.write_word(la, 0, 0, space=D.SPACE_LOCK)
@@ -2007,19 +2040,23 @@ class BatchedEngine:
             kept = {c & 0xFFFFFFFF for _, c in ents}
             newpg = layout.np_internal_rebuild(pg, ents, 1)
             lm = int(pg[C.W_LEFTMOST]) & 0xFFFFFFFF
-            if lm in drop:
-                # the retired page is this parent's leftmost child: point
-                # at its absorber instead (the back-sibling target) so no
-                # reference survives into reuse
-                newpg[C.W_LEFTMOST] = np.int32(
-                    np.uint32(absorber[lm] & 0xFFFFFFFF).view(np.int32))
             dsm._batch([
                 {"op": D.OP_WRITE, "addr": pa, "woff": 0,
                  "nw": C.PAGE_WORDS, "payload": newpg},
                 tree._unlock_row(la),
             ])
             for e, k, ab in items:
-                if (e & 0xFFFFFFFF) in kept:  # entry elsewhere: retry
+                eu = e & 0xFFFFFFFF
+                if eu == lm:
+                    # this parent's LEFTMOST child: the pointer cannot be
+                    # dropped (the page has no left entry) and repointing
+                    # it at the absorber would dangle once the absorber
+                    # is itself reclaimed and reused.  PARK the page: it
+                    # stays retired forever (reads/writes refuse via the
+                    # zero fence; stale descents self-heal through its
+                    # back-sibling) and is never freed.
+                    st["parked"].add(e)
+                elif eu in kept:  # entry elsewhere: retry
                     nxt.append((e, k, ab))
                 else:
                     st["quarantine"].append((st["round"], e))

@@ -227,26 +227,31 @@ def _leaf_chain_kernel(pool, next_by_node, P: int, N: int):
     allocated = (pg_i >= 1) & (pg_i < next_by_node[ridx // P])
     fv = pool[:, C.W_FRONT_VER]
     hi_hi, hi_lo = pool[:, C.W_HIGH_HI], pool[:, C.W_HIGH_LO]
-    act = allocated & (fv != 0) & ~((hi_hi == 0) & (hi_lo == 0))
+    retired = allocated & (fv != 0) & (hi_hi == 0) & (hi_lo == 0)
+    act = allocated & (fv != 0) & ~retired
     leaf = act & (pool[:, C.W_LEVEL] == 0)
     n_live = jnp.sum(layout.leaf_slot_used(pool), axis=-1)
     return (leaf, pool[:, C.W_LOW_HI], pool[:, C.W_LOW_LO], hi_hi, hi_lo,
-            pool[:, C.W_SIBLING], n_live.astype(jnp.int32))
+            pool[:, C.W_SIBLING], n_live.astype(jnp.int32),
+            retired & (pool[:, C.W_LEVEL] == 0))
 
 
 def leaf_chain_info(tree):
     """One jitted scan over the pool: every ACTIVE leaf's (addr, low,
-    high, sibling, n_live), sorted by low — the reclaim scanner's view of
-    the B-link chain (single-process meshes; reclamation is a local
-    maintenance pass)."""
+    high, sibling, n_live), sorted by low, plus the RETIRED leaves'
+    (addr, low) — the reclaim scanner's view of the B-link chain
+    (single-process meshes; reclamation is a local maintenance pass).
+    Retired = unlinked by a previous reclaim (highest == 0) but not yet
+    released; surfacing them lets a restored cluster's reclaim pass
+    recover pages that were mid-quarantine at checkpoint time."""
     import jax.numpy as jnp
 
     cfg = tree.dsm.cfg
     nxt = np.ones(cfg.machine_nr, np.int64)
     for d in tree.cluster.directories:
         nxt[d.node_id] = d.allocator.pages_used
-    leaf, lh, ll, hh, hl, sib, nl = (np.asarray(x) for x in
-                                     _leaf_chain_kernel(
+    leaf, lh, ll, hh, hl, sib, nl, ret = (np.asarray(x) for x in
+                                          _leaf_chain_kernel(
         tree.dsm.pool, jnp.asarray(nxt, jnp.int32),
         P=cfg.pages_per_node, N=cfg.machine_nr))
     rows = np.nonzero(leaf)[0]
@@ -255,9 +260,13 @@ def leaf_chain_info(tree):
     lows = bits.pairs_to_keys(lh[rows], ll[rows])
     highs = bits.pairs_to_keys(hh[rows], hl[rows])
     order = np.argsort(lows)
+    rrows = np.nonzero(ret)[0]
+    raddrs = ((rrows // P).astype(np.int64) << C.ADDR_PAGE_BITS) \
+        | (rrows % P)
+    rlows = bits.pairs_to_keys(lh[rrows], ll[rrows])
     return (addrs[order], lows[order], highs[order],
             sib[rows][order].astype(np.int64) & 0xFFFFFFFF,
-            nl[rows][order])
+            nl[rows][order], raddrs, rlows)
 
 
 def check_structure_device(tree) -> dict:

@@ -69,9 +69,17 @@ class GlobalAllocator:
     def reclaim(self, pages) -> None:
         """Return page indices to this node's free pool.  Callers own the
         safety argument (quarantine): a returned page must be unreachable
-        from the tree AND past any stale reader's grace period."""
+        from the tree AND past any stale reader's grace period.  Raises
+        on a double-free — the same page pooled twice would eventually be
+        granted twice (silent aliasing), so surface it at the boundary."""
         with self._mu:
-            self._free.extend(int(p) for p in pages)
+            incoming = [int(p) for p in pages]
+            dup = set(incoming) & set(self._free)
+            if dup or len(set(incoming)) != len(incoming):
+                raise ValueError(
+                    f"node {self.node_id}: double-free into the reclaim "
+                    f"pool (duplicates: {sorted(dup)[:4]})")
+            self._free.extend(incoming)
 
     def pop_free_page(self) -> int:
         """-> one reclaimed page index, or -1 when the free pool is empty."""

@@ -168,3 +168,36 @@ def test_reclaim_free_pool_survives_checkpoint(eight_devices, tmp_path):
     got, found = e3.search(kept)
     assert found.all() and (got == kept).all()
     assert out["live_pages"] < 4000
+
+
+def test_reclaim_recovers_inflight_state_after_restore(eight_devices,
+                                                       tmp_path):
+    """Pages unlinked but still in quarantine/cleanup at checkpoint time
+    (engine-local state) must be recovered by a RESTORED cluster's
+    reclaim calls: the scan re-surfaces retired strays."""
+    from sherman_tpu.utils import checkpoint as CK
+
+    cluster, tree, eng = make()
+    keys = np.arange(1, 4001, dtype=np.uint64) * np.uint64(7)
+    batched.bulk_load(tree, keys, keys, fill=0.9)
+    eng.attach_router()
+    dead = keys[(keys > 700) & (keys < 4000)]
+    eng.delete(dead)
+    st1 = eng.reclaim_empty_leaves()   # unlink + clean; pages quarantined
+    assert st1["unlinked"] > 0
+    src = str(tmp_path / "c.npz")
+    CK.checkpoint(cluster, src)        # quarantine NOT yet released
+
+    c2 = CK.restore(src)
+    from sherman_tpu.models.btree import Tree
+    t2 = Tree(c2)
+    e2 = batched.BatchedEngine(t2, batch_per_node=512)
+    e2.attach_router()
+    freed = 0
+    for _ in range(4):                 # sweep + clean + pass quarantine
+        freed += e2.reclaim_empty_leaves()["freed"]
+    assert freed > 0, "restored cluster never recovered in-flight pages"
+    kept = np.setdiff1d(keys, dead)
+    got, found = e2.search(kept)
+    assert found.all() and (got == kept).all()
+    t2.check_structure()
