@@ -259,3 +259,58 @@ def test_multinode_straggler_compaction_read_parity(eight_devices):
     assert reads[4] <= reads[1] * 1.2 + 64, reads
     # and both are ~1 read/op (cache-hit contract), not height * ops
     assert reads[1] <= int(q.size * 1.2) + 64, reads
+
+
+def test_note_splits_batch_matches_scalar(eight_devices):
+    """The vectorized split-log table update must be bit-identical to the
+    scalar note_split path, including splits keyed near 2^64 (where naive
+    uint64 ceil-div wraps and would repoint unrelated buckets)."""
+    from sherman_tpu.models.router import LeafRouter
+
+    class _T:  # minimal tree stand-in
+        _root_addr = 17
+        router = None
+
+    rng = np.random.default_rng(9)
+    a, b = LeafRouter(_T(), 12), LeafRouter(_T(), 12)
+    # seed identical non-trivial tables spanning the full key range
+    lows = np.sort(rng.integers(1, np.iinfo(np.uint64).max, 200,
+                                dtype=np.uint64))
+    lows[0] = 0
+    addrs = rng.integers(1, 1 << 30, 200, dtype=np.int64)
+    a.seed_from_leaves(addrs, lows)
+    b.seed_from_leaves(addrs, lows)
+    sk = rng.integers(1, np.iinfo(np.uint64).max - 2, 64, dtype=np.uint64)
+    oh = sk + rng.integers(1, 1 << 40, 64, dtype=np.uint64)  # may wrap: ok
+    oh = np.maximum(oh, sk + np.uint64(1))
+    # include the wrap hazard: a split key within one bucket of 2^64
+    sk[0] = np.uint64((1 << 64) - (1 << 37))
+    oh[0] = np.uint64((1 << 64) - 1)   # = KEY_POS_INF -> rightmost
+    na = rng.integers(1, 1 << 30, 64, dtype=np.int64)
+    for i in range(64):
+        a.note_split(int(sk[i]), int(na[i]), int(oh[i]))
+    b.note_splits_batch(sk, na, oh)
+    np.testing.assert_array_equal(a.table_np, b.table_np)
+    assert a.shift == b.shift and a.splits_noted == b.splits_noted
+
+
+def test_remap_addrs_vectorized(eight_devices):
+    """remap_addrs must repoint exactly the buckets holding the old
+    addresses (incl. negative int32 bit patterns) and nothing else."""
+    from sherman_tpu.models.router import LeafRouter
+
+    class _T:
+        _root_addr = 3
+        router = None
+
+    r = LeafRouter(_T(), 8)
+    neg = int(np.uint32(0x80000005).view(np.int32))  # node >= 128 pattern
+    r.table_np[10:20] = 111
+    r.table_np[30:40] = np.int32(neg)
+    before = r.table_np.copy()
+    r.remap_addrs({111: 222, neg & 0xFFFFFFFF: 333})
+    assert (r.table_np[10:20] == 222).all()
+    assert (r.table_np[30:40] == 333).all()
+    mask = np.ones(r.nb, bool)
+    mask[10:20] = mask[30:40] = False
+    np.testing.assert_array_equal(r.table_np[mask], before[mask])
