@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -977,6 +978,10 @@ class BatchedEngine:
         # leaves.
         self._reclaim_state: dict = {"round": 0, "quarantine": [],
                                      "pending_parent": [], "parked": set()}
+        # reclaim mutates engine-local reclaim state and the allocator
+        # free pools across many steps; it is a maintenance pass, not a
+        # concurrent op — overlapping calls are a caller bug
+        self._reclaim_mutex = threading.Lock()
         self._parent_descend_cache: dict = {}
         self.router = None
         self._search_cache: dict = {}
@@ -1851,6 +1856,16 @@ class BatchedEngine:
         """
         assert self.cfg.machine_nr == 1 or not self._mh, \
             "reclaim_empty_leaves is a single-process maintenance pass"
+        if not self._reclaim_mutex.acquire(blocking=False):
+            raise RuntimeError(
+                "reclaim_empty_leaves is not reentrant: another reclaim "
+                "pass is already running on this engine")
+        try:
+            return self._reclaim_empty_leaves_locked(quarantine_rounds)
+        finally:
+            self._reclaim_mutex.release()
+
+    def _reclaim_empty_leaves_locked(self, quarantine_rounds: int) -> dict:
         from sherman_tpu.models.validate import leaf_chain_info
         tree, dsm = self.tree, self.dsm
         st = self._reclaim_state
@@ -1859,27 +1874,40 @@ class BatchedEngine:
                  "quarantined": len(st["quarantine"]),
                  "parked": len(st["parked"])}
 
-        (addrs, lows, highs, sibs, n_live,
-         retired_addrs, retired_lows) = leaf_chain_info(tree)
+        # Snapshot the released-page state BEFORE the scan: a page freed
+        # at snapshot time is either still free at scan time (snapshot
+        # covers it) or was popped and rewritten by a writer (the scan
+        # then no longer sees it as retired).  Snapshotting AFTER the
+        # scan would leave a window where a writer pops a scanned-
+        # retired page out of the pool and the sweep double-frees it.
+        released = set()
+        for nd, d in self.tree.ctx.alloc._by_node.items():
+            for p in d.allocator.free_pages_list:
+                released.add((nd << C.ADDR_PAGE_BITS) | p)
+        for lst in self._fresh_cache.values():
+            for a in lst:
+                released.add(int(a) & 0xFFFFFFFF)
+        # the chain scan launches on the CURRENT pool handle: hold the
+        # step mutex so a concurrent host writer's donated-buffer swap
+        # cannot invalidate the handle between read and launch (the scan
+        # materializes inside, so the mutex spans one kernel execution —
+        # acceptable for a maintenance pass)
+        with self._step_mutex:
+            (addrs, lows, highs, sibs, n_live,
+             retired_addrs, retired_lows) = leaf_chain_info(tree)
         tree._refresh_root()
         quarantined = {a for _, a in st["quarantine"]}
         # sweep retired strays: pages unlinked by a PREVIOUS incarnation
         # (in-flight quarantine/cleanup state is engine-local and not
         # checkpointed) re-enter the parent-cleanup -> quarantine path
         # here, so a restored cluster's reclaim calls recover them.
-        # `known` MUST also cover pages already RELEASED — the allocator
-        # free pools and the engine's cached split grants — because a
-        # freed page still LOOKS retired until its next write; sweeping
-        # one would double-free it into the pool (the same page granted
-        # twice = silent aliasing).
-        known = (quarantined | st["parked"]
+        # `known` MUST also cover pages already RELEASED — the pre-scan
+        # `released` snapshot of the allocator free pools and cached
+        # split grants — because a freed page still LOOKS retired until
+        # its next write; sweeping one would double-free it into the
+        # pool (the same page granted twice = silent aliasing).
+        known = (quarantined | st["parked"] | released
                  | {e for e, _, _ in st["pending_parent"]})
-        for nd, d in self.tree.ctx.alloc._by_node.items():
-            for p in d.allocator.free_pages_list:
-                known.add((nd << C.ADDR_PAGE_BITS) | p)
-        for lst in self._fresh_cache.values():
-            for a in lst:
-                known.add(int(a) & 0xFFFFFFFF)
         for ra, rl in zip(retired_addrs.tolist(), retired_lows.tolist()):
             if ra not in known:
                 st["pending_parent"].append((int(ra), int(rl), 0))
