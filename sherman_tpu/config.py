@@ -115,10 +115,15 @@ class DSMConfig:
 # fver == rver != 0, ver == 0 marks a free slot.  One word instead of two
 # cuts the update write-back scatter from 4 lanes to 3 (scatter cost is
 # ~13.5 ms/lane at 2 M rows — the write path's #1 knob) and grows
-# LEAF_CAP 41 -> 49 (+20% leaf density).  The pair stays a PAIR
-# semantically: host-path word writes land whole words atomically, so
-# fver/rver equality still certifies an untorn entry exactly as in the
-# reference.
+# LEAF_CAP 41 -> 49 (+20% leaf density).  NOTE the invariant this buys:
+# with both halves in one word, fver == rver can never observe a torn
+# PAIR — the check degenerates to a single-word liveness marker
+# (ver != 0, halves equal by construction) and certifies nothing about
+# the other four entry words.  Entry tear-freedom rests on the DSM's
+# whole-batch step atomicity plus step serialization (a writer's 3-word
+# update lands in ONE step; readers see before or after, never between).
+# Any change that splits a host write batch for one entry across steps
+# loses that protection — it cannot lean on the version check.
 # ---------------------------------------------------------------------------
 
 W_FRONT_VER = 0

@@ -1508,13 +1508,16 @@ class BatchedEngine:
         import time as _t
         dbg = os.environ.get("SHERMAN_DEBUG_INSERT")
 
-        total = len(self._pending_parents)
+        # atomic drain: swap the list out FIRST — building pend from the
+        # live list and then reassigning [] would silently drop an entry
+        # a concurrent writer appends between the two statements (reclaim
+        # calls this from a maintenance thread)
+        raw, self._pending_parents = self._pending_parents, []
+        total = len(raw)
         if not total:
             return 0
         # legacy 2-tuples target level 1
-        pend = [t if len(t) == 3 else (t[0], t[1], 1)
-                for t in self._pending_parents]
-        self._pending_parents = []
+        pend = [t if len(t) == 3 else (t[0], t[1], 1) for t in raw]
         tree, dsm = self.tree, self.dsm
         for _attempt in range(12):
             if not pend:
@@ -1868,6 +1871,13 @@ class BatchedEngine:
     def _reclaim_empty_leaves_locked(self, quarantine_rounds: int) -> dict:
         from sherman_tpu.models.validate import leaf_chain_info
         tree, dsm = self.tree, self.dsm
+        # Drain deferred parent entries BEFORE scanning: a pending
+        # (k -> c) entry not yet flushed leaves leaf c with no parent
+        # entry to find, so parent removal would quarantine it while the
+        # deferred flush still owes a parent entry pointing at it — the
+        # flush would then alias a freed/reused page.
+        if self._pending_parents:
+            self.flush_parents()
         st = self._reclaim_state
         st["round"] += 1
         stats = {"unlinked": 0, "freed": 0, "candidates": 0,
@@ -1912,13 +1922,20 @@ class BatchedEngine:
             if ra not in known:
                 st["pending_parent"].append((int(ra), int(rl), 0))
         # adjacent pairs with chain continuity; greedy-alternate so a
-        # pair's left member is never itself unlinked this round
+        # pair's left member is never itself unlinked this round.  Pages
+        # still owed a deferred parent entry (appended after the flush
+        # above, e.g. by a concurrent writer's split log) are excluded:
+        # their parent entry does not exist yet, so parent removal would
+        # wrongly conclude they are unreferenced.
+        pend_children = {int(t[1]) & 0xFFFFFFFF
+                         for t in self._pending_parents}
         pairs = []
         taken = set()
         for i in range(1, addrs.size):
             L, E = int(addrs[i - 1]), int(addrs[i])
             if (n_live[i] == 0 and sibs[i - 1] == E and E not in taken
                     and L not in taken and E not in quarantined
+                    and (E & 0xFFFFFFFF) not in pend_children
                     and E != tree._root_addr):
                 pairs.append((L, E, int(lows[i]), int(highs[i])))
                 taken.add(E)
@@ -2057,12 +2074,27 @@ class BatchedEngine:
                 nxt.extend(items)
                 continue
             pg = np.array(rep.data[1])
-            drop = {e & 0xFFFFFFFF for e, _, _ in items}
             if int(pg[C.W_LEVEL]) != 1:
                 # fence moved / wrong page: retry next round
                 dsm.write_word(la, 0, 0, space=D.SPACE_LOCK)
                 nxt.extend(items)
                 continue
+            # fence re-check UNDER the lock (the same guard flush_parents
+            # applies at its merge step): a concurrent split of this
+            # parent between the descent and the CAS moves entries >= the
+            # split key to the right sibling.  An item whose key the
+            # locked page no longer covers may have its entry alive over
+            # there — concluding "entry absent, page unreferenced" from
+            # THIS page would quarantine and reuse a page a live parent
+            # entry still resolves to.  Uncovered items retry next round.
+            lo, hi = layout.np_lowest(pg), layout.np_highest(pg)
+            covered = [t for t in items if lo <= t[1] < hi]
+            nxt.extend(t for t in items if not (lo <= t[1] < hi))
+            if not covered:
+                dsm.write_word(la, 0, 0, space=D.SPACE_LOCK)
+                continue
+            items = covered
+            drop = {e & 0xFFFFFFFF for e, _, _ in items}
             ents = [(k, c) for k, c in layout.np_internal_entries(pg)
                     if (c & 0xFFFFFFFF) not in drop]
             kept = {c & 0xFFFFFFFF for _, c in ents}

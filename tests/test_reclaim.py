@@ -203,6 +203,79 @@ def test_reclaim_recovers_inflight_state_after_restore(eight_devices,
     t2.check_structure()
 
 
+def test_remove_parent_entries_fence_recheck(eight_devices, monkeypatch):
+    """A concurrent parent split between the descent and the CAS moves
+    the retired page's entry to the right sibling.  The locked page then
+    no longer covers the retired page's key — parent removal must RETRY
+    the item (fence re-check under the lock, like flush_parents), never
+    conclude from the stale page that the entry is gone and quarantine a
+    page a live parent entry still references."""
+    cluster, tree, eng = make()
+    keys = np.arange(1, 4001, dtype=np.uint64) * np.uint64(7)
+    batched.bulk_load(tree, keys, keys, fill=0.9)
+    eng.attach_router()
+    # two keys far enough apart to live under different level-1 parents
+    k_lo, k_hi = int(keys[10]), int(keys[-10])
+    paddrs, done = eng._descend_to_level(
+        np.array([k_lo, k_hi], np.uint64), 1)
+    assert done.all() and int(paddrs[0]) != int(paddrs[1])
+    stale = np.array([paddrs[0]]), np.array([True])
+    # simulate the race: the descent resolves k_hi to the LEFT parent
+    # (as if the right entries moved after the descent snapshot)
+    monkeypatch.setattr(eng, "_descend_to_level", lambda *a, **kw: stale)
+    fake_e = 0x00AB0001  # "retired page" whose entry is NOT on paddrs[0]
+    st = eng._reclaim_state
+    q_before = list(st["quarantine"])
+    nxt = eng._remove_parent_entries([(fake_e, k_hi, 0)], st)
+    assert nxt == [(fake_e, k_hi, 0)], \
+        "uncovered item must retry, not be treated as entry-absent"
+    assert st["quarantine"] == q_before, \
+        "page quarantined off a stale parent page (aliasing after reuse)"
+    # the lock word taken on the stale parent must have been released
+    from sherman_tpu.parallel import dsm as D
+    la = tree._lock_word_addr(int(paddrs[0]))
+    assert int(eng.dsm.read_word(la, 0, space=D.SPACE_LOCK)) == 0
+
+
+def test_reclaim_drains_pending_parents_first(eight_devices):
+    """Deferred parent entries must be flushed before the reclaim scan:
+    a pending (k -> c) entry makes leaf c look parentless, so reclaim
+    would quarantine it while the flush still owes an entry pointing at
+    it (silent aliasing after reuse)."""
+    cluster, tree, eng = make()
+    keys = np.arange(1, 3001, dtype=np.uint64) * np.uint64(5)
+    batched.bulk_load(tree, keys, keys, fill=0.9)
+    eng.attach_router()
+    # leave split parent entries deferred: drive _insert_chunk directly
+    # (insert() flushes unconditionally at its end — the advisor scenario
+    # is an exception mid-storm leaving the deferred entries behind)
+    eng.parent_flush_threshold = 10 ** 9
+    fresh = np.arange(1, 2001, dtype=np.uint64) * np.uint64(5) \
+        + np.uint64(20000)
+    stats = {"applied": 0, "superseded": 0, "host_path": 0, "rounds": 0,
+             "st_locked": 0}
+    total = eng.cfg.machine_nr * eng.B
+    for i in range(0, fresh.size, total):
+        eng._insert_chunk(fresh[i:i + total], fresh[i:i + total],
+                          eng.tcfg.insert_rounds, stats)
+    dead = keys[(keys > 2000) & (keys < 9000)]
+    eng.delete(dead)
+    pend_before = len(eng._pending_parents)
+    assert pend_before > 0, \
+        "scenario setup failed: no deferred parent entries pending"
+    st = eng.reclaim_empty_leaves()
+    assert not eng._pending_parents, \
+        f"reclaim left {len(eng._pending_parents)} deferred parent " \
+        f"entries undrained (had {pend_before} before)"
+    # full integrity after the combined flush + reclaim
+    kept = np.setdiff1d(np.concatenate([keys, fresh]), dead)
+    got, found = eng.search(kept)
+    assert found.all()
+    np.testing.assert_array_equal(got, kept)
+    tree.check_structure()
+    assert st["unlinked"] > 0
+
+
 def test_reclaim_under_concurrent_host_writers(eight_devices):
     """Reclaim's lock+verify protocol must hold against live host
     writers: threads upsert into SURVIVING ranges while reclaim unlinks
