@@ -1824,6 +1824,16 @@ class BatchedEngine:
 
         Protocol, per (left, empty) adjacent leaf pair:
 
+        MULTIHOST: a replicated COLLECTIVE — every process must call it
+        at the same point with the same ``quarantine_rounds`` (digest-
+        checked).  The pass then runs the PARITY #7 pattern implicitly:
+        the plan is deterministic host code over mirrored state (the
+        chain scan and every lock/verify/write step ride the leader-
+        posted ReplicatedDSM; the allocator free pools are mirrored
+        directories), so all processes compute and apply the identical
+        plan in lock-step.  Calling it on a subset of processes
+        deadlocks the collective steps — same contract as flush_parents.
+
         1. one jitted pool scan finds candidates (``leaf_chain_info``):
            an ACTIVE leaf with zero live slots whose chain predecessor
            exists (the leftmost leaf is never reclaimed — bounded waste,
@@ -1857,8 +1867,18 @@ class BatchedEngine:
 
         Returns {"unlinked", "freed", "quarantined", "candidates"}.
         """
-        assert self.cfg.machine_nr == 1 or not self._mh, \
-            "reclaim_empty_leaves is a single-process maintenance pass"
+        # replicated-collective contract (multihost): identical call
+        # sites + identical args on every process, pinned by the same
+        # digest check the other engine drivers use.  The engine-local
+        # reclaim round counter rides the digest so a process that
+        # skipped an earlier reclaim call fails loudly here instead of
+        # desyncing the mirrored allocator pools; the deferred-parent
+        # count rides it too so a process whose writer thread raced an
+        # entry in fails HERE, not by desyncing the flush_parents
+        # collective the drain below would run on a subset of processes.
+        self._check_replicated(np.array(
+            [quarantine_rounds, self._reclaim_state["round"],
+             len(self._pending_parents)], np.uint64))
         if not self._reclaim_mutex.acquire(blocking=False):
             raise RuntimeError(
                 "reclaim_empty_leaves is not reentrant: another reclaim "

@@ -239,21 +239,38 @@ def _leaf_chain_kernel(pool, next_by_node, P: int, N: int):
 def leaf_chain_info(tree):
     """One jitted scan over the pool: every ACTIVE leaf's (addr, low,
     high, sibling, n_live), sorted by low, plus the RETIRED leaves'
-    (addr, low) — the reclaim scanner's view of the B-link chain
-    (single-process meshes; reclamation is a local maintenance pass).
-    Retired = unlinked by a previous reclaim (highest == 0) but not yet
-    released; surfacing them lets a restored cluster's reclaim pass
-    recover pages that were mid-quarantine at checkpoint time."""
+    (addr, low) — the reclaim scanner's view of the B-link chain.  On
+    process-spanning meshes the scan is a COLLECTIVE (every process
+    calls it; the global view is allgathered so each computes the same
+    reclaim plan).  Retired = unlinked by a previous reclaim
+    (highest == 0) but not yet released; surfacing them lets a restored
+    cluster's reclaim pass recover pages that were mid-quarantine at
+    checkpoint time."""
     import jax.numpy as jnp
 
     cfg = tree.dsm.cfg
     nxt = np.ones(cfg.machine_nr, np.int64)
     for d in tree.cluster.directories:
         nxt[d.node_id] = d.allocator.pages_used
-    leaf, lh, ll, hh, hl, sib, nl, ret = (np.asarray(x) for x in
-                                          _leaf_chain_kernel(
+    out = _leaf_chain_kernel(
         tree.dsm.pool, jnp.asarray(nxt, jnp.int32),
-        P=cfg.pages_per_node, N=cfg.machine_nr))
+        P=cfg.pages_per_node, N=cfg.machine_nr)
+    if tree.dsm.multihost:
+        # process-spanning pool: materialize local shards, allgather the
+        # global view (every process computes the identical reclaim plan
+        # from it — the replicated-collective contract)
+        from jax.experimental import multihost_utils as mhu
+        blocks = []
+        for x in out:
+            shards = sorted(x.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            blocks.append(np.concatenate([np.asarray(s.data)
+                                          for s in shards]))
+        leaf, lh, ll, hh, hl, sib, nl, ret = (
+            np.asarray(g) for g in
+            mhu.process_allgather(tuple(blocks), tiled=True))
+    else:
+        leaf, lh, ll, hh, hl, sib, nl, ret = (np.asarray(x) for x in out)
     rows = np.nonzero(leaf)[0]
     P = cfg.pages_per_node
     addrs = ((rows // P).astype(np.int64) << C.ADDR_PAGE_BITS) | (rows % P)

@@ -420,14 +420,11 @@ class PrepOverflow(RuntimeError):
 
 
 def mix64(x) -> np.ndarray:
-    """Vectorized splitmix64 finalizer — bit-exact with prep.cc's mix64."""
-    x = np.asarray(x, np.uint64).copy()
-    x ^= x >> np.uint64(30)
-    x *= np.uint64(0xBF58476D1CE4E5B9)
-    x ^= x >> np.uint64(27)
-    x *= np.uint64(0x94D049BB133111EB)
-    x ^= x >> np.uint64(31)
-    return x
+    """Vectorized splitmix64 finalizer — bit-exact with prep.cc's mix64
+    (canonical host implementation lives in ops.bits.mix64_np; this is
+    an alias so the two can never drift)."""
+    from sherman_tpu.ops.bits import mix64_np
+    return mix64_np(x)
 
 
 def synthetic_keyspace(n_keys: int, salt: int):

@@ -154,3 +154,86 @@ def hash32_host(x: int) -> int:
 def lock_index_host(addr: int, locks_per_node: int) -> int:
     """Host scalar twin of :func:`lock_index` (same word, no device)."""
     return hash32_host(addr) % locks_per_node
+
+
+# -- device-side 64-bit pair arithmetic ---------------------------------------
+# TPUs have no 64-bit integer lanes; these compose uint32 (hi, lo) pairs
+# into the few u64 ops the device-resident workload generator needs
+# (full-width multiply for the splitmix64 finalizer).  All inputs/outputs
+# are jnp.uint32 arrays; shifts are Python-int static.
+
+def u32_mul_full(a, b):
+    """Full 32x32 -> 64 multiply via 16-bit limbs: returns (hi, lo)
+    uint32.  jnp uint32 * uint32 keeps only the low word, so the high
+    word is assembled from the four partial products (each exact: a
+    16x16 product fits 32 bits)."""
+    a0, a1 = a & jnp.uint32(0xFFFF), a >> 16
+    b0, b1 = b & jnp.uint32(0xFFFF), b >> 16
+    p00, p01 = a0 * b0, a0 * b1
+    p10, p11 = a1 * b0, a1 * b1
+    t = (p00 >> 16) + (p01 & jnp.uint32(0xFFFF)) + (p10 & jnp.uint32(0xFFFF))
+    lo = (p00 & jnp.uint32(0xFFFF)) | ((t & jnp.uint32(0xFFFF)) << 16)
+    hi = p11 + (p01 >> 16) + (p10 >> 16) + (t >> 16)
+    return hi, lo
+
+
+def u64_mul(ahi, alo, bhi, blo):
+    """(ahi, alo) * (bhi, blo) mod 2^64 -> (hi, lo) uint32 pairs.  The
+    cross terms contribute only to the high word (their low halves are
+    shifted out), so wrapping uint32 multiplies suffice there."""
+    hi, lo = u32_mul_full(alo, blo)
+    hi = hi + alo * bhi + ahi * blo
+    return hi, lo
+
+
+def u64_shr(hi, lo, s: int):
+    """Logical right shift of a (hi, lo) uint32 pair by static s."""
+    if s == 0:
+        return hi, lo
+    if s < 32:
+        return hi >> s, (lo >> s) | (hi << (32 - s))
+    if s == 32:
+        return jnp.zeros_like(hi), hi
+    return jnp.zeros_like(hi), hi >> (s - 32)
+
+
+_MIX64_C1 = (0xBF58476D, 0x1CE4E5B9)  # splitmix64 finalizer constants
+_MIX64_C2 = (0x94D049BB, 0x133111EB)
+
+
+def mix64_pair(hi, lo):
+    """splitmix64 finalizer on (hi, lo) uint32 pairs — bit-exact twin of
+    the native prep's rank->key map (native/src/prep.cc mix64), so a
+    device-generated batch hits exactly the keys the bulk load wrote."""
+    h, l = u64_shr(hi, lo, 30)
+    hi, lo = hi ^ h, lo ^ l
+    hi, lo = u64_mul(hi, lo, jnp.uint32(_MIX64_C1[0]), jnp.uint32(_MIX64_C1[1]))
+    h, l = u64_shr(hi, lo, 27)
+    hi, lo = hi ^ h, lo ^ l
+    hi, lo = u64_mul(hi, lo, jnp.uint32(_MIX64_C2[0]), jnp.uint32(_MIX64_C2[1]))
+    h, l = u64_shr(hi, lo, 31)
+    return hi ^ h, lo ^ l
+
+
+def mix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized host twin of :func:`mix64_pair` on uint64 arrays
+    (numpy integer overflow wraps, matching the native mix64)."""
+    x = np.asarray(x, np.uint64).copy()
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def mix64_host(x: int) -> int:
+    """Host scalar twin of :func:`mix64_pair` (and of the native
+    mix64) — for tests and native-free key-map parity."""
+    x = int(x) & ((1 << 64) - 1)
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & ((1 << 64) - 1)
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & ((1 << 64) - 1)
+    x ^= x >> 31
+    return x

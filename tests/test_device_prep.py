@@ -1,0 +1,180 @@
+"""Device-resident batch staging (workload/device_prep.py).
+
+The sustained serving loop's client side — zipf sampling, the synthetic
+rank->key map, request combining, the router probe — runs as one jitted
+device computation.  These tests pin (1) the rank->key map bit-for-bit
+against the host/native mix64, (2) the quantile-table zipf sampler
+against the analytic CDF, and (3) the fused step end-to-end on the CPU
+mesh: every generated client op must come back with its correct value,
+counted on device.
+"""
+
+import numpy as np
+import pytest
+
+from sherman_tpu.ops import bits
+from sherman_tpu.workload.device_prep import make_staged_step, zipf_table
+
+U64 = (1 << 64) - 1
+
+
+_mix64_np = bits.mix64_np
+
+
+def test_mix64_pair_matches_host(eight_devices):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    xs = rng.integers(0, U64, 4096, dtype=np.uint64)
+    hi = (xs >> np.uint64(32)).astype(np.uint32)
+    lo = (xs & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    ghi, glo = bits.mix64_pair(jnp.asarray(hi), jnp.asarray(lo))
+    got = (np.asarray(ghi).astype(np.uint64) << np.uint64(32)) \
+        | np.asarray(glo).astype(np.uint64)
+    np.testing.assert_array_equal(got, _mix64_np(xs))
+    # scalar host twin agrees too
+    for x in xs[:16]:
+        assert bits.mix64_host(int(x)) == int(_mix64_np(np.array([x]))[0])
+
+
+def test_mix64_matches_native_keyspace():
+    native = pytest.importorskip("sherman_tpu.native")
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    salt = 0x5E17_AB1E_5A17
+    keys, rank_to_key = native.synthetic_keyspace(10_000, salt)
+    ranks = np.arange(10_000, dtype=np.uint64)
+    np.testing.assert_array_equal(
+        rank_to_key, _mix64_np(ranks ^ np.uint64(salt)))
+
+
+def _sample_from_table(table, n, size, rng):
+    """Host emulation of the device sampler (same bin + lerp math)."""
+    lb = int(np.log2(table.shape[0] - 1))
+    w0 = rng.integers(0, 1 << 32, size, dtype=np.uint64)
+    w1 = rng.integers(0, 1 << 32, size, dtype=np.uint64)
+    b = (w0 >> np.uint64(32 - lb)).astype(np.int64)
+    lo, hi = table[b].astype(np.int64), table[b + 1].astype(np.int64)
+    frac = (w1 >> np.uint64(8)).astype(np.float32) * np.float32(2.0 ** -24)
+    r = lo + ((hi - lo).astype(np.float32) * frac).astype(np.int64)
+    return np.clip(r, 0, n - 1)
+
+
+def test_zipf_table_uniform():
+    n = 100_000
+    t = zipf_table(n, 0.0, log2_bins=16)
+    assert t[0] == 0 and t[-1] == n - 1
+    r = _sample_from_table(t, n, 200_000, np.random.default_rng(5))
+    # uniform: mean ~ n/2, head not over-weighted
+    assert abs(r.mean() / n - 0.5) < 0.01
+    assert (r == 0).sum() < 50
+
+
+def test_zipf_table_matches_analytic_cdf():
+    from sherman_tpu.workload.zipf import _zeta
+    n, theta = 100_000, 0.99
+    t = zipf_table(n, theta, log2_bins=20)
+    zetan = _zeta(n, theta)
+    rng = np.random.default_rng(7)
+    r = _sample_from_table(t, n, 1_000_000, rng)
+    # head probabilities exact to the CDF (hot ranks span whole bins)
+    for rank in (0, 1, 2, 10):
+        p_true = (rank + 1.0) ** -theta / zetan
+        p_emp = (r == rank).mean()
+        assert abs(p_emp - p_true) < 0.15 * p_true + 1e-5, \
+            (rank, p_emp, p_true)
+    # overall CDF agreement at a few quantiles (tail inversion sound)
+    for q in (0.5, 0.9, 0.99):
+        emp = np.quantile(r, q)
+        ks = np.arange(1, n + 1, dtype=np.float64)
+        cdf = np.cumsum(ks ** -theta) / zetan
+        true = int(np.searchsorted(cdf, q))
+        assert abs(emp - true) <= max(0.05 * (true + 1), 2.0), \
+            (q, emp, true)
+
+
+def test_zipf_table_head_is_exact_rank_zero():
+    # the hottest rank's probability is CDF-exact: all bins whose
+    # quantile lies below F(0) collapse to [0, 0]
+    n, theta = 10_000, 0.99
+    t = zipf_table(n, theta, log2_bins=16)
+    from sherman_tpu.workload.zipf import _zeta
+    p0 = 1.0 / _zeta(n, theta)
+    nb = t.shape[0] - 1
+    exact_bins = int((t[:-1] == 0).sum() - ((t[:-1] == 0) & (t[1:] > 0)).sum())
+    assert abs(exact_bins / nb - p0) < 2.0 / nb + 0.02 * p0
+
+
+def _build_engine(n_keys, salt, machine_nr=1, B=4096):
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+    cfg = DSMConfig(machine_nr=machine_nr, pages_per_node=2048,
+                    locks_per_node=512, step_capacity=B, chunk_pages=32)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B)
+    ranks = np.arange(n_keys, dtype=np.uint64)
+    keys = _mix64_np(ranks ^ np.uint64(salt))
+    assert (np.diff(np.sort(keys)) != 0).all() and keys.min() >= 1
+    vals = keys ^ np.uint64(0xDEADBEEF)
+    order = np.argsort(keys)
+    batched.bulk_load(tree, keys[order], vals[order], fill=0.8)
+    eng.attach_router()
+    return eng
+
+
+@pytest.mark.parametrize("theta", [0.0, 0.99])
+def test_staged_step_end_to_end(eight_devices, theta):
+    import jax
+    salt = 0x5E17_AB1E_5A17
+    n_keys = 20_000
+    batch = 2048
+    eng = _build_engine(n_keys, salt)
+    step, (new_carry, table_d, rtable_d, rkey_d) = make_staged_step(
+        eng, n_keys=n_keys, theta=theta, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16)
+    carry = new_carry()
+    dsm = eng.dsm
+    counters = dsm.counters
+    S = 4
+    for _ in range(S):
+        counters, carry = step(dsm.pool, counters, table_d, rtable_d,
+                               rkey_d, carry)
+    jax.block_until_ready(carry)
+    dsm.counters = counters  # hand the donated handle back
+    step_idx, ok, n_correct, sum_nu, max_nu = map(
+        lambda x: int(np.asarray(x)), carry)
+    assert step_idx == S and ok == 1
+    assert n_correct == S * batch, \
+        f"{S * batch - n_correct} client ops returned wrong/missing values"
+    assert 0 < max_nu <= batch and sum_nu >= max_nu
+    if theta == 0.99:
+        # zipf-skewed batches must actually combine (duplicate head keys)
+        assert sum_nu < S * batch
+
+
+def test_staged_step_multinode(eight_devices):
+    import jax
+    salt = 0x5E17_AB1E_5A17
+    n_keys = 20_000
+    batch = 1024
+    eng = _build_engine(n_keys, salt, machine_nr=8, B=1024)
+    step, (new_carry, table_d, rtable_d, rkey_d) = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16)
+    carry = new_carry()
+    dsm = eng.dsm
+    counters = dsm.counters
+    S = 3
+    for _ in range(S):
+        counters, carry = step(dsm.pool, counters, table_d, rtable_d,
+                               rkey_d, carry)
+    jax.block_until_ready(carry)
+    dsm.counters = counters
+    step_idx, ok, n_correct, sum_nu, max_nu = map(
+        lambda x: int(np.asarray(x)), carry)
+    assert step_idx == S and ok == 1
+    # every node's batch client ops verified (psum across the mesh)
+    assert n_correct == S * batch * 8, \
+        f"{S * batch * 8 - n_correct} client ops wrong across the mesh"

@@ -176,6 +176,83 @@ print(f"[{pid}] ENGINE-PASS splits={stats['device_splits']}", flush=True)
 '''
 
 
+_RECLAIM_WORKER = r'''
+import os, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["SHERMAN_COORD"] = f"localhost:{port}"
+os.environ["SHERMAN_NPROC"] = str(nproc)
+os.environ["SHERMAN_PROC_ID"] = str(pid)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.parallel import bootstrap
+
+keeper = bootstrap.init_multihost()
+
+# Reclamation as a replicated COLLECTIVE across a process-spanning mesh
+# (the pod-scale gap the reference has everywhere, DSM.h:226): both
+# processes run the identical reclaim calls; the plan is deterministic
+# over mirrored state, the lock/verify/write steps ride the leader-
+# posted ReplicatedDSM, and the mirrored allocator pools must stay in
+# lock-step.
+cfg = DSMConfig(machine_nr=4, pages_per_node=512, locks_per_node=256,
+                step_capacity=128, host_step_capacity=16, chunk_pages=8)
+cluster = Cluster(cfg, keeper=keeper)
+assert cluster.dsm.multihost
+tree = Tree(cluster)
+eng = batched.BatchedEngine(tree, batch_per_node=128)
+
+keys = np.arange(1, 3001, dtype=np.uint64) * np.uint64(7)
+batched.bulk_load(tree, keys, keys + np.uint64(1), fill=0.9)
+eng.attach_router()
+
+dead = keys[(keys > 700) & (keys < 9000)]
+eng.delete(dead)
+
+freed = unlinked = 0
+for _ in range(4):
+    st = eng.reclaim_empty_leaves()
+    unlinked += st["unlinked"]
+    freed += st["freed"]
+assert unlinked > 0, "no leaves unlinked across the mesh"
+assert freed > 0, f"quarantine never released (unlinked={unlinked})"
+
+# mirrored pools must be identical on every process: sum of local pool
+# sizes across processes == nproc * local value
+local_free = sum(d.allocator.pages_free for d in cluster.directories)
+total = keeper.sum("free-pool", int(local_free))
+assert total == nproc * local_free, (total, local_free)
+
+kept = np.setdiff1d(keys, dead)
+got, found = eng.search(kept)
+assert found.all(), f"lost {int((~found).sum())} keys after reclaim"
+np.testing.assert_array_equal(got, kept + np.uint64(1))
+_, f2 = eng.search(dead[:300])
+assert not f2.any()
+info = tree.check_structure()
+assert info["keys"] == kept.size
+
+# reclaimed pages must be allocatable again, in lock-step: insert a
+# fresh band that forces splits (grants served from the freed pools)
+fresh = np.arange(1, 1501, dtype=np.uint64) * np.uint64(7) \
+    + np.uint64(50000)
+eng.insert(fresh, fresh)
+got3, found3 = eng.search(fresh)
+assert found3.all()
+tree.check_structure()
+
+keeper.barrier("done")
+print(f"[{pid}] RECLAIM-PASS unlinked={unlinked} freed={freed}",
+      flush=True)
+'''
+
+
 _SPLIT_STORM_WORKER = r'''
 import os, sys
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
@@ -275,6 +352,13 @@ def test_two_process_engine(tmp_path):
     bulk_load spread over all nodes (cross-host MALLOC), batched insert
     with device-side splits, search, delete, structure check."""
     _run_workers(tmp_path, _ENGINE_WORKER, 900, "ENGINE-PASS")
+
+
+def test_two_process_reclaim(tmp_path):
+    """Empty-leaf reclamation as a replicated collective on a
+    process-spanning mesh: unlink + quarantine + free in lock-step,
+    mirrored pools identical, freed pages re-allocatable."""
+    _run_workers(tmp_path, _RECLAIM_WORKER, 900, "RECLAIM-PASS")
 
 
 def test_two_process_split_storm(tmp_path):

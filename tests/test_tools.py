@@ -115,3 +115,25 @@ def test_benchmark_driver_combined_read_multinode(eight_devices, capsys):
                         "--combine", "on"])
     assert r["peak_ops"] > 0
     assert "in-step fan-out" in capsys.readouterr().out
+
+
+def test_benchmark_driver_exchange_pallas_skip(eight_devices, capsys):
+    """--exchange pallas on a 1-node mesh must auto-skip with one JSON
+    line (the first-pod command is safe to fire anywhere)."""
+    import benchmark
+    r = benchmark.main(["1", "100", "1", "--keys", "5000", "--secs", "1",
+                        "--exchange", "pallas"])
+    assert "skipped" in r and "multi-device" in r["skipped"]
+
+
+def test_benchmark_driver_exchange_pallas_drill(eight_devices, capsys):
+    """--exchange pallas on a multi-node mesh: the engine drill runs on
+    BOTH transports and the DSM counter diff must be exactly zero, then
+    the benchmark itself runs on the pallas exchange (interpreter mode
+    on the CPU mesh; the same command compiles on a real pod)."""
+    import benchmark
+    r = benchmark.main(["2", "100", "1", "--keys", "5000", "--secs", "1",
+                        "--ops-per-coro", "4", "--exchange", "pallas"])
+    assert r["peak_ops"] > 0
+    out = capsys.readouterr().out
+    assert "counter diff vs xla: none (exact match)" in out

@@ -40,7 +40,8 @@ def setup_platform(n_nodes: int):
 
 
 def build_cluster(n_nodes: int, pages_per_node: int, batch_per_node: int,
-                  locks_per_node: int = 65_536, chunk_pages: int = 4096):
+                  locks_per_node: int = 65_536, chunk_pages: int = 4096,
+                  exchange_impl: str = "xla"):
     from sherman_tpu.cluster import Cluster
     from sherman_tpu.config import DSMConfig, TreeConfig
     from sherman_tpu.models import batched
@@ -48,7 +49,8 @@ def build_cluster(n_nodes: int, pages_per_node: int, batch_per_node: int,
 
     cfg = DSMConfig(machine_nr=n_nodes, pages_per_node=pages_per_node,
                     locks_per_node=locks_per_node,
-                    step_capacity=batch_per_node, chunk_pages=chunk_pages)
+                    step_capacity=batch_per_node, chunk_pages=chunk_pages,
+                    exchange_impl=exchange_impl)
     cluster = Cluster(cfg)
     tree = Tree(cluster)
     eng = batched.BatchedEngine(tree, batch_per_node=batch_per_node,

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Decompose the fused device-staged step on the real chip: full step vs
+prep-only vs serve-only, same shard_map structure, same tree."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache"))
+
+    from sherman_tpu import native
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig, LEAF_CAP, TreeConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+    from sherman_tpu.workload import device_prep
+
+    n_keys = int(os.environ.get("KEYS", 10_000_000))
+    batch = int(os.environ.get("B", 4_194_304))
+    theta = 0.99
+    salt = 0x5E17_AB1E_5A17
+    fill = 0.75
+    per_leaf = max(1, int(LEAF_CAP * fill))
+    est_pages = int(n_keys / per_leaf * 1.10) + 8192
+    pages = 1 << max(14, (est_pages - 1).bit_length())
+    cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
+                    locks_per_node=65_536, step_capacity=batch,
+                    chunk_pages=4096)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=batch,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    keys, _ = native.synthetic_keyspace(n_keys, salt)
+    t0 = time.time()
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0xDEADBEEF), fill=fill)
+    eng.attach_router()
+    print(f"bulk_load {time.time() - t0:.1f}s", flush=True)
+
+    dev_b = int(os.environ.get("DEVB", 1_097_728 + 16384))
+    step, (new_carry, table_d, rtable_d, rkey_d) = \
+        device_prep.make_staged_step(eng, n_keys=n_keys, theta=theta,
+                                     salt=salt, batch=batch, dev_b=dev_b)
+    dsm = eng.dsm
+    pool, counters = dsm.pool, dsm.counters
+    K = int(os.environ.get("K", 8))
+
+    def timeit(name, fn, *args, reps=K):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        o = out
+        for _ in range(reps):
+            o = fn(*args)
+        jax.block_until_ready(o)
+        print(f"{name:16s} {(time.time() - t0) / reps * 1e3:9.1f} ms",
+              flush=True)
+        return out
+
+    # A. full fused step
+    carry = new_carry()
+    out = step(pool, counters, table_d, rtable_d, rkey_d, carry)
+    jax.block_until_ready(out)
+    counters, carry = out
+    t0 = time.time()
+    for _ in range(K):
+        counters, carry = step(pool, counters, table_d, rtable_d,
+                               rkey_d, carry)
+    jax.block_until_ready(carry)
+    print(f"{'full_step':16s} {(time.time() - t0) / K * 1e3:9.1f} ms",
+          flush=True)
+    dsm.counters = counters
+
+    # A2. the two chained programs separately
+    carry = new_carry()
+    _, *arrs = step.jprep(table_d, rtable_d, rkey_d, carry[0])
+    jax.block_until_ready(arrs[0])
+    t0 = time.time()
+    for i in range(K):
+        si, *arrs2 = step.jprep(table_d, rtable_d, rkey_d,
+                                np.uint32(i + 1))
+    jax.block_until_ready(arrs2[0])
+    print(f"{'jprep':16s} {(time.time() - t0) / K * 1e3:9.1f} ms",
+          flush=True)
+    rc = tuple(carry[1:])
+    ctr0 = dsm.counters
+    ctr0, rc = step.jserve(pool, ctr0, rc, *arrs2)
+    jax.block_until_ready(rc)
+    t0 = time.time()
+    for i in range(K):
+        _, *arrs2 = step.jprep(table_d, rtable_d, rkey_d, np.uint32(i))
+        jax.block_until_ready(arrs2[0])
+        t1 = time.time()
+        ctr0, rc = step.jserve(pool, ctr0, rc, *arrs2)
+        jax.block_until_ready(rc)
+        print(f"  jserve rep {i}: {(time.time() - t1) * 1e3:9.1f} ms",
+              flush=True)
+    dsm.counters = ctr0
+
+    # B. prep-only, same shard_map structure
+    import functools
+    from jax import lax
+    from sherman_tpu.models.batched import AXIS
+    from sherman_tpu.ops import bits
+
+    spec, rep = eng._spec, eng._rep
+    shift, nb = int(eng.router.shift), int(eng.router.nb)
+    LB = 20
+    salt_hi = np.uint32(salt >> 32)
+    salt_lo = np.uint32(salt & 0xFFFFFFFF)
+
+    def prep_kernel(tpair, rtable, rkey, c):
+        k = jax.random.fold_in(rkey, c)
+        w = jax.random.bits(k, (2, batch), dtype=jnp.uint32)
+        bin_ = (w[0] >> (32 - LB)).astype(jnp.int32)
+        t2 = tpair[bin_]
+        lo_r, hi_r = t2[:, 0], t2[:, 1]
+        frac = (w[1] >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+        rank = lo_r + ((hi_r - lo_r).astype(jnp.float32)
+                       * frac).astype(jnp.int32)
+        rank = jnp.clip(rank, 0, n_keys - 1)
+        xlo = lax.bitcast_convert_type(rank, jnp.uint32) ^ salt_lo
+        xhi = jnp.full((batch,), salt_hi, jnp.uint32)
+        khi_u, klo_u = bits.mix64_pair(xhi, xlo)
+        skhi, sklo = lax.sort((khi_u, klo_u), num_keys=2)
+        first = jnp.concatenate([
+            jnp.ones((1,), jnp.uint32),
+            ((skhi[1:] != skhi[:-1])
+             | (sklo[1:] != sklo[:-1])).astype(jnp.uint32)])
+        seg = (jnp.cumsum(first) - 1).astype(jnp.int32)
+        n_uniq = seg[-1] + 1
+        _, ckhi, cklo = lax.sort((jnp.uint32(1) - first, skhi, sklo),
+                                 num_keys=3)
+        ukhi, uklo = ckhi[:dev_b], cklo[:dev_b]
+        active = lax.iota(jnp.int32, dev_b) < n_uniq
+        bhi, blo = bits.u64_shr(ukhi, uklo, shift)
+        bucket = jnp.where(bhi != 0, jnp.uint32(nb - 1),
+                           jnp.minimum(blo, jnp.uint32(nb - 1)))
+        start = rtable[bucket.astype(jnp.int32)]
+        return (ukhi.sum() + uklo.sum() + seg.sum()
+                + start.sum() + active.sum() + n_uniq)
+
+    sm = jax.shard_map(prep_kernel, mesh=dsm.mesh,
+                       in_specs=(rep, rep, rep, rep), out_specs=rep,
+                       check_vma=False)
+    jprep = jax.jit(sm)
+    timeit("prep_only", jprep, table_d, rtable_d, rkey_d, np.uint32(1))
+
+    # C. serve-only: the throughput-phase fanout kernel on one host-
+    # staged batch of the same width
+    prep_h = native.BatchPrep(batch, dev_b, n_keys, theta, seed=11,
+                              salt=salt)
+    buf = prep_h.buffers()
+    b = prep_h.run_zipf(None, buf, eng.router.table_np, eng.router.shift)
+    fn = eng._get_search_fanout(eng._iters())
+    shard = dsm.shard
+    d = (jax.device_put(b.khi, shard), jax.device_put(b.klo, shard),
+         jax.device_put(b.start, shard),
+         jax.device_put(b.active.view(bool), shard),
+         jax.device_put(b.inv, shard))
+    root = np.int32(tree._root_addr)
+    ctr = dsm.counters
+
+    out = fn(pool, ctr, d[0], d[1], root, d[3], d[2], d[4])
+    jax.block_until_ready(out[2])
+    ctr = out[0]
+    t0 = time.time()
+    for _ in range(K):
+        out = fn(pool, ctr, d[0], d[1], root, d[3], d[2], d[4])
+        ctr = out[0]
+    jax.block_until_ready(out[2])
+    print(f"{'serve_only':16s} {(time.time() - t0) / K * 1e3:9.1f} ms",
+          flush=True)
+    dsm.counters = ctr
+
+
+if __name__ == "__main__":
+    main()
