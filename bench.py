@@ -243,7 +243,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             # a different stream, so give its unique counts their own
             # slack (cross-batch spread is ~0.1%; overflow voids the
             # phase via the ok receipt)
-            dev_b2 = dev_b + 16384
+            dev_b2 = min(batch, dev_b + 16384)
             step_fn, (new_carry, table_d, rtable_d, rkey_d) = \
                 make_staged_step(eng, n_keys=n_keys, theta=theta,
                                  salt=salt, batch=batch, dev_b=dev_b2)
@@ -257,11 +257,25 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             assert w_corr == batch, \
                 f"device-staged warmup: {batch - w_corr} ops wrong"
             dev_steps = max(32, min(96, int(secs / 0.1)))
+            # Windowed dispatch: PJRT allocates a step's output buffers
+            # at ENQUEUE time, so queueing ~100 steps ahead pins
+            # (~75 MB of prep intermediates) x depth of HBM before the
+            # device catches up — at the 100 M-key pool (4.3 GB) that
+            # measured 6x slower per step than the 10 M-key pool.
+            # Bounding in-flight steps by blocking on the carry from
+            # W steps back keeps the allocator happy; the sync cost
+            # amortizes over W.
+            W = int(os.environ.get("SHERMAN_BENCH_DEVWINDOW", 16))
+            from collections import deque
+            pend: deque = deque()
             carry = new_carry()
             t0 = time.time()
             for _ in range(dev_steps):
                 counters, carry = step_fn(pool, counters, table_d,
                                           rtable_d, rkey_d, carry)
+                pend.append(carry[0])
+                if len(pend) > W:
+                    jax.block_until_ready(pend.popleft())
             jax.block_until_ready(carry)
             dev_elapsed = time.time() - t0
             _, d_ok, d_corr, d_sum_nu, d_max_nu = (

@@ -137,3 +137,24 @@ def test_benchmark_driver_exchange_pallas_drill(eight_devices, capsys):
     assert r["peak_ops"] > 0
     out = capsys.readouterr().out
     assert "counter diff vs xla: none (exact match)" in out
+
+
+def test_churn_bench_driver(eight_devices, capsys):
+    """Drifting-keyspace churn + reclaim on a bounded pool (CPU smoke
+    of tools/churn_bench.py): the loop must hold integrity and keep
+    occupancy within the steady-state band."""
+    import json
+
+    import churn_bench
+    import sys as _sys
+    argv = _sys.argv
+    _sys.argv = ["churn_bench.py", "--keys", "30000", "--window", "2500",
+                 "--iters", "6", "--chunk", "8192"]
+    try:
+        churn_bench.main()
+    finally:
+        _sys.argv = argv
+    out = capsys.readouterr().out
+    r = json.loads(out.strip().splitlines()[-1])
+    assert r["tree_keys"] == 30000
+    assert r["freed"] > 0 and r["pool_flat"], r
