@@ -51,6 +51,7 @@ reports p50/p99 in ms.  The throughput window itself stays pipelined
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -184,6 +185,26 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     sus_prep_ms = sus_put_ms = sus_ms_per_step = None
     sus_dev_ms_per_step = sus_dev_combine = None
     sort_ms = None  # staged-phase start-sort cost (native combine only)
+
+    def run_windowed(n_steps, advance):
+        """Dispatch n_steps with a bounded in-flight window: block on
+        the carry from W steps back (PJRT allocates a step's output
+        buffers at ENQUEUE time — ~100 queued steps pinned ~7 GB of
+        prep intermediates and ran 5-20x slower at the 100 M-key pool;
+        W=8-16 measured optimal), then drain the final carry.  Returns
+        elapsed seconds."""
+        from collections import deque
+        W = int(os.environ.get("SHERMAN_BENCH_DEVWINDOW", 16))
+        pend: deque = deque()
+        c = None
+        t0 = time.time()
+        for _ in range(n_steps):
+            c = advance()
+            pend.append(c[0])
+            if len(pend) > W:
+                jax.block_until_ready(pend.popleft())
+        jax.block_until_ready(c)
+        return time.time() - t0
     if combine and salt is not None:
         # static unique capacity: gather cost is per-row, so round up only
         # to the next 8192 (NOT a power of two — a 2^k pad can cost >10%);
@@ -257,27 +278,15 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             assert w_corr == batch, \
                 f"device-staged warmup: {batch - w_corr} ops wrong"
             dev_steps = max(32, min(96, int(secs / 0.1)))
-            # Windowed dispatch: PJRT allocates a step's output buffers
-            # at ENQUEUE time, so queueing ~100 steps ahead pins
-            # (~75 MB of prep intermediates) x depth of HBM before the
-            # device catches up — at the 100 M-key pool (4.3 GB) that
-            # measured 6x slower per step than the 10 M-key pool.
-            # Bounding in-flight steps by blocking on the carry from
-            # W steps back keeps the allocator happy; the sync cost
-            # amortizes over W.
-            W = int(os.environ.get("SHERMAN_BENCH_DEVWINDOW", 16))
-            from collections import deque
-            pend: deque = deque()
             carry = new_carry()
-            t0 = time.time()
-            for _ in range(dev_steps):
+
+            def adv_ro():
+                nonlocal counters, carry
                 counters, carry = step_fn(pool, counters, table_d,
                                           rtable_d, rkey_d, carry)
-                pend.append(carry[0])
-                if len(pend) > W:
-                    jax.block_until_ready(pend.popleft())
-            jax.block_until_ready(carry)
-            dev_elapsed = time.time() - t0
+                return carry
+
+            dev_elapsed = run_windowed(dev_steps, adv_ro)
             _, d_ok, d_corr, d_sum_nu, d_max_nu = (
                 int(np.asarray(x)) for x in carry)
             assert d_ok == 1, "device-staged: unique overflow mid-run"
@@ -580,6 +589,80 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         tree.insert(int(k), int(v))  # in-place update, values unchanged
     host_insert_us = (time.time_ns() - t1) / loops / 1e3
 
+    # DEVICE-STAGED sustained MIXED loop (YCSB-A 50/50 shape) — the same
+    # nothing-shipped open loop as the read-only sustained phase, with
+    # half the clients issuing in-place updates through the fused
+    # mixed_step_spmd descent (reads pre-step snapshot, writes at the
+    # step boundary).  Write values stamp the writing step, so the
+    # on-device read check is a LINEARIZATION receipt: a read must never
+    # observe its own step's writes.  Runs LAST: it rewrites values, so
+    # every key ^ 0xDEADBEEF check above must already have happened.
+    sus_mixed_ops_s = sus_mixed_ms = sus_mixed_combine = None
+    if combine and salt is not None \
+            and os.environ.get("SHERMAN_BENCH_DEVMIXED", "1") != "0":
+        from sherman_tpu.workload.device_prep import make_staged_mixed_step
+        read_ratio = 0.5
+        R_m = int(round(batch * read_ratio))
+        cap0 = min(R_m, dev_b + 16384)
+        pool, counters = tree.dsm.pool, tree.dsm.counters
+        mk = functools.partial(
+            make_staged_mixed_step, eng, n_keys=n_keys, theta=theta,
+            salt=salt, batch=batch, read_ratio=read_ratio)
+        mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(dev_rb=cap0, dev_wb=cap0)
+        mc = new_mc()
+        pool, counters, mc = mstep(pool, tree.dsm.locks, counters, mt_d,
+                                   mrt_d, mrk_d, mc)
+        jax.block_until_ready(mc)
+        m_ok, m_cr, m_cw, _, m_mr, m_mw = (
+            int(np.asarray(x)) for x in mc[1:7])
+        assert m_ok == 1 and m_cr == R_m and m_cw == batch - R_m, \
+            f"mixed warmup: ok={m_ok} reads {R_m - m_cr} writes " \
+            f"{batch - R_m - m_cw} wrong"
+        # retighten the row caps to the measured per-class unique counts
+        # (rounded up for compile-cache stability); the descent + apply
+        # cost per ROW, so generous caps overpay.  The carry is NEVER
+        # reset after this point: the pool already holds warmup step
+        # stamps, so a fresh carry's sidx=0 would reject them as
+        # future-valued — receipts are deltas from the warmup baseline.
+        rcap = min(R_m, -(-int(m_mr * 1.04) // 65536) * 65536)
+        wcap = min(batch - R_m, -(-int(m_mw * 1.04) // 65536) * 65536)
+        if (rcap, wcap) != (cap0, cap0):
+            # staged= reuses the resident zipf/router/PRNG tables — the
+            # rebuild only recompiles the step for the tighter row caps
+            mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(
+                dev_rb=rcap, dev_wb=wcap, staged=(mt_d, mrt_d, mrk_d))
+        pool, counters, mc = mstep(pool, tree.dsm.locks, counters, mt_d,
+                                   mrt_d, mrk_d, mc)
+        jax.block_until_ready(mc)
+        b_cr, b_cw, b_snu = (int(np.asarray(x)) for x in
+                             (mc[2], mc[3], mc[4]))
+        m_steps = max(24, min(64, int(secs / 0.15)))
+
+        def adv_mixed():
+            nonlocal pool, counters, mc
+            pool, counters, mc = mstep(pool, tree.dsm.locks, counters,
+                                       mt_d, mrt_d, mrk_d, mc)
+            return mc
+
+        m_elapsed = run_windowed(m_steps, adv_mixed)
+        tree.dsm.pool, tree.dsm.counters = pool, counters
+        m_ok, m_cr, m_cw, m_snu = (int(np.asarray(x)) for x in mc[1:5])
+        m_cr, m_cw, m_snu = m_cr - b_cr, m_cw - b_cw, m_snu - b_snu
+        assert m_ok == 1, "mixed sustained: unique overflow mid-run"
+        assert m_cr == m_steps * R_m, \
+            f"mixed: {m_steps * R_m - m_cr} reads wrong/future-valued"
+        assert m_cw == m_steps * (batch - R_m), \
+            f"mixed: {m_steps * (batch - R_m) - m_cw} writes unapplied"
+        sus_mixed_ops_s = m_steps * batch / m_elapsed
+        sus_mixed_ms = m_elapsed / m_steps * 1e3
+        sus_mixed_combine = m_steps * batch / max(1, m_snu)
+        print(f"# sustained(device-staged MIXED 50/50): {m_steps} steps "
+              f"in {m_elapsed:.2f}s -> {sus_mixed_ops_s / 1e6:.1f} M "
+              f"ops/s ({sus_mixed_ms:.1f} ms/step; combine "
+              f"{sus_mixed_combine:.2f}x, row caps {rcap}+{wcap}; all "
+              f"{m_cr} reads linearization-checked, {m_cw} writes "
+              f"ST_APPLIED, on device)", file=sys.stderr)
+
     print(f"# {steps} steps in {elapsed:.2f}s "
           f"({elapsed / steps * 1e3:.2f} ms/step, dev rows/s "
           f"{device_rows_s / 1e6:.1f}M); lat p50 {p50_ms:.2f} ms "
@@ -628,6 +711,12 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         if sus_dev_ms_per_step else None,
         "sus_dev_combine": round(sus_dev_combine, 2)
         if sus_dev_combine else None,
+        "sus_mixed_ops_s": round(sus_mixed_ops_s) if sus_mixed_ops_s
+        else None,
+        "sus_mixed_ms_per_step": round(sus_mixed_ms, 1) if sus_mixed_ms
+        else None,
+        "sus_mixed_combine": round(sus_mixed_combine, 2)
+        if sus_mixed_combine else None,
         "sus_host_ops_s": round(sus_host_ops_s) if sus_host_ops_s else None,
         "sus_prep_ms": round(sus_prep_ms, 1) if sus_prep_ms else None,
         "sus_h2d_ms": round(sus_put_ms, 1) if sus_put_ms else None,

@@ -104,9 +104,91 @@ def zipf_table(n: int, theta: float, log2_bins: int = 20) -> np.ndarray:
     return table
 
 
+def _gen_ranks(tpair, w, *, log2_bins: int, n_keys: int):
+    """Zipf ranks from two uint32 PRNG words per sample: bin from the
+    top ``log2_bins`` bits (CDF-exact edges), f32 lerp within the bin on
+    24 fresh bits.  ``tpair`` is the [nb, 2] edge-pair table — one
+    random gather per sample, not two (random HBM access is the
+    dominant prep cost on chip — ~15 ns/row)."""
+    import jax.numpy as jnp
+
+    bin_ = (w[0] >> (32 - log2_bins)).astype(jnp.int32)
+    t2 = tpair[bin_]                     # [batch, 2]
+    lo_r, hi_r = t2[:, 0], t2[:, 1]
+    frac = (w[1] >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    rank = lo_r + ((hi_r - lo_r).astype(jnp.float32)
+                   * frac).astype(jnp.int32)
+    return jnp.clip(rank, 0, n_keys - 1)
+
+
+def _keys_of_ranks(rank, salt_hi, salt_lo):
+    """The synthetic rank->key map, bit-for-bit the native one:
+    ``mix64(rank ^ salt)`` on (hi, lo) uint32 pairs.  Ranks < 2^31, so
+    the high word of ``rank ^ salt`` is salt's high word."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    xlo = lax.bitcast_convert_type(rank, jnp.uint32) ^ salt_lo
+    xhi = jnp.full(rank.shape, salt_hi, jnp.uint32)
+    return bits.mix64_pair(xhi, xlo)
+
+
+def _sort_combine(khi, klo, cap):
+    """Sort-based request combining: clients served in key-sorted order
+    (no index payload, no inverse-permutation scatter).  Returns the
+    sorted client keys, the unique rows compacted to ``cap``, the
+    client->row segment map, and the unique count.
+
+    The unique set is compacted with a flag-sort: plain 3-key sort, NOT
+    ``is_stable=True`` — the composite (flag, khi, klo) is already a
+    total order on the rows that matter (first rows have distinct
+    keys), and the stable-sort path measured ~12x slower on chip.
+    Sorts are ~4x cheaper than the equivalent scatters on chip."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    skhi, sklo = lax.sort((khi, klo), num_keys=2)
+    first = jnp.concatenate([
+        jnp.ones((1,), jnp.uint32),
+        ((skhi[1:] != skhi[:-1])
+         | (sklo[1:] != sklo[:-1])).astype(jnp.uint32)])
+    seg = (jnp.cumsum(first) - 1).astype(jnp.int32)
+    n_uniq = seg[-1] + 1
+    _, ckhi, cklo = lax.sort((jnp.uint32(1) - first, skhi, sklo),
+                             num_keys=3)
+    return skhi, sklo, ckhi[:cap], cklo[:cap], seg, n_uniq
+
+
+def _router_probe(rtable, ukhi, uklo, shift, nb):
+    """Index-cache probe: bucket = min(key >> shift, nb - 1), one
+    gather from the router table."""
+    import jax.numpy as jnp
+
+    bhi, blo = bits.u64_shr(ukhi, uklo, shift)
+    bucket = jnp.where(bhi != 0, jnp.uint32(nb - 1),
+                       jnp.minimum(blo, jnp.uint32(nb - 1)))
+    return rtable[bucket.astype(jnp.int32)]
+
+
+def _stage_inputs(router, n_keys: int, theta: float, log2_bins: int,
+                  seed: int):
+    """Stage the step's device-resident inputs once, before any timed
+    region: the [nb, 2] zipf edge-pair table, the router table, and the
+    PRNG key."""
+    import jax
+
+    t = zipf_table(n_keys, theta, log2_bins)
+    table_d = jax.device_put(np.stack([t[:-1], t[1:]], axis=1))
+    with router._read_locked():
+        rtable_d = jax.device_put(router.table_np)
+    rkey_d = jax.device_put(jax.random.PRNGKey(seed))
+    return table_d, rtable_d, rkey_d
+
+
 def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                      batch: int, dev_b: int, log2_bins: int = 20,
-                     check_xor: int = 0xDEADBEEF, seed: int = 11):
+                     check_xor: int = 0xDEADBEEF, seed: int = 11,
+                     staged=None):
     """Build the device-staged serving step for ``eng`` (a
     :class:`~sherman_tpu.models.batched.BatchedEngine` with an attached
     router).
@@ -168,48 +250,15 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         k = jax.random.fold_in(rkey, step_idx * np.uint32(N)
                                + node.astype(jnp.uint32))
         w = jax.random.bits(k, (2, batch), dtype=jnp.uint32)
-        # zipf rank: bin from the top LB bits (CDF-exact edges), f32
-        # lerp within the bin on 24 fresh bits.  The table is staged as
-        # [nb, 2] = (edge_i, edge_{i+1}) pairs so the bin lookup is ONE
-        # random gather, not two (random HBM access is the dominant prep
-        # cost on chip — ~15 ns/row).
-        bin_ = (w[0] >> (32 - LB)).astype(jnp.int32)
-        t2 = tpair[bin_]                     # [batch, 2]
-        lo_r, hi_r = t2[:, 0], t2[:, 1]
-        frac = (w[1] >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
-        rank = lo_r + ((hi_r - lo_r).astype(jnp.float32)
-                       * frac).astype(jnp.int32)
-        rank = jnp.clip(rank, 0, n_keys - 1)
-        # key = mix64(rank ^ salt): ranks < 2^31 so the high word of
-        # (rank ^ salt) is salt's high word
-        xlo = lax.bitcast_convert_type(rank, jnp.uint32) ^ salt_lo
-        xhi = jnp.full((batch,), salt_hi, jnp.uint32)
-        khi_u, klo_u = bits.mix64_pair(xhi, xlo)
+        rank = _gen_ranks(tpair, w, log2_bins=LB, n_keys=n_keys)
+        khi_u, klo_u = _keys_of_ranks(rank, salt_hi, salt_lo)
         # sort-based unique (request combining): clients are served in
         # key-sorted order (see module docstring), so no index payload
         # and no inverse-permutation scatter are needed
-        skhi, sklo = lax.sort((khi_u, klo_u), num_keys=2)
-        first = jnp.concatenate([
-            jnp.ones((1,), jnp.uint32),
-            ((skhi[1:] != skhi[:-1])
-             | (sklo[1:] != sklo[:-1])).astype(jnp.uint32)])
-        seg = (jnp.cumsum(first) - 1).astype(jnp.int32)  # [batch] slots
-        n_uniq = seg[-1] + 1
-        # compact the unique set with a flag-sort: first occurrences to
-        # the front, key order preserved.  Plain 3-key sort, NOT
-        # is_stable=True — the composite (flag, khi, klo) is already a
-        # total order on the rows that matter (first rows have distinct
-        # keys), and the stable-sort path measured ~12x slower on chip.
-        # Sorts are ~4x cheaper than the equivalent scatters on chip.
-        _, ckhi, cklo = lax.sort((jnp.uint32(1) - first, skhi, sklo),
-                                 num_keys=3)
-        ukhi, uklo = ckhi[:dev_b], cklo[:dev_b]
+        skhi, sklo, ukhi, uklo, seg, n_uniq = _sort_combine(
+            khi_u, klo_u, dev_b)
         active = lax.iota(jnp.int32, dev_b) < n_uniq
-        # router probe: bucket = min(key >> shift, nb - 1)
-        bhi, blo = bits.u64_shr(ukhi, uklo, shift)
-        bucket = jnp.where(bhi != 0, jnp.uint32(nb - 1),
-                           jnp.minimum(blo, jnp.uint32(nb - 1)))
-        start = rtable[bucket.astype(jnp.int32)]
+        start = _router_probe(rtable, ukhi, uklo, shift, nb)
         # n_uniq ships as a [1] array so it shards per node like the rest
         return (step_idx + np.uint32(1), skhi, sklo, ukhi, uklo, start,
                 active, seg, n_uniq[None])
@@ -286,9 +335,201 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                      for v in (np.uint32(0), np.int32(1), np.int32(0),
                                np.int32(0), np.int32(0)))
 
-    t = zipf_table(n_keys, theta, LB)
-    table_d = jax.device_put(np.stack([t[:-1], t[1:]], axis=1))  # [nb, 2]
-    with router._read_locked():
-        rtable_d = jax.device_put(router.table_np)
-    rkey_d = jax.device_put(jax.random.PRNGKey(seed))
+    table_d, rtable_d, rkey_d = staged or _stage_inputs(
+        router, n_keys, theta, LB, seed)
+    return step, (new_carry, table_d, rtable_d, rkey_d)
+
+
+def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
+                           batch: int, read_ratio: float, dev_rb: int,
+                           dev_wb: int, log2_bins: int = 20,
+                           check_xor: int = 0xDEADBEEF, seed: int = 13,
+                           staged=None):
+    """Device-staged sustained MIXED loop (YCSB-A/B shape): the same
+    nothing-shipped open loop as :func:`make_staged_step`, but each step
+    carries both point lookups and in-place updates through ONE fused
+    ``mixed_step_spmd`` descent (reads see the pre-step snapshot, writes
+    apply at the step boundary — reference parity:
+    ``test/benchmark.cpp:159-188`` with ``kReadRatio < 100``).
+
+    Client layout per node per step: ``R = round(batch * read_ratio)``
+    read clients then ``batch - R`` write clients (roles fixed by slot;
+    keys are iid zipf draws, so a fixed per-step count is the
+    hypergeometric twin of the reference's per-op biased coin — same
+    marginal mix, no dynamic shapes).  Each class is combined
+    independently by the sort/flag-sort pipeline and served from the
+    ``[reads | writes]`` row block the engine's half-width apply expects
+    (``mixed_step_spmd`` ``write_lo``).
+
+    Write values ENCODE THE WRITING STEP: ``v = key ^ check_xor ^
+    (step + 1)`` (uint64, step in the low word).  Combining stays sound
+    — a step's duplicate writes carry identical values, so supersede
+    returns the value every duplicate wrote — and read verification
+    becomes a linearization check, on device, inside the timed loop: a
+    read's value must decode to a step STRICTLY BEFORE its own
+    (``decoded <= step`` with writers stamping ``step + 1``), i.e.
+    reads must observe the pre-step snapshot, never their own step's
+    writes.  Bulk-loaded values decode to 0 and pass.
+
+    Write receipts: every unique write row must come back ``ST_APPLIED``
+    (update-only over live keys; on multi-node meshes a cross-node
+    same-key duplicate may be ``ST_SUPERSEDED`` by the identical-value
+    winner — also a success), and every write client's fanned-out
+    status is checked in-step.
+
+    Carry fields (replicated scalars):
+
+        (step_idx, ok, n_correct_reads, n_ok_writes, sum_nuniq,
+         max_nuniq_r, max_nuniq_w, serve_step_idx)
+
+    ``serve_step_idx`` is the serve program's OWN step counter (prep's
+    is already bumped when serve runs, so the linearization check keeps
+    a separate one).  After S steps ``n_correct_reads ==
+    S * R * machine_nr`` and ``n_ok_writes == S * (batch - R) *
+    machine_nr`` or the phase is void."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from sherman_tpu.models.batched import (
+        AXIS, ST_APPLIED, ST_SUPERSEDED, mixed_step_spmd)
+
+    router = eng.router
+    assert router is not None, "attach_router() first"
+    cfg = eng.cfg
+    N = cfg.machine_nr
+    iters = eng._iters()
+    spec, rep = eng._spec, eng._rep
+    shift, nb = int(router.shift), int(router.nb)
+    LB = int(log2_bins)
+    root = np.int32(eng.tree._root_addr)
+    salt_hi = np.uint32((salt >> 32) & 0xFFFFFFFF)
+    salt_lo = np.uint32(salt & 0xFFFFFFFF)
+    cx_hi = np.uint32((check_xor >> 32) & 0xFFFFFFFF)
+    cx_lo = np.uint32(check_xor & 0xFFFFFFFF)
+    i32 = lambda x: lax.bitcast_convert_type(x, jnp.int32)
+    u32 = lambda x: lax.bitcast_convert_type(x, jnp.uint32)
+
+    R = int(round(batch * read_ratio))
+    Wc = batch - R
+    assert 0 < R <= batch and Wc > 0, "mixed loop needs both classes"
+    assert R >= dev_rb and Wc >= dev_wb, "dev caps cannot exceed class sizes"
+
+    def prep(tpair, rtable, rkey, step_idx):
+        node = lax.axis_index(AXIS) if N > 1 else jnp.uint32(0)
+        k = jax.random.fold_in(rkey, step_idx * np.uint32(N)
+                               + node.astype(jnp.uint32))
+        w = jax.random.bits(k, (2, batch), dtype=jnp.uint32)
+        rank = _gen_ranks(tpair, w, log2_bins=LB, n_keys=n_keys)
+        khi_u, klo_u = _keys_of_ranks(rank, salt_hi, salt_lo)
+        # slots [0, R) are read clients, [R, batch) write clients; each
+        # class combines independently (same pipeline as the read-only
+        # staged step)
+        rskhi, rsklo, rukhi, ruklo, rseg, r_nu = _sort_combine(
+            khi_u[:R], klo_u[:R], dev_rb)
+        wskhi, wsklo, wukhi, wuklo, wseg, w_nu = _sort_combine(
+            khi_u[R:], klo_u[R:], dev_wb)
+        # the [reads | writes] row block mixed_step_spmd serves
+        akhi = jnp.concatenate([rukhi, wukhi])
+        aklo = jnp.concatenate([ruklo, wuklo])
+        act_r = jnp.concatenate([
+            lax.iota(jnp.int32, dev_rb) < r_nu,
+            jnp.zeros((dev_wb,), bool)])
+        act_w = jnp.concatenate([
+            jnp.zeros((dev_rb,), bool),
+            lax.iota(jnp.int32, dev_wb) < w_nu])
+        # write value = key ^ check_xor ^ (step + 1): identical across a
+        # step's duplicates (combining sound), step-decodable for the
+        # read-side linearization check
+        stamp = step_idx + np.uint32(1)
+        vhi = jnp.concatenate([jnp.zeros((dev_rb,), jnp.uint32),
+                               wukhi ^ cx_hi])
+        vlo = jnp.concatenate([jnp.zeros((dev_rb,), jnp.uint32),
+                               wuklo ^ cx_lo ^ stamp])
+        start = _router_probe(rtable, akhi, aklo, shift, nb)
+        return (step_idx + np.uint32(1), akhi, aklo, vhi, vlo, act_r,
+                act_w, start, rskhi, rsklo, rseg, r_nu[None],
+                wseg, w_nu[None])
+
+    def serve(pool, locks, counters, rcarry, akhi, aklo, vhi, vlo, act_r,
+              act_w, start, rskhi, rsklo, rseg, r_nu_a, wseg, w_nu_a):
+        ok, n_corr_r, n_ok_w, sum_nu, max_nu_r, max_nu_w, sidx = rcarry
+        r_nu, w_nu = r_nu_a[0], w_nu_a[0]
+        pool, counters, status, done_r, found, rvh, rvl = mixed_step_spmd(
+            pool, locks, counters, i32(akhi), i32(aklo), i32(vhi),
+            i32(vlo), root, act_r, act_w, start, cfg=cfg, iters=iters,
+            write_lo=dev_rb, update_only=True)
+        # read fan-out (monotone gather, sorted client order) + the
+        # on-device linearization check: value must decode to a strictly
+        # earlier step (writers stamp step+1; bulk decodes to 0)
+        ans = jnp.stack([found.astype(jnp.int32), rvh, rvl,
+                         jnp.zeros_like(rvh)], axis=-1)[:dev_rb]
+        stat_w = status[dev_rb:]
+        if N > 1:
+            node = lax.axis_index(AXIS)
+            ans = lax.all_gather(ans, AXIS, axis=0, tiled=True)
+            stat_w = lax.all_gather(stat_w, AXIS, axis=0, tiled=True)
+            rseg = rseg + node.astype(jnp.int32) * dev_rb
+            wseg = wseg + node.astype(jnp.int32) * dev_wb
+        out = jnp.take_along_axis(
+            ans, jnp.clip(rseg, 0, ans.shape[0] - 1)[:, None], axis=0)
+        dec_hi = u32(out[:, 1]) ^ rskhi ^ cx_hi
+        dec_lo = u32(out[:, 2]) ^ rsklo ^ cx_lo
+        corr_r = ((out[:, 0] != 0) & (dec_hi == 0) & (dec_lo <= sidx))
+        st_cli = jnp.take_along_axis(
+            stat_w, jnp.clip(wseg, 0, stat_w.shape[0] - 1), axis=0)
+        ok_w = ((st_cli == ST_APPLIED)
+                | ((st_cli == ST_SUPERSEDED) if N > 1
+                   else jnp.zeros_like(st_cli, bool)))
+        inc_r = jnp.sum(corr_r.astype(jnp.int32))
+        inc_w = jnp.sum(ok_w.astype(jnp.int32))
+        step_ok = ((r_nu <= dev_rb) & (w_nu <= dev_wb)).astype(jnp.int32)
+        if N > 1:
+            inc_r = lax.psum(inc_r, AXIS)
+            inc_w = lax.psum(inc_w, AXIS)
+            sum_inc = lax.psum(r_nu + w_nu, AXIS)
+            max_r = lax.pmax(r_nu, AXIS)
+            max_w = lax.pmax(w_nu, AXIS)
+            step_ok = lax.pmin(step_ok, AXIS)
+        else:
+            sum_inc, max_r, max_w = r_nu + w_nu, r_nu, w_nu
+        rcarry = (jnp.minimum(ok, step_ok), n_corr_r + inc_r,
+                  n_ok_w + inc_w, sum_nu + sum_inc,
+                  jnp.maximum(max_nu_r, max_r),
+                  jnp.maximum(max_nu_w, max_w),
+                  sidx + jnp.uint32(1))
+        return pool, counters, rcarry
+
+    mesh = eng.dsm.mesh
+    prep_sm = jax.shard_map(
+        prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
+        out_specs=(rep,) + (spec,) * 13, check_vma=False)
+    jprep = jax.jit(prep_sm)
+    serve_sm = jax.shard_map(
+        serve, mesh=mesh,
+        in_specs=(spec, spec, spec, (rep,) * 7) + (spec,) * 13,
+        out_specs=(spec, spec, (rep,) * 7), check_vma=False)
+    jserve = jax.jit(serve_sm, donate_argnums=(0, 2, 3))
+
+    def step(pool, locks, counters, tpair, rtable, rkey, carry):
+        step_idx, *rcarry = carry
+        step_idx, *arrs = jprep(tpair, rtable, rkey, step_idx)
+        pool, counters, rcarry = jserve(pool, locks, counters,
+                                        tuple(rcarry), *arrs)
+        return pool, counters, (step_idx,) + tuple(rcarry)
+
+    step.jprep, step.jserve = jprep, jserve
+
+    def new_carry():
+        """(step_idx, ok, n_correct_reads, n_ok_writes, sum_nuniq,
+        max_nuniq_r, max_nuniq_w, serve_step_idx) — serve keeps its own
+        step counter (last slot) so its linearization check cannot read
+        prep's already-bumped one."""
+        return tuple(jax.device_put(v)
+                     for v in (np.uint32(0), np.int32(1), np.int32(0),
+                               np.int32(0), np.int32(0), np.int32(0),
+                               np.int32(0), np.uint32(0)))
+
+    table_d, rtable_d, rkey_d = staged or _stage_inputs(
+        router, n_keys, theta, LB, seed)
     return step, (new_carry, table_d, rtable_d, rkey_d)

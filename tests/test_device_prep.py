@@ -154,6 +154,95 @@ def test_staged_step_end_to_end(eight_devices, theta):
         assert sum_nu < S * batch
 
 
+@pytest.mark.parametrize("read_ratio", [0.5, 0.95])
+def test_staged_mixed_end_to_end(eight_devices, read_ratio):
+    """Receipts + full state equivalence: after S mixed steps, every
+    key's value must equal key ^ CX ^ (1 + last step that wrote it)
+    (0 if never written) — recomputed by replaying jprep's pure outputs
+    on the host."""
+    import jax
+    from sherman_tpu.workload.device_prep import make_staged_mixed_step
+    salt = 0x5E17_AB1E_5A17
+    CX = 0xDEADBEEF
+    n_keys = 20_000
+    batch = 2048
+    R = int(round(batch * read_ratio))
+    eng = _build_engine(n_keys, salt)
+    step, (new_carry, table_d, rtable_d, rkey_d) = make_staged_mixed_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        read_ratio=read_ratio, dev_rb=R, dev_wb=batch - R, log2_bins=16)
+    carry = new_carry()
+    dsm = eng.dsm
+    pool, counters = dsm.pool, dsm.counters
+    S = 4
+    for _ in range(S):
+        pool, counters, carry = step(pool, dsm.locks, counters, table_d,
+                                     rtable_d, rkey_d, carry)
+    jax.block_until_ready(carry)
+    dsm.pool, dsm.counters = pool, counters
+    (step_idx, ok, n_corr_r, n_ok_w, sum_nu, max_r, max_w,
+     sidx) = (int(np.asarray(x)) for x in carry)
+    assert step_idx == S and sidx == S and ok == 1
+    assert n_corr_r == S * R, \
+        f"{S * R - n_corr_r} read clients saw a wrong/future value"
+    assert n_ok_w == S * (batch - R), \
+        f"{S * (batch - R) - n_ok_w} write clients missed ST_APPLIED"
+    assert 0 < max_r <= R and 0 < max_w <= batch - R
+
+    # host replay of the device op stream: jprep is a pure function of
+    # (tables, rkey, step_idx), so re-running it yields each step's
+    # exact write set
+    expect = {}
+    for s in range(S):
+        out = step.jprep(table_d, rtable_d, rkey_d, np.uint32(s))
+        akhi, aklo, w_nu = (np.asarray(out[1]), np.asarray(out[2]),
+                            int(np.asarray(out[13])[0]))
+        wk = (akhi[R:R + w_nu].astype(np.uint64) << np.uint64(32)) \
+            | aklo[R:R + w_nu].astype(np.uint64)
+        for k in wk:
+            expect[int(k)] = int(k) ^ CX ^ (s + 1)
+    wkeys = np.array(sorted(expect), dtype=np.uint64)
+    got, found = eng.search(wkeys)
+    assert found.all()
+    np.testing.assert_array_equal(
+        got, np.array([expect[int(k)] for k in wkeys], dtype=np.uint64))
+    # a sample of never-written keys still holds the bulk value
+    ranks = np.arange(n_keys, dtype=np.uint64)
+    allk = _mix64_np(ranks ^ np.uint64(salt))
+    cold = np.setdiff1d(allk, wkeys)[:2000]
+    got, found = eng.search(cold)
+    assert found.all()
+    np.testing.assert_array_equal(got, cold ^ np.uint64(CX))
+
+
+def test_staged_mixed_multinode(eight_devices):
+    import jax
+    from sherman_tpu.workload.device_prep import make_staged_mixed_step
+    salt = 0x5E17_AB1E_5A17
+    n_keys = 20_000
+    batch = 1024
+    eng = _build_engine(n_keys, salt, machine_nr=8, B=1024)
+    step, (new_carry, table_d, rtable_d, rkey_d) = make_staged_mixed_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        read_ratio=0.5, dev_rb=512, dev_wb=512, log2_bins=16)
+    carry = new_carry()
+    dsm = eng.dsm
+    pool, counters = dsm.pool, dsm.counters
+    S = 3
+    for _ in range(S):
+        pool, counters, carry = step(pool, dsm.locks, counters, table_d,
+                                     rtable_d, rkey_d, carry)
+    jax.block_until_ready(carry)
+    dsm.pool, dsm.counters = pool, counters
+    (step_idx, ok, n_corr_r, n_ok_w, *_rest) = (
+        int(np.asarray(x)) for x in carry)
+    assert step_idx == S and ok == 1
+    assert n_corr_r == S * 512 * 8, \
+        f"{S * 512 * 8 - n_corr_r} read clients wrong across the mesh"
+    assert n_ok_w == S * 512 * 8, \
+        f"{S * 512 * 8 - n_ok_w} write clients unapplied across the mesh"
+
+
 def test_staged_step_multinode(eight_devices):
     import jax
     salt = 0x5E17_AB1E_5A17

@@ -146,15 +146,21 @@ def test_churn_bench_driver(eight_devices, capsys):
     import json
 
     import churn_bench
-    import sys as _sys
-    argv = _sys.argv
-    _sys.argv = ["churn_bench.py", "--keys", "30000", "--window", "2500",
-                 "--iters", "6", "--chunk", "8192"]
-    try:
-        churn_bench.main()
-    finally:
-        _sys.argv = argv
+    churn_bench.main(["--keys", "30000", "--window", "2500",
+                      "--iters", "6", "--chunk", "8192"])
     out = capsys.readouterr().out
     r = json.loads(out.strip().splitlines()[-1])
     assert r["tree_keys"] == 30000
     assert r["freed"] > 0 and r["pool_flat"], r
+
+
+def test_ckpt_bench_driver(eight_devices, capsys):
+    """Checkpoint/restore cycle driver (CPU smoke of
+    tools/ckpt_bench.py): the cycle must round-trip and verify."""
+    import json
+
+    import ckpt_bench
+    ckpt_bench.main(["--keys", "30000", "--sample", "3000", "--validate"])
+    r = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert r["keys"] == 30000 and r["verify_sample"] == 3000
+    assert r["checkpoint_s"] is not None and r["validate_s"] is not None

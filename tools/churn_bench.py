@@ -41,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from common import setup_platform  # noqa: E402
 
 
-def main() -> None:
+def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--keys", type=int, default=10_000_000,
                     help="live keys at any moment (the sliding window "
@@ -71,8 +71,9 @@ def main() -> None:
                     help="pool slack over the warm tree, in units of "
                          "window-leaf footprints: sized so the loop "
                          "EXHAUSTS without reclaim but runs flat with "
-                         "it (quarantine holds ~reclaim_every+2 "
-                         "windows in flight)")
+                         "it (unlink + quarantine hold "
+                         "~3*reclaim_every+2 windows in flight — see "
+                         "the sizing comment in main)")
     ap.add_argument("--streams", type=int, default=0,
                     help="append streams (0 = auto: window/128, capped "
                          "4096).  The churn keyspace is a multi-stream "
@@ -93,7 +94,7 @@ def main() -> None:
     ap.add_argument("--minutes", type=float, default=0.0,
                     help="if > 0, keep iterating until this much wall "
                          "time has passed (overrides --iters)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     jax = setup_platform(1)
     jax.config.update("jax_compilation_cache_dir", os.path.join(
@@ -117,12 +118,17 @@ def main() -> None:
     vals_of = lambda k: k ^ np.uint64(0xBEEF)
 
     # pool sizing: warm leaves + internals + a bounded number of
-    # window-leaf footprints (quarantine keeps ~reclaim_every+2 windows
-    # of retired pages in flight before they return to the pool)
+    # window-leaf footprints.  In-flight retired pages before the first
+    # release: a deleted window is UNLINKED one reclaim pass after its
+    # delete (the chain scan sees it empty then), then sits quarantined
+    # for ~2 passes (engine default) — with passes every
+    # ``reclaim_every`` iters that is ~(3 * reclaim_every + 1) windows
+    # of lag, +1 window for the alternate-pair drain (a pass unlinks at
+    # most every other member of an empty run).
     per_leaf = max(1, int(LEAF_CAP * args.fill))
     warm_pages = int(args.keys / per_leaf * 1.06) + 2048
     win_pages = int(args.window / (LEAF_CAP // 2))
-    slack_pages = int(win_pages * (args.reclaim_every + 2)
+    slack_pages = int(win_pages * (3 * args.reclaim_every + 2)
                       * (1.0 + args.slack))
     pages = warm_pages + slack_pages
     cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
@@ -181,14 +187,21 @@ def main() -> None:
             # keep chunks ordered so each cascade builds on the last
             ck = fresh[i: i + args.chunk].copy()
             rng.shuffle(ck)
+            t_c = time.time()
             st_i = eng.insert(ck, vals_of(ck), max_rounds=args.max_rounds)
+            print(f"#     ins chunk {i // args.chunk} "
+                  f"{time.time() - t_c:.1f}s rounds={st_i['rounds']} "
+                  f"host={st_i['host_path']}", file=sys.stderr, flush=True)
             if st_i["host_path"] > args.chunk // 100:
                 print(f"# WARN iter {it}: {st_i['host_path']} keys "
                       f"spilled to the host path (cascade exceeded "
                       f"--max-rounds?)", file=sys.stderr)
         dead = key_of(np.arange(lo, lo + args.window, dtype=np.uint64))
         for i in range(0, dead.size, args.chunk):
+            t_c = time.time()
             eng.delete(dead[i: i + args.chunk])
+            print(f"#     del chunk {i // args.chunk} "
+                  f"{time.time() - t_c:.1f}s", file=sys.stderr, flush=True)
         n_ops += fresh.size + dead.size
         lo += args.window
         hi += args.window
@@ -199,6 +212,11 @@ def main() -> None:
             reclaim_ms.append((time.time() - t1) * 1e3)
             reclaim_stats["unlinked"] += st["unlinked"]
             reclaim_stats["freed"] += st["freed"]
+            print(f"#     reclaim {reclaim_ms[-1] / 1e3:.1f}s "
+                  f"unlinked={st['unlinked']} freed={st['freed']} "
+                  f"quarantined={st['quarantined']} "
+                  f"candidates={st['candidates']}",
+                  file=sys.stderr, flush=True)
         live, free = pool_live()
         occ.append(live)
         parked_hist.append(len(eng._reclaim_state["parked"]))
@@ -236,13 +254,13 @@ def main() -> None:
         "pool_live_last": occ[-1],
         "pool_live_max": max(occ),
         # flat = the steady-state band is bounded: growth since the
-        # first full reclaim cycle stays within the in-flight window
-        # footprint (quarantine holds ~reclaim_every+1 windows) plus
-        # chunk-lease granularity (the allocator bumps whole
+        # first full unlink->quarantine->release cycle stays within the
+        # in-flight window footprint (see the slack sizing comment)
+        # plus chunk-lease granularity (the allocator bumps whole
         # chunk_pages leases, so occupancy moves in those steps)
         "pool_flat": bool(
-            occ[-1] - occ[min(len(occ) - 1, args.reclaim_every)]
-            <= (args.reclaim_every + 1) * win_pages
+            occ[-1] - occ[min(len(occ) - 1, 3 * args.reclaim_every + 1)]
+            <= (3 * args.reclaim_every + 2) * win_pages
             + 2 * cfg.chunk_pages),
         "parked_final": parked_hist[-1],
         "reclaim_passes": len(reclaim_ms),

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Checkpoint/restore wall times at benchmark scale (real chip).
+
+Builds the north-star tree (default 100 M synthetic keys, the bench.py
+config), then times one full cycle: ``checkpoint(cluster, path)`` ->
+``restore(path)`` -> post-restore verification (a key sample searched
+through a fresh engine + the device structure validator).  Prints ONE
+JSON line with the wall times and sizes.
+
+The reference has no durability story at any scale (SURVEY.md §5); this
+pins the cost of ours at the full benchmark config, where the pool is
+multi-GB — checkpoint is one d2h of the sharded pool + tiny metadata,
+restore one h2d.  On this environment both transfers ride the access
+tunnel; the JSON publishes the npz byte size so a co-located host can
+be priced from its own link rate.
+
+Run (real chip):  python tools/ckpt_bench.py --keys 100000000
+CPU smoke:        SHERMAN_PLATFORM=cpu python tools/ckpt_bench.py \\
+                      --keys 50000 --sample 5000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import setup_platform  # noqa: E402
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=100_000_000)
+    ap.add_argument("--sample", type=int, default=200_000,
+                    help="post-restore verification sample size")
+    ap.add_argument("--dir", default=None,
+                    help="where to write the .npz (default: a tempdir; "
+                         "the 100 M-key pool is ~4.3 GB on disk)")
+    ap.add_argument("--validate", action="store_true",
+                    help="run the whole-pool device validator on the "
+                         "restored tree too (adds its own wall time)")
+    args = ap.parse_args(argv)
+
+    jax = setup_platform(1)
+    jax.config.update("jax_compilation_cache_dir", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from sherman_tpu import native
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import LEAF_CAP, DSMConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+    from sherman_tpu.utils import checkpoint as CK
+
+    fill = 0.75
+    per_leaf = max(1, int(LEAF_CAP * fill))
+    est_pages = int(args.keys / per_leaf * 1.10) + 8192
+    pages = 1 << max(14, (est_pages - 1).bit_length())
+    cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
+                    locks_per_node=65_536, step_capacity=65_536,
+                    chunk_pages=4096)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+
+    salt = 0x5E17_AB1E_5A17
+    if native.available():
+        keys, _ = native.synthetic_keyspace(args.keys, salt)
+    else:
+        rng = np.random.default_rng(7)
+        keys = np.unique(rng.integers(1, 1 << 63, int(args.keys * 1.05),
+                                      dtype=np.uint64))[: args.keys]
+    vals = keys ^ np.uint64(0xDEADBEEF)
+    t0 = time.time()
+    batched.bulk_load(tree, keys, vals, fill=fill)
+    build_s = time.time() - t0
+    print(f"# bulk_load {build_s:.1f}s ({args.keys} keys, pool {pages} "
+          f"pages)", file=sys.stderr, flush=True)
+
+    td = args.dir or tempfile.mkdtemp(prefix="sherman_ckpt_")
+    path = os.path.join(td, "bench.npz")
+    try:
+        t0 = time.time()
+        CK.checkpoint(cluster, path)
+        ckpt_s = time.time() - t0
+        size = os.path.getsize(path)
+        print(f"# checkpoint {ckpt_s:.1f}s ({size / 1e9:.2f} GB)",
+              file=sys.stderr, flush=True)
+
+        t0 = time.time()
+        c2 = CK.restore(path, mesh=cluster.dsm.mesh)
+        restore_s = time.time() - t0
+        print(f"# restore {restore_s:.1f}s", file=sys.stderr, flush=True)
+
+        t2 = Tree(c2)
+        e2 = batched.BatchedEngine(t2, batch_per_node=65_536)
+        e2.attach_router()
+        t0 = time.time()
+        idx = np.linspace(0, args.keys - 1,
+                          min(args.sample, args.keys)).astype(np.int64)
+        probe = keys[idx]
+        got, found = e2.search(probe)
+        assert found.all(), f"restore lost {int((~found).sum())} keys"
+        np.testing.assert_array_equal(got, probe ^ np.uint64(0xDEADBEEF))
+        verify_s = time.time() - t0
+        validate_s = None
+        if args.validate:
+            from sherman_tpu.models.validate import check_structure_device
+            t0 = time.time()
+            info = check_structure_device(t2)
+            validate_s = time.time() - t0
+            assert info["keys"] == args.keys
+    finally:
+        if args.dir is None:
+            try:
+                os.unlink(path)
+                os.rmdir(td)
+            except OSError:
+                pass
+
+    print(json.dumps({
+        "metric": "checkpoint_restore_at_scale",
+        "value": round(ckpt_s + restore_s, 1),
+        "unit": "s",
+        "keys": args.keys,
+        "pool_pages": pages,
+        "npz_bytes": size,
+        "bulk_load_s": round(build_s, 1),
+        "checkpoint_s": round(ckpt_s, 1),
+        "restore_s": round(restore_s, 1),
+        "verify_sample": int(probe.shape[0]),
+        "verify_s": round(verify_s, 1),
+        "validate_s": round(validate_s, 1) if validate_s else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Decompose the device-staged PREP pipeline on the real chip.
+
+Builds cumulative cut-down versions of the prep program (PRNG only ->
++zipf table gather -> +mix64 -> +pair sort -> +flag-sort compaction ->
++router probe = full) and times each; the successive deltas price every
+phase.  Informs the sustained-loop optimization (BENCHMARKS.md round-5
+section): prep serializes with the serve on one chip, so every ms cut
+here is ms off the sustained step.
+
+Env: KEYS (default 10_000_000), B (batch, default 4_194_304), K (reps).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache"))
+
+    from sherman_tpu.ops import bits
+    from sherman_tpu.workload.device_prep import (
+        _gen_ranks, _keys_of_ranks, _router_probe, _sort_combine,
+        zipf_table)
+
+    n_keys = int(os.environ.get("KEYS", 10_000_000))
+    batch = int(os.environ.get("B", 4_194_304))
+    K = int(os.environ.get("K", 16))
+    theta = 0.99
+    salt = 0x5E17_AB1E_5A17
+    LB = int(os.environ.get("LB", 20))
+    dev_b = int(os.environ.get("DEVB", 1_114_112))
+    salt_hi = np.uint32((salt >> 32) & 0xFFFFFFFF)
+    salt_lo = np.uint32(salt & 0xFFFFFFFF)
+
+    t = zipf_table(n_keys, theta, LB)
+    tpair = jax.device_put(np.stack([t[:-1], t[1:]], axis=1))
+    # stand-in router table (the probe is one gather from an int32 table
+    # of this size; content does not affect its cost)
+    rt_size = int(os.environ.get("RT", 1 << 24))
+    rtable = jax.device_put(np.zeros(rt_size, np.int32))
+    rkey = jax.device_put(jax.random.PRNGKey(11))
+
+    # cumulative stages call the SHIPPED device_prep helpers — a change
+    # to the production pipeline is automatically what gets priced here
+    def stage_prng(rk, si):
+        k = jax.random.fold_in(rk, si)
+        return jax.random.bits(k, (2, batch), dtype=jnp.uint32)
+
+    def stage_rank(rk, si):
+        return _gen_ranks(tpair, stage_prng(rk, si), log2_bins=LB,
+                          n_keys=n_keys)
+
+    def stage_mix(rk, si):
+        return _keys_of_ranks(stage_rank(rk, si), salt_hi, salt_lo)
+
+    def stage_sort(rk, si):
+        khi, klo = stage_mix(rk, si)
+        return lax.sort((khi, klo), num_keys=2)
+
+    def stage_compact(rk, si):
+        khi, klo = stage_mix(rk, si)
+        skhi, sklo, ukhi, uklo, seg, n_uniq = _sort_combine(
+            khi, klo, dev_b)
+        return ukhi, uklo, seg
+
+    def stage_full(rk, si):
+        ukhi, uklo, seg = stage_compact(rk, si)
+        return _router_probe(rtable, ukhi, uklo, 20, rt_size), seg
+
+    # --- rank-sort alternative: 1-op sort + 2-op flag sort, mix64 and
+    # probe on the unique set only; clients served in rank-sorted order
+    def stage_ranksort_full(rk, si):
+        rank = stage_rank(rk, si)
+        srank = lax.sort(rank)
+        first = jnp.concatenate([
+            jnp.ones((1,), jnp.int32),
+            (srank[1:] != srank[:-1]).astype(jnp.int32)])
+        seg = (jnp.cumsum(first) - 1).astype(jnp.int32)
+        _, crank = lax.sort((jnp.int32(1) - first, srank), num_keys=2)
+        ur = crank[:dev_b]
+        xlo = lax.bitcast_convert_type(ur, jnp.uint32) ^ salt_lo
+        xhi = jnp.full((dev_b,), salt_hi, jnp.uint32)
+        ukhi, uklo = bits.mix64_pair(xhi, xlo)
+        bhi, blo = bits.u64_shr(ukhi, uklo, 20)
+        bucket = jnp.where(bhi != 0, jnp.uint32(rt_size - 1),
+                           jnp.minimum(blo, jnp.uint32(rt_size - 1)))
+        # client keys for the verification compare: monotone gather from
+        # the unique rows
+        ckh = jnp.take_along_axis(ukhi, jnp.clip(seg, 0, dev_b - 1), 0)
+        ckl = jnp.take_along_axis(uklo, jnp.clip(seg, 0, dev_b - 1), 0)
+        return rtable[bucket.astype(jnp.int32)], seg, ckh, ckl
+
+    stages = [
+        ("prng(2xB)", stage_prng),
+        ("+zipf gather", stage_rank),
+        ("+mix64", stage_mix),
+        ("+pair sort", stage_sort),
+        ("+flag compact", stage_compact),
+        ("+router probe", stage_full),
+        ("ranksort FULL", stage_ranksort_full),
+    ]
+    prev = 0.0
+    for name, fn in stages:
+        j = jax.jit(fn)
+        out = j(rkey, np.uint32(0))
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for i in range(K):
+            out = j(rkey, np.uint32(i))
+        jax.block_until_ready(out)
+        ms = (time.time() - t0) / K * 1e3
+        print(f"{name:16s} {ms:8.1f} ms  (delta {ms - prev:+7.1f})",
+              flush=True)
+        prev = ms
+
+
+if __name__ == "__main__":
+    main()
