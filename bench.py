@@ -603,12 +603,14 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         from sherman_tpu.workload.device_prep import make_staged_mixed_step
         read_ratio = 0.5
         R_m = int(round(batch * read_ratio))
-        cap0 = min(R_m, dev_b + 16384)
+        cap_r0 = min(R_m, dev_b + 16384)
+        cap_w0 = min(batch - R_m, dev_b + 16384)
         pool, counters = tree.dsm.pool, tree.dsm.counters
         mk = functools.partial(
             make_staged_mixed_step, eng, n_keys=n_keys, theta=theta,
             salt=salt, batch=batch, read_ratio=read_ratio)
-        mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(dev_rb=cap0, dev_wb=cap0)
+        mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(dev_rb=cap_r0,
+                                                 dev_wb=cap_w0)
         mc = new_mc()
         pool, counters, mc = mstep(pool, tree.dsm.locks, counters, mt_d,
                                    mrt_d, mrk_d, mc)
@@ -626,7 +628,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # future-valued — receipts are deltas from the warmup baseline.
         rcap = min(R_m, -(-int(m_mr * 1.04) // 65536) * 65536)
         wcap = min(batch - R_m, -(-int(m_mw * 1.04) // 65536) * 65536)
-        if (rcap, wcap) != (cap0, cap0):
+        if (rcap, wcap) != (cap_r0, cap_w0):
             # staged= reuses the resident zipf/router/PRNG tables — the
             # rebuild only recompiles the step for the tighter row caps
             mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(

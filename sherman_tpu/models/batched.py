@@ -2107,6 +2107,7 @@ class BatchedEngine:
             rows.append({"op": D.OP_READ, "addr": pa})
         rep = dsm._batch(rows) if rows else None
         out_rows = []
+        decisions = []
         for i, (pa, la, items) in enumerate(plan):
             if not bool(rep.ok[2 * i]):
                 nxt.extend(items)
@@ -2141,6 +2142,16 @@ class BatchedEngine:
             out_rows.append({"op": D.OP_WRITE, "addr": pa, "woff": 0,
                              "nw": C.PAGE_WORDS, "payload": newpg})
             out_rows.append(tree._unlock_row(la))
+            decisions.append((items, kept, lm))
+        # quarantine/park decisions apply ONLY after the write batch
+        # lands: if it raises, st is untouched and the caller's
+        # pending_parent assignment never happens, so every item stays
+        # pending and retries — a failed batch must never quarantine
+        # (-> later free + reuse) a page whose parent entry survived
+        # on-device.
+        if out_rows:
+            dsm._batch(out_rows)
+        for items, kept, lm in decisions:
             for e, k, ab in items:
                 eu = e & 0xFFFFFFFF
                 if eu == lm:
@@ -2156,8 +2167,6 @@ class BatchedEngine:
                     nxt.append((e, k, ab))
                 else:
                     st["quarantine"].append((st["round"], e))
-        if out_rows:
-            dsm._batch(out_rows)
         return nxt
 
     def range_query(self, lo: int, hi: int) -> tuple[np.ndarray, np.ndarray]:

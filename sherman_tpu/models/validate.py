@@ -143,14 +143,27 @@ def _validate_kernel(pool, next_by_node, P: int, N: int):
     # -- 6. parent/child coherence -------------------------------------------
     lm = col(C.W_LEFTMOST)
     lmrow, lm_ok = rows_of(lm)
+    # a PARKED page — retired (zero high fence) but still this parent's
+    # leftmost child — is legal: reclaim cannot drop a leftmost pointer
+    # (batched.py _remove_parent_entries), so the page stays retired
+    # forever and descents through it self-heal via its back-sibling.
+    # Level and lowest must still match; only the liveness clause is
+    # relaxed.
+    lm_live_ok = is_act(lmrow) | retired[lmrow]
     bad_lm = internal & (
-        (lm == 0) | ~lm_ok | ~is_act(lmrow) | (lvl[lmrow] != lvl - 1)
+        (lm == 0) | ~lm_ok | ~lm_live_ok | (lvl[lmrow] != lvl - 1)
         | (lo_hi[lmrow] != lo_hi) | (lo_lo[lmrow] != lo_lo))
     iptr = pool[:, C.I_PTR_W:C.I_PTR_W + IC]
     crow, c_ok = rows_of(iptr)
     e_valid = internal[:, None] & (pos[None, :] < nk[:, None])
+    # a RETIRED child with matching level+lowest is in-flight reclaim
+    # state (unlinked, parent-entry removal pending retry — the
+    # pending_parent set; a restored cluster's reclaim sweeps it), not
+    # corruption.  A freed-and-REUSED page cannot hide here: reuse
+    # rewrites the fences, so the lowest-key clause flags the entry.
     bad_child = e_valid & (
-        ~c_ok | ~is_act(crow) | (lvl[crow] != (lvl - 1)[:, None])
+        ~c_ok | ~(is_act(crow) | retired[crow])
+        | (lvl[crow] != (lvl - 1)[:, None])
         | (lo_hi[crow] != ikh) | (lo_lo[crow] != ikl))
 
     # int32 counts are ample (< 2^31 pages/keys per cluster by

@@ -19,8 +19,16 @@ Control: ``--no-reclaim`` runs the same loop without reclaim passes —
 on the default sizing the pool exhausts within a few iterations
 (MemoryError), which is the reference's fate on this workload.
 
+Steady state needs DENSITY-MATCHED warm data: churn-inserted leaves
+hold ~LEAF_CAP/2 keys (append-split density), so bulk-load at
+``--fill 0.5`` or warm leaves (denser) retire SLOWER than inserts
+create new ones and live pages grow structurally — ~window/7 pages per
+iteration at the default fill 0.75 — until the delete window reaches
+the churned region, regardless of reclaim.
+
 Run (real chip):  python tools/churn_bench.py --keys 10000000
-                      --window 524288 --iters 55
+                      --window 524288 --reclaim-every 1 --fill 0.5 \\
+                      --minutes 32
 CPU smoke:        SHERMAN_PLATFORM=cpu python tools/churn_bench.py \\
                       --keys 60000 --window 4000 --iters 8 --chunk 8192
 """
@@ -131,8 +139,15 @@ def main(argv=None) -> None:
     slack_pages = int(win_pages * (3 * args.reclaim_every + 2)
                       * (1.0 + args.slack))
     pages = warm_pages + slack_pages
+    # locks_per_node sized for the reclaim batches: a pass's candidate
+    # set (10^4-10^5 pairs under churn backlog) CAS-locks pages through
+    # the hashed lock table, and pairs hashing onto an already-taken
+    # word defer to the next pass — at 65,536 words the birthday
+    # collisions capped unlinks ~15% under the retire rate and the pool
+    # leaked ~3K pages/iter until exhaustion.  1M words (4 MB) keeps
+    # the deferral rate negligible.
     cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
-                    locks_per_node=65_536, step_capacity=args.chunk,
+                    locks_per_node=1 << 20, step_capacity=args.chunk,
                     chunk_pages=1024, host_step_capacity=8192)
     cluster = Cluster(cfg)
     tree = Tree(cluster)
@@ -229,8 +244,11 @@ def main(argv=None) -> None:
     elapsed = time.time() - t_start
 
     # integrity: current window fully live, dead band gone, structure ok
+    print(f"# verify: probing live window + structure", file=sys.stderr,
+          flush=True)
+    t_v = time.time()
     live_keys = key_of(np.arange(lo, hi, dtype=np.uint64))
-    probe = live_keys[:: max(1, live_keys.size // 200_000)]
+    probe = live_keys[:: max(1, live_keys.size // 50_000)]
     got, found = eng.search(probe)
     assert found.all(), f"churn lost {int((~found).sum())} live keys"
     np.testing.assert_array_equal(got, vals_of(probe))
@@ -238,7 +256,13 @@ def main(argv=None) -> None:
                                  dtype=np.uint64))[:10_000]
     _, f2 = eng.search(old_probe)
     assert not f2.any(), "deleted window still resolves"
-    info = tree.check_structure()
+    # whole-pool structure check on DEVICE (models/validate.py): the
+    # host walker costs 30+ minutes at 10^5-page scale over an access
+    # tunnel, the jitted validator seconds
+    from sherman_tpu.models.validate import check_structure_device
+    info = check_structure_device(tree)
+    print(f"# verify done in {time.time() - t_v:.1f}s: {info}",
+          file=sys.stderr, flush=True)
 
     out = {
         "metric": "churn_reclaim",
@@ -257,9 +281,14 @@ def main(argv=None) -> None:
         # first full unlink->quarantine->release cycle stays within the
         # in-flight window footprint (see the slack sizing comment)
         # plus chunk-lease granularity (the allocator bumps whole
-        # chunk_pages leases, so occupancy moves in those steps)
+        # chunk_pages leases, so occupancy moves in those steps).  The
+        # baseline clamps to the run's midpoint so short runs (CI
+        # smoke) still compare two distinct samples instead of
+        # degenerating to occ[-1] - occ[-1].
         "pool_flat": bool(
-            occ[-1] - occ[min(len(occ) - 1, 3 * args.reclaim_every + 1)]
+            occ[-1] - occ[max(1, min(len(occ) - 1,
+                                     3 * args.reclaim_every + 1,
+                                     (len(occ) - 1) // 2))]
             <= (3 * args.reclaim_every + 2) * win_pages
             + 2 * cfg.chunk_pages),
         "parked_final": parked_hist[-1],
