@@ -56,7 +56,7 @@ _STATS = ("keys", "leaves", "internal_pages", "retired", "bad_version",
 
 
 @functools.partial(jax.jit, static_argnames=("P", "N"))
-def _validate_kernel(pool, next_by_node, P: int, N: int):
+def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
     import jax.numpy as jnp
 
     rows = N * P
@@ -148,8 +148,13 @@ def _validate_kernel(pool, next_by_node, P: int, N: int):
     # (batched.py _remove_parent_entries), so the page stays retired
     # forever and descents through it self-heal via its back-sibling.
     # Level and lowest must still match; only the liveness clause is
-    # relaxed.
-    lm_live_ok = is_act(lmrow) | retired[lmrow]
+    # relaxed.  A page in the allocator FREE POOL is excluded from the
+    # accepted retired set: its stale contents still look retired with
+    # the old level/lowest until reuse rewrites them, so without the
+    # mask a dangling parent entry to a freed page — the exact
+    # corruption quarantine exists to prevent — would pass until reuse.
+    ref_ok = retired & ~freed
+    lm_live_ok = is_act(lmrow) | ref_ok[lmrow]
     bad_lm = internal & (
         (lm == 0) | ~lm_ok | ~lm_live_ok | (lvl[lmrow] != lvl - 1)
         | (lo_hi[lmrow] != lo_hi) | (lo_lo[lmrow] != lo_lo))
@@ -160,9 +165,11 @@ def _validate_kernel(pool, next_by_node, P: int, N: int):
     # state (unlinked, parent-entry removal pending retry — the
     # pending_parent set; a restored cluster's reclaim sweeps it), not
     # corruption.  A freed-and-REUSED page cannot hide here: reuse
-    # rewrites the fences, so the lowest-key clause flags the entry.
+    # rewrites the fences, so the lowest-key clause flags the entry —
+    # and a freed-NOT-YET-reused page is caught by the freed mask
+    # (ref_ok above), closing the window between free and reuse.
     bad_child = e_valid & (
-        ~c_ok | ~(is_act(crow) | retired[crow])
+        ~c_ok | ~(is_act(crow) | ref_ok[crow])
         | (lvl[crow] != (lvl - 1)[:, None])
         | (lo_hi[crow] != ikh) | (lo_lo[crow] != ikl))
 
@@ -308,12 +315,21 @@ def check_structure_device(tree) -> dict:
 
     tree._refresh_root()
     cfg = tree.dsm.cfg
+    P = cfg.pages_per_node
     nxt = np.ones(cfg.machine_nr, np.int64)
+    # pages in the allocator free pools: retired pages a parent entry
+    # must NOT reference anymore (see the ref_ok comment in the kernel).
+    # Directories are mirrored in every process (replicated-driver
+    # model), so the mask is globally consistent on multihost meshes.
+    freed = np.zeros(cfg.machine_nr * P, bool)
     for d in tree.cluster.directories:
         nxt[d.node_id] = d.allocator.pages_used
+        fp = d.allocator.free_pages_list
+        if fp:
+            freed[d.node_id * P + np.asarray(fp, np.int64)] = True
     out = np.asarray(_validate_kernel(
-        tree.dsm.pool, jnp.asarray(nxt, jnp.int32),
-        P=cfg.pages_per_node, N=cfg.machine_nr))
+        tree.dsm.pool, jnp.asarray(nxt, jnp.int32), jnp.asarray(freed),
+        P=P, N=cfg.machine_nr))
     s = dict(zip(_STATS, out.tolist()))
     problems = [f"{k}={s[k]}" for k in (
         "bad_version", "bad_fence", "bad_leaf_slot", "bad_internal_order",

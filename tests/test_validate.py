@@ -152,3 +152,54 @@ def test_detects_unsorted_internal(grown_tree):
     with pytest.raises(RuntimeError,
                        match="bad_internal_order|bad_child|bad_leftmost"):
         check_structure_device(tree)
+
+
+def test_detects_dangling_entry_to_freed_page(eight_devices):
+    """A parent entry pointing at a page in the allocator FREE POOL must
+    fail validation even before reuse rewrites the page: the freed
+    page's stale contents still look retired with the old level/lowest,
+    which the in-flight-reclaim relaxation (ref_ok) would accept if the
+    freed mask did not exclude free-pool pages."""
+    cfg = DSMConfig(machine_nr=1, pages_per_node=2048, locks_per_node=512,
+                    step_capacity=512, chunk_pages=32)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=512)
+    keys = np.arange(1, 4001, dtype=np.uint64) * np.uint64(7)
+    batched.bulk_load(tree, keys, keys + np.uint64(1), fill=0.9)
+    eng.attach_router()
+    eng.delete(keys[(keys > 700) & (keys < 2100)])
+    for _ in range(3):  # unlink -> quarantine -> release to free pool
+        eng.reclaim_empty_leaves()
+    check_structure_device(tree)  # clean state passes
+    fp = cluster.directories[0].allocator.free_pages_list
+    assert fp, "reclaim produced no free-pool pages"
+    F = bits.make_addr(0, fp[0])
+    pgF = tree.dsm.read_page(F)
+    lowF = layout.np_lowest(pgF)
+    assert int(pgF[C.W_LEVEL]) == 0 and layout.np_highest(pgF) == 0
+    # forge: in the level-1 page covering lowF, overwrite the entry at
+    # lowF's sort position with (lowF, F) — ordering stays valid, and
+    # the freed page's stale level/lowest make every OTHER clause pass
+    pool = np.asarray(tree.dsm.pool)
+    P = cfg.pages_per_node
+    parents = np.nonzero((pool[:, C.W_LEVEL] == 1)
+                         & (pool[:, C.W_FRONT_VER] != 0))[0]
+    row = next(r for r in parents
+               if layout.np_lowest(pool[r]) <= lowF
+               < layout.np_highest(pool[r]))
+    pa = bits.make_addr(row // P, row % P)
+    pg = pool[row]
+    ekeys = [k for k, _ in layout.np_internal_entries(pg)]
+    j = min(int(np.searchsorted(ekeys, lowF)), len(ekeys) - 1)
+    khi, klo = bits.key_to_pair(lowF)
+    tree.dsm.write_rows([
+        {"op": D.OP_WRITE_WORD, "addr": pa, "woff": C.I_KHI_W + j,
+         "arg1": khi},
+        {"op": D.OP_WRITE_WORD, "addr": pa, "woff": C.I_KLO_W + j,
+         "arg1": klo},
+        {"op": D.OP_WRITE_WORD, "addr": pa, "woff": C.I_PTR_W + j,
+         "arg1": F},
+    ])
+    with pytest.raises(RuntimeError, match="bad_child"):
+        check_structure_device(tree)

@@ -261,6 +261,11 @@ def main(argv=None) -> None:
     # tunnel, the jitted validator seconds
     from sherman_tpu.models.validate import check_structure_device
     info = check_structure_device(tree)
+    # exact count: the validator's device-side key total must equal the
+    # live window EXACTLY — catches any lost or duplicated key the
+    # sampled probes above could miss, at zero extra device cost
+    assert info["keys"] == hi - lo, \
+        f"device key count {info['keys']} != live window {hi - lo}"
     print(f"# verify done in {time.time() - t_v:.1f}s: {info}",
           file=sys.stderr, flush=True)
 

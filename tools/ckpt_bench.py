@@ -96,8 +96,14 @@ def main(argv=None) -> None:
         print(f"# checkpoint {ckpt_s:.1f}s ({size / 1e9:.2f} GB)",
               file=sys.stderr, flush=True)
 
+        # release the ORIGINAL pool before restoring: at the 100 M-key
+        # config two resident pools (4.3 GB each) plus the validator's
+        # intermediates exhaust a 16 GB chip
+        mesh = cluster.dsm.mesh
+        cluster.dsm.pool.delete()
+        del tree
         t0 = time.time()
-        c2 = CK.restore(path, mesh=cluster.dsm.mesh)
+        c2 = CK.restore(path, mesh=mesh)
         restore_s = time.time() - t0
         print(f"# restore {restore_s:.1f}s", file=sys.stderr, flush=True)
 

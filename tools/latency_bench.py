@@ -22,6 +22,13 @@ This driver measures, per width:
 - ``p50_model``  — 1.5 x span (formation wait + service); the measured
                    span is the same quantity bench.py's p50 reports at
                    wide widths, where the sync share is negligible.
+- ``p50_measured_raw`` / ``p50_measured`` — a MEASURED open-loop
+                   async-dispatch client (wall-clock-paced admissions at
+                   utilization ``--rho``, sampled completion drains)
+                   brackets the true per-op latency: raw timestamps are
+                   an upper bound (the observing drain adds <= 1 tunnel
+                   RTT; co-located hosts read raw directly), the
+                   calibrated-sync-subtracted values a lower bound.
 
 Run: python tools/latency_bench.py [--keys 10000000]
          [--widths 16384,32768,65536,262144] [--blocks 64] [--kblk 32]
@@ -53,6 +60,10 @@ def main() -> None:
     ap.add_argument("--kblk", type=int, default=32,
                     help="steps per latency block (one sync each)")
     ap.add_argument("--theta", type=float, default=0.99)
+    ap.add_argument("--rho", type=float, default=0.85,
+                    help="open-loop admission utilization (offered rate "
+                         "/ service rate).  1.0 is marginally stable — "
+                         "any stall grows the queue without bound")
     args = ap.parse_args()
     widths = [int(w) for w in args.widths.split(",")]
 
@@ -175,15 +186,36 @@ def main() -> None:
         # the access tunnel, so timestamping every batch would throttle
         # admission; every STRIDE-th batch keeps the drain duty cycle
         # under ~50% and the in-between batches pipeline freely (the
-        # emergent dispatch queue IS the client's depth).  A sampled
-        # batch's mean op latency = t_complete - sync_ms
-        # - (its mean arrival); the sync subtraction is the calibrated
-        # tunnel adjustment published above (on a co-located host it is
-        # ~0 and the raw timestamps stand).
-        T = pipe_ms / 1e3
+        # emergent dispatch queue IS the client's depth).
+        #
+        # Admission runs at utilization RHO < 1 (batch period T =
+        # pipe_ms / rho): an open loop offered EXACTLY the service rate
+        # is marginally stable — any stall (here: tunnel RPC jitter)
+        # grows the queue without bound and the measurement diverges
+        # (rho=1.0 measured p50 ~= the tunnel RTT at W=16K).  The
+        # reference's own open loop is self-limiting the same way: its
+        # clients cap in-flight ops at coroutine depth.
+        #
+        # A sampled batch's completion timestamp brackets the true
+        # latency between two published numbers:
+        #   raw      = t_complete - mean_arrival      (upper bound: the
+        #              observing drain adds up to one tunnel RTT;
+        #              co-located hosts read this directly)
+        #   adjusted = raw - sync_ms, clamped >= 0    (lower bound: the
+        #              calibrated MEDIAN RTT may exceed this sample's
+        #              actual RTT, so the subtraction can overshoot)
+        # On this environment service latencies are ms-scale while the
+        # RTT is ~100-200 ms, so the bracket is wide here and tight
+        # co-located — both ends are published per width.
+        rho = args.rho
+        T = pipe_ms / 1e3 / rho
         stride = max(1, int(np.ceil((sync_ms / 1e3) / T / 0.5)))
-        n_ol = min(args.blocks, max(16, 2000 // stride)) * stride
-        lat_ms = []
+        # --blocks is the sample-count target here too, bounded by a
+        # ~2000-dispatch budget per width (long strides on high-RTT
+        # hosts would otherwise turn many samples into minutes)
+        n_samp = max(8, min(args.blocks, max(16, 2000 // stride)))
+        n_ol = n_samp * stride
+        lat_raw = []
         t_b = time.time() + 2 * T
         for i in range(n_ol):
             due = t_b + i * T
@@ -193,9 +225,9 @@ def main() -> None:
             counters, done, found, vhi, vlo = step(i, counters)
             if i % stride == stride - 1:
                 jax.block_until_ready(found)
-                t_c = time.time() - sync_ms / 1e3
+                t_c = time.time()
                 mean_arrival = t_b + (i - 0.5) * T
-                lat_ms.append(max(0.0, (t_c - mean_arrival)) * 1e3)
+                lat_raw.append((t_c - mean_arrival) * 1e3)
                 # RE-ANCHOR the admission schedule by the OBSERVER's
                 # stall only (~sync_ms): without it, admissions accrue
                 # against the drain-stalled clock and every later
@@ -209,13 +241,16 @@ def main() -> None:
                 lag = time.time() - (t_b + (i + 1) * T)
                 if lag > 0:
                     t_b += min(lag, sync_ms / 1e3)
-        p50_meas = float(np.percentile(lat_ms, 50))
         # each sample is a batch-MEAN op latency; op arrivals are
         # uniform over a T-wide window, so op-level tails spread
         # +-T/2 around the batch mean.  p50 is unaffected (symmetric);
         # p99 adds ~0.48*T (the 98th pct of U[-T/2, T/2]) — published
         # op-level, not batch-level.
-        p99_meas = float(np.percentile(lat_ms, 99)) + 0.48 * T * 1e3
+        p50_raw_m = float(np.percentile(lat_raw, 50))
+        p99_raw_m = float(np.percentile(lat_raw, 99)) + 0.48 * T * 1e3
+        adj_l = [max(0.0, x - sync_ms) for x in lat_raw]
+        p50_meas = float(np.percentile(adj_l, 50))
+        p99_meas = float(np.percentile(adj_l, 99)) + 0.48 * T * 1e3
         row = {
             "width": W,
             "pipe_ms": round(pipe_ms, 2),
@@ -224,10 +259,16 @@ def main() -> None:
             "span_p99_ms": round(span99, 2),
             "ops_s": round(ops_s),
             "p50_model_ms": round(1.5 * span50, 2),
+            # measured open-loop bracket (see comment above): raw =
+            # upper bound incl. <= 1 tunnel RTT (co-located hosts read
+            # this directly), plain = sync-adjusted lower bound
+            "p50_measured_raw_ms": round(p50_raw_m, 2),
+            "p99_measured_raw_ms": round(p99_raw_m, 2),
             "p50_measured_ms": round(p50_meas, 2),
             "p99_measured_ms": round(p99_meas, 2),
-            "ol_samples": len(lat_ms),
+            "ol_samples": len(lat_raw),
             "ol_stride": stride,
+            "ol_rho": rho,
             "sync_share_ms": round(adj, 2),
         }
         rows.append(row)
@@ -235,25 +276,28 @@ def main() -> None:
               f"{ops_s / 1e6:5.1f} M ops/s; span p50 {span50:5.2f} ms "
               f"(raw {raw50:5.2f} - sync/blk {adj:4.2f}), p99 "
               f"{span99:5.2f}; open-loop p50 model {1.5 * span50:5.2f} ms "
-              f"vs MEASURED {p50_meas:5.2f} ms (p99 {p99_meas:5.2f}, "
-              f"{len(lat_ms)} samples, stride {stride})",
+              f"vs MEASURED [{p50_meas:5.2f}, {p50_raw_m:6.2f}] ms "
+              f"(p99 [{p99_meas:5.2f}, {p99_raw_m:6.2f}], "
+              f"{len(lat_raw)} samples, stride {stride}, rho {rho})",
               file=sys.stderr)
         tree.dsm.counters = counters
 
     best = [r for r in rows if r["ops_s"] >= 10_000_000]
     best = min(best, key=lambda r: r["p50_model_ms"]) if best else None
-    # model honesty: worst-case measured/model ratio across the frontier
-    ratios = [r["p50_measured_ms"] / max(r["p50_model_ms"], 1e-9)
-              for r in rows]
+    # model honesty: does the model's p50 land inside the measured
+    # [adjusted, raw] bracket per width?  (On a co-located host the
+    # bracket collapses to a point and this becomes a direct check.)
+    in_bracket = [r["p50_measured_ms"] <= r["p50_model_ms"]
+                  <= r["p50_measured_raw_ms"] for r in rows]
     out = {
         "metric": "latency_frontier",
         "sync_ms": round(sync_ms, 1),
         "rows": rows,
         "best_10M": best,
-        # measured p50 divided by the 1.5x-span model's p50 (>1 = the
-        # open loop measured WORSE than the model predicts)
-        "measured_vs_model_p50_ratio_max": round(max(ratios), 2),
-        "measured_vs_model_p50_ratio_min": round(min(ratios), 2),
+        # per-width: model p50 inside the measured [adjusted, raw]
+        # bracket (lower bound subtracts the calibrated tunnel RTT,
+        # upper includes <= 1 RTT; see the open-loop comment)
+        "model_p50_in_measured_bracket": in_bracket,
         "keys": n_keys,
     }
     print(json.dumps(out))
