@@ -192,15 +192,23 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         buffers at ENQUEUE time — ~100 queued steps pinned ~7 GB of
         prep intermediates and ran 5-20x slower at the 100 M-key pool;
         W=8-16 measured optimal), then drain the final carry.  Returns
-        elapsed seconds."""
+        elapsed seconds.
+
+        The window blocks on carry[1] ('ok') — a SERVE output — not
+        carry[0] (step_idx, produced by the PREP program).  The prep
+        chain depends only on itself, so a backend that overlaps
+        independent programs lets preps sprint ahead of the lagging
+        serves; bounding the prep chain would then leave up to n_steps
+        of ~80 MB prep intermediates alive.  Bounding the serve chain
+        caps live prep outputs at exactly W under any scheduler."""
         from collections import deque
-        W = int(os.environ.get("SHERMAN_BENCH_DEVWINDOW", 16))
+        W = int(os.environ.get("SHERMAN_BENCH_DEVWINDOW", 8))
         pend: deque = deque()
         c = None
         t0 = time.time()
         for _ in range(n_steps):
             c = advance()
-            pend.append(c[0])
+            pend.append(c[1])
             if len(pend) > W:
                 jax.block_until_ready(pend.popleft())
         jax.block_until_ready(c)

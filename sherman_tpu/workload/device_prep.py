@@ -315,11 +315,14 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         serve, mesh=mesh,
         in_specs=(spec, spec, (rep,) * 4) + (spec,) * 8,
         out_specs=(spec, (rep,) * 4), check_vma=False)
-    # donate counters + the receipts carry only: the prep intermediates'
-    # shapes cannot alias any serve output, so donating them just emits
-    # a "donated buffers were not usable" warning every compile (they
-    # are freed after the call regardless)
-    jserve = jax.jit(serve_sm, donate_argnums=(1, 2))
+    # donate counters only: the prep intermediates' shapes cannot alias
+    # any serve output (donating them just warns every compile), and the
+    # rcarry scalars are deliberately NOT donated — callers block their
+    # dispatch window on carry[1] (a serve output; see bench.py
+    # run_windowed), which must stay a live buffer after the next step
+    # consumes it (blocking a donated buffer is an error on some
+    # backends).  Donating 4 replicated scalars saves nothing.
+    jserve = jax.jit(serve_sm, donate_argnums=(1,))
 
     def step(pool, counters, tpair, rtable, rkey, carry):
         step_idx, *rcarry = carry
@@ -509,7 +512,9 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
         serve, mesh=mesh,
         in_specs=(spec, spec, spec, (rep,) * 7) + (spec,) * 13,
         out_specs=(spec, spec, (rep,) * 7), check_vma=False)
-    jserve = jax.jit(serve_sm, donate_argnums=(0, 2, 3))
+    # pool + counters donated; rcarry is NOT (callers block the
+    # dispatch window on carry[1] — see the read-only step's note)
+    jserve = jax.jit(serve_sm, donate_argnums=(0, 2))
 
     def step(pool, locks, counters, tpair, rtable, rkey, carry):
         step_idx, *rcarry = carry
