@@ -184,6 +184,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     sustained_ops_s = sus_host_ops_s = None
     sus_prep_ms = sus_put_ms = sus_ms_per_step = None
     sus_dev_ms_per_step = sus_dev_combine = dev_attempts = None
+    dev_sampler = None
     sort_ms = None  # staged-phase start-sort cost (native combine only)
 
     def run_windowed(n_steps, advance):
@@ -273,9 +274,17 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             # slack (cross-batch spread is ~0.1%; overflow voids the
             # phase via the ok receipt)
             dev_b2 = min(batch, dev_b + 16384)
+            # analytic zipf sampler by default: same approximation
+            # class as the quantile table (tests pin both against the
+            # exact CDF) with no HBM table gather — measured ~10 ms/step
+            # cheaper at the 100 M config
+            dev_sampler = os.environ.get("SHERMAN_BENCH_SAMPLER",
+                                         "analytic")
             step_fn, (new_carry, table_d, rtable_d, rkey_d) = \
                 make_staged_step(eng, n_keys=n_keys, theta=theta,
-                                 salt=salt, batch=batch, dev_b=dev_b2)
+                                 salt=salt, batch=batch, dev_b=dev_b2,
+                                 sampler=dev_sampler)
+            dev_sampler = step_fn.sampler  # effective (fallback-aware)
             carry = new_carry()
             counters, carry = step_fn(pool, counters, table_d, rtable_d,
                                       rkey_d, carry)
@@ -331,8 +340,9 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                   f"{dev_elapsed:.2f}s -> {sustained_ops_s / 1e6:.1f} M "
                   f"ops/s end-to-end ({sus_dev_ms_per_step:.1f} ms/step; "
                   f"combine {sus_dev_combine:.2f}x, max_uniq {d_max_nu}, "
-                  f"all {d_corr} answers verified on device; attempts "
-                  f"{dev_attempts})", file=sys.stderr)
+                  f"all {d_corr} answers verified on device; sampler "
+                  f"{dev_sampler}, attempts {dev_attempts})",
+                  file=sys.stderr)
         # SUSTAINED end-to-end (the reference's open-loop contract,
         # test/benchmark.cpp:159-188: clients generate and issue ops
         # inline — nothing hoisted): zipf sampling, unique+inverse
@@ -640,7 +650,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         pool, counters = tree.dsm.pool, tree.dsm.counters
         mk = functools.partial(
             make_staged_mixed_step, eng, n_keys=n_keys, theta=theta,
-            salt=salt, batch=batch, read_ratio=read_ratio)
+            salt=salt, batch=batch, read_ratio=read_ratio,
+            sampler=os.environ.get("SHERMAN_BENCH_SAMPLER", "analytic"))
         mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(dev_rb=cap_r0,
                                                  dev_wb=cap_w0)
         mc = new_mc()
@@ -764,6 +775,9 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # the last attempt; >1 entry = tunnel degradation was detected
         # and retried, see the retry comment in run())
         "sus_dev_attempts_s": dev_attempts,
+        # which zipf sampler the staged loops actually ran (fallback-
+        # aware: 'analytic' needs 0<theta<1 and keys>64)
+        "sus_dev_sampler": dev_sampler,
         "sus_dev_combine": round(sus_dev_combine, 2)
         if sus_dev_combine else None,
         "sus_mixed_ops_s": round(sus_mixed_ops_s) if sus_mixed_ops_s

@@ -104,6 +104,97 @@ def zipf_table(n: int, theta: float, log2_bins: int = 20) -> np.ndarray:
     return table
 
 
+def zipf_analytic_consts(n: int, theta: float, head: int = 64) -> dict:
+    """Host-side float64 constants for the ANALYTIC device inverse CDF
+    (:func:`_gen_ranks_analytic`): exact partial sums H(1..head) for the
+    head, and the Euler-Maclaurin continuation constants for the tail.
+
+    Same approximation class as the quantile table (exact head, E-M
+    tail, ~single-rank precision where the density is steep and a flat
+    local density where it is not) — but evaluated in VPU registers
+    instead of a [2^20, 2] HBM gather, which is the dominant prep cost
+    on chip (~15 ns/row)."""
+    assert 0.0 < theta < 1.0 and n > head
+    f = np.arange(1, head + 1, dtype=np.float64) ** -theta
+    Hh = np.cumsum(f)
+    om = 1.0 - theta
+    M = float(head)
+
+    def H(r):
+        """E-M continuation of the harmonic partial sum for r >= head."""
+        r = np.asarray(r, np.float64)
+        return (Hh[-1] + (r ** om - M ** om) / om
+                + 0.5 * (r ** -theta - M ** -theta)
+                - (theta / 12.0) * (r ** (-theta - 1.0)
+                                    - M ** (-theta - 1.0)))
+
+    return {
+        "head_sums": Hh, "om": om, "theta": theta, "M": M,
+        "Hn": float(H(float(n))),
+        # tail-init constant: r0 = (om*(x - B0))^(1/om) drops the small
+        # E-M terms; Newton below restores them
+        "B0": float(Hh[-1] - (M ** om) / om),
+    }
+
+
+def _gen_ranks_analytic(consts: dict, w, *, n_keys: int):
+    """Zipf ranks via the analytic inverse CDF — NO table gather.
+
+    u from 24 fresh PRNG bits -> x = u * H(n); head ranks (< head) by
+    64 unrolled register compares against the exact partial sums (CDF-
+    exact, like the table's head); tail by inverting the Euler-Maclaurin
+    continuation: closed-form init + two Newton steps in f32
+    (H'(r) = r^-theta).  f32 rank jitter in the deep tail (~1e4 ranks
+    at r ~ 1e8) sits inside the quantile table's own bin width there
+    (up to 2^24 ranks), so the two samplers share an approximation
+    class; `tests/test_device_prep.py` pins both against the exact CDF.
+    """
+    import jax.numpy as jnp
+
+    Hh = consts["head_sums"]
+    om = jnp.float32(consts["om"])
+    theta = jnp.float32(consts["theta"])
+    Mf = jnp.float32(consts["M"])
+    u = (w[0] >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    x = u * jnp.float32(consts["Hn"])
+    # head: rank = #(partial sums < x), CDF-exact for ranks < head
+    rank_head = jnp.zeros(x.shape, jnp.int32)
+    for h in Hh:
+        rank_head = rank_head + (x > jnp.float32(h)).astype(jnp.int32)
+    HhM = jnp.float32(Hh[-1])
+    c_half = jnp.float32(0.5 * consts["M"] ** -consts["theta"])
+    c_d12 = jnp.float32((consts["theta"] / 12.0)
+                        * consts["M"] ** (-consts["theta"] - 1.0))
+    Mom = jnp.float32(consts["M"] ** consts["om"])
+    B0 = jnp.float32(consts["B0"])
+
+    def invert(xt):
+        """Solve H(r) = xt for r >= head: closed-form init (small E-M
+        terms dropped) + two Newton steps (H'(r) = r^-theta)."""
+        r = jnp.exp(jnp.log(om * (xt - B0)) / om)
+        for _ in range(2):
+            r = jnp.maximum(r, Mf)
+            rmt = jnp.exp(-theta * jnp.log(r))         # r^-theta
+            Hr = (HhM + (r * rmt - Mom) / om + 0.5 * rmt - c_half
+                  - (theta / jnp.float32(12.0)) * (rmt / r) + c_d12)
+            r = r - (Hr - xt) / rmt
+        return jnp.maximum(r, Mf)
+
+    # tail: u has 24 bits, so ~4 M draws collide heavily on quantile
+    # cells (2^24 cells); recover the lost entropy EXACTLY like the
+    # quantile table does — invert at BOTH edges of the 2^-24-wide cell
+    # and lerp on w[1] (a virtual [2^24]-bin table, piecewise-linear in
+    # the locally flat tail)
+    du = jnp.float32(2.0 ** -24) * jnp.float32(consts["Hn"])
+    xt = jnp.maximum(x, HhM)
+    r_lo = invert(xt)
+    r_hi = invert(xt + du)
+    v = (w[1] >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    rank_tail = (r_lo + (r_hi - r_lo) * v).astype(jnp.int32)
+    rank = jnp.where(rank_head < jnp.int32(len(Hh)), rank_head, rank_tail)
+    return jnp.clip(rank, 0, n_keys - 1)
+
+
 def _gen_ranks(tpair, w, *, log2_bins: int, n_keys: int):
     """Zipf ranks from two uint32 PRNG words per sample: bin from the
     top ``log2_bins`` bits (CDF-exact edges), f32 lerp within the bin on
@@ -171,24 +262,45 @@ def _router_probe(rtable, ukhi, uklo, shift, nb):
 
 
 def _stage_inputs(router, n_keys: int, theta: float, log2_bins: int,
-                  seed: int):
+                  seed: int, sampler: str = "table"):
     """Stage the step's device-resident inputs once, before any timed
-    region: the [nb, 2] zipf edge-pair table, the router table, and the
-    PRNG key."""
+    region: the [nb, 2] zipf edge-pair table (a tiny dummy when the
+    analytic sampler needs no table), the router table, and the PRNG
+    key."""
     import jax
 
-    t = zipf_table(n_keys, theta, log2_bins)
-    table_d = jax.device_put(np.stack([t[:-1], t[1:]], axis=1))
+    if sampler == "analytic":
+        table_d = jax.device_put(np.zeros((1, 2), np.int32))
+    else:
+        t = zipf_table(n_keys, theta, log2_bins)
+        table_d = jax.device_put(np.stack([t[:-1], t[1:]], axis=1))
     with router._read_locked():
         rtable_d = jax.device_put(router.table_np)
     rkey_d = jax.device_put(jax.random.PRNGKey(seed))
     return table_d, rtable_d, rkey_d
 
 
+def _rank_sampler(sampler: str, n_keys: int, theta: float,
+                  log2_bins: int):
+    """-> (rank(tpair, w), effective_name) for the chosen sampler.
+    ``analytic`` (no HBM table gather) requires 0 < theta < 1 AND a
+    keyspace larger than its exact head; BOTH out-of-range cases fall
+    back to the quantile table (uniformly — never a crash on one and a
+    silent fallback on the other), and the effective name is returned
+    so drivers can log which sampler actually ran."""
+    if sampler == "analytic" and 0.0 < theta < 1.0 and n_keys > 64:
+        zc = zipf_analytic_consts(n_keys, theta)
+        return (lambda tpair, w: _gen_ranks_analytic(zc, w,
+                                                     n_keys=n_keys),
+                "analytic")
+    return (lambda tpair, w: _gen_ranks(tpair, w, log2_bins=log2_bins,
+                                        n_keys=n_keys), "table")
+
+
 def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                      batch: int, dev_b: int, log2_bins: int = 20,
                      check_xor: int = 0xDEADBEEF, seed: int = 11,
-                     staged=None):
+                     staged=None, sampler: str = "table"):
     """Build the device-staged serving step for ``eng`` (a
     :class:`~sherman_tpu.models.batched.BatchedEngine` with an attached
     router).
@@ -236,6 +348,7 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     spec, rep = eng._spec, eng._rep
     shift, nb = int(router.shift), int(router.nb)
     LB = int(log2_bins)
+    gen_ranks, sampler = _rank_sampler(sampler, n_keys, theta, LB)
     root = np.int32(eng.tree._root_addr)
     salt_hi = np.uint32((salt >> 32) & 0xFFFFFFFF)
     salt_lo = np.uint32(salt & 0xFFFFFFFF)
@@ -250,7 +363,7 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         k = jax.random.fold_in(rkey, step_idx * np.uint32(N)
                                + node.astype(jnp.uint32))
         w = jax.random.bits(k, (2, batch), dtype=jnp.uint32)
-        rank = _gen_ranks(tpair, w, log2_bins=LB, n_keys=n_keys)
+        rank = gen_ranks(tpair, w)
         khi_u, klo_u = _keys_of_ranks(rank, salt_hi, salt_lo)
         # sort-based unique (request combining): clients are served in
         # key-sorted order (see module docstring), so no index payload
@@ -331,6 +444,7 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         return counters, (step_idx,) + tuple(rcarry)
 
     step.jprep, step.jserve = jprep, jserve
+    step.sampler = sampler
 
     def new_carry():
         """Fresh device-resident carry (the previous one is donated)."""
@@ -339,7 +453,7 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                                np.int32(0), np.int32(0)))
 
     table_d, rtable_d, rkey_d = staged or _stage_inputs(
-        router, n_keys, theta, LB, seed)
+        router, n_keys, theta, LB, seed, sampler)
     return step, (new_carry, table_d, rtable_d, rkey_d)
 
 
@@ -347,7 +461,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
                            batch: int, read_ratio: float, dev_rb: int,
                            dev_wb: int, log2_bins: int = 20,
                            check_xor: int = 0xDEADBEEF, seed: int = 13,
-                           staged=None):
+                           staged=None, sampler: str = "table"):
     """Device-staged sustained MIXED loop (YCSB-A/B shape): the same
     nothing-shipped open loop as :func:`make_staged_step`, but each step
     carries both point lookups and in-place updates through ONE fused
@@ -405,6 +519,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     spec, rep = eng._spec, eng._rep
     shift, nb = int(router.shift), int(router.nb)
     LB = int(log2_bins)
+    gen_ranks, sampler = _rank_sampler(sampler, n_keys, theta, LB)
     root = np.int32(eng.tree._root_addr)
     salt_hi = np.uint32((salt >> 32) & 0xFFFFFFFF)
     salt_lo = np.uint32(salt & 0xFFFFFFFF)
@@ -423,7 +538,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
         k = jax.random.fold_in(rkey, step_idx * np.uint32(N)
                                + node.astype(jnp.uint32))
         w = jax.random.bits(k, (2, batch), dtype=jnp.uint32)
-        rank = _gen_ranks(tpair, w, log2_bins=LB, n_keys=n_keys)
+        rank = gen_ranks(tpair, w)
         khi_u, klo_u = _keys_of_ranks(rank, salt_hi, salt_lo)
         # slots [0, R) are read clients, [R, batch) write clients; each
         # class combines independently (same pipeline as the read-only
@@ -524,6 +639,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
         return pool, counters, (step_idx,) + tuple(rcarry)
 
     step.jprep, step.jserve = jprep, jserve
+    step.sampler = sampler
 
     def new_carry():
         """(step_idx, ok, n_correct_reads, n_ok_writes, sum_nuniq,
@@ -536,5 +652,5 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
                                np.int32(0), np.uint32(0)))
 
     table_d, rtable_d, rkey_d = staged or _stage_inputs(
-        router, n_keys, theta, LB, seed)
+        router, n_keys, theta, LB, seed, sampler)
     return step, (new_carry, table_d, rtable_d, rkey_d)
