@@ -28,6 +28,12 @@ Environment knobs:
                          16; set 1 on a co-located host for exact spans)
   SHERMAN_BENCH_LAT_BLOCKS number of latency block samples (default 64 —
                          the p50/p99 distribution size)
+  SHERMAN_BENCH_TRACE    Chrome-trace export path (default
+                         bench_logs/trace_last.json; "0" disables).  The
+                         JSON also carries an "obs" section: the metrics
+                         registry snapshot (dsm.* op/byte counters,
+                         btree.* cache counters) + per-phase span stats
+                         from sherman_tpu/obs.
 
 Read combining: a zipf-0.99 batch of 4 M ops contains ~1-2 M distinct
 keys (~2-4x dedup depending on keyspace size).  The engine already
@@ -69,6 +75,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     import jax
     import jax.numpy as jnp
 
+    from sherman_tpu import obs
     from sherman_tpu.cluster import Cluster
     from sherman_tpu.config import DSMConfig, LEAF_CAP, TreeConfig
     from sherman_tpu.models import batched
@@ -124,7 +131,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                                       dtype=np.uint64))[:n_keys]
     assert keys.shape[0] == n_keys
     vals = keys ^ np.uint64(0xDEADBEEF)
-    stats = batched.bulk_load(tree, keys, vals, fill=fill)
+    with obs.span("bench.bulk_load", keys=n_keys):
+        stats = batched.bulk_load(tree, keys, vals, fill=fill)
     lb_env = os.environ.get("SHERMAN_BENCH_LB")
     router = eng.attach_router(int(lb_env) if lb_env else None)
     print(f"# bulk_load {time.time() - t0:.1f}s {stats} "
@@ -184,7 +192,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     sustained_ops_s = sus_host_ops_s = None
     sus_prep_ms = sus_put_ms = sus_ms_per_step = None
     sus_dev_ms_per_step = sus_dev_combine = dev_attempts = None
-    dev_sampler = None
+    dev_sampler = sus_mixed_sampler = None
+    sus_dev_degraded = None  # final staged attempt still over threshold
     sort_ms = None  # staged-phase start-sort cost (native combine only)
 
     def run_windowed(n_steps, advance):
@@ -319,7 +328,9 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             dev_attempts = []
             for _attempt in range(3):
                 carry = new_carry()
-                dev_elapsed = run_windowed(dev_steps, adv_ro)
+                with obs.span("bench.sustained_dev",
+                              attempt=_attempt + 1, steps=dev_steps):
+                    dev_elapsed = run_windowed(dev_steps, adv_ro)
                 _, d_ok, d_corr, d_sum_nu, d_max_nu = (
                     int(np.asarray(x)) for x in carry)
                 assert d_ok == 1, "device-staged: unique overflow mid-run"
@@ -336,6 +347,10 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             sustained_ops_s = dev_steps * batch / dev_elapsed
             sus_dev_ms_per_step = dev_elapsed / dev_steps * 1e3
             sus_dev_combine = dev_steps * batch / max(1, d_sum_nu)
+            # explicit degradation flag: even the last attempt ran over
+            # the tunnel-thrash threshold, so the published number is a
+            # degraded-environment measurement, not the workload's
+            sus_dev_degraded = dev_elapsed / dev_steps >= degraded_s
             print(f"# sustained(device-staged): {dev_steps} steps in "
                   f"{dev_elapsed:.2f}s -> {sustained_ops_s / 1e6:.1f} M "
                   f"ops/s end-to-end ({sus_dev_ms_per_step:.1f} ms/step; "
@@ -382,6 +397,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                 prep_t += time.time() - t1
         jax.block_until_ready(found)
         sus_elapsed = time.time() - t0
+        obs.get_tracer().record("bench.sustained_host", sus_elapsed)
         assert bool(np.asarray(done)[:last_nu].all()), \
             "sustained: stragglers"
         sus_host_ops_s = sus_steps * batch / sus_elapsed
@@ -560,6 +576,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     jax.block_until_ready(found)
     np.asarray(jnp.ravel(found)[0])  # true pipeline drain
     elapsed = time.time() - t0
+    obs.get_tracer().record("bench.throughput_window", elapsed)
     n_last = n_uniq[(steps - 1) % n_batches]
     assert bool(np.asarray(done)[:n_last].all()), "lookups did not converge"
 
@@ -583,6 +600,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     # gave p50 ~= p99 by construction)
     lat_blocks = int(os.environ.get("SHERMAN_BENCH_LAT_BLOCKS", 64))
     spans = []
+    obs_hist = obs.histogram("bench.step_span_ns")
     for b in range(lat_blocks):
         s0 = time.time_ns()
         for i in range(kblk):
@@ -590,6 +608,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         jax.block_until_ready(found)
         span = (time.time_ns() - s0) / kblk
         spans.append(span)
+        obs_hist.record(span)
         if hist is not None:
             hist.record_batch(int(span), batch * kblk)
     if hist is not None and max(spans) < 100e6:
@@ -654,6 +673,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             sampler=os.environ.get("SHERMAN_BENCH_SAMPLER", "analytic"))
         mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(dev_rb=cap_r0,
                                                  dev_wb=cap_w0)
+        sus_mixed_sampler = mstep.sampler  # effective (fallback-aware)
         mc = new_mc()
         pool, counters, mc = mstep(pool, tree.dsm.locks, counters, mt_d,
                                    mrt_d, mrk_d, mc)
@@ -697,7 +717,9 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             "SHERMAN_BENCH_DEGRADED_S", 0.5)) + 0.1
         m_attempts = []
         for _attempt in range(3):
-            m_elapsed = run_windowed(m_steps, adv_mixed)
+            with obs.span("bench.sustained_mixed",
+                          attempt=_attempt + 1, steps=m_steps):
+                m_elapsed = run_windowed(m_steps, adv_mixed)
             tree.dsm.pool, tree.dsm.counters = pool, counters
             m_ok, m_cr, m_cw, m_snu = (int(np.asarray(x))
                                        for x in mc[1:5])
@@ -733,6 +755,24 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
           f"lock {host_lock_us:.0f} us search {host_search_us:.0f} us "
           f"insert {host_insert_us:.0f} us (incl. access-tunnel RTT); "
           f"{tree.dsm.counter_snapshot()}", file=sys.stderr)
+    if dev_sampler is None and sus_mixed_sampler is not None:
+        # read-only staged phase skipped: the mixed loop ran the same
+        # device sampler stack — publish its effective choice
+        dev_sampler = sus_mixed_sampler
+    # observability: export the run's Chrome trace (Perfetto-loadable)
+    # and embed the registry snapshot + per-phase span stats in the JSON
+    trace_env = os.environ.get("SHERMAN_BENCH_TRACE", "")
+    trace_file = None
+    if trace_env != "0":
+        trace_file = trace_env or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_logs",
+            "trace_last.json")
+        # one-call dump: trace events (Perfetto-loadable) + the full
+        # metrics snapshot riding in otherData
+        obs.dump(trace_file, extra={"bench_keys": n_keys,
+                                    "bench_batch": batch})
+    obs_sec = obs.obs_section()
+    obs_sec["trace_file"] = trace_file
     return {
         "metric": "ycsb_c_zipf%.2f_lookup_throughput" % theta,
         "value": round(client_ops_s),
@@ -776,8 +816,14 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # and retried, see the retry comment in run())
         "sus_dev_attempts_s": dev_attempts,
         # which zipf sampler the staged loops actually ran (fallback-
-        # aware: 'analytic' needs 0<theta<1 and keys>64)
+        # aware: 'analytic' needs 0<theta<1 and keys>64); when the
+        # read-only staged phase was skipped this is the mixed loop's
         "sus_dev_sampler": dev_sampler,
+        # true = every retry of the read-only staged loop still exceeded
+        # SHERMAN_BENCH_DEGRADED_S per step (tunnel degradation): the
+        # published sustained_ops_s is an environment-degraded number
+        "sus_dev_degraded": sus_dev_degraded,
+        "sus_mixed_sampler": sus_mixed_sampler,
         "sus_dev_combine": round(sus_dev_combine, 2)
         if sus_dev_combine else None,
         "sus_mixed_ops_s": round(sus_mixed_ops_s) if sus_mixed_ops_s
@@ -797,6 +843,10 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         "host_insert_us": round(host_insert_us, 1),
         "keys": n_keys,
         "batch": batch,
+        # unified observability plane (sherman_tpu/obs): registry
+        # snapshot (incl. dsm.* device op/byte counters), per-phase span
+        # stats, and the Perfetto-loadable trace file of this run
+        "obs": obs_sec,
     }
 
 

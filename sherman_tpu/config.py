@@ -37,6 +37,25 @@ MAX_MACHINE = 1 << ADDR_NODE_BITS
 META_ROOT_ADDR_W = 0   # packed addr of the current root page
 
 
+def donate_argnums(*argnums: int) -> tuple[int, ...]:
+    """Buffer-donation argnums for jit, gated by backend.
+
+    Donation is a pure optimization (the output reuses the input's
+    buffer in place).  On this toolchain's CPU backend, donated-input
+    aliasing is unstable under suite-level churn: with donation on, the
+    CPU test suite intermittently reads corrupt pool/meta words or
+    segfaults inside result materialization in tests that run AFTER a
+    donation-heavy test, at identical code — classic freed-buffer reuse
+    while an earlier donated execution is still completing.  Off-CPU
+    (TPU) donation is load-bearing (avoids copying the pool every step)
+    and unaffected; CPU pools in tests are small, so the copies are
+    noise there.  Call at jit-CONSTRUCTION time, never import time (it
+    initializes the backend, which must stay after
+    jax.distributed.initialize in multihost drivers)."""
+    import jax
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
 @dataclasses.dataclass(frozen=True)
 class DSMConfig:
     """Cluster + memory-pool shape (reference ``Config.h:13-22``).

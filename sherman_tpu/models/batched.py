@@ -38,6 +38,7 @@ import numpy as np
 from jax import lax
 
 from sherman_tpu import config as C
+from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig, TreeConfig
 from sherman_tpu.models.btree import META_ADDR
 from sherman_tpu.ops import bits, layout
@@ -1069,7 +1070,7 @@ class BatchedEngine:
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=(1,))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(1))
             self._search_cache[key] = fn
         return fn
 
@@ -1113,7 +1114,7 @@ class BatchedEngine:
                 out_specs=((spec, spec, spec, log_spec) if with_fresh
                            else (spec, spec, spec)),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=(0, 2))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2))
             self._insert_cache[key] = fn
         return fn
 
@@ -1132,7 +1133,7 @@ class BatchedEngine:
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=(0, 2))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2))
             self._delete_cache[key] = fn
         return fn
 
@@ -1159,7 +1160,7 @@ class BatchedEngine:
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec, spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=(0, 2))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2))
             self._mixed_cache[key] = fn
         return fn
 
@@ -1205,12 +1206,13 @@ class BatchedEngine:
                 self._shard(ar), self._shard(aw)]
         if use_router:
             args.append(self._shard(self.router.host_start(khi, klo)))
-        with self._step_mutex:
-            (self.dsm.pool, self.dsm.counters, status, done_r, found,
-             rvh, rvl) = fn(self.dsm.pool, self.dsm.locks,
-                            self.dsm.counters, *args)
-        status, done_r, found, rvh, rvl = self._unshard(
-            status, done_r, found, rvh, rvl)
+        with obs.span("engine.mixed.descend_lock_apply", n=int(n)):
+            with self._step_mutex:
+                (self.dsm.pool, self.dsm.counters, status, done_r, found,
+                 rvh, rvl) = fn(self.dsm.pool, self.dsm.locks,
+                                self.dsm.counters, *args)
+            status, done_r, found, rvh, rvl = self._unshard(
+                status, done_r, found, rvh, rvl)
         status = np.array(status[:n])  # writable: retry outcomes land here
         done_r = done_r[:n]
         found = np.array(found[:n])
@@ -1318,10 +1320,13 @@ class BatchedEngine:
                 np.int32(self.tree._root_addr), self._shard(active)]
         if use_router:
             args.append(self._shard(self.router.host_start(khi, klo)))
-        with self._step_mutex:  # launch-only (prep above)
-            self.dsm.counters, done, found, vhi, vlo = fn(
-                self.dsm.pool, self.dsm.counters, *args)
-        done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
+        # span covers launch -> materialized replies (dispatch is async;
+        # _unshard's host materialization is the real step drain)
+        with obs.span("engine.search.descend", n=int(n)):
+            with self._step_mutex:  # launch-only (prep above)
+                self.dsm.counters, done, found, vhi, vlo = fn(
+                    self.dsm.pool, self.dsm.counters, *args)
+            done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
         done = done[:n]
         if not done.all():
             assert _depth < 8, "search stragglers not converging"
@@ -1373,7 +1378,7 @@ class BatchedEngine:
                 kernel, mesh=self.dsm.mesh,
                 in_specs=(spec, spec, spec, spec, rep, spec, spec, spec),
                 out_specs=(spec, spec, spec, spec, spec), check_vma=False)
-            fn = jax.jit(sm, donate_argnums=(1,))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(1))
             self._search_cache[("fanout", iters)] = fn
         return fn
 
@@ -1393,7 +1398,8 @@ class BatchedEngine:
         on zipf-skewed batches.  Returns (values uint64 [n], found [n]).
         """
         keys = np.asarray(keys, np.uint64)
-        uk, inv = np.unique(keys, return_inverse=True)
+        with obs.span("engine.search.combine", n=int(keys.size)):
+            uk, inv = np.unique(keys, return_inverse=True)
         use_device = (self.router is not None
                       and 0 < uk.size <= self.B * self.cfg.machine_nr)
         if not use_device:
@@ -1420,10 +1426,12 @@ class BatchedEngine:
                 np.int32(self.tree._root_addr), self._shard(active),
                 self._shard(self.router.host_start(khi, klo)),
                 self._shard(inv_p)]
-        with self._step_mutex:  # launch-only (prep above)
-            self.dsm.counters, done, found, vhi, vlo = fn(
-                self.dsm.pool, self.dsm.counters, *args)
-        done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
+        with obs.span("engine.search.descend", n=int(uk.size),
+                      fanout=int(n)):
+            with self._step_mutex:  # launch-only (prep above)
+                self.dsm.counters, done, found, vhi, vlo = fn(
+                    self.dsm.pool, self.dsm.counters, *args)
+            done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
         if not bool(done[: uk.size].all()):
             # straggler rescue (stale seeds / growth): host fan-out path
             vals, fnd = self.search(uk)
@@ -1464,7 +1472,7 @@ class BatchedEngine:
                 in_specs=(spec, spec, spec, spec, rep, spec),
                 out_specs=(spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=(1,))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(1))
             self._parent_descend_cache[key] = fn
         return fn
 
@@ -1516,6 +1524,13 @@ class BatchedEngine:
         total = len(raw)
         if not total:
             return 0
+        with obs.span("engine.insert.flush_parents", n=total):
+            return self._flush_parents_drained(raw, total, dbg)
+
+    def _flush_parents_drained(self, raw, total, dbg) -> int:
+        import collections
+        import time as _t
+
         # legacy 2-tuples target level 1
         pend = [t if len(t) == 3 else (t[0], t[1], 1) for t in raw]
         tree, dsm = self.tree, self.dsm
@@ -1757,17 +1772,21 @@ class BatchedEngine:
                 args.append(self._shard(self.router.host_start(khi, klo)))
             if with_fresh:
                 args.append(self._shard(fresh_np))
-            with self._step_mutex:  # launch-only (prep above)
-                if with_fresh:
-                    self.dsm.pool, self.dsm.counters, status, log = fn(
-                        self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                        *args)
-                else:
-                    self.dsm.pool, self.dsm.counters, status = fn(
-                        self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                        *args)
-                    log = None
-            status = self._unshard(status)[:idx.shape[0]]
+            # one fused device round: descend + lock + leaf apply (+
+            # splits); the span drains at the status materialization
+            with obs.span("engine.insert.descend_lock_apply",
+                          n=int(idx.shape[0]), round=round_i):
+                with self._step_mutex:  # launch-only (prep above)
+                    if with_fresh:
+                        self.dsm.pool, self.dsm.counters, status, log = fn(
+                            self.dsm.pool, self.dsm.locks,
+                            self.dsm.counters, *args)
+                    else:
+                        self.dsm.pool, self.dsm.counters, status = fn(
+                            self.dsm.pool, self.dsm.locks,
+                            self.dsm.counters, *args)
+                        log = None
+                status = self._unshard(status)[:idx.shape[0]]
             if dbg:
                 import collections as _c
                 print(f"[ins] status {dict(_c.Counter(status.tolist()))} "
@@ -1777,7 +1796,8 @@ class BatchedEngine:
             # so drivers/tests can assert the interleaving really happened
             stats["st_locked"] += int((status == ST_LOCKED).sum())
             if log is not None:
-                self._drain_split_log(log, stats)
+                with obs.span("engine.insert.split_drain"):
+                    self._drain_split_log(log, stats)
             if len(self._pending_parents) >= self.parent_flush_threshold:
                 # flush between rounds: parents keep descent paths short —
                 # deferring across many split rounds can grow a B-link
@@ -2223,11 +2243,13 @@ class BatchedEngine:
                     np.int32(self.tree._root_addr), self._shard(active)]
             if use_router:
                 args.append(self._shard(self.router.host_start(khi, klo)))
-            with self._step_mutex:  # launch-only (prep above)
-                self.dsm.pool, self.dsm.counters, status = fn(
-                    self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                    *args)
-            status = self._unshard(status)[:idx.shape[0]]
+            with obs.span("engine.delete.descend_lock_apply",
+                          n=int(idx.shape[0])):
+                with self._step_mutex:  # launch-only (prep above)
+                    self.dsm.pool, self.dsm.counters, status = fn(
+                        self.dsm.pool, self.dsm.locks, self.dsm.counters,
+                        *args)
+                status = self._unshard(status)[:idx.shape[0]]
 
             found_out[idx[status == ST_APPLIED]] = True
             done = (status == ST_APPLIED) | (status == ST_NOT_FOUND)
@@ -2377,15 +2399,38 @@ def range_query_many(eng: "BatchedEngine", ranges
 # Bulk load: bottom-up tree construction (benchmark warmup path).
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _install_pages(pool, rows, pages):
+def _install_pages_impl(pool, rows, pages):
     return pool.at[rows].set(pages)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,),
+@functools.lru_cache(maxsize=None)
+def _install_pages_jit():
+    # jitted lazily so the donation decision (backend-gated — see
+    # config.donate_argnums) never initializes the backend at import
+    return jax.jit(_install_pages_impl,
+                   donate_argnums=C.donate_argnums(0))
+
+
+def _install_pages(pool, rows, pages):
+    return _install_pages_jit()(pool, rows, pages)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_install_leaves_jit():
+    return jax.jit(_build_install_leaves_impl,
+                   donate_argnums=C.donate_argnums(0),
                    static_argnames=("per_leaf",))
+
+
 def _build_install_leaves(pool, rows, khi, klo, vhi, vlo, live,
                           lhi, llo, hhi, hlo, sib, *, per_leaf: int):
+    return _build_install_leaves_jit()(
+        pool, rows, khi, klo, vhi, vlo, live, lhi, llo, hhi, hlo, sib,
+        per_leaf=per_leaf)
+
+
+def _build_install_leaves_impl(pool, rows, khi, klo, vhi, vlo, live,
+                               lhi, llo, hhi, hlo, sib, *, per_leaf: int):
     """Build all leaf pages ON DEVICE and scatter them into the pool.
 
     The leaf level is ~97% of a bulk load's bytes; building it device-side

@@ -46,12 +46,22 @@ from __future__ import annotations
 import numpy as np
 
 from sherman_tpu import config as C
+from sherman_tpu import obs
 from sherman_tpu.cluster import ClientContext, Cluster
 from sherman_tpu.ops import bits, layout
 from sherman_tpu.parallel import dsm as D
 
 META_ADDR = bits.make_addr(0, 0)
 LOCK_SPIN_LIMIT = 1_000_000  # deadlock reporter threshold (Tree.cpp:219-227)
+
+# Index-cache effectiveness counters (the reference counts cache
+# hit/miss rates by hand in its benchmark threads; here they ride the
+# process registry — lock-free increments on the descent path).
+_OBS_CACHE_HITS = obs.counter("btree.cache_hits")
+_OBS_CACHE_MISSES = obs.counter("btree.cache_misses")
+_OBS_CACHE_INVALIDATIONS = obs.counter("btree.cache_invalidations")
+_OBS_SIBLING_CHASES = obs.counter("btree.sibling_chases")
+_OBS_ROOT_REFRESHES = obs.counter("btree.root_refreshes")
 
 
 class Tree:
@@ -290,6 +300,9 @@ class Tree:
             hit = self.index_cache.lookup(key)
             if hit:
                 addr, from_cache = hit, True
+                _OBS_CACHE_HITS.inc()
+            else:
+                _OBS_CACHE_MISSES.inc()
         path: dict[int, int] = {}
         hops = 0
         while True:
@@ -300,6 +313,7 @@ class Tree:
                 # are never freed — but a non-leaf/fence miss means the
                 # mapping is junk): drop it, restart uncached
                 self.index_cache.invalidate(key)
+                _OBS_CACHE_INVALIDATIONS.inc()
                 addr, from_cache = self._root_addr, False
                 continue
             if key >= layout.np_highest(pg):
@@ -307,13 +321,16 @@ class Tree:
                     # split moved the key right since caching: invalidate,
                     # then chase the sibling (cheaper than a full restart)
                     self.index_cache.invalidate(key)
+                    _OBS_CACHE_INVALIDATIONS.inc()
                 sib = int(pg[C.W_SIBLING])
                 if bits.addr_is_null(sib):
                     # stale root cache (concurrent new root): refresh
                     self._refresh_root()
                     addr = self._root_addr
+                    _OBS_ROOT_REFRESHES.inc()
                 else:
                     addr = sib
+                    _OBS_SIBLING_CHASES.inc()
                 from_cache = False
                 hops += 1
                 assert hops < 1000, "sibling chase runaway"

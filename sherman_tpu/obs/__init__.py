@@ -1,0 +1,42 @@
+"""sherman_tpu.obs — the unified observability plane.
+
+The reference Sherman has no observability layer (SURVEY.md §5):
+profiling is a manual ns ``Timer`` plus hand-rolled latency histograms,
+and op counters live inside ``DSM``.  This package is the single
+instrumentation surface every layer reports through:
+
+- :mod:`sherman_tpu.obs.registry` — process-wide metrics registry
+  (counters, gauges, histograms) with snapshot/delta semantics so
+  drivers and tests can diff op counts around a timed region.  Hot-path
+  increments are a plain attribute add — no locks, no dict lookups.
+- :mod:`sherman_tpu.obs.spans` — nested span tracing with thread-safe
+  recording and Chrome-trace-event JSON export (loadable in
+  ``chrome://tracing`` / Perfetto), absorbing the legacy
+  :class:`StepTrace` micro-tracer.
+- :mod:`sherman_tpu.obs.export` — JSONL periodic snapshots and the
+  one-call :func:`~sherman_tpu.obs.export.dump` used by ``bench.py``.
+
+Wired-in sources: the DSM registers its device op/byte counters as a
+pull collector (``dsm.*`` keys in every snapshot), the transports count
+collective builds and payload bytes, the batched engine wraps its
+combine/descend/apply phases in spans, and the host B+Tree counts index
+cache hits/misses/invalidations.
+"""
+
+from __future__ import annotations
+
+from sherman_tpu.obs.export import dump, obs_section, write_snapshot_jsonl
+from sherman_tpu.obs.registry import (Counter, Gauge, Histogram,
+                                      MetricsRegistry, counter, delta, gauge,
+                                      get_registry, histogram,
+                                      register_collector, snapshot)
+from sherman_tpu.obs.spans import (SpanTracer, StepTrace, device_trace,
+                                   get_tracer, span)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "counter", "gauge", "histogram", "snapshot", "delta",
+    "register_collector", "get_registry",
+    "SpanTracer", "StepTrace", "device_trace", "get_tracer", "span",
+    "dump", "obs_section", "write_snapshot_jsonl",
+]

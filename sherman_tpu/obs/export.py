@@ -1,0 +1,114 @@
+"""Exporters: one-call JSON dumps and periodic JSONL snapshots.
+
+``bench.py`` and the tools/ drivers report through here instead of
+hand-formatting their own strings:
+
+- :func:`obs_section` — the dict a driver embeds in its JSON output
+  (``{"counters": ..., "spans": ...}``), built from the default
+  registry + tracer.
+- :func:`dump` — write a full observability dump (metrics snapshot +
+  span summary + Chrome trace events) to one JSON file.
+- :func:`write_snapshot_jsonl` / :class:`PeriodicExporter` — append
+  timestamped registry snapshots to a JSONL file, manually or on a
+  background interval (the long-churn drivers' flight recorder).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from sherman_tpu.obs import registry as _registry
+from sherman_tpu.obs import spans as _spans
+
+__all__ = ["dump", "obs_section", "write_snapshot_jsonl",
+           "PeriodicExporter"]
+
+
+def obs_section(reg=None, tracer=None) -> dict:
+    """The ``obs`` dict drivers embed in their JSON output."""
+    reg = reg if reg is not None else _registry.get_registry()
+    tracer = tracer if tracer is not None else _spans.get_tracer()
+    return {"counters": reg.snapshot(), "spans": tracer.summary()}
+
+
+def dump(path: str, reg=None, tracer=None, *, extra: dict | None = None
+         ) -> str:
+    """Write metrics + spans + Chrome trace events to ``path`` (JSON).
+
+    The file doubles as a Perfetto-loadable trace: ``traceEvents`` is
+    top-level per the Chrome trace-event spec, with the metrics
+    snapshot riding in ``otherData``.  Returns the path."""
+    reg = reg if reg is not None else _registry.get_registry()
+    tracer = tracer if tracer is not None else _spans.get_tracer()
+    doc = tracer.chrome_trace()
+    doc["otherData"].update({
+        "metrics": reg.snapshot(),
+        "span_summary": tracer.summary(),
+        "wall_time": time.time(),
+        **(extra or {}),
+    })
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def write_snapshot_jsonl(path: str, reg=None, *,
+                         extra: dict | None = None) -> None:
+    """Append one timestamped registry snapshot as a JSONL line."""
+    reg = reg if reg is not None else _registry.get_registry()
+    line = {"t": time.time(), "metrics": reg.snapshot(), **(extra or {})}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(line) + "\n")
+
+
+class PeriodicExporter:
+    """Background-thread JSONL snapshot writer.
+
+    >>> ex = PeriodicExporter("obs.jsonl", interval_s=10.0)
+    >>> ex.start()
+    ...
+    >>> ex.stop()   # writes one final snapshot
+
+    Snapshots invoke registry collectors (which may touch device
+    arrays); drivers whose collectors are not safe mid-step should
+    snapshot manually at step boundaries instead.
+    """
+
+    def __init__(self, path: str, interval_s: float = 10.0, reg=None):
+        self.path = path
+        self.interval_s = interval_s
+        self.reg = reg if reg is not None else _registry.get_registry()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "PeriodicExporter":
+        assert self._thread is None, "already started"
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="obs-exporter")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            write_snapshot_jsonl(self.path, self.reg)
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        write_snapshot_jsonl(self.path, self.reg, extra={"final": True})
+
+    def __enter__(self) -> "PeriodicExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sherman_tpu import config as CFG
+from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig, PAGE_WORDS
 from sherman_tpu.ops import bits
 from sherman_tpu.parallel import transport
@@ -75,6 +76,11 @@ CNT_CAS_OPS = 4
 CNT_FAA_OPS = 5
 CNT_WW_OPS = 6
 N_COUNTERS = 8
+
+# Host-side step counter (device op counts ride the sharded counters
+# array and surface via the registry's "dsm" collector; this one counts
+# host-API step LAUNCHES — the control-plane round-trip rate).
+_OBS_HOST_STEPS = obs.counter("dsm.host_steps")
 
 
 def empty_requests(n: int) -> dict[str, np.ndarray]:
@@ -515,7 +521,7 @@ class DSM(_HostOps):
             functools.partial(dsm_step_spmd, cfg=self._host_cfg),
             mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False)
-        self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._step = jax.jit(step, donate_argnums=CFG.donate_argnums(0, 1, 2))
         # Per-step request slots available to the *host* API; device kernels
         # compose dsm_step_spmd directly and have their own batches.
         self.host_slots = len(self.local_nodes) * self._host_cfg.step_capacity
@@ -524,6 +530,18 @@ class DSM(_HostOps):
         # lock tier's use case) can't interleave inside a step.
         import threading
         self._step_mutex = threading.Lock()
+
+        # Observability: expose the device op/byte counters as a pull
+        # collector on the process-wide registry — snapshots then carry
+        # ``dsm.read_ops`` etc. without any per-op host cost (the
+        # counters accumulate on device; reading them is the same
+        # materialization counter_snapshot always did).  Weakly bound:
+        # a dead DSM drops out instead of pinning its device arrays.
+        import weakref
+        ref = weakref.ref(self)
+        obs.register_collector(
+            "dsm", lambda: (lambda d: d.counter_snapshot() if d is not None
+                            else {})(ref()))
 
     # -- raw step ------------------------------------------------------------
 
@@ -537,6 +555,7 @@ class DSM(_HostOps):
 
         Thread-safe: one step at a time (the state arrays are donated).
         """
+        _OBS_HOST_STEPS.inc()
         with self._step_mutex:
             return self._step_locked(reqs)
 

@@ -17,6 +17,24 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from sherman_tpu import obs
+
+# Collective-issue accounting.  ``exchange`` executes INSIDE compiled
+# SPMD programs, so a per-execution host counter is impossible without
+# round-tripping device state; what IS observable host-side is each
+# exchange issued during program tracing.  The counters therefore mean:
+# one inc per collective issued per program BUILD (recompiles included),
+# with ``bytes`` the per-node payload that collective moves on every
+# execution of that program.  Executed-op truth stays with the DSM's
+# device counters ("dsm.*" in the registry snapshot).
+_OBS_XCH_ISSUES = obs.counter("transport.exchange_issues_traced")
+_OBS_XCH_BYTES = obs.counter("transport.exchange_bytes_per_step")
+_OBS_XCH_PALLAS = obs.counter("transport.pallas_exchange_issues_traced")
+
+
+def _tree_nbytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
 
 def bucketize(dest, active, n_nodes: int, capacity: int):
     """Assign each request a slot in its destination bucket.
@@ -60,10 +78,17 @@ def exchange(tree, axis_name: str, *, impl: str = "xla"):
     """
     if impl == "pallas":
         from sherman_tpu.parallel import transport_pallas
-        n_nodes = jax.lax.axis_size(axis_name)
+        if hasattr(jax.lax, "axis_size"):
+            n_nodes = jax.lax.axis_size(axis_name)
+        else:  # JAX < 0.5: psum of a literal folds to a static int
+            n_nodes = jax.lax.psum(1, axis_name)
         interpret = jax.default_backend() != "tpu"
+        _OBS_XCH_PALLAS.inc()
+        _OBS_XCH_BYTES.inc(_tree_nbytes(tree))
         return transport_pallas.exchange(tree, axis_name, n_nodes,
                                          interpret=interpret)
+    _OBS_XCH_ISSUES.inc(len(jax.tree.leaves(tree)))
+    _OBS_XCH_BYTES.inc(_tree_nbytes(tree))
     return jax.tree.map(
         lambda x: jax.lax.all_to_all(x, axis_name, 0, 0, tiled=True), tree
     )

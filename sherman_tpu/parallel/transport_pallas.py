@@ -46,8 +46,19 @@ try:  # pallas is TPU-oriented; CPU uses interpreter mode
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     HAVE_PALLAS = True
+    # JAX < 0.5 spells CompilerParams TPUCompilerParams
+    _CompilerParams = getattr(pltpu, "CompilerParams",
+                              getattr(pltpu, "TPUCompilerParams", None))
 except Exception:  # pragma: no cover
     HAVE_PALLAS = False
+
+from sherman_tpu import obs
+
+# Traced-issue accounting (see transport.py for the trace-time
+# semantics): per kernel BUILD, the number of one-sided remote writes
+# it posts per execution and the packed payload bytes it moves.
+_OBS_REMOTE_WRITES = obs.counter("transport.pallas_remote_writes_traced")
+_OBS_PACKED_BYTES = obs.counter("transport.pallas_packed_bytes_per_step")
 
 def _collective_id(n_nodes: int, rows: int, width: int) -> int:
     """Barrier-semaphore key, distinct per program shape family.
@@ -126,6 +137,8 @@ def exchange_pallas(x, axis_name: str, n_nodes: int, *,
     rows = x.shape[0]
     assert rows % n_nodes == 0
     C = rows // n_nodes
+    _OBS_REMOTE_WRITES.inc(n_nodes - 1)
+    _OBS_PACKED_BYTES.inc(x.size * x.dtype.itemsize)
     kernel = functools.partial(
         _exchange_kernel, n_nodes=n_nodes, rows_per_peer=C,
         axis_name=axis_name, use_barrier=not interpret)
@@ -136,7 +149,7 @@ def exchange_pallas(x, axis_name: str, n_nodes: int, *,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA((n_nodes,)),
                         pltpu.SemaphoreType.DMA((n_nodes,))],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             collective_id=_collective_id(
                 n_nodes, C, math.prod(x.shape[1:]))),
         interpret=interpret,

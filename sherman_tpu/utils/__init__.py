@@ -3,8 +3,9 @@
 Reference parity: ``include/Timer.h`` (ns timer, spin-sleep, per-loop
 print) and ``include/Debug.h`` / ``src/Debug.cpp`` (printf-style leveled
 logging with ANSI colors, compile-time gates).  Beyond the reference:
-step/phase tracing and XLA device traces (``utils.trace`` — the
-reference has no tracer, SURVEY.md §5).
+step/phase tracing and XLA device traces, now part of the unified
+observability plane (``sherman_tpu.obs``; ``utils.trace`` re-exports —
+the reference has no tracer, SURVEY.md §5).
 """
 
 from __future__ import annotations
@@ -12,11 +13,11 @@ from __future__ import annotations
 from sherman_tpu.utils.debug import (DEBUG, ERROR, INFO, debug_item,
                                      notify_error, notify_info, set_level)
 from sherman_tpu.utils.timer import Timer, spin_sleep_ns
-from sherman_tpu.utils.trace import StepTrace, device_trace
+from sherman_tpu.utils.trace import SpanTracer, StepTrace, device_trace
 
 __all__ = [
     "Timer", "spin_sleep_ns",
     "notify_info", "notify_error", "debug_item", "set_level",
     "INFO", "ERROR", "DEBUG",
-    "StepTrace", "device_trace",
+    "StepTrace", "SpanTracer", "device_trace",
 ]

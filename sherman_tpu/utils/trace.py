@@ -1,71 +1,15 @@
-"""Step tracing/profiling — beyond the reference's Timer+rdtsc surface.
+"""Back-compat shim — tracing moved to :mod:`sherman_tpu.obs.spans`.
 
-The reference has no tracer (SURVEY.md §5): profiling is a manual ns Timer
-and latency histograms.  This module keeps those (``utils.timer``,
-``native.LatencyHistogram``) and adds the TPU-native pieces:
-
-- :class:`StepTrace` — per-named-phase wall spans with step counts, the
-  micro-tracer for driver loops (host-side; ~100 ns overhead per record).
-- :func:`device_trace` — context manager around ``jax.profiler.trace``:
-  captures an XLA/TPU execution trace viewable in TensorBoard/Perfetto
-  (kernel timings, DMA waits, fusion boundaries) for any code block.
+The observability subsystem (``sherman_tpu/obs/``) absorbed this
+module: :class:`StepTrace` (the flat per-phase micro-tracer) and
+:func:`device_trace` (the XLA profiler capture) live in
+``obs.spans`` alongside the nested :class:`~sherman_tpu.obs.spans.
+SpanTracer` and its Chrome-trace export.  Importing from here keeps
+working for existing drivers and tests.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
-from collections import defaultdict
+from sherman_tpu.obs.spans import SpanTracer, StepTrace, device_trace
 
-
-class StepTrace:
-    """Accumulate (phase -> spans) across a driver loop.
-
-    >>> tr = StepTrace()
-    >>> with tr.span("descend"):
-    ...     ...
-    >>> tr.summary()  # {'descend': {'n': 1, 'total_s': ..., 'mean_ms': ...}}
-    """
-
-    def __init__(self):
-        self._spans = defaultdict(list)
-
-    @contextlib.contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield self
-        finally:
-            self._spans[name].append(time.perf_counter() - t0)
-
-    def record(self, name: str, seconds: float) -> None:
-        self._spans[name].append(float(seconds))
-
-    def summary(self) -> dict[str, dict[str, float]]:
-        out = {}
-        for name, spans in self._spans.items():
-            tot = sum(spans)
-            out[name] = {"n": len(spans), "total_s": tot,
-                         "mean_ms": tot / len(spans) * 1e3}
-        return out
-
-    def report(self) -> str:
-        lines = []
-        for name, s in sorted(self.summary().items(),
-                              key=lambda kv: -kv[1]["total_s"]):
-            lines.append(f"{name:24s} n={s['n']:<6d} "
-                         f"total={s['total_s']:8.3f}s "
-                         f"mean={s['mean_ms']:8.3f}ms")
-        return "\n".join(lines)
-
-
-@contextlib.contextmanager
-def device_trace(log_dir: str):
-    """Capture an XLA device trace for the enclosed block.
-
-    View with TensorBoard's profile plugin or Perfetto.  No-op overhead
-    outside the block; inside, the runtime records kernel/DMA timelines.
-    """
-    import jax
-    with jax.profiler.trace(log_dir):
-        yield
+__all__ = ["StepTrace", "SpanTracer", "device_trace"]

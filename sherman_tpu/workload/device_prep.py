@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from sherman_tpu import config as C
 from sherman_tpu.ops import bits
 
 
@@ -435,7 +436,7 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     # run_windowed), which must stay a live buffer after the next step
     # consumes it (blocking a donated buffer is an error on some
     # backends).  Donating 4 replicated scalars saves nothing.
-    jserve = jax.jit(serve_sm, donate_argnums=(1,))
+    jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(1))
 
     def step(pool, counters, tpair, rtable, rkey, carry):
         step_idx, *rcarry = carry
@@ -629,7 +630,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
         out_specs=(spec, spec, (rep,) * 7), check_vma=False)
     # pool + counters donated; rcarry is NOT (callers block the
     # dispatch window on carry[1] — see the read-only step's note)
-    jserve = jax.jit(serve_sm, donate_argnums=(0, 2))
+    jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2))
 
     def step(pool, locks, counters, tpair, rtable, rkey, carry):
         step_idx, *rcarry = carry

@@ -389,4 +389,6 @@ def test_zipf_analytic_dedup_rate_matches_table():
     rt = np.asarray(jax.jit(
         lambda tp, w: _gen_ranks(tp, w, log2_bins=20, n_keys=n))(tp, w))
     ua, ut = np.unique(ra).size, np.unique(rt).size
-    assert abs(ua - ut) < 0.03 * ut, (ua, ut)
+    # measured gap is ~0.2-0.3% across seeds (3x headroom at 1%); the
+    # BENCHMARKS.md "within 1%" claim is pinned by this tolerance
+    assert abs(ua - ut) < 0.01 * ut, (ua, ut)

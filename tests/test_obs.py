@@ -1,0 +1,258 @@
+"""sherman_tpu.obs — registry, spans, export, and layer wiring."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from sherman_tpu import obs
+from sherman_tpu.obs.registry import MetricsRegistry, delta
+from sherman_tpu.obs.spans import SpanTracer, StepTrace
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_counter_gauge_histogram_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(4)
+    reg.gauge("g").set(2.5)
+    h = reg.histogram("h")
+    for v in (1, 2, 3, 1000):
+        h.record(v)
+    snap = reg.snapshot()
+    assert snap["c"] == 5
+    assert snap["g"] == 2.5
+    assert snap["h"]["count"] == 4
+    assert snap["h"]["sum"] == 1006
+    assert snap["h"]["min"] == 1 and snap["h"]["max"] == 1000
+    # percentile is bucket-resolved: p50 within 2x of the true median
+    assert 1 <= snap["h"]["p50"] <= 4
+    assert snap["h"]["p99"] >= 511
+
+
+def test_metric_get_or_create_idempotent_and_typed():
+    reg = MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_snapshot_delta_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("ops")
+    c.inc(10)
+    before = reg.snapshot()
+    c.inc(7)
+    reg.counter("born_inside").inc(3)  # metric created inside the region
+    after = reg.snapshot()
+    d = delta(before, after)
+    assert d["ops"] == 7
+    assert d["born_inside"] == 3
+
+
+def test_reset_zeroes_in_place_keeping_bindings():
+    # instrumentation sites bind Counter objects at import; reset must
+    # zero them in place, not orphan them from future snapshots
+    reg = MetricsRegistry()
+    c = reg.counter("bound")
+    g = reg.gauge("g")
+    h = reg.histogram("h")
+    c.inc(5)
+    g.set(3.0)
+    h.record(10)
+    reg.register_collector("src", lambda: {"a": 1})
+    reg.reset()
+    assert reg.snapshot()["bound"] == 0
+    assert reg.snapshot()["h"]["count"] == 0
+    c.inc(2)  # the pre-reset object still feeds snapshots
+    assert reg.counter("bound") is c
+    assert reg.snapshot()["bound"] == 2
+    assert reg.snapshot()["src.a"] == 1  # collectors survive too
+
+
+def test_collector_merge_and_error_isolation():
+    reg = MetricsRegistry()
+    reg.register_collector("src", lambda: {"a": 1, "b": 2})
+    reg.register_collector("bad", lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert snap["src.a"] == 1 and snap["src.b"] == 2
+    assert any("bad" in e for e in snap["_collector_errors"])
+    reg.unregister_collector("src")
+    assert "src.a" not in reg.snapshot()
+
+
+# -- spans -------------------------------------------------------------------
+
+def test_legacy_steptrace_api_still_works():
+    # the exact pre-obs surface, importable from the old module path
+    from sherman_tpu.utils.trace import StepTrace as LegacyStepTrace
+    assert LegacyStepTrace is StepTrace
+    tr = LegacyStepTrace()
+    with tr.span("descend"):
+        pass
+    tr.record("descend", 0.25)
+    s = tr.summary()
+    assert s["descend"]["n"] == 2
+    assert s["descend"]["total_s"] >= 0.25
+    assert "descend" in tr.report()
+
+
+def test_nested_spans_and_summary():
+    tr = SpanTracer()
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner"):
+            pass
+    s = tr.summary()
+    assert s["outer"]["n"] == 1
+    assert s["inner"]["n"] == 2
+    # nesting recorded: inner events carry depth 1 under outer
+    depths = {e[0]: e[4] for e in tr._events}
+    assert depths["outer"] == 0 and depths["inner"] == 1
+
+
+def test_chrome_trace_roundtrips_through_json(tmp_path):
+    tr = SpanTracer()
+    with tr.span("phase_a", step=3):
+        with tr.span("phase_b"):
+            pass
+    path = tr.export_chrome(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and len(evs) == 2
+    by_name = {e["name"]: e for e in evs}
+    for e in evs:
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0 and e["ts"] >= 0
+    assert by_name["phase_a"]["args"] == {"step": 3}
+    # b nests inside a on the timeline
+    a, b = by_name["phase_a"], by_name["phase_b"]
+    assert a["ts"] <= b["ts"]
+    assert b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-3
+
+
+def test_span_recording_thread_safe():
+    tr = SpanTracer()
+
+    def worker():
+        for _ in range(200):
+            with tr.span("w"):
+                pass
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert tr.summary()["w"]["n"] == 800
+    assert len(tr.chrome_trace()["traceEvents"]) == 800
+
+
+def test_event_cap_keeps_aggregates():
+    tr = SpanTracer(max_events=3)
+    for _ in range(10):
+        with tr.span("s"):
+            pass
+    assert tr.summary()["s"]["n"] == 10  # aggregate sees everything
+    assert len(tr.chrome_trace()["traceEvents"]) == 3
+    assert tr.dropped == 7
+
+
+# -- export ------------------------------------------------------------------
+
+def test_dump_and_jsonl(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("n").inc(2)
+    tr = SpanTracer()
+    with tr.span("p"):
+        pass
+    path = obs.dump(str(tmp_path / "obs.json"), reg, tr,
+                    extra={"run": "test"})
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"][0]["name"] == "p"
+    assert doc["otherData"]["metrics"]["n"] == 2
+    assert doc["otherData"]["run"] == "test"
+    jl = str(tmp_path / "obs.jsonl")
+    obs.write_snapshot_jsonl(jl, reg)
+    obs.write_snapshot_jsonl(jl, reg)
+    lines = [json.loads(ln) for ln in open(jl)]
+    assert len(lines) == 2 and lines[0]["metrics"]["n"] == 2
+
+
+# -- layer wiring ------------------------------------------------------------
+
+def test_dsm_counters_visible_through_registry(eight_devices):
+    from sherman_tpu.config import DSMConfig
+    from sherman_tpu.ops import bits
+    from sherman_tpu.parallel.dsm import DSM
+
+    cfg = DSMConfig(machine_nr=2, pages_per_node=64, locks_per_node=64,
+                    step_capacity=16)
+    dsm = DSM(cfg)
+    before = obs.snapshot()
+    a = bits.make_addr(1, 3)
+    dsm.write_page(a, np.arange(256, dtype=np.int32))
+    pg = dsm.read_page(a)
+    assert pg[7] == 7
+    d = delta(before, obs.snapshot())
+    assert d["dsm.read_ops"] == 1
+    assert d["dsm.write_ops"] == 1
+    assert d["dsm.read_bytes"] == 1024
+    assert d["dsm.host_steps"] == 2
+    # the registry view and the legacy attribute API agree
+    snap = obs.snapshot()
+    for k, v in dsm.counter_snapshot().items():
+        assert snap[f"dsm.{k}"] == v
+
+
+def test_btree_cache_counters(eight_devices):
+    from sherman_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig
+    from sherman_tpu.models.btree import Tree
+
+    cfg = DSMConfig(machine_nr=2, pages_per_node=128, locks_per_node=64,
+                    step_capacity=64)
+    tree = Tree(Cluster(cfg))
+    tree.enable_index_cache(64)
+    for k in range(1, 6):
+        tree.insert(k, k + 100)
+    before = obs.snapshot()
+    tree.search(3)  # miss (nothing cached at leaf level yet) or hit
+    tree.search(3)
+    d = delta(before, obs.snapshot())
+    assert d.get("btree.cache_hits", 0) + d.get("btree.cache_misses", 0) == 2
+
+
+def test_engine_phases_recorded_as_spans(eight_devices):
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+
+    cfg = DSMConfig(machine_nr=2, pages_per_node=256, locks_per_node=128,
+                    step_capacity=256)
+    tree = Tree(Cluster(cfg))
+    eng = batched.BatchedEngine(tree, batch_per_node=64)
+    keys = np.arange(1, 65, dtype=np.uint64)
+    before = obs.get_tracer().summary()
+    eng.insert(keys, keys + 1)
+    vals, found = eng.search(keys)
+    assert found.all() and (vals == keys + 1).all()
+    after = obs.get_tracer().summary()
+
+    def n(summ, name):
+        return summ.get(name, {}).get("n", 0)
+
+    assert n(after, "engine.insert.descend_lock_apply") > n(
+        before, "engine.insert.descend_lock_apply")
+    assert n(after, "engine.search.descend") > n(
+        before, "engine.search.descend")

@@ -85,7 +85,10 @@ def test_multichip_tpu_lowering_smoke():
         pytest.skip("pallas unavailable")
 
     N, C, W = 8, 16, 8
-    mesh = AbstractMesh((N,), ("node",))
+    try:
+        mesh = AbstractMesh((N,), ("node",))
+    except TypeError:  # JAX < 0.5 spells the shape as (name, size) pairs
+        mesh = AbstractMesh((("node", N),))
     spec = P("node")
 
     def step(x):
@@ -95,7 +98,15 @@ def test_multichip_tpu_lowering_smoke():
                                out_specs=spec, check_vma=False))
     arg = jax.ShapeDtypeStruct((N * N * C, W), jnp.int32,
                                sharding=NamedSharding(mesh, spec))
-    txt = fn.trace(arg).lower(lowering_platforms=("tpu",)).as_text()
+    try:
+        txt = fn.trace(arg).lower(lowering_platforms=("tpu",)).as_text()
+    except ValueError as e:
+        # only the known capability gap skips (JAX < 0.5 cannot lower
+        # over a device-less AbstractMesh); any other lowering error is
+        # a real regression this smoke test exists to catch
+        if "AbstractMesh" in str(e) or "_device_assignment" in str(e):
+            pytest.skip(f"AbstractMesh TPU lowering unsupported here: {e}")
+        raise
     assert "tpu_custom_call" in txt or "mosaic" in txt.lower()
 
 

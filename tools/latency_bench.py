@@ -56,7 +56,10 @@ def main() -> None:
     ap.add_argument("--keys", type=int, default=10_000_000)
     ap.add_argument("--widths", type=str, default="16384,32768,65536,262144")
     ap.add_argument("--blocks", type=int, default=64,
-                    help="latency block samples per width")
+                    help="latency block samples per width; also the "
+                         "open-loop sample-count target (values below 8 "
+                         "are honored as given — expect coarse "
+                         "percentiles)")
     ap.add_argument("--kblk", type=int, default=32,
                     help="steps per latency block (one sync each)")
     ap.add_argument("--theta", type=float, default=0.99)
@@ -65,6 +68,8 @@ def main() -> None:
                          "/ service rate).  1.0 is marginally stable — "
                          "any stall grows the queue without bound")
     args = ap.parse_args()
+    if args.blocks < 1:
+        ap.error("--blocks must be >= 1 (percentiles need samples)")
     widths = [int(w) for w in args.widths.split(",")]
 
     jax = setup_platform(1)
@@ -212,8 +217,12 @@ def main() -> None:
         stride = max(1, int(np.ceil((sync_ms / 1e3) / T / 0.5)))
         # --blocks is the sample-count target here too, bounded by a
         # ~2000-dispatch budget per width (long strides on high-RTT
-        # hosts would otherwise turn many samples into minutes)
-        n_samp = max(8, min(args.blocks, max(16, 2000 // stride)))
+        # hosts would otherwise turn many samples into minutes).  An
+        # explicit --blocks below 8 is honored as given (quick smoke
+        # runs; the old 8-sample floor silently overrode it) — the
+        # dispatch-budget bound is >= 16, so any --blocks <= 16 passes
+        # through unchanged.
+        n_samp = min(args.blocks, max(16, 2000 // stride))
         n_ol = n_samp * stride
         lat_raw = []
         t_b = time.time() + 2 * T
