@@ -194,7 +194,11 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     sus_dev_ms_per_step = sus_dev_combine = dev_attempts = None
     dev_sampler = sus_mixed_sampler = None
     sus_dev_degraded = None  # final staged attempt still over threshold
+    sus_dev_fusion = None  # compiled-program structure of the staged step
+    sus_dev_phase_ms = sus_mixed_phase_ms = None  # per-phase attribution
     sort_ms = None  # staged-phase start-sort cost (native combine only)
+    phase_k = int(os.environ.get("SHERMAN_BENCH_PHASE_K", 4))
+    want_phases = os.environ.get("SHERMAN_BENCH_PHASES", "1") != "0"
 
     def run_windowed(n_steps, advance):
         """Dispatch n_steps with a bounded in-flight window: block on
@@ -294,6 +298,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                                  salt=salt, batch=batch, dev_b=dev_b2,
                                  sampler=dev_sampler)
             dev_sampler = step_fn.sampler  # effective (fallback-aware)
+            sus_dev_fusion = step_fn.fusion  # aligned|chained|fused
             carry = new_carry()
             counters, carry = step_fn(pool, counters, table_d, rtable_d,
                                       rkey_d, carry)
@@ -358,6 +363,26 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                   f"all {d_corr} answers verified on device; sampler "
                   f"{dev_sampler}, attempts {dev_attempts})",
                   file=sys.stderr)
+            if want_phases:
+                # per-phase attribution of the staged step (prep /
+                # serve+fan-out / verify), chained-delta timed so each
+                # program's cost is honest through the access tunnel —
+                # published in the JSON so future rounds see phase
+                # regressions without re-profiling.  The phase SUM can
+                # exceed ms/step: the pipelined loop overlaps prep with
+                # serve; attribution measures each program standalone.
+                with obs.span("bench.staged_phase_attribution",
+                              reps=phase_k, fusion=sus_dev_fusion):
+                    sus_dev_phase_ms, counters = step_fn.phase_profile(
+                        pool, counters, table_d, rtable_d, rkey_d,
+                        reps=phase_k)
+                for _n, _ms in sus_dev_phase_ms.items():
+                    obs.histogram(f"staged.{_n}_ms").record(_ms)
+                print("# staged-step phases (chained-delta, K="
+                      f"{phase_k}, fusion {sus_dev_fusion}): "
+                      + ", ".join(f"{n} {ms:.1f} ms" for n, ms in
+                                  sus_dev_phase_ms.items()),
+                      file=sys.stderr)
         # SUSTAINED end-to-end (the reference's open-loop contract,
         # test/benchmark.cpp:159-188: clients generate and issue ops
         # inline — nothing hoisted): zipf sampling, unique+inverse
@@ -746,6 +771,21 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
               f"{sus_mixed_combine:.2f}x, row caps {rcap}+{wcap}; all "
               f"{m_cr} reads linearization-checked, {m_cw} writes "
               f"ST_APPLIED, on device)", file=sys.stderr)
+        if want_phases:
+            # mixed-step phase attribution runs LAST (its serve chain
+            # re-applies one prep's write batch, stamping the pool)
+            with obs.span("bench.mixed_phase_attribution", reps=phase_k):
+                sus_mixed_phase_ms, pool, counters = mstep.phase_profile(
+                    pool, tree.dsm.locks, counters, mt_d, mrt_d, mrk_d,
+                    reps=phase_k)
+            tree.dsm.pool, tree.dsm.counters = pool, counters
+            for _n, _ms in sus_mixed_phase_ms.items():
+                obs.histogram(f"staged_mixed.{_n}_ms").record(_ms)
+            print("# mixed-step phases (chained-delta, K="
+                  f"{phase_k}): "
+                  + ", ".join(f"{n} {ms:.1f} ms" for n, ms in
+                              sus_mixed_phase_ms.items()),
+                  file=sys.stderr)
 
     print(f"# {steps} steps in {elapsed:.2f}s "
           f"({elapsed / steps * 1e3:.2f} ms/step, dev rows/s "
@@ -824,6 +864,20 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # published sustained_ops_s is an environment-degraded number
         "sus_dev_degraded": sus_dev_degraded,
         "sus_mixed_sampler": sus_mixed_sampler,
+        # compiled-program structure of the staged step (config.
+        # staged_fusion: aligned = serve is the host-staged program)
+        "sus_dev_fusion": sus_dev_fusion,
+        # per-phase staged-step attribution, chained-delta timed (ms):
+        # aligned -> {prep, serve_fanout, verify}; chained -> {prep,
+        # serve_fanout_verify}; fused -> {fused_step}.  Phases measure
+        # each program STANDALONE — the pipelined loop overlaps prep
+        # with serve, so the sum can exceed sus_dev_ms_per_step.
+        "sus_dev_phase_ms": {k: round(v, 2)
+                             for k, v in sus_dev_phase_ms.items()}
+        if sus_dev_phase_ms else None,
+        "sus_mixed_phase_ms": {k: round(v, 2)
+                               for k, v in sus_mixed_phase_ms.items()}
+        if sus_mixed_phase_ms else None,
         "sus_dev_combine": round(sus_dev_combine, 2)
         if sus_dev_combine else None,
         "sus_mixed_ops_s": round(sus_mixed_ops_s) if sus_mixed_ops_s

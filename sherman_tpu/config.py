@@ -56,6 +56,34 @@ def donate_argnums(*argnums: int) -> tuple[int, ...]:
     return argnums if jax.default_backend() != "cpu" else ()
 
 
+def staged_fusion() -> str:
+    """Compiled-program structure of the device-staged benchmark step
+    (``workload.device_prep.make_staged_step``), from the
+    ``SHERMAN_STAGED_FUSION`` env var:
+
+    - ``aligned`` (default): prep -> serve -> verify, where the serve
+      is the ENGINE's host-staged combined-search fan-out program — the
+      same compiled executable the throughput phase runs, so the staged
+      serve's input layouts/donation/HLO match the host-staged case by
+      construction (the round-6 answer to BENCHMARKS.md's round-5
+      "known headroom" suspects).
+    - ``chained``: the round-5 two-program form (fan-out + verification
+      fused into the serve program), kept for A/B measurement.
+    - ``fused``: one jitted program — the CPU-mesh regression form
+      (proves no host round trip between generation and serve); on TPU
+      the known XLA pathology makes it 50-100x slower (BENCHMARKS.md).
+
+    Buffer donation inside every form stays gated by
+    :func:`donate_argnums` (CPU donation is unstable on this
+    toolchain)."""
+    import os
+    v = os.environ.get("SHERMAN_STAGED_FUSION", "aligned").lower()
+    if v not in ("aligned", "chained", "fused"):
+        raise ValueError(
+            f"SHERMAN_STAGED_FUSION={v!r}: want aligned|chained|fused")
+    return v
+
+
 @dataclasses.dataclass(frozen=True)
 class DSMConfig:
     """Cluster + memory-pool shape (reference ``Config.h:13-22``).

@@ -1367,8 +1367,7 @@ class BatchedEngine:
                 ans = jnp.stack([found.astype(jnp.int32), vhi, vlo,
                                  jnp.zeros_like(vhi)], axis=-1)  # [U_loc, 4]
                 if N > 1:
-                    ans = lax.all_gather(ans, AXIS, axis=0,
-                                         tiled=True)            # [U, 4]
+                    ans = transport.gather_rows(ans, AXIS)      # [U, 4]
                 safe = jnp.clip(inv, 0, ans.shape[0] - 1)
                 out = jnp.take_along_axis(ans, safe[:, None], axis=0)
                 return (counters, done, out[:, 0].astype(bool),

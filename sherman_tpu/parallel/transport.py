@@ -30,6 +30,8 @@ from sherman_tpu import obs
 _OBS_XCH_ISSUES = obs.counter("transport.exchange_issues_traced")
 _OBS_XCH_BYTES = obs.counter("transport.exchange_bytes_per_step")
 _OBS_XCH_PALLAS = obs.counter("transport.pallas_exchange_issues_traced")
+_OBS_AG_ISSUES = obs.counter("transport.allgather_issues_traced")
+_OBS_AG_BYTES = obs.counter("transport.allgather_bytes_per_step")
 
 
 def _tree_nbytes(tree) -> int:
@@ -65,6 +67,29 @@ def scatter_to_buckets(field, bucket_idx, n_slots: int):
     safe = jnp.where(bucket_idx >= 0, bucket_idx, n_slots)
     out = jnp.zeros((n_slots,) + field.shape[1:], field.dtype)
     return out.at[safe].set(field, mode="drop")
+
+
+def gather_rows(x, axis_name: str):
+    """Tiled ``all_gather`` of ``x`` along dim 0 — the reply-side
+    answer-table broadcast shared by every fan-out kernel (the engine's
+    combined-search fan-out and the device-staged serve/mixed serve):
+    each node contributes its local row block, every node receives the
+    full table, and client slots gather from GLOBAL row indices.
+
+    One helper so collective PLACEMENT is a single code site: the
+    all-gather always runs AFTER the descent/stack (on the packed [U, 4]
+    answer lanes, never on the raw descent outputs — 4 int32 words/row
+    is the minimal reply payload) and before the per-client take.
+    Traced-issue accounting follows :func:`exchange`'s convention: one
+    inc per collective per program BUILD, bytes = the per-step GLOBAL
+    payload every node receives."""
+    if hasattr(jax.lax, "axis_size"):
+        n = jax.lax.axis_size(axis_name)
+    else:  # JAX < 0.5: psum of a literal folds to a static int
+        n = jax.lax.psum(1, axis_name)
+    _OBS_AG_ISSUES.inc()
+    _OBS_AG_BYTES.inc(int(x.size) * x.dtype.itemsize * int(n))
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
 
 
 def exchange(tree, axis_name: str, *, impl: str = "xla"):

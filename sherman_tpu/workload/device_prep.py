@@ -44,6 +44,18 @@ step.  Fidelity:
   VERIFIED on device: the carry accumulates the exact count of client
   ops whose returned value matched ``key ^ check_xor`` — the
   honest-accounting receipts ride inside the timed loop.
+
+Program structure (the round-6 "staged-step anatomy" work): the step's
+compiled-program split is a first-class knob (``fusion=`` /
+``SHERMAN_STAGED_FUSION``, see :func:`make_staged_step`).  The default
+``aligned`` form dispatches ``prep -> serve -> verify`` where the serve
+IS the engine's host-staged combined-search fan-out program — the same
+compiled executable the throughput phase runs — so no input-layout,
+donation, or shard_map-fusion difference can exist between the staged
+serve and the host-staged serve by construction.  Every form exposes
+``step.programs`` and ``step.phase_profile`` (chained-delta per-phase
+wall costs) so benchmarks publish per-phase timings instead of
+re-profiling.
 """
 
 from __future__ import annotations
@@ -262,23 +274,59 @@ def _router_probe(rtable, ukhi, uklo, shift, nb):
     return rtable[bucket.astype(jnp.int32)]
 
 
-def _stage_inputs(router, n_keys: int, theta: float, log2_bins: int,
+def _rep_put(dsm, x):
+    """Host value -> device-resident REPLICATED array, multihost-aware:
+    single-process meshes use a plain ``device_put``; process-spanning
+    meshes build the global replicated array from every process's
+    identical local copy (the engine's ``_shard`` idiom with an empty
+    partition spec)."""
+    import jax
+
+    x = np.asarray(x)
+    if getattr(dsm, "multihost", False):
+        from jax.experimental import multihost_utils as mhu
+        return mhu.host_local_array_to_global_array(
+            x, dsm.mesh, jax.sharding.PartitionSpec())
+    return jax.device_put(x)
+
+
+def _stage_inputs(dsm, router, n_keys: int, theta: float, log2_bins: int,
                   seed: int, sampler: str = "table"):
     """Stage the step's device-resident inputs once, before any timed
     region: the [nb, 2] zipf edge-pair table (a tiny dummy when the
     analytic sampler needs no table), the router table, and the PRNG
-    key."""
+    key.  All replicated (multihost-aware via :func:`_rep_put`)."""
     import jax
 
     if sampler == "analytic":
-        table_d = jax.device_put(np.zeros((1, 2), np.int32))
+        table = np.zeros((1, 2), np.int32)
     else:
         t = zipf_table(n_keys, theta, log2_bins)
-        table_d = jax.device_put(np.stack([t[:-1], t[1:]], axis=1))
+        table = np.stack([t[:-1], t[1:]], axis=1)
     with router._read_locked():
-        rtable_d = jax.device_put(router.table_np)
-    rkey_d = jax.device_put(jax.random.PRNGKey(seed))
-    return table_d, rtable_d, rkey_d
+        rtable = np.array(router.table_np)
+    rkey = np.asarray(jax.random.PRNGKey(seed))
+    return (_rep_put(dsm, table), _rep_put(dsm, rtable),
+            _rep_put(dsm, rkey))
+
+
+def _delta_ms(loop, reps: int) -> float:
+    """Chained-delta phase timing: run ``loop(K)`` and ``loop(2K)``
+    (each a chain of data-dependent dispatches ending in a drain) and
+    return ``(t_2K - t_K) / K`` in ms — the methodology of
+    tools/profile_insert.py, which cancels the per-call dispatch + sync
+    overhead exactly (a per-call timing through a remote access tunnel
+    measures the tunnel, not the program)."""
+    import time
+
+    loop(1)  # warm: compile + remote program load stay out of the delta
+    t0 = time.perf_counter()
+    loop(reps)
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loop(2 * reps)
+    t2 = time.perf_counter() - t0
+    return max(0.0, (t2 - t1) / reps * 1e3)
 
 
 def _rank_sampler(sampler: str, n_keys: int, theta: float,
@@ -301,15 +349,16 @@ def _rank_sampler(sampler: str, n_keys: int, theta: float,
 def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                      batch: int, dev_b: int, log2_bins: int = 20,
                      check_xor: int = 0xDEADBEEF, seed: int = 11,
-                     staged=None, sampler: str = "table"):
+                     staged=None, sampler: str = "table",
+                     fusion: str | None = None):
     """Build the device-staged serving step for ``eng`` (a
     :class:`~sherman_tpu.models.batched.BatchedEngine` with an attached
     router).
 
     Returns ``(step, state)`` where ``state = (new_carry, table_d,
     rtable_d, rkey_d)``: ``new_carry()`` makes a fresh device-resident
-    carry (the previous one is donated), the rest are device-resident
-    inputs staged once, before any timed region.  Then
+    carry, the rest are device-resident inputs staged once, before any
+    timed region.  Then
 
         ``counters, carry = step(pool, counters, table_d, rtable_d,
                                  rkey_d, carry)``
@@ -317,15 +366,8 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     runs ONE step: generate ``batch`` zipf client keys per node from the
     carry's step counter, combine to <= ``dev_b`` unique rows, probe the
     router, descend, fan out every answer in-step, and fold the
-    verification receipts into the carry.  The step is TWO chained
-    jitted programs (``step.jprep`` -> ``step.jserve``) dispatched
-    back-to-back with no host work or transfer between them: XLA
-    compiles the prep pipeline fused into the serve's straggler
-    while-loop ~50-100x slower than the sum of its parts (measured
-    6.8-10.3 s fused vs 56 + 63 ms split on chip, optimization_barrier
-    included), so the split IS the fast form.  ``counters``/``carry``
-    and the intermediate prep arrays are donated.  Carry fields (all
-    replicated int32/uint32 scalars):
+    verification receipts into the carry.  Carry fields (all replicated
+    int32/uint32 scalars):
 
         (step_idx, ok, n_correct, sum_nuniq, max_nuniq)
 
@@ -334,16 +376,59 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     ``n_correct`` counts client ops whose value matched
     ``key ^ check_xor`` — after S steps it must equal
     ``S * batch * machine_nr``.  ``sum_nuniq`` accumulates per-node
-    unique counts (psum across nodes) for combine-ratio reporting."""
+    unique counts (psum across nodes) for combine-ratio reporting.
+
+    ``fusion`` picks the compiled-program structure (default
+    :func:`sherman_tpu.config.staged_fusion`, overridable via the
+    ``SHERMAN_STAGED_FUSION`` env var):
+
+    - ``"aligned"`` (default): THREE chained programs ``prep -> serve
+      -> verify`` where the serve IS the engine's combined-search
+      fan-out program (``BatchedEngine._get_search_fanout``) — the
+      byte-identical compiled executable the host-staged throughput
+      phase runs.  This forces the staged serve's input layouts,
+      donation and HLO to match the host-staged case by construction,
+      eliminating the cross-program layout / shard_map-fusion suspects
+      of BENCHMARKS.md round-5 "known headroom"; the receipts
+      arithmetic moves to its own elementwise ``verify`` program.
+    - ``"chained"``: the round-5 two-program form (``prep -> serve``
+      with fan-out + verification fused into the serve program), kept
+      for continuity and A/B measurement against ``aligned``.
+    - ``"fused"``: ONE jitted program.  On TPU, XLA compiles the prep
+      pipeline fused into the serve's straggler while-loop ~50-100x
+      slower than the sum of its parts (measured 6.8-10.3 s fused vs
+      56 + 63 ms split on chip; ``optimization_barrier`` does not fix
+      it), so this form exists for CPU-mesh regression tests — a single
+      program PROVES no host round trip can hide between generation and
+      serve — and for re-testing the pathology on new toolchains.
+
+    In every mode the dispatched programs are chained back-to-back with
+    no host work or transfer between them (the multi-program forms pass
+    device-resident arrays only).  ``counters`` is donated; the rcarry
+    scalars are deliberately NOT donated — callers block their dispatch
+    window on ``carry[1]`` (the LAST program's output; see bench.py
+    ``run_windowed``), which must stay a live buffer after the next
+    step consumes it.  Step attributes: ``step.fusion``,
+    ``step.sampler``, ``step.programs`` (name -> jitted program in
+    dispatch order), ``step.n_programs``, ``step.phase_profile``
+    (chained-delta per-phase wall costs), plus per-mode handles
+    (``step.jprep`` / ``step.jserve`` / ``step.jverify`` /
+    ``step.jfused``)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from sherman_tpu.models.batched import AXIS, search_routed_spmd
+    from sherman_tpu.parallel import transport
 
+    fusion = fusion or C.staged_fusion()
+    if fusion not in ("aligned", "chained", "fused"):
+        raise ValueError(
+            f"fusion={fusion!r}: want aligned|chained|fused")
     router = eng.router
     assert router is not None, "attach_router() first"
     cfg = eng.cfg
+    dsm = eng.dsm
     N = cfg.machine_nr
     iters = eng._iters()
     spec, rep = eng._spec, eng._rep
@@ -353,11 +438,13 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     root = np.int32(eng.tree._root_addr)
     salt_hi = np.uint32((salt >> 32) & 0xFFFFFFFF)
     salt_lo = np.uint32(salt & 0xFFFFFFFF)
+    cx_hi = np.uint32((check_xor >> 32) & 0xFFFFFFFF)
+    cx_lo = np.uint32(check_xor & 0xFFFFFFFF)
     i32 = lambda x: lax.bitcast_convert_type(x, jnp.int32)
 
     assert batch >= dev_b, "dev_b is the unique-set cap; cannot exceed batch"
 
-    def prep(tpair, rtable, rkey, step_idx):
+    def prep_core(tpair, rtable, rkey, step_idx):
         # per-node, per-step independent stream (counter-based PRNG):
         # fold the step counter and the node index into the key
         node = lax.axis_index(AXIS) if N > 1 else jnp.uint32(0)
@@ -373,35 +460,34 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
             khi_u, klo_u, dev_b)
         active = lax.iota(jnp.int32, dev_b) < n_uniq
         start = _router_probe(rtable, ukhi, uklo, shift, nb)
-        # n_uniq ships as a [1] array so it shards per node like the rest
-        return (step_idx + np.uint32(1), skhi, sklo, ukhi, uklo, start,
-                active, seg, n_uniq[None])
+        return skhi, sklo, ukhi, uklo, start, active, seg, n_uniq
 
-    def serve(pool, counters, rcarry, skhi, sklo, ukhi, uklo, start,
-              active, seg, n_uniq_a):
-        ok, n_correct, sum_nu, max_nu = rcarry
-        n_uniq = n_uniq_a[0]
+    def serve_fanout(pool, counters, ukhi, uklo, start, active, seg):
+        """chained/fused serve body: routed descent + the monotone
+        per-client answer fan-out (seg is NONDECREASING, so the gather
+        is sequential in HBM, unlike an inverse-permuted one).  GLOBAL
+        indices on multi-node meshes: the answer table all-gathers
+        tiled, node n's rows at [n*dev_b, (n+1)*dev_b)."""
         counters, done, found, vhi, vlo = search_routed_spmd(
             pool, counters, i32(ukhi), i32(uklo), root, active, start,
             cfg=cfg, iters=iters)
         ans = jnp.stack([found.astype(jnp.int32), vhi, vlo,
                          jnp.zeros_like(vhi)], axis=-1)     # [U_loc, 4]
-        # per-client fan-out: seg is NONDECREASING, so this gather is
-        # monotone (sequential HBM locality), unlike an inverse-permuted
-        # one.  GLOBAL indices on multi-node meshes: the answer table
-        # all-gathers tiled, node n's rows at [n*dev_b, (n+1)*dev_b).
         if N > 1:
             node = lax.axis_index(AXIS)
-            ans = lax.all_gather(ans, AXIS, axis=0, tiled=True)
+            ans = transport.gather_rows(ans, AXIS)
             seg = seg + node.astype(jnp.int32) * dev_b
         safe = jnp.clip(seg, 0, ans.shape[0] - 1)
         out = jnp.take_along_axis(ans, safe[:, None], axis=0)
-        # in-step verification: value must be (sorted) client key ^
-        # check_xor
-        exp_hi = i32(skhi ^ jnp.uint32((check_xor >> 32) & 0xFFFFFFFF))
-        exp_lo = i32(sklo ^ jnp.uint32(check_xor & 0xFFFFFFFF))
-        corr = ((out[:, 0] != 0) & (out[:, 1] == exp_hi)
-                & (out[:, 2] == exp_lo))
+        return counters, out[:, 0] != 0, out[:, 1], out[:, 2]
+
+    def verify_core(rcarry, skhi, sklo, found, vhi, vlo, n_uniq):
+        """Receipts: every (sorted-order) client answer must equal its
+        key ^ check_xor; the scalar carries psum across the mesh."""
+        ok, n_correct, sum_nu, max_nu = rcarry
+        exp_hi = i32(skhi ^ cx_hi)
+        exp_lo = i32(sklo ^ cx_lo)
+        corr = found & (vhi == exp_hi) & (vlo == exp_lo)
         inc_corr = jnp.sum(corr.astype(jnp.int32))
         step_ok = (n_uniq <= dev_b).astype(jnp.int32)
         if N > 1:
@@ -411,50 +497,204 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
             step_ok = lax.pmin(step_ok, AXIS)
         else:
             sum_inc, max_inc = n_uniq, n_uniq
-        rcarry = (jnp.minimum(ok, step_ok),
-                  n_correct + inc_corr,
-                  sum_nu + sum_inc,
-                  jnp.maximum(max_nu, max_inc))
-        return counters, rcarry
+        return (jnp.minimum(ok, step_ok), n_correct + inc_corr,
+                sum_nu + sum_inc, jnp.maximum(max_nu, max_inc))
 
-    mesh = eng.dsm.mesh
-    # prep is per-node independent (no collectives); its 8 array outputs
-    # shard along the node axis (each node's local block), the bumped
-    # step counter is replicated
-    prep_sm = jax.shard_map(
-        prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
-        out_specs=(rep,) + (spec,) * 8, check_vma=False)
-    jprep = jax.jit(prep_sm)
-    serve_sm = jax.shard_map(
-        serve, mesh=mesh,
-        in_specs=(spec, spec, (rep,) * 4) + (spec,) * 8,
-        out_specs=(spec, (rep,) * 4), check_vma=False)
-    # donate counters only: the prep intermediates' shapes cannot alias
-    # any serve output (donating them just warns every compile), and the
-    # rcarry scalars are deliberately NOT donated — callers block their
-    # dispatch window on carry[1] (a serve output; see bench.py
-    # run_windowed), which must stay a live buffer after the next step
-    # consumes it (blocking a donated buffer is an error on some
-    # backends).  Donating 4 replicated scalars saves nothing.
-    jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(1))
+    mesh = dsm.mesh
+    root_rep = None
 
-    def step(pool, counters, tpair, rtable, rkey, carry):
-        step_idx, *rcarry = carry
-        step_idx, *arrs = jprep(tpair, rtable, rkey, step_idx)
-        counters, rcarry = jserve(pool, counters, tuple(rcarry), *arrs)
-        return counters, (step_idx,) + tuple(rcarry)
+    if fusion == "aligned":
+        def prep(tpair, rtable, rkey, step_idx):
+            skhi, sklo, ukhi, uklo, start, active, seg, n_uniq = \
+                prep_core(tpair, rtable, rkey, step_idx)
+            if N > 1:
+                # the engine fan-out kernel takes GLOBAL unique indices
+                node = lax.axis_index(AXIS)
+                seg = seg + node.astype(jnp.int32) * dev_b
+            # keys bitcast to int32 IN PREP: the serve consumes exactly
+            # the dtypes/layouts the host-staged path ships
+            return (step_idx + np.uint32(1), skhi, sklo, i32(ukhi),
+                    i32(uklo), start, active, seg, n_uniq[None])
 
-    step.jprep, step.jserve = jprep, jserve
-    step.sampler = sampler
+        jprep = jax.jit(jax.shard_map(
+            prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
+            out_specs=(rep,) + (spec,) * 8, check_vma=False))
+        # the serve is the ENGINE's host-staged program object: same jit
+        # cache entry, same donation, same HLO as the throughput phase
+        jserve = eng._get_search_fanout(iters)
+
+        def verify(rcarry, skhi, sklo, found, vhi, vlo, n_uniq_a):
+            return verify_core(rcarry, skhi, sklo, found, vhi, vlo,
+                               n_uniq_a[0])
+
+        jverify = jax.jit(jax.shard_map(
+            verify, mesh=mesh,
+            in_specs=((rep,) * 4, spec, spec, spec, spec, spec, spec),
+            out_specs=(rep,) * 4, check_vma=False))
+        root_rep = _rep_put(dsm, root)
+
+        def step(pool, counters, tpair, rtable, rkey, carry):
+            step_idx, *rcarry = carry
+            (step_idx, skhi, sklo, khi, klo, start, active, inv,
+             nu) = jprep(tpair, rtable, rkey, step_idx)
+            counters, done, found, vhi, vlo = jserve(
+                pool, counters, khi, klo, root_rep, active, start, inv)
+            rcarry = jverify(tuple(rcarry), skhi, sklo, found, vhi,
+                             vlo, nu)
+            return counters, (step_idx,) + tuple(rcarry)
+
+        step.jprep, step.jserve, step.jverify = jprep, jserve, jverify
+        programs = {"prep": jprep, "serve_fanout": jserve,
+                    "verify": jverify}
+
+    elif fusion == "chained":
+        def prep(tpair, rtable, rkey, step_idx):
+            skhi, sklo, ukhi, uklo, start, active, seg, n_uniq = \
+                prep_core(tpair, rtable, rkey, step_idx)
+            # n_uniq ships as [1] so it shards per node like the rest
+            return (step_idx + np.uint32(1), skhi, sklo, ukhi, uklo,
+                    start, active, seg, n_uniq[None])
+
+        jprep = jax.jit(jax.shard_map(
+            prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
+            out_specs=(rep,) + (spec,) * 8, check_vma=False))
+
+        def serve(pool, counters, rcarry, skhi, sklo, ukhi, uklo, start,
+                  active, seg, n_uniq_a):
+            counters, found, vhi, vlo = serve_fanout(
+                pool, counters, ukhi, uklo, start, active, seg)
+            rcarry = verify_core(rcarry, skhi, sklo, found, vhi, vlo,
+                                 n_uniq_a[0])
+            return counters, rcarry
+
+        serve_sm = jax.shard_map(
+            serve, mesh=mesh,
+            in_specs=(spec, spec, (rep,) * 4) + (spec,) * 8,
+            out_specs=(spec, (rep,) * 4), check_vma=False)
+        # donate counters only: the prep intermediates' shapes cannot
+        # alias any serve output (donating them just warns every
+        # compile), and donating 4 replicated scalars saves nothing
+        jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(1))
+
+        def step(pool, counters, tpair, rtable, rkey, carry):
+            step_idx, *rcarry = carry
+            step_idx, *arrs = jprep(tpair, rtable, rkey, step_idx)
+            counters, rcarry = jserve(pool, counters, tuple(rcarry),
+                                      *arrs)
+            return counters, (step_idx,) + tuple(rcarry)
+
+        step.jprep, step.jserve = jprep, jserve
+        programs = {"prep": jprep, "serve_fanout_verify": jserve}
+
+    else:  # fused: one program, CPU regression / toolchain re-tests
+        def fused(pool, counters, rcarry, tpair, rtable, rkey, step_idx):
+            skhi, sklo, ukhi, uklo, start, active, seg, n_uniq = \
+                prep_core(tpair, rtable, rkey, step_idx)
+            counters, found, vhi, vlo = serve_fanout(
+                pool, counters, ukhi, uklo, start, active, seg)
+            rcarry = verify_core(rcarry, skhi, sklo, found, vhi, vlo,
+                                 n_uniq)
+            return step_idx + np.uint32(1), counters, rcarry
+
+        fused_sm = jax.shard_map(
+            fused, mesh=mesh,
+            in_specs=(spec, spec, (rep,) * 4, rep, rep, rep, rep),
+            out_specs=(rep, spec, (rep,) * 4), check_vma=False)
+        jfused = jax.jit(fused_sm, donate_argnums=C.donate_argnums(1))
+
+        def step(pool, counters, tpair, rtable, rkey, carry):
+            step_idx, *rcarry = carry
+            step_idx, counters, rcarry = jfused(
+                pool, counters, tuple(rcarry), tpair, rtable, rkey,
+                step_idx)
+            return counters, (step_idx,) + tuple(rcarry)
+
+        step.jfused = jfused
+        programs = {"fused_step": jfused}
+
+    step.fusion, step.sampler = fusion, sampler
+    step.programs, step.n_programs = programs, len(programs)
 
     def new_carry():
-        """Fresh device-resident carry (the previous one is donated)."""
-        return tuple(jax.device_put(v)
+        """Fresh device-resident carry."""
+        return tuple(_rep_put(dsm, v)
                      for v in (np.uint32(0), np.int32(1), np.int32(0),
                                np.int32(0), np.int32(0)))
 
+    def phase_profile(pool, counters, tpair, rtable, rkey, reps: int = 4):
+        """Per-phase wall-cost attribution of the staged step: each
+        dispatched program runs K and 2K CHAINED repetitions (data-
+        dependent carries) and costs ``(t_2K - t_K)/K``
+        (:func:`_delta_ms` — cancels per-call dispatch/sync overhead,
+        so the numbers are honest through a remote access tunnel).
+        Read-only: safe to run mid-benchmark.  NOTE the per-phase sum
+        can exceed the pipelined ms/step — the pipelined loop overlaps
+        prep with serve; attribution measures each program standalone.
+        Returns ``({phase: ms}, counters)`` with the threaded counters
+        handle (the serve donates its input counters)."""
+        box = {"c": counters}
+        out = {}
+        if fusion == "fused":
+            rc0 = new_carry()
+
+            def floop(k):
+                si, rc = rc0[0], tuple(rc0[1:])
+                for _ in range(k):
+                    si, box["c"], rc = jfused(pool, box["c"], rc, tpair,
+                                              rtable, rkey, si)
+                jax.block_until_ready(rc)
+
+            out["fused_step"] = _delta_ms(floop, reps)
+            return out, box["c"]
+
+        def prep_loop(k):
+            si, o = new_carry()[0], None
+            for _ in range(k):
+                o = jprep(tpair, rtable, rkey, si)
+                si = o[0]
+            jax.block_until_ready(o)
+
+        out["prep"] = _delta_ms(prep_loop, reps)
+        arrs = jprep(tpair, rtable, rkey, new_carry()[0])[1:]
+        jax.block_until_ready(arrs)
+        if fusion == "aligned":
+            skhi, sklo, khi, klo, start, active, inv, nu = arrs
+
+            def serve_loop(k):
+                o = None
+                for _ in range(k):
+                    box["c"], done, f, vh, vl = jserve(
+                        pool, box["c"], khi, klo, root_rep, active,
+                        start, inv)
+                    o = f
+                jax.block_until_ready(o)
+
+            out["serve_fanout"] = _delta_ms(serve_loop, reps)
+            box["c"], done, f, vh, vl = jserve(
+                pool, box["c"], khi, klo, root_rep, active, start, inv)
+
+            def verify_loop(k):
+                rc = tuple(new_carry()[1:])
+                for _ in range(k):
+                    rc = jverify(rc, skhi, sklo, f, vh, vl, nu)
+                jax.block_until_ready(rc)
+
+            out["verify"] = _delta_ms(verify_loop, reps)
+        else:  # chained
+
+            def sv_loop(k):
+                rc = tuple(new_carry()[1:])
+                for _ in range(k):
+                    box["c"], rc = jserve(pool, box["c"], rc, *arrs)
+                jax.block_until_ready(rc)
+
+            out["serve_fanout_verify"] = _delta_ms(sv_loop, reps)
+        return out, box["c"]
+
+    step.phase_profile = phase_profile
+
     table_d, rtable_d, rkey_d = staged or _stage_inputs(
-        router, n_keys, theta, LB, seed, sampler)
+        dsm, router, n_keys, theta, LB, seed, sampler)
     return step, (new_carry, table_d, rtable_d, rkey_d)
 
 
@@ -511,10 +751,12 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
 
     from sherman_tpu.models.batched import (
         AXIS, ST_APPLIED, ST_SUPERSEDED, mixed_step_spmd)
+    from sherman_tpu.parallel import transport
 
     router = eng.router
     assert router is not None, "attach_router() first"
     cfg = eng.cfg
+    dsm = eng.dsm
     N = cfg.machine_nr
     iters = eng._iters()
     spec, rep = eng._spec, eng._rep
@@ -586,8 +828,8 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
         stat_w = status[dev_rb:]
         if N > 1:
             node = lax.axis_index(AXIS)
-            ans = lax.all_gather(ans, AXIS, axis=0, tiled=True)
-            stat_w = lax.all_gather(stat_w, AXIS, axis=0, tiled=True)
+            ans = transport.gather_rows(ans, AXIS)
+            stat_w = transport.gather_rows(stat_w, AXIS)
             rseg = rseg + node.astype(jnp.int32) * dev_rb
             wseg = wseg + node.astype(jnp.int32) * dev_wb
         out = jnp.take_along_axis(
@@ -619,7 +861,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
                   sidx + jnp.uint32(1))
         return pool, counters, rcarry
 
-    mesh = eng.dsm.mesh
+    mesh = dsm.mesh
     prep_sm = jax.shard_map(
         prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
         out_specs=(rep,) + (spec,) * 13, check_vma=False)
@@ -641,17 +883,54 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
 
     step.jprep, step.jserve = jprep, jserve
     step.sampler = sampler
+    step.fusion = "chained"
+    step.programs = {"prep": jprep, "serve_fanout_verify": jserve}
+    step.n_programs = len(step.programs)
 
     def new_carry():
         """(step_idx, ok, n_correct_reads, n_ok_writes, sum_nuniq,
         max_nuniq_r, max_nuniq_w, serve_step_idx) — serve keeps its own
         step counter (last slot) so its linearization check cannot read
         prep's already-bumped one."""
-        return tuple(jax.device_put(v)
+        return tuple(_rep_put(dsm, v)
                      for v in (np.uint32(0), np.int32(1), np.int32(0),
                                np.int32(0), np.int32(0), np.int32(0),
                                np.int32(0), np.uint32(0)))
 
+    def phase_profile(pool, locks, counters, tpair, rtable, rkey,
+                      reps: int = 4):
+        """Per-phase attribution of the mixed step (same chained-delta
+        methodology as the read-only step's).  NOT read-only: the serve
+        chain re-applies ONE prep's write batch each repetition (same
+        keys, same stamped values — idempotent tree content, but the
+        profiled steps' stamps land in the pool), so run it only AFTER
+        the receipt-checked windows.  Returns ``({phase: ms}, pool,
+        counters)``."""
+        box = {"p": pool, "c": counters}
+
+        def prep_loop(k):
+            si, o = new_carry()[0], None
+            for _ in range(k):
+                o = jprep(tpair, rtable, rkey, si)
+                si = o[0]
+            jax.block_until_ready(o)
+
+        out = {"prep": _delta_ms(prep_loop, reps)}
+        arrs = jprep(tpair, rtable, rkey, new_carry()[0])[1:]
+        jax.block_until_ready(arrs)
+
+        def sv_loop(k):
+            rc = tuple(new_carry()[1:])
+            for _ in range(k):
+                box["p"], box["c"], rc = jserve(box["p"], locks,
+                                                box["c"], rc, *arrs)
+            jax.block_until_ready(rc)
+
+        out["serve_fanout_verify"] = _delta_ms(sv_loop, reps)
+        return out, box["p"], box["c"]
+
+    step.phase_profile = phase_profile
+
     table_d, rtable_d, rkey_d = staged or _stage_inputs(
-        router, n_keys, theta, LB, seed, sampler)
+        dsm, router, n_keys, theta, LB, seed, sampler)
     return step, (new_carry, table_d, rtable_d, rkey_d)

@@ -124,6 +124,96 @@ def _build_engine(n_keys, salt, machine_nr=1, B=4096):
     return eng
 
 
+def test_staged_fusion_modes_agree(eight_devices):
+    """All three program structures of the staged step (aligned /
+    chained / fused) are the same computation: same PRNG stream, same
+    receipts.  aligned's serve is the engine's host-staged program;
+    chained is the round-5 form; fused is one program."""
+    import jax
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch, S = 20_000, 2048, 3
+    eng = _build_engine(n_keys, salt, B=batch)
+    results = {}
+    for fusion in ("aligned", "chained", "fused"):
+        step, (new_carry, tb, rt, rk) = make_staged_step(
+            eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+            dev_b=batch, log2_bins=16, fusion=fusion)
+        assert step.fusion == fusion
+        carry = new_carry()
+        counters = eng.dsm.counters
+        for _ in range(S):
+            counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                                   carry)
+        jax.block_until_ready(carry)
+        eng.dsm.counters = counters
+        results[fusion] = tuple(int(np.asarray(x)) for x in carry)
+    for fusion, (si, ok, n_corr, sum_nu, max_nu) in results.items():
+        assert si == S and ok == 1, (fusion, results[fusion])
+        assert n_corr == S * batch, (fusion, results[fusion])
+    assert len(set(results.values())) == 1, \
+        f"fusion modes diverged: {results}"
+
+
+def test_staged_fused_one_program_no_host_roundtrip(eight_devices):
+    """The fused staged step is ONE compiled program, and the timed
+    loop ships NOTHING: with jax.transfer_guard('disallow') armed, the
+    steps must run to completion — any hidden host round trip or
+    implicit h2d between generation and serve would raise."""
+    import jax
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch, S = 20_000, 2048, 2
+    eng = _build_engine(n_keys, salt, B=batch)
+    step, (new_carry, tb, rt, rk) = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16, fusion="fused")
+    assert step.n_programs == 1 and list(step.programs) == ["fused_step"]
+    carry = new_carry()
+    counters = eng.dsm.counters
+    # warm outside the guard (compilation transfers constants)
+    counters, carry = step(eng.dsm.pool, counters, tb, rt, rk, carry)
+    jax.block_until_ready(carry)
+    with jax.transfer_guard("disallow"):
+        for _ in range(S):
+            counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                                   carry)
+        jax.block_until_ready(carry)
+    eng.dsm.counters = counters
+    si, ok, n_corr, *_ = (int(np.asarray(x)) for x in carry)
+    assert si == S + 1 and ok == 1 and n_corr == (S + 1) * batch
+
+
+def test_staged_aligned_serve_is_host_staged_program(eight_devices):
+    """In 'aligned' mode the staged serve IS the engine's combined-
+    search fan-out program object — the same jit cache entry the
+    host-staged throughput phase dispatches, so input layouts, donation
+    and HLO match the host-staged case by construction."""
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch = 20_000, 2048
+    eng = _build_engine(n_keys, salt, B=batch)
+    step, _ = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16, fusion="aligned")
+    assert step.jserve is eng._get_search_fanout(eng._iters())
+    assert list(step.programs) == ["prep", "serve_fanout", "verify"]
+
+
+def test_staged_phase_profile_keys(eight_devices):
+    """phase_profile returns the per-phase dict bench.py publishes
+    (sus_dev_phase_ms) and threads the counters handle back."""
+    import jax
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch = 20_000, 2048
+    eng = _build_engine(n_keys, salt, B=batch)
+    step, (new_carry, tb, rt, rk) = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16, fusion="aligned")
+    phases, counters = step.phase_profile(eng.dsm.pool, eng.dsm.counters,
+                                          tb, rt, rk, reps=1)
+    eng.dsm.counters = counters
+    assert set(phases) == {"prep", "serve_fanout", "verify"}
+    assert all(v >= 0.0 for v in phases.values())
+
+
 @pytest.mark.parametrize("theta", [0.0, 0.99])
 def test_staged_step_end_to_end(eight_devices, theta):
     import jax
@@ -243,7 +333,8 @@ def test_staged_mixed_multinode(eight_devices):
         f"{S * 512 * 8 - n_ok_w} write clients unapplied across the mesh"
 
 
-def test_staged_step_multinode(eight_devices):
+@pytest.mark.parametrize("fusion", ["aligned", "chained"])
+def test_staged_step_multinode(eight_devices, fusion):
     import jax
     salt = 0x5E17_AB1E_5A17
     n_keys = 20_000
@@ -251,7 +342,7 @@ def test_staged_step_multinode(eight_devices):
     eng = _build_engine(n_keys, salt, machine_nr=8, B=1024)
     step, (new_carry, table_d, rtable_d, rkey_d) = make_staged_step(
         eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
-        dev_b=batch, log2_bins=16)
+        dev_b=batch, log2_bins=16, fusion=fusion)
     carry = new_carry()
     dsm = eng.dsm
     counters = dsm.counters

@@ -312,6 +312,97 @@ print(f"[{pid}] STORM-PASS splits={stats['device_splits']} "
 '''
 
 
+_STAGED_WORKER = r'''
+import os, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["SHERMAN_COORD"] = f"localhost:{port}"
+os.environ["SHERMAN_NPROC"] = str(nproc)
+os.environ["SHERMAN_PROC_ID"] = str(pid)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import bootstrap
+from sherman_tpu.workload.device_prep import (make_staged_mixed_step,
+                                              make_staged_step)
+
+keeper = bootstrap.init_multihost()
+
+# Device-staged open loop across a PROCESS-SPANNING mesh (2 processes x
+# 2 local devices = 4 nodes) — the sustained-benchmark loop shape with
+# on-device-verified receipts, the coverage the engine/reclaim/storm
+# drills already have.  Both processes dispatch the identical staged
+# programs; generation, combining, serve, fan-out and verification all
+# run on device, receipts psum across the whole mesh.
+cfg = DSMConfig(machine_nr=4, pages_per_node=2048, locks_per_node=512,
+                step_capacity=1024, host_step_capacity=16, chunk_pages=32)
+cluster = Cluster(cfg, keeper=keeper)
+assert cluster.dsm.multihost
+tree = Tree(cluster)
+B = 1024
+eng = batched.BatchedEngine(tree, batch_per_node=B)
+
+salt = 0x5E17_AB1E_5A17
+n_keys = 20000
+ranks = np.arange(n_keys, dtype=np.uint64)
+keys = bits.mix64_np(ranks ^ np.uint64(salt))
+order = np.argsort(keys)
+batched.bulk_load(tree, keys[order],
+                  (keys ^ np.uint64(0xDEADBEEF))[order], fill=0.8)
+eng.attach_router()
+
+# read-only staged loop (aligned: the serve is the engine's host-staged
+# fan-out program, compiled once for the process-spanning mesh)
+step, (new_carry, tb, rt, rk) = make_staged_step(
+    eng, n_keys=n_keys, theta=0.99, salt=salt, batch=B, dev_b=B,
+    log2_bins=16, fusion="aligned")
+dsm = eng.dsm
+carry = new_carry()
+counters = dsm.counters
+S = 3
+for _ in range(S):
+    counters, carry = step(dsm.pool, counters, tb, rt, rk, carry)
+jax.block_until_ready(carry)
+dsm.counters = counters
+si, ok, n_corr, sum_nu, max_nu = (int(np.asarray(x)) for x in carry)
+assert si == S and ok == 1, (si, ok)
+# EVERY generated client op on EVERY node verified on device
+assert n_corr == S * B * 4, f"{S * B * 4 - n_corr} ops wrong across mesh"
+assert 0 < max_nu <= B and sum_nu >= max_nu
+total = keeper.sum("staged-receipts", n_corr)
+assert total == nproc * n_corr  # replicated drivers agree exactly
+
+# mixed staged loop (reads linearization-checked, writes ST_APPLIED /
+# cross-node-duplicate ST_SUPERSEDED, all on device inside the step)
+mstep, (new_mc, mtb, mrt, mrk) = make_staged_mixed_step(
+    eng, n_keys=n_keys, theta=0.99, salt=salt, batch=B, read_ratio=0.5,
+    dev_rb=512, dev_wb=512, log2_bins=16)
+mc = new_mc()
+pool, counters = dsm.pool, dsm.counters
+for _ in range(S):
+    pool, counters, mc = mstep(pool, dsm.locks, counters, mtb, mrt,
+                               mrk, mc)
+jax.block_until_ready(mc)
+dsm.pool, dsm.counters = pool, counters
+msi, mok, n_corr_r, n_ok_w, *_rest = (int(np.asarray(x)) for x in mc)
+assert msi == S and mok == 1, (msi, mok)
+assert n_corr_r == S * 512 * 4, \
+    f"{S * 512 * 4 - n_corr_r} reads wrong/future-valued across mesh"
+assert n_ok_w == S * 512 * 4, \
+    f"{S * 512 * 4 - n_ok_w} writes unapplied across mesh"
+
+keeper.barrier("done")
+print(f"[{pid}] STAGED-PASS ro={n_corr} r={n_corr_r} w={n_ok_w}",
+      flush=True)
+'''
+
+
 def _run_workers(tmp_path, script, timeout, tag):
     import socket
 
@@ -359,6 +450,14 @@ def test_two_process_reclaim(tmp_path):
     process-spanning mesh: unlink + quarantine + free in lock-step,
     mirrored pools identical, freed pages re-allocatable."""
     _run_workers(tmp_path, _RECLAIM_WORKER, 900, "RECLAIM-PASS")
+
+
+def test_two_process_staged_loop(tmp_path):
+    """Device-staged open loop (read-only + mixed) on a process-
+    spanning mesh: generation/combine/serve/fan-out/verify all on
+    device, receipts psum'd across processes — the sustained-benchmark
+    loop shape at multihost scale."""
+    _run_workers(tmp_path, _STAGED_WORKER, 900, "STAGED-PASS")
 
 
 def test_two_process_split_storm(tmp_path):

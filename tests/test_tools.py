@@ -139,6 +139,28 @@ def test_benchmark_driver_exchange_pallas_drill(eight_devices, capsys):
     assert "counter diff vs xla: none (exact match)" in out
 
 
+def test_profile_staged2_driver(eight_devices, capsys, monkeypatch):
+    """Staged-step anatomy driver (CPU smoke of tools/profile_staged2):
+    per-phase chained-delta attribution + the host-staged serve
+    comparator must come out with receipts verified and the side-by-
+    side JSON shape bench rounds consume."""
+    import json
+
+    for k, v in (("KEYS", "20000"), ("B", "8192"), ("DEVB", "8192"),
+                 ("K", "2"), ("STEPS", "6"), ("W", "2"),
+                 ("FUSION", "aligned")):
+        monkeypatch.setenv(k, v)
+    import profile_staged2
+    r = profile_staged2.main()
+    out = capsys.readouterr().out
+    j = json.loads(out.strip().splitlines()[-1])
+    assert j["metric"] == "staged_step_anatomy"
+    assert j["fusion"] == "aligned" and j["n_programs"] == 3
+    assert set(j["phase_ms"]) == {"prep", "serve_fanout", "verify"}
+    assert j["serve_host_staged_ms"] > 0 and j["full_step_ms"] > 0
+    assert r["phase_ms"] == j["phase_ms"]
+
+
 def test_churn_bench_driver(eight_devices, capsys):
     """Drifting-keyspace churn + reclaim on a bounded pool (CPU smoke
     of tools/churn_bench.py): the loop must hold integrity and keep

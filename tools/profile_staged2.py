@@ -1,7 +1,33 @@
 #!/usr/bin/env python
-"""Decompose the fused device-staged step on the real chip: full step vs
-prep-only vs serve-only, same shard_map structure, same tree."""
+"""Staged-step anatomy: decompose the device-staged step and put it
+side by side with the host-staged serve it must match.
 
+Round-5 left a measured-but-unexplained 2x ("known headroom" in
+BENCHMARKS.md): the staged step ran ~124 ms/step while the identical
+routed serve fed host-staged inputs measured 72-84 ms.  This driver is
+the attribution tool for that gap:
+
+- builds the staged step in any fusion mode (``FUSION`` env:
+  aligned | chained | fused — see ``config.staged_fusion``),
+- times the FULL pipelined step (bounded dispatch window, the honest
+  loop shape bench.py runs),
+- attributes per-phase costs with the chained-delta method
+  (``step.phase_profile``: K and 2K data-dependent repetitions per
+  program, cost = (t_2K - t_K)/K — per-call timings through a remote
+  access tunnel measure the tunnel, see tools/profile_insert.py),
+- runs the HOST-STAGED comparator: the engine's combined-search
+  fan-out program on one pre-staged batch of the same width — in
+  ``aligned`` mode this is the SAME compiled program object the staged
+  serve dispatches, so staged-vs-host serve cost is an apples-to-apples
+  diff by construction,
+- records every region as an obs span / histogram and prints the
+  side-by-side prep-vs-serve table plus ONE JSON line.
+
+Env knobs: KEYS (10 M), B (4 M), DEVB, K (delta reps, 8), FUSION,
+SAMPLER (analytic), W (dispatch window, 8), STEPS (pipelined steps, 24).
+"""
+
+import json
 import os
 import sys
 import time
@@ -11,24 +37,58 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _host_staged_batch(native, router, n_keys, batch, dev_b, theta, salt):
+    """One host-staged batch (khi, klo, start, active, inv) — the
+    throughput-phase prep: native BatchPrep when available, else the
+    numpy unique+inverse fallback (CPU smoke runs)."""
+    from sherman_tpu.ops import bits
+
+    if native.available():
+        prep_h = native.BatchPrep(batch, dev_b, n_keys, theta, seed=11,
+                                  salt=salt)
+        buf = prep_h.buffers()
+        b = prep_h.run_zipf(None, buf, router.table_np, router.shift)
+        return b.khi, b.klo, b.start, b.active.view(bool), b.inv
+    from sherman_tpu.workload.zipf import ZipfGen, uniform_ranks
+    if theta > 0:
+        ranks = ZipfGen(n_keys, theta, seed=11).sample(batch)
+    else:
+        ranks = uniform_ranks(n_keys, batch, np.random.default_rng(11))
+    keys = bits.mix64_np(ranks.astype(np.uint64) ^ np.uint64(salt))
+    uk, inv = np.unique(keys, return_inverse=True)
+    assert uk.size <= dev_b, (uk.size, dev_b)
+    pad = (0, dev_b - uk.size)
+    khi, klo = bits.keys_to_pairs(np.pad(uk, pad))
+    act = np.zeros(dev_b, bool)
+    act[:uk.size] = True
+    start = np.pad(router.host_start(*bits.keys_to_pairs(uk)), pad)
+    return khi, klo, start, act, inv.astype(np.int32)
+
+
 def main():
     import jax
-    import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir", os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         ".jax_cache"))
 
-    from sherman_tpu import native
+    from sherman_tpu import native, obs
+    from sherman_tpu import config as C
     from sherman_tpu.cluster import Cluster
     from sherman_tpu.config import DSMConfig, LEAF_CAP, TreeConfig
     from sherman_tpu.models import batched
     from sherman_tpu.models.btree import Tree
+    from sherman_tpu.ops import bits
     from sherman_tpu.workload import device_prep
 
     n_keys = int(os.environ.get("KEYS", 10_000_000))
     batch = int(os.environ.get("B", 4_194_304))
-    theta = 0.99
+    theta = float(os.environ.get("THETA", 0.99))
+    fusion = os.environ.get("FUSION") or C.staged_fusion()
+    sampler = os.environ.get("SAMPLER", "analytic")
+    K = int(os.environ.get("K", 8))
+    W = int(os.environ.get("W", 8))
+    n_steps = int(os.environ.get("STEPS", 24))
     salt = 0x5E17_AB1E_5A17
     fill = 0.75
     per_leaf = max(1, int(LEAF_CAP * fill))
@@ -41,101 +101,150 @@ def main():
     tree = Tree(cluster)
     eng = batched.BatchedEngine(tree, batch_per_node=batch,
                                 tcfg=TreeConfig(sibling_chase_budget=1))
-    keys, _ = native.synthetic_keyspace(n_keys, salt)
+    if native.available():
+        keys, _ = native.synthetic_keyspace(n_keys, salt)
+    else:
+        ranks = np.arange(n_keys, dtype=np.uint64)
+        keys = np.sort(bits.mix64_np(ranks ^ np.uint64(salt)))
     t0 = time.time()
-    batched.bulk_load(tree, keys, keys ^ np.uint64(0xDEADBEEF), fill=fill)
+    with obs.span("profile.bulk_load", keys=n_keys):
+        batched.bulk_load(tree, keys, keys ^ np.uint64(0xDEADBEEF),
+                          fill=fill)
     eng.attach_router()
-    print(f"bulk_load {time.time() - t0:.1f}s", flush=True)
+    print(f"# bulk_load {time.time() - t0:.1f}s", file=sys.stderr)
 
-    dev_b = int(os.environ.get("DEVB", 1_097_728 + 16384))
+    dev_b = int(os.environ.get("DEVB", min(batch, 1_097_728 + 16384)))
     step, (new_carry, table_d, rtable_d, rkey_d) = \
         device_prep.make_staged_step(eng, n_keys=n_keys, theta=theta,
-                                     salt=salt, batch=batch, dev_b=dev_b)
+                                     salt=salt, batch=batch, dev_b=dev_b,
+                                     sampler=sampler, fusion=fusion)
     dsm = eng.dsm
     pool, counters = dsm.pool, dsm.counters
-    K = int(os.environ.get("K", 8))
 
-    def timeit(name, fn, *args, reps=K):
-        out = fn(*args)
-        jax.block_until_ready(out)
-        t0 = time.time()
-        o = out
-        for _ in range(reps):
-            o = fn(*args)
-        jax.block_until_ready(o)
-        print(f"{name:16s} {(time.time() - t0) / reps * 1e3:9.1f} ms",
-              flush=True)
-        return out
-
-    # A. full fused step
+    # A. full staged step, pipelined with the bounded dispatch window
+    # bench.py uses (PJRT allocates output buffers at enqueue; block on
+    # the LAST program's carry from W steps back)
+    from collections import deque
     carry = new_carry()
-    out = step(pool, counters, table_d, rtable_d, rkey_d, carry)
-    jax.block_until_ready(out)
-    counters, carry = out
-    t0 = time.time()
-    for _ in range(K):
-        counters, carry = step(pool, counters, table_d, rtable_d,
-                               rkey_d, carry)
+    counters, carry = step(pool, counters, table_d, rtable_d, rkey_d,
+                           carry)
     jax.block_until_ready(carry)
-    print(f"{'full_step':16s} {(time.time() - t0) / K * 1e3:9.1f} ms",
-          flush=True)
+    assert int(np.asarray(carry[1])) == 1, "warmup: unique overflow"
+    assert int(np.asarray(carry[2])) == batch, "warmup: wrong answers"
+    carry = new_carry()
+    pend = deque()
+    with obs.span("profile.full_step_pipelined", steps=n_steps,
+                  fusion=fusion):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            counters, carry = step(pool, counters, table_d, rtable_d,
+                                   rkey_d, carry)
+            pend.append(carry[1])
+            if len(pend) > W:
+                jax.block_until_ready(pend.popleft())
+        jax.block_until_ready(carry)
+        full_ms = (time.perf_counter() - t0) / n_steps * 1e3
+    assert int(np.asarray(carry[1])) == 1
+    assert int(np.asarray(carry[2])) == n_steps * batch, \
+        "pipelined window: receipts failed"
+    obs.histogram("staged.full_step_ms").record(full_ms)
+    print(f"{'full_step':20s} {full_ms:9.1f} ms/step (pipelined, W={W}, "
+          f"receipts verified)", file=sys.stderr)
+
+    # B. per-phase attribution (chained-delta; obs histograms under
+    # staged.<phase>_ms)
+    with obs.span("profile.phase_attribution", reps=K, fusion=fusion):
+        phase_ms, counters = step.phase_profile(pool, counters, table_d,
+                                                rtable_d, rkey_d, reps=K)
+    for name, ms in phase_ms.items():
+        obs.histogram(f"staged.{name}_ms").record(ms)
+        print(f"{name:20s} {ms:9.1f} ms", file=sys.stderr)
+
+    # C. host-staged serve comparator: the engine fan-out program on one
+    # pre-staged batch of the same width.  In 'aligned' mode this is the
+    # same compiled program object as the staged serve.
+    hkhi, hklo, hstart, hact, hinv = _host_staged_batch(
+        native, eng.router, n_keys, batch, dev_b, theta, salt)
+    shard = dsm.shard
+    d = (jax.device_put(hkhi, shard), jax.device_put(hklo, shard),
+         jax.device_put(hstart, shard), jax.device_put(hact, shard),
+         jax.device_put(hinv, shard))
+    fn = eng._get_search_fanout(eng._iters())
+    root = np.int32(tree._root_addr)
+    box = {"c": counters}
+
+    def serve_host_loop(k):
+        out = None
+        for _ in range(k):
+            box["c"], done, found, vhi, vlo = fn(
+                pool, box["c"], d[0], d[1], root, d[3], d[2], d[4])
+            out = found
+        jax.block_until_ready(out)
+
+    with obs.span("profile.serve_host_staged", reps=K):
+        serve_host_ms = device_prep._delta_ms(serve_host_loop, K)
+    counters = box["c"]
+    obs.histogram("staged.serve_host_staged_ms").record(serve_host_ms)
     dsm.counters = counters
 
-    # A2. the two chained programs separately
-    carry = new_carry()
-    _, *arrs = step.jprep(table_d, rtable_d, rkey_d, carry[0])
-    jax.block_until_ready(arrs[0])
-    t0 = time.time()
-    for i in range(K):
-        si, *arrs2 = step.jprep(table_d, rtable_d, rkey_d,
-                                np.uint32(i + 1))
-    jax.block_until_ready(arrs2[0])
-    print(f"{'jprep':16s} {(time.time() - t0) / K * 1e3:9.1f} ms",
-          flush=True)
-    rc = tuple(carry[1:])
-    ctr0 = dsm.counters
-    ctr0, rc = step.jserve(pool, ctr0, rc, *arrs2)
-    jax.block_until_ready(rc)
-    t0 = time.time()
-    for i in range(K):
-        _, *arrs2 = step.jprep(table_d, rtable_d, rkey_d, np.uint32(i))
-        jax.block_until_ready(arrs2[0])
-        t1 = time.time()
-        ctr0, rc = step.jserve(pool, ctr0, rc, *arrs2)
-        jax.block_until_ready(rc)
-        print(f"  jserve rep {i}: {(time.time() - t1) * 1e3:9.1f} ms",
-              flush=True)
-    dsm.counters = ctr0
+    # side-by-side: what the staged loop pays vs the host-staged serve.
+    # Only the serve-bearing phase is comparable: aligned's serve_fanout
+    # (the SAME compiled program as the comparator) and chained's
+    # serve_fanout_verify (serve + ~elementwise verify).  A fused run
+    # has no separable serve — its ratio would fold prep+verify in and
+    # read as a phantom serve regression, so it is not published.
+    staged_serve = phase_ms.get("serve_fanout",
+                                phase_ms.get("serve_fanout_verify"))
+    print("#\n# side-by-side (ms): staged step vs host-staged serve",
+          file=sys.stderr)
+    print(f"# {'phase':22s} {'staged':>9s} {'host-staged':>12s}",
+          file=sys.stderr)
+    print(f"# {'prep':22s} {phase_ms.get('prep', float('nan')):9.1f} "
+          f"{'(host prep untimed)':>12s}", file=sys.stderr)
+    if staged_serve is not None:
+        print(f"# {'serve(+fanout)':22s} {staged_serve:9.1f} "
+              f"{serve_host_ms:12.1f}", file=sys.stderr)
+    else:
+        print(f"# {'fused prep+serve+verify':22s} "
+              f"{phase_ms['fused_step']:9.1f} {serve_host_ms:12.1f}",
+              file=sys.stderr)
+    if "verify" in phase_ms:
+        print(f"# {'verify':22s} {phase_ms['verify']:9.1f} "
+              f"{'—':>12s}", file=sys.stderr)
+    print(f"# {'full step (pipelined)':22s} {full_ms:9.1f} "
+          f"{'—':>12s}", file=sys.stderr)
+    gap = (staged_serve / serve_host_ms
+           if staged_serve is not None and serve_host_ms else None)
+    if gap is not None:
+        same = (" (aligned dispatches the SAME program: any residual is"
+                " input production, not program shape)"
+                if fusion == "aligned" else
+                " (chained serve also folds the ~elementwise verify)")
+        print(f"# staged-serve / host-staged-serve = {gap:.2f}x{same}",
+              file=sys.stderr)
+    else:
+        print("# no serve-only ratio for fused runs (one program; "
+              "prep+verify inseparable)", file=sys.stderr)
 
-    # (prep-only timing: step.jprep above — the profiler reuses the
-    # SHIPPED programs instead of copying the pipeline)
-
-    # C. serve-only: the throughput-phase fanout kernel on one host-
-    # staged batch of the same width
-    prep_h = native.BatchPrep(batch, dev_b, n_keys, theta, seed=11,
-                              salt=salt)
-    buf = prep_h.buffers()
-    b = prep_h.run_zipf(None, buf, eng.router.table_np, eng.router.shift)
-    fn = eng._get_search_fanout(eng._iters())
-    shard = dsm.shard
-    d = (jax.device_put(b.khi, shard), jax.device_put(b.klo, shard),
-         jax.device_put(b.start, shard),
-         jax.device_put(b.active.view(bool), shard),
-         jax.device_put(b.inv, shard))
-    root = np.int32(tree._root_addr)
-    ctr = dsm.counters
-
-    out = fn(pool, ctr, d[0], d[1], root, d[3], d[2], d[4])
-    jax.block_until_ready(out[2])
-    ctr = out[0]
-    t0 = time.time()
-    for _ in range(K):
-        out = fn(pool, ctr, d[0], d[1], root, d[3], d[2], d[4])
-        ctr = out[0]
-    jax.block_until_ready(out[2])
-    print(f"{'serve_only':16s} {(time.time() - t0) / K * 1e3:9.1f} ms",
-          flush=True)
-    dsm.counters = ctr
+    out = {
+        "metric": "staged_step_anatomy",
+        "fusion": fusion,
+        "sampler": step.sampler,
+        "n_programs": step.n_programs,
+        "full_step_ms": round(full_ms, 2),
+        "phase_ms": {k: round(v, 2) for k, v in phase_ms.items()},
+        "serve_host_staged_ms": round(serve_host_ms, 2),
+        # serve-vs-serve only (aligned/chained); null on fused runs —
+        # there is no separable staged serve to compare
+        "staged_vs_host_serve_ratio": round(gap, 3)
+        if gap is not None else None,
+        "keys": n_keys, "batch": batch, "dev_b": dev_b,
+        "window": W, "delta_reps": K,
+        # per-phase obs spans/histograms of this run (staged.* keys)
+        "obs": obs.obs_section(),
+    }
+    print(json.dumps(out))
+    return out
 
 
 if __name__ == "__main__":
