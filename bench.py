@@ -34,6 +34,15 @@ Environment knobs:
                          registry snapshot (dsm.* op/byte counters,
                          btree.* cache counters) + per-phase span stats
                          from sherman_tpu/obs.
+  SHERMAN_COLLECTIVE_TIMEOUT_S  arms a fail-fast watchdog around the
+                         sustained/mixed device-step windows: a wedged
+                         on-chip collective dumps the DSM counter
+                         snapshot and exits (code 86) instead of
+                         hanging the run (utils/failure.py).
+
+``bench.py --chaos-drill`` runs the data-plane chaos drill
+(tools/chaos_drill.py: fault injection -> lease/scrub detection ->
+recovery) instead of the benchmark — see README "Robustness".
 
 Read combining: a zipf-0.99 batch of 4 M ops contains ~1-2 M distinct
 keys (~2-4x dedup depending on keyspace size).  The engine already
@@ -214,19 +223,31 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         independent programs lets preps sprint ahead of the lagging
         serves; bounding the prep chain would then leave up to n_steps
         of ~80 MB prep intermediates alive.  Bounding the serve chain
-        caps live prep outputs at exactly W under any scheduler."""
+        caps live prep outputs at exactly W under any scheduler.
+
+        Fail-fast (utils/failure.py): SHERMAN_COLLECTIVE_TIMEOUT_S arms
+        a watchdog around the whole windowed dispatch — a wedged
+        on-chip collective cannot be cancelled from Python, so on
+        expiry the watchdog dumps the DSM op-counter snapshot (what the
+        cluster was doing when it stuck) and exits for the launcher to
+        restart, instead of hanging the sustained/mixed phase forever."""
         from collections import deque
+
+        from sherman_tpu.utils import failure
         W = int(os.environ.get("SHERMAN_BENCH_DEVWINDOW", 8))
         pend: deque = deque()
         c = None
-        t0 = time.time()
-        for _ in range(n_steps):
-            c = advance()
-            pend.append(c[1])
-            if len(pend) > W:
-                jax.block_until_ready(pend.popleft())
-        jax.block_until_ready(c)
-        return time.time() - t0
+        with failure.Watchdog.maybe(
+                what=f"device-step window ({n_steps} steps)",
+                diagnostics=tree.dsm.counter_snapshot):
+            t0 = time.time()
+            for _ in range(n_steps):
+                c = advance()
+                pend.append(c[1])
+                if len(pend) > W:
+                    jax.block_until_ready(pend.popleft())
+            jax.block_until_ready(c)
+            return time.time() - t0
     if combine and salt is not None:
         # static unique capacity: gather cost is per-row, so round up only
         # to the next 8192 (NOT a power of two — a 2^k pad can cost >10%);
@@ -905,6 +926,19 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
 
 
 def main() -> None:
+    if "--chaos-drill" in sys.argv:
+        # Robustness lane: run the end-to-end data-plane chaos drill
+        # (inject wedged locks + torn versions -> scrub/lease detection
+        # -> revoke/quarantine/degrade -> checkpoint-restore recovery)
+        # instead of the throughput benchmark.  tools/chaos_drill.py
+        # owns the sequence; it prints its own one-line JSON.
+        sys.argv.remove("--chaos-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import chaos_drill
+        chaos_drill.main(sys.argv[1:])
+        return
+
     # persistent compilation cache: kernel compiles cost 20-40 s each over
     # the remote-compile path; caching them makes repeat runs (and the
     # driver's capture) pay only execution

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Chaos CI lane: pin the data-plane failure story on the CPU mesh.
+#
+# Runs (1) the fast-tier chaos/scrub/lease tests, (2) the end-to-end
+# chaos drill (inject -> detect -> recover -> re-validate, one JSON
+# receipt line), and (3) an injection-determinism check: the same
+# SHERMAN_CHAOS seed must fire the same faults twice (chaos.* counters
+# equal across two runs) — the property every chaos repro depends on.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== chaos fast tier =="
+python -m pytest tests/test_chaos.py -q
+
+echo "== chaos drill (end-to-end) =="
+python bench.py --chaos-drill --keys "${SHERMAN_DRILL_KEYS:-3000}"
+
+echo "== injection determinism =="
+python - <<'EOF'
+import json, os, subprocess, sys
+repo = os.getcwd()
+probe = r'''
+import json
+import numpy as np
+from sherman_tpu import chaos as CH
+faults = [(f.kind, f.step, f.slot) for f in
+          CH.FaultPlan.parse("random:11:6").faults]
+print(json.dumps(faults))
+'''
+outs = [subprocess.run([sys.executable, "-c", probe], cwd=repo,
+                       capture_output=True, text=True, check=True
+                       ).stdout.strip() for _ in range(2)]
+assert outs[0] == outs[1], f"nondeterministic plans:\n{outs[0]}\n{outs[1]}"
+print("deterministic:", outs[0])
+EOF
+echo "CHAOS-CI PASS"

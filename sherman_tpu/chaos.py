@@ -1,0 +1,334 @@
+"""Deterministic data-plane fault injection — the chaos subsystem.
+
+The reference has no failure story at all and our control plane got one
+in PR 1 (``utils/failure.py``: peer death, stalls, preemption).  This
+module is the DATA plane's matching tool: a seedable, deterministic
+:class:`FaultPlan` installed on the DSM and fired at the host-step
+boundary (``DSM.step`` — the single injection hook), able to
+
+- **corrupt pool words**: tear a page's front/rear version pair
+  (``torn_page``) or flip one half of a leaf slot's packed fver/rver
+  pair (``flip_entry_ver``) — exactly the torn-read classes Sherman
+  gates behind ``CONFIG_ENABLE_CRC`` and the step-atomic design makes
+  impossible *without* injection; the online scrubber
+  (``models/scrub.py``) must catch both;
+- **wedge lock words** as held-by-a-dead-client (``wedge_lock``): the
+  lock word gets a lease no live client owns, so spin paths must detect
+  and revoke it (lock-lease recovery) instead of hanging;
+- **drop a step's CAS winners** (``drop_cas``): every CAS/masked-CAS
+  request in the target step has its expectation perturbed so it loses
+  honestly (ok=0) — retry paths must absorb it;
+- **serve a stale-snapshot reply** (``stale_read``): page reads in the
+  target step answer from an older pool snapshot — the torn-NIC-read
+  analogue at step granularity.
+
+Faults that corrupt STATE (torn/flip/wedge) record the overwritten
+words, so :meth:`FaultPlan.undo` can restore them — the chaos fuzz
+leans on this to inject, assert detection, repair and continue.
+
+Determinism: everything derives from the plan (and its seed for
+``random`` plans); the step index is the count of ``DSM.step`` calls
+since installation.  Zero cost when off: the DSM's hook is a single
+``is None`` test, and no engine/staged program changes at all.
+
+Env: ``SHERMAN_CHAOS`` installs a plan on every DSM at construction —
+either a JSON list of fault dicts (``[{"kind": "wedge_lock", "step":
+2, "addr": 5}]``) or ``random:SEED[:N]`` for N seeded random faults.
+Observability: every injection counts under ``chaos.*``.
+
+Scope: single-process meshes (drills, CI, the CPU fuzz tier).  The
+corruptions target the shared pool/locks arrays, so they are seen by
+EVERY program — engine steps, staged loops, scrub kernels — not just
+host-API steps; only ``drop_cas``/``stale_read`` are host-step-local.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu import obs
+from sherman_tpu.ops import bits
+
+KINDS = ("torn_page", "flip_entry_ver", "wedge_lock", "drop_cas",
+         "stale_read")
+
+# a lease word no live client can own: unregistered owner tag + an
+# epoch far from any real client's generation
+DEAD_OWNER_TAG = 0xDEAD
+DEAD_OWNER_EPOCH = 0x5A
+
+_OBS = {k: obs.counter(f"chaos.{k}") for k in KINDS}
+_OBS_TOTAL = obs.counter("chaos.faults_injected")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault.  ``step`` is the host-step index (count of
+    ``DSM.step`` calls after plan installation) at which it fires; a
+    fault whose step has already passed fires on the next step.
+    ``addr`` is a packed pool-page address (torn/flip) or a lock-space
+    address ``make_addr(node, lock_index)`` (wedge); ``addr=-1`` means
+    "pick a live page/lock deterministically from the plan's RNG at
+    fire time" (random plans) — a deferred corruption fault that finds
+    no live page yet stays pending and retries at the next step."""
+
+    kind: str
+    step: int = 0
+    addr: int = -1
+    slot: int = 0                  # flip_entry_ver: leaf slot
+    owner: int = DEAD_OWNER_TAG    # wedge_lock: lease owner tag
+    epoch: int = DEAD_OWNER_EPOCH  # wedge_lock: lease epoch
+    fired: bool = dataclasses.field(default=False, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"want one of {KINDS}")
+
+
+class FaultPlan:
+    """A deterministic schedule of data-plane faults over one DSM."""
+
+    def __init__(self, faults, seed: int = 0):
+        self.faults = [f if isinstance(f, Fault) else Fault(**f)
+                       for f in faults]
+        self.seed = int(seed)
+        self._rng = np.random.default_rng(self.seed)
+        self._steps = 0
+        self._undo: list = []       # (space, row, col, old_value)
+        self._stale_pool = None     # np snapshot for stale_read serving
+        self.injected = 0
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``SHERMAN_CHAOS`` grammar: a JSON list of fault dicts, or
+        ``random:SEED[:N]``."""
+        spec = spec.strip()
+        if spec.startswith("["):
+            return cls(json.loads(spec))
+        if spec.startswith("random"):
+            parts = spec.split(":")
+            seed = int(parts[1]) if len(parts) > 1 else 0
+            n = int(parts[2]) if len(parts) > 2 else 3
+            return cls.random(seed, n_faults=n)
+        raise ValueError(
+            f"SHERMAN_CHAOS={spec!r}: want a JSON fault list or "
+            "'random:SEED[:N]'")
+
+    @classmethod
+    def from_env(cls, env: str = "SHERMAN_CHAOS") -> "FaultPlan | None":
+        spec = os.environ.get(env)
+        return cls.parse(spec) if spec else None
+
+    @classmethod
+    def random(cls, seed: int, n_faults: int = 3, step_lo: int = 0,
+               step_hi: int = 8, kinds=("torn_page", "flip_entry_ver",
+                                        "wedge_lock")) -> "FaultPlan":
+        """Seeded random plan.  Targets are deferred (``addr=-1``): each
+        fault picks a live page (or a lock word) from the plan RNG at
+        fire time, so the same seed over the same state sequence lands
+        on the same words.  Default kinds are the persistent-corruption
+        set whose DETECTION the chaos fuzz asserts; ``drop_cas`` /
+        ``stale_read`` perturb only transient host-step replies."""
+        rng = np.random.default_rng(int(seed))
+        faults = [Fault(kind=str(rng.choice(list(kinds))),
+                        step=int(rng.integers(step_lo, max(step_hi, 1))),
+                        slot=int(rng.integers(0, C.LEAF_CAP)))
+                  for _ in range(n_faults)]
+        return cls(faults, seed=seed)
+
+    # -- the DSM hook (called under the DSM step mutex) -----------------------
+
+    def on_step(self, dsm, reqs):
+        """Fire every due fault; returns (reqs, post) where ``post`` is
+        truthy when :meth:`on_replies` must post-process this step's
+        replies (stale_read)."""
+        if dsm.multihost:
+            raise RuntimeError(
+                "chaos injection supports single-process meshes only")
+        step = self._steps
+        self._steps += 1
+        post = False
+        # arm the stale snapshot at the plan's FIRST step: serving a
+        # fault-step read from its own pre-step pool would be the normal
+        # reply — staleness must reach back at least one mutation
+        if self._stale_pool is None and any(
+                f.kind == "stale_read" and not f.fired
+                for f in self.faults):
+            self._stale_pool = np.asarray(dsm.pool)
+        for f in self.faults:
+            if f.fired or f.step > step:
+                continue
+            if f.kind == "torn_page":
+                landed = self._torn_page(dsm, f)
+            elif f.kind == "flip_entry_ver":
+                landed = self._flip_entry_ver(dsm, f)
+            elif f.kind == "wedge_lock":
+                self._wedge_lock(dsm, f)
+                landed = True
+            elif f.kind == "drop_cas":
+                reqs = self._drop_cas(reqs)
+                landed = True
+            else:  # stale_read: snapshot armed at the plan's first step
+                post = True
+                landed = True
+            if not landed:
+                continue  # nothing live to corrupt yet: defer the fault
+            f.fired = True
+            self.injected += 1
+            _OBS_TOTAL.inc()
+            _OBS[f.kind].inc()
+        return reqs, post
+
+    def on_replies(self, dsm, reqs, rep):
+        """stale_read: answer this step's page reads from the armed
+        older snapshot (the reference's torn/stale NIC read, at step
+        granularity)."""
+        import sherman_tpu.parallel.dsm as D
+        P = self._stale_pool.shape[0] // dsm.cfg.machine_nr
+        op = np.asarray(reqs["op"]).reshape(-1)
+        addr = np.asarray(reqs["addr"]).reshape(-1)
+        data = np.array(rep.data)  # materialized replies are read-only
+        for i in np.nonzero(op == D.OP_READ)[0]:
+            node = bits.addr_node(int(addr[i]))
+            page = bits.addr_page(int(addr[i]))
+            row = node * P + page
+            if 0 <= row < self._stale_pool.shape[0]:
+                data[i] = self._stale_pool[row]
+        return D.Replies(data=data, old=rep.old, ok=rep.ok)
+
+    # -- fault bodies ---------------------------------------------------------
+
+    def _pick_live_page(self, dsm) -> int:
+        """Deferred-target resolution: a deterministic live non-meta
+        page (front version != 0), from the plan RNG."""
+        fv = np.asarray(dsm.pool[:, C.W_FRONT_VER])
+        hi = np.asarray(dsm.pool[:, C.W_HIGH_HI])
+        lo = np.asarray(dsm.pool[:, C.W_HIGH_LO])
+        P = fv.shape[0] // dsm.cfg.machine_nr
+        rows = np.nonzero((fv != 0) & ~((hi == 0) & (lo == 0))
+                          & (np.arange(fv.shape[0]) % P != 0))[0]
+        if rows.size == 0:
+            return 0
+        r = int(rows[int(self._rng.integers(0, rows.size))])
+        return bits.make_addr(r // P, r % P)
+
+    def _poke_pool(self, dsm, row: int, col: int, value: int) -> None:
+        import jax
+        old = int(np.asarray(dsm.pool[row, col]))
+        self._undo.append(("pool", row, col, old, int(np.int32(value))))
+        dsm.pool = jax.device_put(
+            dsm.pool.at[row, col].set(np.int32(value)), dsm.shard)
+
+    def _poke_lock(self, dsm, row: int, value: int) -> None:
+        import jax
+        old = int(np.asarray(dsm.locks[row]))
+        self._undo.append(("lock", row, 0, old, int(np.int32(value))))
+        dsm.locks = jax.device_put(
+            dsm.locks.at[row].set(np.int32(value)), dsm.shard)
+
+    def _pool_row(self, dsm, addr: int) -> int:
+        return (bits.addr_node(addr) * dsm.cfg.pages_per_node
+                + bits.addr_page(addr))
+
+    def _torn_page(self, dsm, f: Fault) -> bool:
+        """Tear the page's front/rear version pair: rear := front + 1
+        (the mid-write state a torn NIC read would expose).  False when
+        a deferred target (-1) found no live page to corrupt yet."""
+        addr = f.addr if f.addr != -1 else self._pick_live_page(dsm)
+        if addr == 0:
+            return False
+        row = self._pool_row(dsm, addr)
+        front = int(np.asarray(dsm.pool[row, C.W_FRONT_VER]))
+        self._poke_pool(dsm, row, C.W_REAR_VER, (front + 1) & 0x7FFFFFFF)
+        return True
+
+    def _flip_entry_ver(self, dsm, f: Fault) -> bool:
+        """Flip the fver half of a leaf slot's packed version pair:
+        fver != rver is unreachable by construction (ver_pack writes
+        both halves equal in one step), so any occurrence is corruption
+        the scrubber must flag.  False when a deferred target (-1)
+        found no live page to corrupt yet."""
+        addr = f.addr if f.addr != -1 else self._pick_live_page(dsm)
+        if addr == 0:
+            return False
+        row = self._pool_row(dsm, addr)
+        col = C.L_VER_W + (int(f.slot) % C.LEAF_CAP)
+        old = int(np.asarray(dsm.pool[row, col]))
+        self._poke_pool(dsm, row, col, old ^ (1 << 16))
+        return True
+
+    def _wedge_lock(self, dsm, f: Fault) -> None:
+        """Wedge a lock word as held by a dead client: a lease no live
+        registration owns.  ``addr`` addresses the lock space
+        (``make_addr(node, lock_index)``); -1 picks a random word."""
+        L = dsm.cfg.locks_per_node
+        if f.addr != -1:
+            row = bits.addr_node(f.addr) * L + bits.addr_page(f.addr)
+        else:
+            row = int(self._rng.integers(0, dsm.cfg.machine_nr * L))
+        self._poke_lock(dsm, row,
+                        bits.lease_word(f.owner or DEAD_OWNER_TAG,
+                                        f.epoch))
+
+    @staticmethod
+    def _drop_cas(reqs):
+        """Perturb every CAS/masked-CAS expectation in this step so the
+        op honestly loses (ok=0) — the caller's retry path must absorb
+        a cluster-wide lost-CAS round."""
+        import sherman_tpu.parallel.dsm as D
+        reqs = dict(reqs)
+        op = np.asarray(reqs["op"])
+        arg0 = np.array(reqs["arg0"], np.int32, copy=True)
+        arg2 = np.asarray(reqs["arg2"])
+        cas = op == D.OP_CAS
+        arg0[cas] ^= np.int32(0x40000000)
+        mcas = op == D.OP_MASKED_CAS
+        # flip the masked bits of the expectation (mask 0 has no winner
+        # to drop anyway)
+        arg0[mcas] ^= arg2[mcas]
+        reqs["arg0"] = arg0
+        return reqs
+
+    # -- repair / bookkeeping -------------------------------------------------
+
+    def undo(self, dsm) -> int:
+        """Restore every corrupted word (reverse order) — the fuzz
+        harness's repair step.  A word that no longer holds the
+        INJECTED value was legitimately rewritten after injection
+        (e.g. a split rebuilt the page, a client re-acquired the lock):
+        restoring the pre-fault value there would itself corrupt state,
+        so such entries are skipped.  Returns the number of words
+        restored.  Only state faults are undoable; drop_cas/stale_read
+        perturbed replies, not state."""
+        import jax
+        n = 0
+        for space, row, col, old, injected in reversed(self._undo):
+            if space == "pool":
+                if int(np.asarray(dsm.pool[row, col])) != injected:
+                    continue  # overwritten since: leave the legit value
+                dsm.pool = jax.device_put(
+                    dsm.pool.at[row, col].set(np.int32(old)), dsm.shard)
+            else:
+                if int(np.asarray(dsm.locks[row])) != injected:
+                    continue
+                dsm.locks = jax.device_put(
+                    dsm.locks.at[row].set(np.int32(old)), dsm.shard)
+            n += 1
+        self._undo.clear()
+        return n
+
+    @property
+    def exhausted(self) -> bool:
+        return all(f.fired for f in self.faults)
+
+    def describe(self) -> list[dict]:
+        return [{"kind": f.kind, "step": f.step, "addr": f.addr,
+                 "fired": f.fired} for f in self.faults]

@@ -28,11 +28,22 @@ class ClientContext:
 
     client_id: int
     alloc: LocalAllocator
+    # lease epoch under which this client's lock acquisitions are valid
+    # (bumped by Cluster.expire_client when the control plane declares
+    # the client dead; a lock word holding the old epoch is revocable)
+    epoch: int = 1
 
     @property
     def tag(self) -> int:
         """Lock-holder tag; must be nonzero (thread_tag, DSM.cpp:76)."""
         return self.client_id + 1
+
+    @property
+    def lease(self) -> int:
+        """The lock word this client writes when acquiring a global
+        lock: {epoch:15, owner:16} (see ops.bits lease helpers)."""
+        from sherman_tpu.ops import bits
+        return bits.lease_word(self.tag, self.epoch)
 
 
 class Cluster:
@@ -88,6 +99,14 @@ class Cluster:
             native.LocalLockTable(cfg.machine_nr * cfg.locks_per_node)
             if not self.dsm.multihost and native.available() else None)
         self._next_client = 0
+        # Lock-lease epoch table: tag -> current lease epoch of every
+        # registered client.  The data-plane liveness oracle for lock
+        # revocation (Tree._try_revoke_lease): a lock word whose
+        # (owner, epoch) is absent or stale here belongs to a dead
+        # client and may be revoked.  Mirrored across processes by the
+        # replicated-registration contract (identical register_client
+        # streams), exactly like the directories above.
+        self.lease_epochs: dict[int, int] = {}
         self.keeper.barrier("DSM-init")
 
     def register_client(self, replicated: bool | None = None
@@ -115,8 +134,58 @@ class Cluster:
                 "not allocate")
         cid = self._next_client
         self._next_client += 1
-        return ClientContext(client_id=cid,
-                             alloc=LocalAllocator(self.directories))
+        ctx = ClientContext(client_id=cid,
+                            alloc=LocalAllocator(self.directories))
+        self.lease_epochs[ctx.tag] = ctx.epoch
+        return ctx
+
+    # -- lock-lease liveness (data-plane failure story) ----------------------
+    # The control plane (utils/failure.py) detects peer DEATH and stalls;
+    # these methods are the data plane's matching oracle: whether a lock
+    # word's holder is still entitled to it.  The spin paths consult ONLY
+    # the host-local epoch table (a dict lookup — no collective, no extra
+    # DSM op); ``sweep_dead_processes`` is the periodic maintenance pass
+    # that folds coordination-service liveness into the table.
+
+    def lease_is_live(self, owner_tag: int, epoch: int) -> bool:
+        """True iff a lock word's (owner, epoch) names a live lease:
+        the tag is registered here and the epoch matches its current
+        lease generation.  An unregistered tag (a client of a previous
+        incarnation, or junk from corruption) is dead; a registered tag
+        at a stale epoch was expired by the control plane."""
+        return self.lease_epochs.get(int(owner_tag)) == int(epoch)
+
+    def expire_client(self, owner_tag: int) -> None:
+        """Declare a client's current lease dead: bump its epoch so any
+        lock word it still holds fails ``lease_is_live`` and becomes
+        revocable.  Called by control-plane death handling (and tests);
+        on multi-host meshes every process must call identically (the
+        table is mirrored, like the directories)."""
+        t = int(owner_tag)
+        self.lease_epochs[t] = self.lease_epochs.get(t, 0) + 1
+
+    def sweep_dead_processes(self, tags_by_process: dict[int, list[int]]
+                             ) -> list[int]:
+        """COLLECTIVE maintenance pass: consult the coordination
+        service's liveness roll call (``failure.live_processes`` — every
+        live process must call this together) and expire every client
+        tag owned by a process that is no longer live.  ``tags_by_
+        process`` maps process index -> the tags that process's
+        non-replicated drivers registered (replicated clients exist on
+        every process and die only with the whole cluster).  Returns the
+        expired tags.  Single-process clusters trivially expire nothing.
+        """
+        from sherman_tpu.utils import failure
+        live = set(failure.live_processes(
+            self.keeper.machine_nr if self.keeper.is_multihost else 1))
+        expired = []
+        for proc, tags in tags_by_process.items():
+            if int(proc) in live:
+                continue
+            for t in tags:
+                self.expire_client(t)
+                expired.append(int(t))
+        return expired
 
     # NEW_ROOT broadcast (Tree.cpp:116-124): update the local directories'
     # hints.  The hint is advisory acceleration only — the authoritative

@@ -233,3 +233,12 @@ class TreeConfig:
     bulk_fill: float = 0.75
     # Local lock table size for the hierarchical lock (kNumOfLock parity).
     hand_over_limit: int = 8  # kMaxHandOverTime, Common.h:101
+    # Bounded lock retry (data-plane failure story): every this-many
+    # consecutive rounds a device-insert row stays blocked on a HELD
+    # page lock, the engine probes the lease table and revokes a DEAD
+    # holder's lock (client died mid-critical-section).  Live holders
+    # are normal contention and keep retrying (with host-side backoff)
+    # through the round budget; rows still blocked when it runs out are
+    # rejected with the typed ST_LOCK_TIMEOUT status instead of
+    # spinning unboundedly in the host fallback.
+    lock_retry_rounds: int = 3

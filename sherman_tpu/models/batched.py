@@ -58,8 +58,36 @@ ST_LOCKED = 4       # page lock held (host split in flight) -> retry
 ST_RETRY = 5        # routing overflow / descent incomplete -> retry
 ST_BAD = 6          # failed sanity checks (not a level-0 page / fence)
 ST_NOT_FOUND = 7    # delete: key absent (final)
+ST_LOCK_TIMEOUT = 8  # host-side terminal: the key's page lock was STILL
+                     # held by a LIVE lease when the insert round budget
+                     # ran out — the op is REJECTED with this typed
+                     # status instead of spinning unboundedly in the
+                     # host fallback (dead leases are revoked by the
+                     # in-loop probes every tcfg.lock_retry_rounds
+                     # blocked rounds; see _recover_wedged_locks)
 
 _PW = C.PAGE_WORDS
+
+
+class DegradedError(RuntimeError):
+    """Typed write rejection: the engine is in read-only degraded mode.
+
+    Raised by every mutating engine entry point after unrecoverable
+    data-plane damage (scrub-detected corruption that quarantine could
+    not contain, or a failed lock revocation).  Searches keep being
+    served; the documented exit is ``utils.checkpoint.restore`` into a
+    fresh cluster (see README "Robustness")."""
+
+    def __init__(self, reason: str):
+        super().__init__(
+            "engine degraded (read-only): write rejected — " + reason
+            + "; recover via utils.checkpoint.restore")
+        self.reason = reason
+
+
+# degraded-mode gauge + lock-timeout counter (data-plane failure story)
+_OBS_DEGRADED = obs.gauge("engine.degraded")
+_OBS_LOCK_TIMEOUTS = obs.counter("engine.lock_timeouts")
 
 
 # ---------------------------------------------------------------------------
@@ -985,6 +1013,13 @@ class BatchedEngine:
         self._reclaim_mutex = threading.Lock()
         self._parent_descend_cache: dict = {}
         self.router = None
+        # Graceful degradation (data-plane failure story): once flipped,
+        # every mutating entry point raises DegradedError (typed write
+        # rejection) while searches keep serving; exit = checkpoint
+        # restore into a fresh engine.  A fresh engine is healthy by
+        # construction, so the gauge resets here.
+        self._degraded_reason: str | None = None
+        _OBS_DEGRADED.set(0)
         self._search_cache: dict = {}
         self._insert_cache: dict = {}
         self._delete_cache: dict = {}
@@ -1011,6 +1046,36 @@ class BatchedEngine:
         # dispatch is async, so the mutex is held microseconds and never
         # across a host DSM op (threading.Lock is not reentrant).
         self._step_mutex = self.dsm._step_mutex
+
+    # -- degraded mode (read-only serving after unrecoverable damage) --------
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded_reason is not None
+
+    @property
+    def degraded_reason(self) -> str | None:
+        return self._degraded_reason
+
+    def enter_degraded(self, reason: str) -> None:
+        """Flip to read-only degraded serving: searches continue, writes
+        raise :class:`DegradedError`.  Idempotent (the first reason
+        wins — it names the root cause)."""
+        if self._degraded_reason is None:
+            self._degraded_reason = reason
+            _OBS_DEGRADED.set(1)
+            obs.counter("engine.degraded_entries").inc()
+
+    def exit_degraded(self) -> None:
+        """Clear degraded mode — only after the damage is actually gone
+        (state restored or repaired and re-validated); the chaos drill
+        is the reference sequence."""
+        self._degraded_reason = None
+        _OBS_DEGRADED.set(0)
+
+    def _require_writable(self) -> None:
+        if self._degraded_reason is not None:
+            raise DegradedError(self._degraded_reason)
 
     def _iters(self) -> int:
         # STATIC descent budget: max height + chase slack.  Deliberately
@@ -1184,6 +1249,8 @@ class BatchedEngine:
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
         values = np.asarray(values, np.uint64)
         is_read = np.asarray(is_read, bool)
+        if not bool(np.asarray(is_read).all()):
+            self._require_writable()  # degraded mode: reads-only batches
         self._check_replicated(keys, values, is_read)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
@@ -1226,22 +1293,29 @@ class BatchedEngine:
             st = self.insert(keys[miss_w], values[miss_w])
             # The rewrite below depends on insert()'s postcondition: every
             # request ends APPLIED, SUPERSEDED by a same-batch duplicate,
-            # or applied through the host path — nothing stays pending
-            # (insert raises on st_locked exhaustion rather than dropping
-            # rows).  Assert it so a future relaxation of that guarantee
-            # cannot silently turn these synthesized statuses into lies.
-            resolved = (st["applied"] + st["superseded"] + st["host_path"])
+            # applied through the host path, or REJECTED with the typed
+            # ST_LOCK_TIMEOUT outcome (lock held by a live lease past the
+            # bounded retry budget).  Assert it so a future relaxation of
+            # that guarantee cannot silently turn these synthesized
+            # statuses into lies.
+            resolved = (st["applied"] + st["superseded"] + st["host_path"]
+                        + st["lock_timeouts"])
             assert resolved == int(miss_w.sum()), (
                 f"insert() postcondition broken: {st} resolved != "
                 f"{int(miss_w.sum())} retried writes")
             # per-request outcomes match the fast path's dedup semantics:
             # the first-ordered request of a key applies, later duplicates
-            # are superseded by it (insert linearizes them the same way)
+            # are superseded by it (insert linearizes them the same way);
+            # lock-timeout keys carry the typed rejection through
             idx_w = np.nonzero(miss_w)[0]
             first = np.zeros(idx_w.shape[0], bool)
             first[np.unique(keys[idx_w], return_index=True)[1]] = True
             status[idx_w[first]] = ST_APPLIED
             status[idx_w[~first]] = ST_SUPERSEDED
+            if st["lock_timeouts"]:
+                to = np.isin(keys[idx_w],
+                             np.asarray(st["lock_timeout_keys"], np.uint64))
+                status[idx_w[to]] = ST_LOCK_TIMEOUT
         return out_vals, found, status
 
     # -- helpers -------------------------------------------------------------
@@ -1440,8 +1514,14 @@ class BatchedEngine:
     def insert(self, keys, values, max_rounds: int | None = None) -> dict:
         """Batched upsert with host fallback for splits.
 
-        Returns stats {applied, superseded, host_path, rounds}.
+        Returns stats {applied, superseded, host_path, rounds, st_locked,
+        lock_timeouts, lock_timeout_keys}: every request ends APPLIED,
+        SUPERSEDED, applied through the host path, or — when its page
+        lock stayed held by a live lease past the bounded retry budget —
+        REJECTED with the typed ST_LOCK_TIMEOUT outcome (counted in
+        lock_timeouts, keys listed in lock_timeout_keys).
         """
+        self._require_writable()
         if max_rounds is None:
             max_rounds = self.tcfg.insert_rounds
         keys = np.asarray(keys, np.uint64)
@@ -1452,7 +1532,7 @@ class BatchedEngine:
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
         stats = {"applied": 0, "superseded": 0, "host_path": 0, "rounds": 0,
-                 "st_locked": 0}
+                 "st_locked": 0, "lock_timeouts": 0, "lock_timeout_keys": []}
         for i in range(0, n, total):
             self._insert_chunk(keys[i:i + total], values[i:i + total],
                                max_rounds, stats)
@@ -1565,7 +1645,7 @@ class BatchedEngine:
                 for a in uaddr:
                     la = tree._lock_word_addr(a)
                     rows.append({"op": D.OP_CAS, "addr": la, "woff": 0,
-                                 "arg0": 0, "arg1": tree.ctx.tag,
+                                 "arg0": 0, "arg1": tree.ctx.lease,
                                  "space": D.SPACE_LOCK})
                     rows.append({"op": D.OP_READ, "addr": a})
                 rep = dsm._batch(rows)
@@ -1715,6 +1795,9 @@ class BatchedEngine:
         dbg = os.environ.get("SHERMAN_DEBUG_INSERT")
         n = keys.shape[0]
         pending = np.ones(n, bool)
+        # consecutive rounds each row spent blocked on a HELD page lock
+        # (bounded lock retry: see the ST_LOCKED handling below)
+        locked_rounds = np.zeros(n, np.int32)
         fresh_np = self._fill_fresh(False)  # round 0: optimistic, no splits
         # Progress-adaptive rounds: append-shaped workloads drain the
         # rightmost leaf at ~(free slots + 1) keys per round (the same
@@ -1811,6 +1894,34 @@ class BatchedEngine:
             done = (status == ST_APPLIED) | (status == ST_SUPERSEDED)
             pending[idx[done]] = False
 
+            # Bounded lock retry with backoff (data-plane failure story):
+            # a row blocked on a HELD page lock for lock_retry_rounds
+            # consecutive rounds triggers a lease probe — a DEAD holder
+            # (client died mid-critical-section) is revoked and the row
+            # retries fresh; a LIVE holder is normal contention and
+            # keeps retrying (with host-side backoff) through the round
+            # budget.  Rows still lock-blocked when the budget runs out
+            # get the typed ST_LOCK_TIMEOUT rejection below instead of
+            # the host path's unbounded spin.
+            lr = status == ST_LOCKED
+            locked_rounds[idx[lr]] += 1
+            locked_rounds[idx[~lr]] = 0
+            probe = np.zeros(n, bool)
+            probe[idx] = lr & (locked_rounds[idx]
+                               % self.tcfg.lock_retry_rounds == 0)
+            if probe.any():
+                live = self._recover_wedged_locks(keys[probe])
+                # reset ONLY rows whose lock was dead (now revoked) or
+                # already freed — a live-blocked row must keep its
+                # counter so budget exhaustion still rejects it typed
+                rows_p = np.nonzero(probe)[0]
+                locked_rounds[rows_p[~live]] = 0
+            if lr.any():
+                # brief host-side backoff before re-spinning on held
+                # locks (doubles per consecutive blocked round, capped)
+                _t.sleep(min(2e-4 * (1 << min(int(locked_rounds.max()),
+                                              6)), 2e-2))
+
             # ST_FULL keys retry with fresh-page grants: the next round
             # splits their leaves on-device.  ST_BAD shouldn't happen but
             # is retried via host for robustness.
@@ -1828,10 +1939,56 @@ class BatchedEngine:
             fresh_np = self._fill_fresh(
                 bool(((status == ST_FULL) | (status == ST_RETRY)).any()))
             stalled = stalled + 1 if int(pending.sum()) == n_before else 0
+        # Round budget exhausted.  Rows that ended it still blocked on a
+        # page lock held by a LIVE lease get the typed ST_LOCK_TIMEOUT
+        # rejection: handing them to the host path would trade a bounded
+        # budget for an unbounded spin on a holder that never drained
+        # (dead leases were revoked by the probes above, and one final
+        # probe here catches a holder that died after the last round).
+        still = np.nonzero(pending)[0]
+        blocked = still[locked_rounds[still] > 0]
+        if blocked.size:
+            live_mask = self._recover_wedged_locks(keys[blocked])
+            to = blocked[live_mask]
+            if to.size:
+                stats["lock_timeouts"] += int(to.size)
+                stats["lock_timeout_keys"] += [int(k) for k in keys[to]]
+                pending[to] = False
+                _OBS_LOCK_TIMEOUTS.inc(int(to.size))
         # anything still pending after max_rounds: host path
         for j in np.nonzero(pending)[0]:
             self.tree.insert(int(keys[j]), int(values[j]))
             stats["host_path"] += 1
+
+    def _recover_wedged_locks(self, keys: np.ndarray) -> np.ndarray:
+        """Lock-lease recovery for keys blocked on held page locks:
+        resolve each key's leaf with one device descent, read the
+        leaves' global lock words in one step, and revoke every holder
+        whose lease is DEAD — delegated per word to
+        ``Tree._try_revoke_lease``, the single revocation policy (lease
+        decode, epoch-table liveness, masked CAS, lease.* counters).
+        -> live_mask [bool, aligned with keys]: True where the lock is
+        held by a LIVE lease (legit contention or a stuck-but-alive
+        peer — never revoked here).  Rides ``host_dsm``, so it is
+        collective-safe: in multihost mode every process calls with the
+        identical replicated key set and the revocation executes once
+        cluster-wide."""
+        tree = self.tree
+        keys = np.asarray(keys, np.uint64)
+        addrs, done = self._descend_to_level(keys, 0)
+        la_by_key = np.array(
+            [tree._lock_word_addr(int(a)) if d else -1
+             for a, d in zip(addrs, done)], np.int64)
+        las = sorted({int(la) for la in la_by_key if la != -1})
+        if not las:
+            return np.zeros(keys.shape[0], bool)
+        rep = self.dsm._batch(
+            [{"op": D.OP_READ_WORD, "addr": la, "woff": 0,
+              "space": D.SPACE_LOCK} for la in las])
+        live_las = {la for la, w in zip(las, rep.old)
+                    if int(w) != 0
+                    and not tree._try_revoke_lease(la, int(w))}
+        return np.array([int(la) in live_las for la in la_by_key])
 
     def reclaim_empty_leaves(self, quarantine_rounds: int = 2) -> dict:
         """Unlink EMPTY leaves from the B-link chain and recycle their
@@ -1886,6 +2043,7 @@ class BatchedEngine:
 
         Returns {"unlinked", "freed", "quarantined", "candidates"}.
         """
+        self._require_writable()  # reclaim rewrites pages: not degraded
         # replicated-collective contract (multihost): identical call
         # sites + identical args on every process, pinned by the same
         # digest check the other engine drivers use.  The engine-local
@@ -2003,7 +2161,7 @@ class BatchedEngine:
             base[E] = len(rows)
             for w in words:
                 rows.append({"op": D.OP_CAS, "addr": w, "woff": 0,
-                             "arg0": 0, "arg1": tree.ctx.tag,
+                             "arg0": 0, "arg1": tree.ctx.lease,
                              "space": D.SPACE_LOCK})
             rows.append({"op": D.OP_READ, "addr": L})
             rows.append({"op": D.OP_READ, "addr": E})
@@ -2122,7 +2280,7 @@ class BatchedEngine:
         rows = []
         for pa, la, _items in plan:
             rows.append({"op": D.OP_CAS, "addr": la, "woff": 0, "arg0": 0,
-                         "arg1": tree.ctx.tag, "space": D.SPACE_LOCK})
+                         "arg1": tree.ctx.lease, "space": D.SPACE_LOCK})
             rows.append({"op": D.OP_READ, "addr": pa})
         rep = dsm._batch(rows) if rows else None
         out_rows = []
@@ -2211,6 +2369,7 @@ class BatchedEngine:
     def delete(self, keys, max_rounds: int | None = None) -> np.ndarray:
         """Batched delete (``Tree::del`` parity).  Returns found bool [n]
         (True where the key existed and was removed)."""
+        self._require_writable()
         if max_rounds is None:
             max_rounds = self.tcfg.insert_rounds
         keys = np.asarray(keys, np.uint64)

@@ -33,6 +33,11 @@ Reference options deliberately NOT carried over (``Common.h:19-23``):
   a read returns one pre-step snapshot — so the CRC's failure mode
   cannot occur; the front/rear page versions and per-entry version
   pairs are kept for protocol parity and cross-step interleavings.
+  They now also EARN their keep: the online scrubber
+  (``models/scrub.py``) treats a torn front/rear pair or a torn
+  per-entry pair as corruption (unreachable by legal step-atomic
+  writes) — the CRC's detection role, served by the version protocol,
+  and provable end-to-end with chaos injection (``sherman_tpu/chaos``).
 - ``CONFIG_ENABLE_EMBEDDING_LOCK`` (lock word inside the page): an
   alternative to the on-chip lock table.  The separate per-node lock
   space IS the on-chip table analogue and composes with coalesced
@@ -53,6 +58,12 @@ from sherman_tpu.parallel import dsm as D
 
 META_ADDR = bits.make_addr(0, 0)
 LOCK_SPIN_LIMIT = 1_000_000  # deadlock reporter threshold (Tree.cpp:219-227)
+# Failed spins on a HELD lock before the spin loop consults the lease
+# table about the holder (a host-local dict lookup, no extra DSM op) and
+# revokes a dead owner's lock by masked CAS.  Small so a wedged lock
+# (client died mid-critical-section) resolves in a handful of steps; the
+# reporter threshold above still bounds the wait on a LIVE holder.
+LEASE_PROBE_SPINS = 4
 
 # Index-cache effectiveness counters (the reference counts cache
 # hit/miss rates by hand in its benchmark threads; here they ride the
@@ -62,6 +73,14 @@ _OBS_CACHE_MISSES = obs.counter("btree.cache_misses")
 _OBS_CACHE_INVALIDATIONS = obs.counter("btree.cache_invalidations")
 _OBS_SIBLING_CHASES = obs.counter("btree.sibling_chases")
 _OBS_ROOT_REFRESHES = obs.counter("btree.root_refreshes")
+
+# Lock-lease recovery counters (data-plane failure story): revocations
+# of dead holders' locks, lost revocation races (another client got
+# there first, or the holder moved), and deadlock reports on live
+# holders.
+_OBS_LEASE_REVOKED = obs.counter("lease.revoked")
+_OBS_LEASE_REVOKE_LOST = obs.counter("lease.revoke_lost")
+_OBS_DEADLOCK_REPORTS = obs.counter("lease.deadlock_reports")
 
 
 class Tree:
@@ -87,6 +106,13 @@ class Tree:
         self._llocks = cluster.local_locks
         self._lheld: dict[int, int] = {}   # lock addr -> local table index
         self._lpass: dict[int, bool] = {}  # lock addr -> handover decision
+        # Injectable deadlock-reporter threshold (Tree.cpp:219-227 kept
+        # the 10^6 constant unreachable in tests; SHERMAN_LOCK_SPIN_LIMIT
+        # or a direct attribute write makes the path testable and lets
+        # latency-sensitive deployments bound the wait).
+        import os
+        self.lock_spin_limit = int(
+            os.environ.get("SHERMAN_LOCK_SPIN_LIMIT", LOCK_SPIN_LIMIT))
 
         # Adopt an existing root if one is installed; otherwise construct an
         # empty root leaf and CAS-install it (one winner across the cluster,
@@ -164,21 +190,56 @@ class Tree:
             self._abort_local(la)
         self._lpass.clear()
 
+    def _try_revoke_lease(self, la: int, observed: int) -> bool:
+        """Lock-lease recovery (the FUSEE-style repairable-metadata
+        shape): if the observed holder of lock word ``la`` is DEAD per
+        the cluster's epoch table, revoke its lock with a masked CAS on
+        the lease fields and return True (caller retries acquisition
+        immediately).  A LIVE holder returns False — the caller keeps
+        spinning toward the deadlock reporter.  Sound because DSM steps
+        are atomic: a dead client's protected write either landed whole
+        or not at all, so freeing its lock never exposes a torn page."""
+        owner = bits.lease_owner(observed)
+        if owner == 0:
+            return True  # freed between CAS and probe: just retry
+        if self.cluster.lease_is_live(owner, bits.lease_epoch(observed)):
+            return False
+        _, won = self.dsm.masked_cas(la, 0, observed, 0, bits.LEASE_MASK,
+                                     space=D.SPACE_LOCK)
+        (_OBS_LEASE_REVOKED if won else _OBS_LEASE_REVOKE_LOST).inc()
+        return True  # lost race = someone else revoked/acquired: retry
+
+    def _deadlock_report(self, la: int, old: int) -> RuntimeError:
+        """The reporter (Tree.cpp:219-227), now lease-aware: names the
+        lock word, the holder's tag/epoch, and whether its lease is
+        live (a dead lease reaching here means revocation kept losing
+        races — diagnosable, not silent)."""
+        _OBS_DEADLOCK_REPORTS.inc()
+        owner = bits.lease_owner(old)
+        live = self.cluster.lease_is_live(owner, bits.lease_epoch(old))
+        verdict = ("live lease; not revocable" if live
+                   else "dead lease; revocation kept losing")
+        return RuntimeError(
+            f"possible deadlock on lock {la:#x}: holder tag {owner} "
+            f"epoch {bits.lease_epoch(old)} ({verdict}) after "
+            f"{self.lock_spin_limit} spins")
+
     def _lock(self, page_addr: int) -> int:
         la = self._lock_word_addr(page_addr)
         if self._acquire_local(la):
             return la
         spins = 0
         while True:
-            old, ok = self.dsm.cas(la, 0, 0, self.ctx.tag,
+            old, ok = self.dsm.cas(la, 0, 0, self.ctx.lease,
                                    space=D.SPACE_LOCK)
             if ok:
                 return la
             spins += 1
-            if spins > LOCK_SPIN_LIMIT:
+            if spins >= LEASE_PROBE_SPINS:
+                self._try_revoke_lease(la, old)  # dead holder -> freed
+            if spins > self.lock_spin_limit:
                 self._abort_local(la)
-                raise RuntimeError(
-                    f"possible deadlock on lock {la:#x}: holder tag {old}")
+                raise self._deadlock_report(la, old)
 
     def _lock_and_read(self, page_addr: int) -> tuple[int, np.ndarray]:
         """Acquire the page's global lock and fetch the page in ONE step —
@@ -194,15 +255,16 @@ class Tree:
             return la, self.dsm.read_page(page_addr)
         spins = 0
         while True:
-            old, ok, pg = self.dsm.cas_read(la, 0, 0, self.ctx.tag,
+            old, ok, pg = self.dsm.cas_read(la, 0, 0, self.ctx.lease,
                                             page_addr)
             if ok:
                 return la, pg
             spins += 1
-            if spins > LOCK_SPIN_LIMIT:
+            if spins >= LEASE_PROBE_SPINS:
+                self._try_revoke_lease(la, old)  # dead holder -> freed
+            if spins > self.lock_spin_limit:
                 self._abort_local(la)
-                raise RuntimeError(
-                    f"possible deadlock on lock {la:#x}: holder tag {old}")
+                raise self._deadlock_report(la, old)
 
     def _unlock_row(self, lock_addr: int) -> dict:
         """Raw global-unlock request row (no local tier involvement)."""

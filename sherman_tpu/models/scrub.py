@@ -1,0 +1,153 @@
+"""Online scrubber — periodic O(1)-step integrity pass + quarantine.
+
+The reference's only structural check is an offline host walk
+(``print_and_check_tree``); ours has the one-step device validator
+(``models/validate.py``).  This module makes a SERVING-TIME tool of it:
+a :class:`Scrubber` runs the validator's per-page local predicates
+(``validate._scrub_kernel`` — the same code the full check uses) over
+the live pool between engine steps, publishes ``scrub.*`` metrics, and
+acts on what it finds:
+
+- every violating page is **quarantined**: its global lock word is
+  taken with the scrubber's own (live) lease, so no writer can touch
+  the page — device inserts report the typed lock-timeout status, host
+  writers hit the deadlock reporter — while reads keep flowing;
+- a **structural** violation (torn page version pair, broken fence,
+  unsorted internal page, broken B-link — ``validate.SCRUB_STRUCTURAL``)
+  means the page cannot be trusted as a unit: the engine flips to
+  read-only degraded mode (:meth:`BatchedEngine.enter_degraded`);
+- a quarantine that cannot be taken (the lock is held by a live lease
+  that never drains) is a containment failure: degrade as well.
+
+Entry-level violations (a torn fver/rver slot, an out-of-fence slot)
+stay contained: the page is fenced off from writers and counted, the
+engine keeps serving.  The documented exit from degraded mode is
+``utils.checkpoint.restore`` + re-validate — ``tools/chaos_drill.py``
+runs the whole inject -> detect -> recover -> re-validate sequence.
+
+Metrics: ``scrub.passes``, ``scrub.pages_checked``,
+``scrub.violations`` (counters), ``scrub.quarantined`` (gauge).
+"""
+
+from __future__ import annotations
+
+from sherman_tpu import obs
+from sherman_tpu.models.validate import (SCRUB_BITS, SCRUB_STRUCTURAL,
+                                         scrub_pass)
+from sherman_tpu.parallel import dsm as D
+
+_OBS_PASSES = obs.counter("scrub.passes")
+_OBS_CHECKED = obs.counter("scrub.pages_checked")
+_OBS_VIOLATIONS = obs.counter("scrub.violations")
+_OBS_QUARANTINED = obs.gauge("scrub.quarantined")
+
+# CAS attempts to take a violating page's lock word before treating the
+# quarantine as failed (a legitimately held lock drains within a step
+# or two; a wedged-by-live-holder word never does)
+_QUARANTINE_TRIES = 8
+
+
+class Scrubber:
+    """Periodic data-plane integrity scrubbing over a BatchedEngine.
+
+    Drivers call :meth:`tick` between engine steps (every call is a
+    counter bump; every ``interval``-th runs a pass) or :meth:`scrub`
+    directly.  Registers its own client context so its quarantine
+    leases are LIVE — lock-lease recovery will never revoke a
+    quarantine.  Collective in multihost deployments (same contract as
+    ``check_structure_device``: every process calls together).
+    """
+
+    def __init__(self, engine, interval: int = 64,
+                 quarantine: bool = True):
+        self.eng = engine
+        self.tree = engine.tree
+        self.interval = max(1, int(interval))
+        self.quarantine = quarantine
+        self.ctx = self.tree.cluster.register_client(replicated=True)
+        self._ticks = 0
+        # addr -> violation mask for every page ever flagged; lock words
+        # this scrubber holds (quarantines) are tracked separately since
+        # two pages can hash onto one word
+        self.flagged: dict[int, int] = {}
+        self._held_words: set[int] = set()
+
+    # -- driving --------------------------------------------------------------
+
+    def tick(self) -> dict | None:
+        """Call between engine steps; runs a pass every ``interval``
+        calls.  Returns the pass result when one ran."""
+        self._ticks += 1
+        if self._ticks % self.interval == 0:
+            return self.scrub()
+        return None
+
+    def scrub(self) -> dict:
+        """One pass: check, count, quarantine new violations, degrade
+        on structural damage or containment failure."""
+        with obs.span("scrub.pass"):
+            res = scrub_pass(self.tree)
+        _OBS_PASSES.inc()
+        _OBS_CHECKED.inc(res["pages_checked"])
+        # "new" = pages with violation BITS not seen before, so a page
+        # first flagged entry-level (contained) still escalates when a
+        # structural class appears on it later
+        new = [(a, mk) for a, mk in res["bad"]
+               if mk & ~self.flagged.get(a, 0)]
+        _OBS_VIOLATIONS.inc(len(new))
+        for addr, mask in new:
+            self.flagged[addr] = self.flagged.get(addr, 0) | mask
+            contained = self._quarantine_page(addr) if self.quarantine \
+                else False
+            if mask & SCRUB_STRUCTURAL:
+                self.eng.enter_degraded(
+                    f"scrub: structural violation on page {addr:#x} "
+                    f"(mask {self._mask_names(mask)})")
+            elif self.quarantine and not contained:
+                self.eng.enter_degraded(
+                    f"scrub: page {addr:#x} violated "
+                    f"({self._mask_names(mask)}) and quarantine could "
+                    "not take its lock")
+        _OBS_QUARANTINED.set(len(self._held_words))
+        res["new_violations"] = len(new)
+        res["quarantined"] = len(self._held_words)
+        res["degraded"] = self.eng.degraded
+        return res
+
+    # -- quarantine -----------------------------------------------------------
+
+    def _quarantine_page(self, addr: int) -> bool:
+        """Fence writers off a violating page by holding its global
+        lock word under the scrubber's live lease.  True when the word
+        is held (newly, or already ours via a hash-sharing page)."""
+        la = self.tree._lock_word_addr(addr)
+        if la in self._held_words:
+            return True
+        for _ in range(_QUARANTINE_TRIES):
+            old, won = self.tree.dsm.cas(la, 0, 0, self.ctx.lease,
+                                         space=D.SPACE_LOCK)
+            if won or old == self.ctx.lease:
+                self._held_words.add(la)
+                obs.counter("scrub.pages_quarantined").inc()
+                return True
+            # a DEAD holder (e.g. the same fault storm that corrupted
+            # the page wedged its lock) is revoked, then retaken
+            self.tree._try_revoke_lease(la, old)
+        return False
+
+    def release_quarantine(self) -> int:
+        """Drop every quarantine lock (after repair + re-validation
+        only — the drill's post-restore path).  Returns words freed."""
+        n = 0
+        for la in sorted(self._held_words):
+            self.tree.dsm.write_word(la, 0, 0, space=D.SPACE_LOCK)
+            n += 1
+        self._held_words.clear()
+        self.flagged.clear()
+        _OBS_QUARANTINED.set(0)
+        return n
+
+    @staticmethod
+    def _mask_names(mask: int) -> str:
+        return "|".join(n for n, b in SCRUB_BITS.items() if mask & b) \
+            or hex(mask)

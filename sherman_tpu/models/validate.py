@@ -50,13 +50,18 @@ from sherman_tpu import config as C
 from sherman_tpu.ops import bits, layout
 
 _STATS = ("keys", "leaves", "internal_pages", "retired", "bad_version",
-          "bad_fence", "bad_leaf_slot", "bad_internal_order",
-          "bad_sibling", "heads", "bad_head", "tails", "bad_tail",
-          "multi_indegree", "bad_leftmost", "bad_child")
+          "bad_fence", "bad_leaf_slot", "bad_torn_slot",
+          "bad_internal_order", "bad_sibling", "heads", "bad_head",
+          "tails", "bad_tail", "multi_indegree", "bad_leftmost",
+          "bad_child")
 
 
-@functools.partial(jax.jit, static_argnames=("P", "N"))
-def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
+def _local_invariants(pool, next_by_node, P: int, N: int) -> dict:
+    """Per-page LOCAL invariant predicates over the whole pool — the
+    shared core of the full validator below and the online scrubber's
+    per-row fault masks (``_scrub_kernel``).  Every mask is [rows]
+    (or [rows, CAP] for the slot/entry matrices); trace-time only.
+    """
     import jax.numpy as jnp
 
     rows = N * P
@@ -86,7 +91,11 @@ def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
     # cannot exist
     bad_fence = act & ~bits.key_lt(lo_hi, lo_lo, hi_hi, hi_lo)
 
-    # -- 2. leaf slots inside fences + key count -----------------------------
+    # leaf slots: liveness, fence containment, and the TORN pair class.
+    # ver_pack writes both halves of the packed fver/rver pair equal in
+    # one atomic step, so fver != rver is unreachable by legal writes —
+    # any occurrence is corruption (the failure class CONFIG_ENABLE_CRC
+    # guards in the reference; here the scrubber's bread and butter).
     LC = C.LEAF_CAP
     sfv, srv = layout.ver_unpack(pool[:, C.L_VER_W:C.L_VER_W + LC])
     skh = pool[:, C.L_KHI_W:C.L_KHI_W + LC]
@@ -95,10 +104,10 @@ def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
     in_f = (bits.key_le(lo_hi[:, None], lo_lo[:, None], skh, skl)
             & bits.key_lt(skh, skl, hi_hi[:, None], hi_lo[:, None]))
     leaf_slots = leaf[:, None] & s_live
-    bad_slot = (leaf_slots & ~in_f).sum()
-    n_keys = leaf_slots.sum()
+    bad_slot_rows = (leaf_slots & ~in_f).sum(axis=-1)
+    torn_slot_rows = (leaf[:, None] & (sfv != srv)).sum(axis=-1)
 
-    # -- 3. internal entries strictly ascending ------------------------------
+    # internal entries strictly ascending
     IC = C.INTERNAL_CAP
     ikh = pool[:, C.I_KHI_W:C.I_KHI_W + IC]
     ikl = pool[:, C.I_KLO_W:C.I_KLO_W + IC]
@@ -106,9 +115,9 @@ def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
     pos = jnp.arange(IC, dtype=jnp.int32)
     asc = bits.key_lt(ikh[:, :-1], ikl[:, :-1], ikh[:, 1:], ikl[:, 1:])
     pair_valid = internal[:, None] & (pos[None, 1:] < nk[:, None])
-    bad_order = (pair_valid & ~asc).sum()
+    bad_order_rows = (pair_valid & ~asc).sum(axis=-1)
 
-    # -- addr -> pool row (single-word gathers only) -------------------------
+    # addr -> pool row (single-word gathers only)
     def rows_of(addr):
         u = addr.astype(jnp.uint32)
         node = (u >> C.ADDR_PAGE_BITS).astype(jnp.int32)
@@ -118,16 +127,46 @@ def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
         ok = (addr != 0) & (node < N) & (page < P)
         return jnp.clip(node * P + page, 0, rows - 1), ok
 
-    def is_act(rowv):  # target-page liveness (act recomputed by gather)
-        return act[rowv]
-
-    # -- 4. B-link continuity per link ---------------------------------------
+    # B-link continuity per link
     sib = col(C.W_SIBLING)
     srow, s_in_range = rows_of(sib)
     has_sib = act & (sib != 0)
     bad_sib = has_sib & (
-        ~s_in_range | ~is_act(srow) | (lvl[srow] != lvl)
+        ~s_in_range | ~act[srow] | (lvl[srow] != lvl)
         | (lo_hi[srow] != hi_hi) | (lo_lo[srow] != hi_lo))
+
+    return dict(rows=rows, act=act, retired=retired, leaf=leaf,
+                internal=internal, lvl=lvl, sib=sib, srow=srow,
+                lo_hi=lo_hi, lo_lo=lo_lo, hi_hi=hi_hi, hi_lo=hi_lo,
+                bad_ver=bad_ver, bad_fence=bad_fence,
+                leaf_slots=leaf_slots, bad_slot_rows=bad_slot_rows,
+                torn_slot_rows=torn_slot_rows,
+                bad_order_rows=bad_order_rows, bad_sib=bad_sib,
+                has_sib=has_sib, ikh=ikh, ikl=ikl, nk=nk, pos=pos,
+                rows_of=rows_of)
+
+
+@functools.partial(jax.jit, static_argnames=("P", "N"))
+def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
+    import jax.numpy as jnp
+
+    m = _local_invariants(pool, next_by_node, P, N)
+    rows = m["rows"]
+    act, retired = m["act"], m["retired"]
+    leaf, internal, lvl = m["leaf"], m["internal"], m["lvl"]
+    lo_hi, lo_lo = m["lo_hi"], m["lo_lo"]
+    hi_hi, hi_lo = m["hi_hi"], m["hi_lo"]
+    bad_ver, bad_fence, bad_sib = m["bad_ver"], m["bad_fence"], m["bad_sib"]
+    ikh, ikl, nk, pos = m["ikh"], m["ikl"], m["nk"], m["pos"]
+    rows_of, srow, has_sib = m["rows_of"], m["srow"], m["has_sib"]
+    sib = m["sib"]
+    bad_slot = m["bad_slot_rows"].sum()
+    torn_slot = m["torn_slot_rows"].sum()
+    bad_order = m["bad_order_rows"].sum()
+    n_keys = m["leaf_slots"].sum()
+
+    def is_act(rowv):  # target-page liveness (act recomputed by gather)
+        return act[rowv]
 
     # -- 5. leaf-chain shape via in-degrees ----------------------------------
     link_src = leaf & has_sib
@@ -141,7 +180,8 @@ def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
     multi_in = leaf & (indeg > 1)
 
     # -- 6. parent/child coherence -------------------------------------------
-    lm = col(C.W_LEFTMOST)
+    IC = C.INTERNAL_CAP
+    lm = pool[:, C.W_LEFTMOST]
     lmrow, lm_ok = rows_of(lm)
     # a PARKED page — retired (zero high fence) but still this parent's
     # leftmost child — is legal: reclaim cannot drop a leftmost pointer
@@ -179,10 +219,89 @@ def _validate_kernel(pool, next_by_node, freed, P: int, N: int):
         n_keys.astype(jnp.int32),
         leaf.sum(), internal.sum(), retired.sum(), bad_ver.sum(),
         bad_fence.sum(), bad_slot.astype(jnp.int32),
+        torn_slot.astype(jnp.int32),
         bad_order.astype(jnp.int32),
         bad_sib.sum(), heads.sum(), bad_head.sum(),
         tails.sum(), bad_tail.sum(), multi_in.sum(), bad_lm.sum(),
         bad_child.sum()])
+
+
+# ---------------------------------------------------------------------------
+# Online scrubbing: the per-page fault-mask view of the local invariants.
+# ---------------------------------------------------------------------------
+
+# violation classes, one bit each, in the per-page mask _scrub_kernel
+# emits.  STRUCTURAL classes mean the page cannot be trusted as a unit
+# (the scrubber degrades the engine); entry-level classes (torn /
+# out-of-fence slots) are contained by quarantining the page.
+SCRUB_BITS = {
+    "bad_version": 1,
+    "bad_fence": 2,
+    "bad_leaf_slot": 4,
+    "torn_slot": 8,
+    "bad_internal_order": 16,
+    "bad_sibling": 32,
+}
+SCRUB_STRUCTURAL = (SCRUB_BITS["bad_version"] | SCRUB_BITS["bad_fence"]
+                    | SCRUB_BITS["bad_internal_order"]
+                    | SCRUB_BITS["bad_sibling"])
+
+
+@functools.partial(jax.jit, static_argnames=("P", "N"))
+def _scrub_kernel(pool, next_by_node, P: int, N: int):
+    """Per-page violation bitmask over the live pool — the SAME local
+    predicates as the full validator (``_local_invariants``), reduced
+    per row instead of globally, so the scrubber can QUARANTINE the
+    specific violating pages.  One jitted step at any scale."""
+    import jax.numpy as jnp
+
+    m = _local_invariants(pool, next_by_node, P, N)
+    z = jnp.int32(0)
+    mask = (
+        jnp.where(m["bad_ver"], jnp.int32(SCRUB_BITS["bad_version"]), z)
+        | jnp.where(m["bad_fence"], jnp.int32(SCRUB_BITS["bad_fence"]), z)
+        | jnp.where(m["bad_slot_rows"] > 0,
+                    jnp.int32(SCRUB_BITS["bad_leaf_slot"]), z)
+        | jnp.where(m["torn_slot_rows"] > 0,
+                    jnp.int32(SCRUB_BITS["torn_slot"]), z)
+        | jnp.where(m["bad_order_rows"] > 0,
+                    jnp.int32(SCRUB_BITS["bad_internal_order"]), z)
+        | jnp.where(m["bad_sib"], jnp.int32(SCRUB_BITS["bad_sibling"]), z))
+    return mask, m["act"].sum()
+
+
+def scrub_pass(tree) -> dict:
+    """One online-scrub pass over the live pool: -> {"pages_checked",
+    "violations", "bad": [(addr, mask), ...], "classes": {name: pages}}.
+    Collective in multihost deployments (the jit partitions the sharded
+    pool; every process calls together and computes the same result)."""
+    import jax.numpy as jnp
+
+    cfg = tree.dsm.cfg
+    P = cfg.pages_per_node
+    nxt = np.ones(cfg.machine_nr, np.int64)
+    for d in tree.cluster.directories:
+        nxt[d.node_id] = d.allocator.pages_used
+    mask, checked = _scrub_kernel(tree.dsm.pool,
+                                  jnp.asarray(nxt, jnp.int32),
+                                  P=P, N=cfg.machine_nr)
+    if tree.dsm.multihost:
+        from jax.experimental import multihost_utils as mhu
+        shards = sorted(mask.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards])
+        mask = np.asarray(mhu.process_allgather(local, tiled=True))
+        checked = int(np.asarray(checked))
+    else:
+        mask = np.asarray(mask)
+        checked = int(checked)
+    rows = np.nonzero(mask)[0]
+    bad = [(bits.make_addr(int(r) // P, int(r) % P), int(mask[r]))
+           for r in rows]
+    classes = {name: int(sum(1 for _, mk in bad if mk & bit))
+               for name, bit in SCRUB_BITS.items()}
+    return {"pages_checked": checked, "violations": len(bad),
+            "bad": bad, "classes": classes}
 
 
 @functools.partial(jax.jit, static_argnames=("P", "N"))
@@ -332,9 +451,9 @@ def check_structure_device(tree) -> dict:
         P=P, N=cfg.machine_nr))
     s = dict(zip(_STATS, out.tolist()))
     problems = [f"{k}={s[k]}" for k in (
-        "bad_version", "bad_fence", "bad_leaf_slot", "bad_internal_order",
-        "bad_sibling", "bad_head", "bad_tail", "multi_indegree",
-        "bad_leftmost", "bad_child") if s[k]]
+        "bad_version", "bad_fence", "bad_leaf_slot", "bad_torn_slot",
+        "bad_internal_order", "bad_sibling", "bad_head", "bad_tail",
+        "multi_indegree", "bad_leftmost", "bad_child") if s[k]]
     if s["heads"] != 1:
         problems.append(f"heads={s['heads']} (want exactly 1)")
     if s["tails"] != 1:

@@ -116,6 +116,45 @@ def addr_is_null(addr):
     return addr == 0
 
 
+# -- lock leases --------------------------------------------------------------
+# A held global lock word encodes WHO holds it and under which lease
+# epoch: {epoch:15, owner:16} (bit 31 stays clear so the int32 word is
+# non-negative and mask arithmetic never sees the sign bit).  0 = free.
+# The owner field is the client tag (client_id + 1, nonzero); the epoch
+# is the owner's lease generation in the cluster's epoch table
+# (``Cluster.lease_is_live``).  A holder whose (owner, epoch) no longer
+# matches the table is DEAD — its lock is revocable by masked CAS on
+# exactly these fields (the FUSEE-style lock-lease recovery shape).
+# Step atomicity makes revocation sound: a dead client's protected
+# write either landed as one step or not at all, so freeing its lock
+# can never expose a torn page.
+
+LEASE_OWNER_BITS = 16
+LEASE_EPOCH_BITS = 15
+LEASE_OWNER_MASK = (1 << LEASE_OWNER_BITS) - 1
+LEASE_EPOCH_MASK = (1 << LEASE_EPOCH_BITS) - 1
+# both fields — the bits a lease revocation masked-CAS compares/swaps
+LEASE_MASK = (LEASE_EPOCH_MASK << LEASE_OWNER_BITS) | LEASE_OWNER_MASK
+
+
+def lease_word(owner_tag: int, epoch: int = 1) -> int:
+    """Pack (owner tag, lease epoch) into a held-lock word (int32 >= 0)."""
+    assert 0 < int(owner_tag) <= LEASE_OWNER_MASK, "owner tag out of range"
+    return ((int(epoch) & LEASE_EPOCH_MASK) << LEASE_OWNER_BITS) \
+        | (int(owner_tag) & LEASE_OWNER_MASK)
+
+
+def lease_owner(word: int) -> int:
+    """Owner tag of a held-lock word (0 = free)."""
+    return int(np.int64(int(word)) & _U32_MASK) & LEASE_OWNER_MASK
+
+
+def lease_epoch(word: int) -> int:
+    """Lease epoch of a held-lock word."""
+    return (int(np.int64(int(word)) & _U32_MASK)
+            >> LEASE_OWNER_BITS) & LEASE_EPOCH_MASK
+
+
 # -- lock hash ---------------------------------------------------------------
 # The reference hashes page addresses onto the on-chip lock table with
 # CityHash64 % kNumOfLock (Tree.cpp:702-707,832-842).  We use a 32-bit

@@ -531,6 +531,18 @@ class DSM(_HostOps):
         import threading
         self._step_mutex = threading.Lock()
 
+        # Chaos injection hook (sherman_tpu/chaos.py): a FaultPlan fired
+        # at the host-step boundary.  None (the default) costs one `is
+        # None` test per host step — engine/staged programs are
+        # untouched, so receipts with chaos off are bit-identical to a
+        # build without the subsystem.  Env-drivable: SHERMAN_CHAOS
+        # installs a plan on every DSM at construction.
+        import os as _os
+        self.chaos = None
+        if _os.environ.get("SHERMAN_CHAOS"):
+            from sherman_tpu.chaos import FaultPlan
+            self.chaos = FaultPlan.from_env()
+
         # Observability: expose the device op/byte counters as a pull
         # collector on the process-wide registry — snapshots then carry
         # ``dsm.read_ops`` etc. without any per-op host cost (the
@@ -557,7 +569,17 @@ class DSM(_HostOps):
         """
         _OBS_HOST_STEPS.inc()
         with self._step_mutex:
-            return self._step_locked(reqs)
+            if self.chaos is None:
+                return self._step_locked(reqs)
+            # Fault injection at the step boundary (the single chaos
+            # hook): due faults corrupt pool/lock words or rewrite this
+            # step's requests before it runs; stale_read faults
+            # post-process its replies.  Runs under the step mutex, so
+            # the corruption + step land as one atomic handle swap.
+            reqs0 = reqs
+            reqs, post = self.chaos.on_step(self, reqs)
+            rep = self._step_locked(reqs)
+            return self.chaos.on_replies(self, reqs0, rep) if post else rep
 
     def _step_locked(self, reqs: dict[str, np.ndarray]) -> Replies:
         if self.multihost:
@@ -578,6 +600,12 @@ class DSM(_HostOps):
                    for k, v in rep.items()}
         return Replies(data=np.asarray(rep["data"]), old=np.asarray(rep["old"]),
                        ok=np.asarray(rep["ok"]))
+
+    def install_chaos(self, plan) -> None:
+        """Install (or clear, with ``None``) a chaos
+        :class:`~sherman_tpu.chaos.FaultPlan`; its step indices count
+        host steps from the moment of installation."""
+        self.chaos = plan
 
     # -- host convenience ops (control plane / slow paths / tests) -----------
     # Each builds a small batch and steps once; requests are spread over

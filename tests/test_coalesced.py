@@ -64,8 +64,8 @@ def test_tree_lock_and_read_fused(eight_devices):
     addr, pg, _ = tree._descend(10, 0)
     la, pg2 = tree._lock_and_read(addr)
     np.testing.assert_array_equal(pg, pg2)
-    # lock word is held by our tag until unlock
-    assert tree.dsm.read_word(la, 0, space=D.SPACE_LOCK) == tree.ctx.tag
+    # lock word is held by our lease (owner tag + epoch) until unlock
+    assert tree.dsm.read_word(la, 0, space=D.SPACE_LOCK) == tree.ctx.lease
     tree._unlock(la)
     assert tree.dsm.read_word(la, 0, space=D.SPACE_LOCK) == 0
 

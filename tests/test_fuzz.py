@@ -137,6 +137,8 @@ def test_fuzz_batched_vs_model(eight_devices, seed, key_bits):
             np.testing.assert_array_equal(
                 vs, np.array([model[k] for k in exp], np.uint64))
 
+
+
     # structural invariants after the storm: host walk AND the one-step
     # device validator must agree
     info = tree.check_structure()
@@ -151,3 +153,82 @@ def test_fuzz_batched_vs_model(eight_devices, seed, key_bits):
     assert f.all()
     np.testing.assert_array_equal(
         v, np.array([model[int(k)] for k in all_keys], np.uint64))
+
+
+def test_fuzz_chaos_detection(eight_devices):
+    """Chaos-seeded fuzz: every iteration fires a fresh random
+    FaultPlan (seeded — reruns are bit-identical) into a live tree and
+    asserts DETECTION: pool corruption must show up as scrub
+    violations; writes during the fault window must end in typed
+    outcomes (applied / superseded / host path / lock-timeout /
+    DegradedError) — never a silent wrong answer.  Each iteration then
+    repairs (plan.undo), re-verifies reads against the model, and
+    keeps storming."""
+
+    from sherman_tpu import chaos as CH
+    from sherman_tpu.models.scrub import Scrubber
+    from sherman_tpu.models.validate import check_structure_device
+
+    rng = np.random.default_rng(42)
+    cfg = DSMConfig(machine_nr=4, pages_per_node=2048, locks_per_node=512,
+                    step_capacity=512, chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    from sherman_tpu.config import TreeConfig
+    eng = batched.BatchedEngine(tree, batch_per_node=128,
+                                tcfg=TreeConfig(lock_retry_rounds=2))
+    keyspace = np.unique(rng.integers(1, 1 << 56, 4000, dtype=np.uint64))
+    model: dict[int, int] = {}
+    k0 = keyspace[: keyspace.shape[0] // 2]
+    batched.bulk_load(tree, k0, k0 * np.uint64(3))
+    eng.attach_router()
+    model.update(zip(k0.tolist(), (k0 * np.uint64(3)).tolist()))
+    # detection-focused scrubber: no quarantine locks to unwind after
+    # each repair (the quarantine/degrade path is tests/test_chaos.py)
+    scr = Scrubber(eng, interval=1, quarantine=False)
+
+    for it in range(8):
+        plan = CH.FaultPlan.random(1000 + it, n_faults=2, step_hi=1)
+        cluster.dsm.install_chaos(plan)
+        cluster.dsm.read_word(0, 0)  # one host step fires the plan
+        cluster.dsm.install_chaos(None)
+        corrupting = [f for f in plan.faults
+                      if f.kind in ("torn_page", "flip_entry_ver")]
+        res = scr.scrub()
+        if corrupting:
+            # every pool corruption is DETECTED (violations cover at
+            # least one page; distinct faults may share a victim page)
+            assert res["violations"] >= 1, (it, plan.describe())
+        # writes during the fault window: every op must end in a typed
+        # outcome — applied, superseded, host path, or lock-timeout
+        ks = rng.choice(keyspace, size=100, replace=True)
+        vs = ks ^ np.uint64(it * 31 + 7)
+        try:
+            st = eng.insert(ks, vs)
+        except batched.DegradedError:
+            st = None  # structural corruption degraded the engine: a
+            #            typed rejection, not a silent wrong answer
+        if st is not None:
+            n_uniq_first = np.unique(ks, return_index=True)[1]
+            resolved = (st["applied"] + st["superseded"] + st["host_path"]
+                        + st["lock_timeouts"])
+            assert resolved == ks.size, st
+            timed_out = set(st["lock_timeout_keys"])
+            for i in sorted(n_uniq_first):
+                if int(ks[i]) not in timed_out:
+                    model[int(ks[i])] = int(vs[i])
+        # repair: undo the injected words, clear detection state
+        assert plan.undo(cluster.dsm) >= 0
+        scr.flagged.clear()
+        eng.exit_degraded()
+        # post-repair: reads must match the model exactly again
+        probe = rng.choice(keyspace, size=200, replace=False)
+        v, f = eng.search(probe)
+        exp_f = np.array([int(k) in model for k in probe])
+        np.testing.assert_array_equal(f, exp_f)
+        exp_v = np.array([model.get(int(k), 0) for k in probe], np.uint64)
+        np.testing.assert_array_equal(v[f], exp_v[exp_f])
+
+    assert scr.scrub()["violations"] == 0
+    dev = check_structure_device(tree)
+    assert dev["keys"] == len(model)

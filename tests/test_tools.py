@@ -95,6 +95,19 @@ def test_benchmark_driver_multinode_read_combine(eight_devices, capsys):
     assert "combine" in capsys.readouterr().out
 
 
+def test_chaos_drill_driver(eight_devices, capsys):
+    # the full data-plane drill: inject (wedged locks, torn versions)
+    # -> detect (lease probe, scrub) -> recover (revoke, quarantine,
+    # degrade) -> checkpoint-restore -> re-validate green
+    import chaos_drill
+    r = chaos_drill.main(["--keys", "2500", "--nodes", "4"])
+    assert r["ok"]
+    assert r["host_revoked"] >= 1 and r["engine_revoked"] >= 1
+    assert r["lock_timeouts"] == 4
+    assert r["scrub"]["violations"] >= 1
+    assert "CHAOS-DRILL PASS" in capsys.readouterr().err
+
+
 def test_benchmark_driver_combined_mixed_fanout(eight_devices, capsys):
     # combined 50/50 mix: read answers AND write statuses fan out to
     # every client slot on device inside the timed step
