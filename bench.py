@@ -39,6 +39,20 @@ Environment knobs:
                          on-chip collective dumps the DSM counter
                          snapshot and exits (code 86) instead of
                          hanging the run (utils/failure.py).
+  SHERMAN_GATHER_IMPL    page-engine implementation, "xla" (default) or
+                         "pallas" (ops/pallas_page.py explicit-DMA
+                         kernels; bit-identical results).  Recorded in
+                         the JSON "config" block — impl knobs live in
+                         the artifact, not the log.
+  SHERMAN_BENCH_KERNEL_PHASES  1/0: pallas-vs-xla chained-delta timings
+                         of the page kernels at the end of the run
+                         ("kernel_phase_ms" + kernels.* obs
+                         histograms).  Default on only on TPU (off-TPU
+                         the pallas kernels are interpreted and the A/B
+                         would time the interpreter).
+  SHERMAN_BENCH_KERNEL_ROWS  row count of that kernel A/B (default
+                         2_097_152 — the BENCHMARKS.md phase-table
+                         scale).
 
 ``bench.py --chaos-drill`` runs the data-plane chaos drill
 (tools/chaos_drill.py: fault injection -> lease/scrub detection ->
@@ -86,7 +100,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
 
     from sherman_tpu import obs
     from sherman_tpu.cluster import Cluster
-    from sherman_tpu.config import DSMConfig, LEAF_CAP, TreeConfig
+    from sherman_tpu.config import (DSMConfig, LEAF_CAP, TreeConfig,
+                                    staged_fusion)
     from sherman_tpu.models import batched
     from sherman_tpu.models.btree import Tree
     from sherman_tpu.ops import bits
@@ -99,7 +114,9 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     pages = 1 << max(14, (est_pages - 1).bit_length())
     cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
                     locks_per_node=65_536, step_capacity=batch,
-                    chunk_pages=4096)
+                    chunk_pages=4096,
+                    gather_impl=os.environ.get("SHERMAN_GATHER_IMPL",
+                                               "xla"))
     dev = jax.devices()[0]
     print(f"# device={dev.platform} keys={n_keys} pages={pages} "
           f"batch={batch} theta={theta}", file=sys.stderr)
@@ -808,6 +825,46 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                               sus_mixed_phase_ms.items()),
                   file=sys.stderr)
 
+    # Page-engine kernel phase receipts (the pallas-vs-xla A/B):
+    # chained-delta ms of the three ops/pallas_page kernels vs their
+    # XLA twins, recorded as kernels.*_ms obs histograms + the
+    # kernel_phase_ms JSON block so artifact diffs catch kernel-phase
+    # regressions without re-profiling.  Runs LAST: the write-back
+    # phase scatters random entries into timed pool COPIES (the live
+    # pool handle is untouched), but every correctness receipt above
+    # has already been taken.  Default-on only on TPU — off-TPU the
+    # pallas kernels run INTERPRETED and the A/B would time the
+    # interpreter, not the hardware.
+    kernel_phase_ms = kr = None
+    want_kernels = os.environ.get(
+        "SHERMAN_BENCH_KERNEL_PHASES",
+        "1" if jax.default_backend() == "tpu" else "0") != "0"
+    if want_kernels:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import profile_gather
+        kr = min(int(os.environ.get("SHERMAN_BENCH_KERNEL_ROWS",
+                                    2_097_152)), batch)
+        k_rng = np.random.default_rng(23)
+        k_addr = k_rng.integers(0, tree.dsm.pool.shape[0],
+                                kr).astype(np.int32)
+        k_khi, k_klo = bits.keys_to_pairs(
+            keys[k_rng.integers(0, n_keys, kr)])
+        with obs.span("bench.kernel_phase_attribution", rows=kr,
+                      gather_impl=cfg.gather_impl):
+            kernel_phase_ms = profile_gather.phase_table(
+                tree.dsm.pool, jax.device_put(k_addr, shard),
+                jax.device_put(k_khi, shard),
+                jax.device_put(k_klo, shard), k=phase_k)
+        print("# page-kernel phases (chained-delta, K="
+              f"{phase_k}, {kr} rows): "
+              + "; ".join(
+                  f"{ph} " + ", ".join(f"{im} {ms:.1f} ms"
+                                       for im, ms in by.items()
+                                       if im != "ratio")
+                  for ph, by in kernel_phase_ms.items()),
+              file=sys.stderr)
+
     print(f"# {steps} steps in {elapsed:.2f}s "
           f"({elapsed / steps * 1e3:.2f} ms/step, dev rows/s "
           f"{device_rows_s / 1e6:.1f}M); lat p50 {p50_ms:.2f} ms "
@@ -888,6 +945,27 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # compiled-program structure of the staged step (config.
         # staged_fusion: aligned = serve is the host-staged program)
         "sus_dev_fusion": sus_dev_fusion,
+        # which page-engine implementation served every device step of
+        # this run (DSMConfig.gather_impl — the descent/apply kernels)
+        "sus_dev_gather_impl": cfg.gather_impl,
+        # every impl knob that shaped this run's compiled programs, in
+        # ONE block (round-5 lesson: sampler-mode ambiguity showed impl
+        # knobs must live in the artifact, not the log)
+        "config": {
+            "gather_impl": cfg.gather_impl,
+            "exchange_impl": cfg.exchange_impl,
+            "staged_fusion": staged_fusion(),
+        },
+        # pallas-vs-xla chained-delta ms of the page kernels (None when
+        # the A/B was skipped; also in obs as kernels.*_ms histograms).
+        # kernel_phase_rows records the row count the phases ran at —
+        # SHERMAN_BENCH_KERNEL_ROWS capped by the batch width — so
+        # artifact diffs never compare per-phase ms across row scales.
+        "kernel_phase_ms": {
+            ph: {k2: round(v, 2) for k2, v in by.items()}
+            for ph, by in kernel_phase_ms.items()}
+        if kernel_phase_ms else None,
+        "kernel_phase_rows": kr if kernel_phase_ms else None,
         # per-phase staged-step attribution, chained-delta timed (ms):
         # aligned -> {prep, serve_fanout, verify}; chained -> {prep,
         # serve_fanout_verify}; fused -> {fused_step}.  Phases measure

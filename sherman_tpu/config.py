@@ -116,6 +116,15 @@ class DSMConfig:
     # (default); "pallas" = explicit per-peer one-sided remote-DMA writes
     # (transport_pallas.py — the literal RDMA-verbs analogue).
     exchange_impl: str = "xla"
+    # Page-engine implementation — the HBM<->VMEM half of the explicit-
+    # DMA story (exchange_impl is the inter-chip half): "xla" = native
+    # gather/scatter primitives (default — the measured floors in
+    # BENCHMARKS.md are theirs); "pallas" = the ops/pallas_page.py
+    # kernel suite (fused descent round, multi-lane write-back,
+    # snapshot gather).  Both produce bit-identical pools/results
+    # (CI-pinned); flip per deployment from tools/profile_gather.py
+    # measurements, not belief.
+    gather_impl: str = "xla"
 
     def __post_init__(self):
         assert 1 <= self.machine_nr <= MAX_MACHINE
@@ -129,6 +138,7 @@ class DSMConfig:
             f"pages_per_node={self.pages_per_node} exceeds the 8 GB "
             "per-node pool limit (int32 word indexing); add nodes instead")
         assert self.exchange_impl in ("xla", "pallas")
+        assert self.gather_impl in ("xla", "pallas")
 
 
 # ---------------------------------------------------------------------------

@@ -41,7 +41,7 @@ from sherman_tpu import config as C
 from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig, TreeConfig
 from sherman_tpu.models.btree import META_ADDR
-from sherman_tpu.ops import bits, layout
+from sherman_tpu.ops import bits, layout, pallas_page
 from sherman_tpu.parallel import dsm as D
 from sherman_tpu.parallel import transport
 from sherman_tpu.parallel.mesh import AXIS
@@ -120,18 +120,30 @@ def descend_spmd(pool, counters, khi, klo, root, active, *, cfg: DSMConfig,
         start = jnp.broadcast_to(jnp.asarray(root, jnp.int32), (B,))
     addr = start
     done = ~active
+    # Single-node + gather_impl="pallas": the level's gather + in-page
+    # pick run FUSED in one kernel (the page is searched in VMEM while
+    # the next rows stream in; no [B, PAGE_WORDS] intermediate lands in
+    # HBM between them).  Multi-node descents keep the XLA elementwise
+    # pick after the exchange; their owner-side page reads still go
+    # through the pallas snapshot kernel inside read_pages_spmd.
+    fused = cfg.machine_nr == 1 and pallas_page.use_pallas(cfg)
 
     def advance(addr, done, nreads):
         # exact read accounting (DSM.cpp:17-21 counter semantics): one
         # read op per page actually fetched — the rows still descending
         nreads = nreads + jnp.sum((~done).astype(jnp.uint32))
-        pages, ok = D.read_pages_spmd(pool, addr, cfg=cfg,
-                                      axis_name=axis_name, active=~done)
-        lvl = layout.h_level(pages)
-        chase = layout.needs_sibling_chase(pages, khi, klo)
-        at_leaf = (lvl == stop_level) & ~chase
-        nxt = jnp.where(chase, layout.h_sibling(pages),
-                        layout.internal_pick_child(pages, khi, klo))
+        if fused:
+            nxt, at_leaf, _, ok, _, _, _ = pallas_page.descent_round(
+                pool, addr, khi, klo, ~done, stop_level=stop_level)
+        else:
+            pages, ok = D.read_pages_spmd(pool, addr, cfg=cfg,
+                                          axis_name=axis_name,
+                                          active=~done)
+            lvl = layout.h_level(pages)
+            chase = layout.needs_sibling_chase(pages, khi, klo)
+            at_leaf = (lvl == stop_level) & ~chase
+            nxt = jnp.where(chase, layout.h_sibling(pages),
+                            layout.internal_pick_child(pages, khi, klo))
         step_ok = ok & ~done
         new_addr = jnp.where(step_ok & ~at_leaf, nxt, addr)
         new_done = done | (step_ok & at_leaf)
@@ -245,6 +257,10 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int,
     N = cfg.machine_nr
     S = max(min(1024, B), B // 16)
     max_rounds = iters * 4
+    # gather_impl="pallas" on one node: each round is ONE fused kernel
+    # (page stream + in-VMEM search, ops/pallas_page.descent_round) —
+    # bit-identical outputs to the gather + elementwise composition.
+    fused = N == 1 and pallas_page.use_pallas(cfg)
 
     if N == 1:
         def read(addrs, act, loop: bool):
@@ -271,20 +287,28 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int,
     # round 1: full batch from the cache-seeded start; leaf-only logic
     # (no internal_pick_child on the full batch — stragglers descend in
     # the compacted loop below)
-    pg, ok = read(start, active, False)
-    # NO optimization_barrier here: materializing the [B, PW] round-1
-    # gather costs ~+10 ms at 2 M rows vs letting XLA fuse it into the
-    # chase/level/find consumers (measured; the opposite tradeoff from
-    # the apply path's snapshot)
-    chase = layout.needs_sibling_chase(pg, khi, klo)
-    at_leaf = ok & (layout.h_level(pg) == 0) & ~chase
-    f, vh, vl, _ = layout.leaf_find_key(pg, khi, klo)
+    if fused:
+        # when chase is set the kernel's next address IS the sibling
+        nxt1, leaf1, chase, ok, f, vh, vl = pallas_page.descent_round(
+            pool, start, khi, klo, active)
+        at_leaf = ok & leaf1
+        sib1 = nxt1
+    else:
+        pg, ok = read(start, active, False)
+        # NO optimization_barrier here: materializing the [B, PW] round-1
+        # gather costs ~+10 ms at 2 M rows vs letting XLA fuse it into the
+        # chase/level/find consumers (measured; the opposite tradeoff from
+        # the apply path's snapshot)
+        chase = layout.needs_sibling_chase(pg, khi, klo)
+        at_leaf = ok & (layout.h_level(pg) == 0) & ~chase
+        f, vh, vl, _ = layout.leaf_find_key(pg, khi, klo)
+        sib1 = layout.h_sibling(pg)
     hit = active & at_leaf
     done = ~active | at_leaf
     found = hit & f
     vhi = jnp.where(found, vh, 0)
     vlo = jnp.where(found, vl, 0)
-    addr = jnp.where(ok & chase, layout.h_sibling(pg), start)
+    addr = jnp.where(ok & chase, sib1, start)
 
     # one-time compaction; fill rows (sidx == B) start done
     sidx = jnp.nonzero(~done, size=S, fill_value=B)[0].astype(jnp.int32)
@@ -313,9 +337,14 @@ def _routed_resolve(pool, counters, khi, klo, active, start, *, iters: int,
     def body(st):
         it, s_done, s_addr, s_f, s_vh, s_vl, loop_reads, _ = st
         loop_reads = loop_reads + jnp.sum((~s_done).astype(jnp.uint32))
-        pg, ok = read(s_addr, ~s_done, True)
-        ok = ok & ~s_done
-        at_leaf, nxt, f, vh, vl = advance(pg, ok, s_kh, s_kl)
+        if fused:
+            nxt, leafb, _, ok, f, vh, vl = pallas_page.descent_round(
+                pool, s_addr, s_kh, s_kl, ~s_done)
+            at_leaf = ok & leafb
+        else:
+            pg, ok = read(s_addr, ~s_done, True)
+            ok = ok & ~s_done
+            at_leaf, nxt, f, vh, vl = advance(pg, ok, s_kh, s_kl)
         fin = ok & at_leaf
         s_f = jnp.where(fin, f, s_f)
         s_vh = jnp.where(fin & f, vh, s_vh)
@@ -416,7 +445,13 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     # the full per-row latency again).  Reusing the descent's round-1
     # pages here instead was measured SLOWER (+24 ms at 2 M rows — the
     # materialized [B, PW] hint buffer costs more than the re-gather).
-    pg = lax.optimization_barrier(pool[safe_page])         # [M, PW] snapshot
+    # gather_impl="pallas": the explicit-DMA snapshot kernel's output IS
+    # the materialized buffer — no barrier needed.
+    use_pk = pallas_page.use_pallas(cfg)
+    if use_pk:
+        pg = pallas_page.gather_pages(pool, safe_page)     # [M, PW] snapshot
+    else:
+        pg = lax.optimization_barrier(pool[safe_page])     # [M, PW] snapshot
 
     lock_idx = bits.lock_index(inc["addr"], cfg.locks_per_node)
     locked = locks[jnp.clip(lock_idx, 0, L - 1)] != 0
@@ -568,19 +603,20 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     if update_only:
         ent = jnp.stack([new_pair, inc["vhi"], inc["vlo"]],
                         axis=-1)                           # [M, 3]
-        field_w = jnp.asarray([C.L_VER_W, C.L_VHI_W, C.L_VLO_W],
-                              jnp.int32)
+        lanes = (C.L_VER_W, C.L_VHI_W, C.L_VLO_W)
     else:
         ent = jnp.stack([new_pair, khi, klo, inc["vhi"], inc["vlo"]],
                         axis=-1)                           # [M, 5]
-        field_w = jnp.asarray([C.L_VER_W, C.L_KHI_W, C.L_KLO_W,
-                               C.L_VHI_W, C.L_VLO_W],
-                              jnp.int32)
-    idx = (safe_page * _PW)[:, None] + field_w[None, :] + slot[:, None]
-    idx = jnp.where(applied[:, None], idx, P * _PW)
-    flat = pool.reshape(-1)
-    flat = flat.at[idx.reshape(-1)].set(ent.reshape(-1), mode="drop")
-    pool = flat.reshape(P, _PW)
+        lanes = (C.L_VER_W, C.L_KHI_W, C.L_KLO_W, C.L_VHI_W, C.L_VLO_W)
+    if use_pk:
+        # all lanes ride ONE kernel pass (per-row doorbell batch of
+        # single-word DMAs) instead of one full-batch scatter per lane
+        pool = pallas_page.writeback(pool, safe_page, slot, applied,
+                                     ent, lanes)
+    else:
+        # the twin the parity fuzz pins IS the served path
+        pool = pallas_page.writeback_xla(pool, safe_page, slot, applied,
+                                         ent, lanes)
 
     # --- device-side splits (consume the POST-apply page) ------------------
     if fresh is not None:
@@ -827,7 +863,11 @@ def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     khi, klo = inc["khi"], inc["klo"]
     page_idx = bits.addr_page(inc["addr"])
     safe_page = jnp.clip(page_idx, 0, P - 1)
-    pg = lax.optimization_barrier(pool[safe_page])  # one gather, many uses
+    use_pk = pallas_page.use_pallas(cfg)
+    if use_pk:
+        pg = pallas_page.gather_pages(pool, safe_page)  # one gather
+    else:
+        pg = lax.optimization_barrier(pool[safe_page])  # one gather, many uses
 
     lock_idx = bits.lock_index(inc["addr"], cfg.locks_per_node)
     locked = locks[jnp.clip(lock_idx, 0, L - 1)] != 0
@@ -845,11 +885,9 @@ def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
     # free.  Like the insert write-back, page front/rear versions move
     # only on structural rewrites (reference parity: Tree::del writes the
     # entry, not the page header).
-    idx = jnp.where(applied, safe_page * _PW + C.L_VER_W + safe_slot,
-                    P * _PW)
-    flat = pool.reshape(-1)
-    flat = flat.at[idx].set(0, mode="drop")
-    pool = flat.reshape(P, _PW)
+    wb = pallas_page.writeback if use_pk else pallas_page.writeback_xla
+    pool = wb(pool, safe_page, safe_slot, applied,
+              jnp.zeros((M, 1), jnp.int32), (C.L_VER_W,))
 
     status = jnp.full(M, ST_INVALID, jnp.int32)
     status = jnp.where(act, ST_BAD, status)

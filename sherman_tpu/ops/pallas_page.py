@@ -1,0 +1,513 @@
+"""Pallas page-engine kernels: the explicit-DMA data plane for the pool.
+
+The two measured memory floors every published number sits on
+(BENCHMARKS.md phase table) are per-ROW and per-WORD latency floors of
+XLA's gather/scatter primitives:
+
+- the routed-search descent costs ~13-30 ns/row at 2 M rows because each
+  level is "gather [B, PAGE_WORDS] pages to HBM, then elementwise pick"
+  — the page round-trips through HBM between the two halves;
+- the steady-state write-back scatter costs ~13.5 ms per word LANE at
+  2 M rows — each of the update's 3-5 entry words is a separate
+  full-batch scatter pass.
+
+These are the TPU twins of the reference's one-sided READ descent loop
+(``Tree.cpp:429-458``) and single-entry write-back (``Tree.cpp:914-921``);
+the paper wins by making each RDMA op carry exactly the needed bytes.
+This module is the hand-rolled equivalent for the page data plane, the
+HBM<->VMEM complement of :mod:`~sherman_tpu.parallel.transport_pallas`'s
+inter-chip lane:
+
+1. :func:`descent_round` — the FUSED descent round: each row's page is
+   streamed HBM->VMEM with double-buffered ``make_async_copy`` chunks
+   (the next chunk's DMAs fly while the previous chunk's in-page
+   search/child-pick runs on the VPU), and only the next-level address +
+   leaf verdicts leave the kernel — no ``[B, PAGE_WORDS]`` intermediate
+   is materialized in HBM between the gather and the pick.
+2. :func:`writeback` — the multi-lane write-back: all 3-5 word lanes of
+   an applied entry ride ONE kernel pass (per row, the lane writes are
+   posted back-to-back as single-word DMAs — a doorbell batch), so cost
+   stops scaling linearly per lane.
+3. :func:`gather_pages` — the snapshot gather for the apply path's
+   one-page-many-consumers read (``leaf_apply_spmd``'s page snapshot),
+   row DMAs with an ``N_INFLIGHT``-deep ring.
+
+Selection: ``DSMConfig.gather_impl = "xla" | "pallas"`` (mirroring
+``exchange_impl``); wrappers raise :class:`PallasUnavailableError` naming
+the knob when the toolchain is absent.  ``"xla"`` stays the default —
+HISTORY: a round-1 Pallas page-gather kernel measured ~310 ns/row vs
+XLA's ~20-25 ns/row on v5e (sequential per-row DMA waits; removed in
+round 3, see BENCHMARKS.md reproducibility notes and
+``git log -- sherman_tpu/ops/gather.py``).  This suite changes what is
+FUSED (descent compute rides the stream; write lanes share one pass),
+not just how bytes move, and ships with standing receipts
+(``tools/profile_gather.py``, ``kernels.*`` obs counters, bench JSON
+fields) so the pallas-vs-xla A/B is a one-command capture on chip —
+the knob flips per deployment from measurement, not belief.
+
+Parity contract: every kernel is BIT-IDENTICAL to its ``*_xla`` twin
+(which mirrors the inline code in ``models/batched.py`` /
+``parallel/dsm.py``) on ANY inputs — including garbage pages — pinned
+by the interpreter-mode fuzz in ``tests/test_pallas_page.py``.  The one
+exception is :func:`writeback`: rows with ``applied`` must carry
+in-range (page, word) targets, which the apply kernels guarantee by
+construction (clipped pages, found/ranked slots).
+
+Mosaic toolchain notes (jax 0.4.37): integer reductions do not lower,
+so in-kernel sums/anys run as exact float32 16-bit-half sums (<= 82
+terms of < 2^16 each — exact in f32, recombined with int32 wrap
+arithmetic, so results stay bit-identical to XLA's wrapping integer
+sums); iota constants are ``lax.broadcasted_iota`` (kernels cannot
+capture array constants).
+
+Like transport_pallas, kernels run in INTERPRETER mode off-TPU (the CPU
+test mesh) and are compile-smoked for the TPU target without hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sherman_tpu import config as C
+from sherman_tpu import obs
+from sherman_tpu.ops import bits, layout
+
+try:  # pallas is TPU-oriented; CPU uses interpreter mode
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+_PW = C.PAGE_WORDS
+
+# Rows per grid program (descent / snapshot / write-back).  256 keeps
+# the VMEM blocks on the (8, 128)-divisible grid Mosaic requires and
+# bounds per-program VMEM at 2 * CHUNK pages + the row blocks.
+BLOCK = 256
+# Rows per double-buffer slot of the descent stream: the next CHUNK's
+# page DMAs are posted before the current CHUNK's search runs, and the
+# (CHUNK, PAGE_WORDS) tile keeps the VPU lanes full during the pick.
+CHUNK = 8
+# In-flight row DMAs of the snapshot gather ring.
+N_INFLIGHT = 16
+# Write-back rows whose lane DMAs may be in flight at once.
+WB_WINDOW = 8
+
+# Traced-issue accounting (transport.py convention: one inc per program
+# BUILD; per-execution truth stays with the dsm.* device counters).
+_OBS_DESCENT = obs.counter("kernels.descent_rounds_traced")
+_OBS_DESCENT_ROWS = obs.counter("kernels.descent_rows_per_round")
+_OBS_SNAP = obs.counter("kernels.snapshot_gathers_traced")
+_OBS_SNAP_ROWS = obs.counter("kernels.snapshot_rows_per_gather")
+_OBS_WB = obs.counter("kernels.writeback_passes_traced")
+_OBS_WB_ROWS = obs.counter("kernels.writeback_rows_per_pass")
+_OBS_WB_LANES = obs.counter("kernels.writeback_lanes_traced")
+
+
+class PallasUnavailableError(RuntimeError):
+    """Typed, actionable: the Pallas/Mosaic toolchain is missing but a
+    config knob selected it.  Names the knob to flip back."""
+
+    def __init__(self, knob: str):
+        super().__init__(
+            f"Pallas/Mosaic toolchain unavailable but {knob}=\"pallas\" "
+            f"was requested: set {knob}=\"xla\" (the default, "
+            "compiler-scheduled path) or install a jaxlib with Pallas "
+            "TPU support")
+        self.knob = knob
+
+
+def available() -> bool:
+    return HAVE_PALLAS
+
+
+def use_pallas(cfg) -> bool:
+    """True iff ``cfg.gather_impl == "pallas"``; raises the typed error
+    (naming the knob) when that was requested without the toolchain."""
+    if cfg.gather_impl != "pallas":
+        return False
+    if not HAVE_PALLAS:
+        raise PallasUnavailableError("DSMConfig.gather_impl")
+    return True
+
+
+def _interpret() -> bool:
+    # same trace-time rule as transport.exchange: interpreter everywhere
+    # but a real TPU backend
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to_block(n: int) -> int:
+    return -(-max(n, 1) // BLOCK) * BLOCK
+
+
+def _pad1(x, n_pad, fill=0):
+    n = x.shape[0]
+    if n == n_pad:
+        return x
+    return jnp.concatenate(
+        [x, jnp.full((n_pad - n,) + x.shape[1:], fill, x.dtype)])
+
+
+# ---------------------------------------------------------------------------
+# In-kernel page search primitives — bit-exact twins of ops/layout.py,
+# expressed without captured array constants or integer reductions.
+# ---------------------------------------------------------------------------
+
+def _masked_isum(vals, mask):
+    """Exact int32 wrap-sum of ``vals`` where ``mask`` (along the last
+    axis) without integer reductions (Mosaic gap): sum the unsigned
+    16-bit halves in float32 (<= 82 terms of < 2^16 each — exact), then
+    recombine with int32 wrap arithmetic.  Equals XLA's wrapping integer
+    masked sum bit-for-bit."""
+    lo = vals & jnp.int32(0xFFFF)
+    hi = jnp.right_shift(vals, 16) & jnp.int32(0xFFFF)
+    z = jnp.float32(0)
+    slo = jnp.sum(jnp.where(mask, lo.astype(jnp.float32), z), axis=-1)
+    shi = jnp.sum(jnp.where(mask, hi.astype(jnp.float32), z), axis=-1)
+    return (jnp.left_shift(shi.astype(jnp.int32), 16)
+            + slo.astype(jnp.int32))
+
+
+def _any_last(mask):
+    """jnp.any(mask, -1) via an exact f32 count (no integer reduce)."""
+    return jnp.sum(mask.astype(jnp.float32), axis=-1) > 0
+
+
+def _pick_child_k(pg, kh, kl):
+    """In-kernel ``layout.internal_pick_child`` twin.  The le_next shift
+    reads the entry blocks offset by one word (static slice) instead of
+    concatenating, masked so column CAP-1 is always False — identical to
+    the zero-padded shift on ALL inputs, garbage pages included."""
+    ICAP = C.INTERNAL_CAP
+    ekhi = pg[:, C.I_KHI_W:C.I_KHI_W + ICAP]
+    eklo = pg[:, C.I_KLO_W:C.I_KLO_W + ICAP]
+    n = layout.h_nkeys(pg)[:, None]
+    iota = lax.broadcasted_iota(jnp.int32, ekhi.shape, 1)
+    le = bits.key_le(ekhi, eklo, kh[:, None], kl[:, None]) & (iota < n)
+    ekhi1 = pg[:, C.I_KHI_W + 1:C.I_KHI_W + 1 + ICAP]
+    eklo1 = pg[:, C.I_KLO_W + 1:C.I_KLO_W + 1 + ICAP]
+    le_next = (bits.key_le(ekhi1, eklo1, kh[:, None], kl[:, None])
+               & ((iota + 1) < n) & (iota < ICAP - 1))
+    edge = le & ~le_next
+    ptrs = pg[:, C.I_PTR_W:C.I_PTR_W + ICAP]
+    child = _masked_isum(ptrs, edge)
+    return jnp.where(_any_last(le), child, layout.h_leftmost(pg))
+
+
+def _leaf_find_k(pg, kh, kl):
+    """In-kernel ``layout.leaf_find_key`` twin (found, vhi, vlo)."""
+    LCAP = C.LEAF_CAP
+    fv, rv = layout.ver_unpack(pg[:, C.L_VER_W:C.L_VER_W + LCAP])
+    used = (fv == rv) & (fv != 0)
+    ekhi = pg[:, C.L_KHI_W:C.L_KHI_W + LCAP]
+    eklo = pg[:, C.L_KLO_W:C.L_KLO_W + LCAP]
+    hit = used & bits.key_eq(ekhi, eklo, kh[:, None], kl[:, None])
+    found = _any_last(hit)
+    vh = _masked_isum(pg[:, C.L_VHI_W:C.L_VHI_W + LCAP], hit)
+    vl = _masked_isum(pg[:, C.L_VLO_W:C.L_VLO_W + LCAP], hit)
+    return found, vh, vl
+
+
+def _round_compute(pg, kh, kl, ok, stop_level: int):
+    """One row-chunk's in-VMEM search: level/chase/child-pick/leaf-find
+    on (CHUNK, PAGE_WORDS) pages, zeroed where not ok (the read_pages
+    contract)."""
+    pg = jnp.where(ok[:, None], pg, 0)
+    lvl = layout.h_level(pg)
+    chase = layout.needs_sibling_chase(pg, kh, kl)
+    is_leaf = (lvl == stop_level) & ~chase
+    nxt = jnp.where(chase, layout.h_sibling(pg), _pick_child_k(pg, kh, kl))
+    f, vh, vl = _leaf_find_k(pg, kh, kl)
+    return nxt, is_leaf, chase, f, vh, vl
+
+
+# ---------------------------------------------------------------------------
+# Kernel 1: fused descent round.
+# ---------------------------------------------------------------------------
+
+def _descent_kernel(addr_sref, addr_ref, khi_ref, klo_ref, act_ref,
+                    pool_ref, nxt_ref, leaf_ref, chase_ref, ok_ref,
+                    f_ref, vh_ref, vl_ref, buf, sems, *, n_pages: int,
+                    stop_level: int):
+    pid = pl.program_id(0)
+    n_chunks = BLOCK // CHUNK
+
+    def chunk_dma(c, slot, start):
+        # CHUNK single-page copies posted back-to-back (doorbell batch);
+        # the scalar-prefetched addrs are the DMA targets, clipped to
+        # the pool exactly as the XLA gather clips.
+        base = pid * BLOCK + c * CHUNK
+        for r in range(CHUNK):
+            pg = jnp.clip(addr_sref[base + r] & C.ADDR_PAGE_MASK, 0,
+                          n_pages - 1)
+            cp = pltpu.make_async_copy(pool_ref.at[pl.ds(pg, 1)],
+                                       buf.at[slot, pl.ds(r, 1)],
+                                       sems.at[slot, r])
+            (cp.start if start else cp.wait)()
+
+    chunk_dma(0, 0, True)
+
+    def body(c, _):
+        slot = lax.rem(c, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():  # stream the NEXT chunk while this one is searched
+            chunk_dma(c + 1, lax.rem(c + 1, 2), True)
+
+        chunk_dma(c, slot, False)
+        s = pl.ds(c * CHUNK, CHUNK)
+        page_idx = addr_ref[s] & C.ADDR_PAGE_MASK
+        ok = (act_ref[s] != 0) & (page_idx >= 0) & (page_idx < n_pages)
+        nxt, is_leaf, chase, f, vh, vl = _round_compute(
+            buf[slot], khi_ref[s], klo_ref[s], ok, stop_level)
+        nxt_ref[s] = nxt
+        leaf_ref[s] = is_leaf.astype(jnp.int32)
+        chase_ref[s] = chase.astype(jnp.int32)
+        ok_ref[s] = ok.astype(jnp.int32)
+        f_ref[s] = f.astype(jnp.int32)
+        vh_ref[s] = vh
+        vl_ref[s] = vl
+        return 0
+
+    lax.fori_loop(0, n_chunks, body, 0)
+
+
+def descent_round(pool, addr, khi, klo, active, *, stop_level: int = 0,
+                  interpret: bool | None = None):
+    """One fused descent round over ``[B]`` rows.
+
+    For each active row: stream its page HBM->VMEM (double-buffered
+    CHUNK tiles), search it in VMEM, and emit ``(nxt, is_leaf, chase,
+    ok, found, vhi, vlo)`` — next-level address, (level == stop_level
+    and in fence), sibling-chase flag, page-read validity, and the leaf
+    lookup verdicts.  Bit-identical to :func:`descent_round_xla` (the
+    gather + ``ops/layout`` composition the XLA path runs) on any
+    inputs.  Bool outputs return as bool arrays.
+    """
+    if not HAVE_PALLAS:
+        raise PallasUnavailableError("DSMConfig.gather_impl")
+    B = addr.shape[0]
+    P = pool.shape[0]
+    Bp = _pad_to_block(B)
+    addr_p = _pad1(jnp.asarray(addr, jnp.int32), Bp)
+    khi_p = _pad1(jnp.asarray(khi, jnp.int32), Bp)
+    klo_p = _pad1(jnp.asarray(klo, jnp.int32), Bp)
+    act_p = _pad1(active.astype(jnp.int32), Bp)
+    _OBS_DESCENT.inc()
+    _OBS_DESCENT_ROWS.inc(B)
+
+    bspec = lambda: pl.BlockSpec((BLOCK,), lambda i, idx: (i,))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Bp // BLOCK,),
+        in_specs=[bspec(), bspec(), bspec(), bspec(),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=tuple(bspec() for _ in range(7)),
+        scratch_shapes=[pltpu.VMEM((2, CHUNK, _PW), jnp.int32),
+                        pltpu.SemaphoreType.DMA((2, CHUNK))],
+    )
+    sh = jax.ShapeDtypeStruct((Bp,), jnp.int32)
+    kern = functools.partial(_descent_kernel, n_pages=P,
+                             stop_level=stop_level)
+    outs = pl.pallas_call(
+        kern, out_shape=(sh,) * 7, grid_spec=grid_spec,
+        interpret=_interpret() if interpret is None else interpret,
+    )(addr_p, addr_p, khi_p, klo_p, act_p, pool)
+    nxt, is_leaf, chase, ok, f, vh, vl = (o[:B] for o in outs)
+    return (nxt, is_leaf != 0, chase != 0, ok != 0, f != 0, vh, vl)
+
+
+def descent_round_xla(pool, addr, khi, klo, active, *, stop_level: int = 0):
+    """Reference twin: the exact gather + layout composition the XLA
+    descent paths run (``read_pages_spmd`` N==1 + ``advance``), with the
+    same output tuple as :func:`descent_round`."""
+    P = pool.shape[0]
+    page = bits.addr_page(addr)
+    ok = active & (page >= 0) & (page < P)
+    pg = jnp.where(ok[:, None], pool[jnp.clip(page, 0, P - 1)], 0)
+    lvl = layout.h_level(pg)
+    chase = layout.needs_sibling_chase(pg, khi, klo)
+    is_leaf = (lvl == stop_level) & ~chase
+    nxt = jnp.where(chase, layout.h_sibling(pg),
+                    layout.internal_pick_child(pg, khi, klo))
+    f, vh, vl, _ = layout.leaf_find_key(pg, khi, klo)
+    return nxt, is_leaf, chase, ok, f, vh, vl
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2: multi-lane write-back.
+# ---------------------------------------------------------------------------
+
+def _writeback_kernel(page_sref, slot_sref, app_sref, ent_ref, pool_ref,
+                      out_ref, sems, *, n_pages: int,
+                      field_w: tuple[int, ...]):
+    pid = pl.program_id(0)
+    L = len(field_w)
+
+    def lane_copies(r):
+        base = pid * BLOCK + r
+        pg = jnp.clip(page_sref[base], 0, n_pages - 1)
+        sl = slot_sref[base]
+        return [pltpu.make_async_copy(
+                    ent_ref.at[pl.ds(r, 1), pl.ds(l, 1)],
+                    out_ref.at[pl.ds(pg, 1), pl.ds(field_w[l] + sl, 1)],
+                    sems.at[lax.rem(r, WB_WINDOW), l])
+                for l in range(L)]
+
+    def row(r, start):
+        @pl.when(app_sref[pid * BLOCK + r] != 0)
+        def _():
+            # ALL lanes of the row posted before any wait — the
+            # single-entry doorbell batch; cost per row is one DMA
+            # latency, not one per lane.
+            for cp in lane_copies(r):
+                (cp.start if start else cp.wait)()
+
+    def body(r, _):
+        @pl.when(r >= WB_WINDOW)
+        def _():  # recycle the slot's semaphores before reuse
+            row(r - WB_WINDOW, False)
+        row(r, True)
+        return 0
+
+    lax.fori_loop(0, BLOCK, body, 0)
+    for k in range(WB_WINDOW):  # drain the tail window
+        row(BLOCK - WB_WINDOW + k, False)
+
+
+def writeback(pool, page, slot, applied, ent, field_w: tuple[int, ...],
+              interpret: bool | None = None):
+    """Multi-lane entry write-back: for each row with ``applied``, write
+    ``ent[r, l]`` to ``pool[page[r], field_w[l] + slot[r]]`` — all lanes
+    in ONE kernel pass over the rows (vs one full-batch XLA scatter per
+    lane).  In-place on ``pool`` (input/output aliased).
+
+    Contract: ``page`` pre-clipped to the pool (the apply kernels pass
+    ``safe_page``) and applied rows carry in-page ``field_w[l] + slot``
+    word targets — guaranteed by the apply kernels' found/ranked slots.
+    Matches :func:`writeback_xla` under that contract; rows without
+    ``applied`` are dropped exactly like the XLA path's out-of-range
+    scatter indices.
+    """
+    if not HAVE_PALLAS:
+        raise PallasUnavailableError("DSMConfig.gather_impl")
+    M = page.shape[0]
+    P = pool.shape[0]
+    L = len(field_w)
+    assert ent.shape == (M, L)
+    Mp = _pad_to_block(M)
+    page_p = _pad1(jnp.asarray(page, jnp.int32), Mp)
+    slot_p = _pad1(jnp.asarray(slot, jnp.int32), Mp)
+    app_p = _pad1(applied.astype(jnp.int32), Mp)
+    ent_p = _pad1(jnp.asarray(ent, jnp.int32), Mp)
+    _OBS_WB.inc()
+    _OBS_WB_ROWS.inc(M)
+    _OBS_WB_LANES.inc(L)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(Mp // BLOCK,),
+        in_specs=[pl.BlockSpec((BLOCK, L), lambda i, *_: (i, 0)),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((WB_WINDOW, L))],
+    )
+    kern = functools.partial(_writeback_kernel, n_pages=P,
+                             field_w=tuple(int(w) for w in field_w))
+    return pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct((P, _PW), pool.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={4: 0},  # pool (after the 3 prefetch + ent)
+        interpret=_interpret() if interpret is None else interpret,
+    )(page_p, slot_p, app_p, ent_p, pool)
+
+
+def writeback_xla(pool, page, slot, applied, ent, field_w: tuple[int, ...]):
+    """Reference twin: the per-lane flat scatter the XLA apply path runs
+    (``leaf_apply_spmd`` / ``leaf_delete_apply_spmd`` write-back)."""
+    P = pool.shape[0]
+    fw = jnp.asarray(list(field_w), jnp.int32)
+    idx = (page * _PW)[:, None] + fw[None, :] + slot[:, None]
+    idx = jnp.where(applied[:, None], idx, P * _PW)
+    flat = pool.reshape(-1)
+    flat = flat.at[idx.reshape(-1)].set(ent.reshape(-1), mode="drop")
+    return flat.reshape(P, _PW)
+
+
+# ---------------------------------------------------------------------------
+# Kernel 3: snapshot gather (one page, many consumers).
+# ---------------------------------------------------------------------------
+
+def _gather_kernel(rows_sref, pool_ref, out_ref, sems, *, n_pages: int):
+    pid = pl.program_id(0)
+
+    def row_dma(j):
+        pg = jnp.clip(rows_sref[pid * BLOCK + j], 0, n_pages - 1)
+        return pltpu.make_async_copy(pool_ref.at[pl.ds(pg, 1)],
+                                     out_ref.at[pl.ds(j, 1)],
+                                     sems.at[lax.rem(j, N_INFLIGHT)])
+
+    for j in range(N_INFLIGHT):  # fill the ring
+        row_dma(j).start()
+
+    def body(j, _):
+        row_dma(j).wait()
+
+        @pl.when(j + N_INFLIGHT < BLOCK)
+        def _():
+            row_dma(j + N_INFLIGHT).start()
+        return 0
+
+    lax.fori_loop(0, BLOCK, body, 0)
+
+
+def gather_pages(pool, rows, interpret: bool | None = None):
+    """``pool[jnp.clip(rows, 0, P - 1)]`` as an N_INFLIGHT-deep row-DMA
+    ring — the apply path's materialized page snapshot (its output IS
+    the snapshot buffer, so no ``optimization_barrier`` is needed to
+    stop XLA re-fusing the gather into consumers)."""
+    if not HAVE_PALLAS:
+        raise PallasUnavailableError("DSMConfig.gather_impl")
+    M = rows.shape[0]
+    P = pool.shape[0]
+    Mp = _pad_to_block(M)
+    rows_p = _pad1(jnp.asarray(rows, jnp.int32), Mp)
+    _OBS_SNAP.inc()
+    _OBS_SNAP_ROWS.inc(M)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Mp // BLOCK,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec((BLOCK, _PW), lambda i, idx: (i, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((N_INFLIGHT,))],
+    )
+    kern = functools.partial(_gather_kernel, n_pages=P)
+    out = pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct((Mp, _PW), pool.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret() if interpret is None else interpret,
+    )(rows_p, pool)
+    return out[:M]
+
+
+def gather_pages_xla(pool, rows):
+    """Reference twin of :func:`gather_pages`."""
+    P = pool.shape[0]
+    return pool[jnp.clip(rows, 0, P - 1)]
+
+
+def read_pages_local(pool, addrs, active):
+    """The single-node ``read_pages_spmd`` contract over the pallas
+    gather: (pages zeroed where not ok, ok)."""
+    P = pool.shape[0]
+    page = bits.addr_page(addrs)
+    ok = active & (page >= 0) & (page < P)
+    pages = gather_pages(pool, page)
+    return jnp.where(ok[:, None], pages, 0), ok

@@ -276,12 +276,21 @@ def read_pages_spmd(pool, addrs, *, cfg: DSMConfig, axis_name: str = AXIS,
     The hot-loop primitive for batched tree descent — avoids shipping write
     payloads: requests are 1 word each; only replies carry pages.
     Returns (pages [R, 256], ok [R]).
+
+    ``cfg.gather_impl`` selects the page-fetch engine: "xla" (default)
+    is the native gather; "pallas" routes the owner-side page reads
+    through the explicit-DMA snapshot kernel
+    (:mod:`sherman_tpu.ops.pallas_page`) — bit-identical results, same
+    op accounting (counters are per ROW, not per impl).
     """
+    from sherman_tpu.ops import pallas_page
     N, C = cfg.machine_nr, cfg.step_capacity
     P = pool.shape[0]
     if active is None:
         active = jnp.ones(addrs.shape, bool)
     if N == 1:
+        if pallas_page.use_pallas(cfg):
+            return pallas_page.read_pages_local(pool, addrs, active)
         # Single-node fast path: no routing, direct local gather.
         page = bits.addr_page(addrs)
         ok = active & (page >= 0) & (page < P)
@@ -293,7 +302,10 @@ def read_pages_spmd(pool, addrs, *, cfg: DSMConfig, axis_name: str = AXIS,
     bucket_idx, routed = transport.bucketize(dest, active, N, C)
     out = transport.scatter_to_buckets(bits.addr_page(addrs), bucket_idx, N * C)
     inc = xch(out)
-    data = pool[jnp.clip(inc, 0, P - 1)]
+    if pallas_page.use_pallas(cfg):
+        data = pallas_page.gather_pages(pool, inc)
+    else:
+        data = pool[jnp.clip(inc, 0, P - 1)]
     rep = xch({"data": data, "okb": (inc >= 0) & (inc < P)})
     safe_b = jnp.where(routed, bucket_idx, 0)
     served = active & routed & rep["okb"][safe_b]

@@ -53,6 +53,23 @@ except Exception:  # pragma: no cover
     HAVE_PALLAS = False
 
 from sherman_tpu import obs
+from sherman_tpu.ops.pallas_page import PallasUnavailableError
+
+
+class ExchangeLaneError(TypeError):
+    """Typed, actionable: a request field cannot ride the packed 32-bit
+    exchange buffer.  Names the knob whose default path has no such
+    constraint."""
+
+    def __init__(self, dtype):
+        super().__init__(
+            f"pallas exchange carries 32-bit lanes; got {dtype} — widen "
+            "the field to a 32-bit dtype (bools and any 4-byte dtype "
+            "travel bit-exactly) or set DSMConfig.exchange_impl=\"xla\" "
+            "(the default all_to_all transport, which has no lane-width "
+            "constraint)")
+        self.dtype = dtype
+
 
 # Traced-issue accounting (see transport.py for the trace-time
 # semantics): per kernel BUILD, the number of one-sided remote writes
@@ -133,7 +150,8 @@ def exchange_pallas(x, axis_name: str, n_nodes: int, *,
     Call inside shard_map on per-node shards.  Equivalent to
     ``lax.all_to_all(x, axis_name, 0, 0, tiled=True)``.
     """
-    assert HAVE_PALLAS, "pallas unavailable"
+    if not HAVE_PALLAS:
+        raise PallasUnavailableError("DSMConfig.exchange_impl")
     rows = x.shape[0]
     assert rows % n_nodes == 0
     C = rows // n_nodes
@@ -178,8 +196,7 @@ def exchange(tree, axis_name: str, n_nodes: int, *, interpret: bool = False):
         elif x.dtype.itemsize == 4:
             x2 = jax.lax.bitcast_convert_type(x, jnp.int32)
         else:
-            raise TypeError(
-                f"pallas exchange carries 32-bit lanes; got {dt}")
+            raise ExchangeLaneError(dt)
         assert x2.shape[0] == rows, "exchange arrays must share dim 0"
         return x2.reshape(rows, -1)
 

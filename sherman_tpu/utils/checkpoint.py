@@ -34,7 +34,7 @@ from sherman_tpu.config import DSMConfig
 
 _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
                "step_capacity", "host_step_capacity", "chunk_pages",
-               "exchange_impl")
+               "exchange_impl", "gather_impl")
 
 # Page-layout fingerprint stamped into every checkpoint: the pool is raw
 # words, so restoring across a layout change (e.g. round 4's packed

@@ -174,6 +174,27 @@ def test_profile_staged2_driver(eight_devices, capsys, monkeypatch):
     assert r["phase_ms"] == j["phase_ms"]
 
 
+def test_profile_gather_driver(eight_devices, capsys):
+    """Page-kernel A/B driver (CPU smoke of tools/profile_gather.py):
+    the side-by-side table must cover every kernel phase for both
+    impls, with the pallas column honestly flagged as interpreted on a
+    non-TPU backend."""
+    import json
+
+    import profile_gather
+    r = profile_gather.main(["--rows", "1024", "--keys", "2000",
+                             "--k", "1"])
+    out = capsys.readouterr().out
+    j = json.loads(out.strip().splitlines()[-1])
+    assert j["metric"] == "pallas_vs_xla_page_kernels"
+    assert j["pallas_interpreted"] is True  # CPU mesh
+    assert set(j["phases"]) == {"descent_round", "snapshot_gather",
+                                "writeback_3w", "writeback_5w"}
+    for ph, by in j["phases"].items():
+        assert set(by) >= {"xla", "pallas", "ratio"}, ph
+    assert r["phases"] == j["phases"]
+
+
 def test_churn_bench_driver(eight_devices, capsys):
     """Drifting-keyspace churn + reclaim on a bounded pool (CPU smoke
     of tools/churn_bench.py): the loop must hold integrity and keep

@@ -115,3 +115,40 @@ def test_collective_id_distinct_per_shape_family():
     ids = {(_collective_id(n, c, w))
            for n in (2, 4, 8) for c in (16, 64, 512) for w in (1, 8, 262)}
     assert len(ids) == 27, "shape families collided in a tiny sample"
+
+
+def test_exchange_pallas_unavailable_names_the_knob(monkeypatch):
+    """Toolchain-missing fallback: a typed error that tells the operator
+    which knob to flip, not a bare AssertionError."""
+    from sherman_tpu.parallel import transport_pallas as TP
+
+    monkeypatch.setattr(TP, "HAVE_PALLAS", False)
+    with pytest.raises(TP.PallasUnavailableError) as ei:
+        TP.exchange_pallas(jnp.zeros((8, 4), jnp.int32), AXIS, 4)
+    msg = str(ei.value)
+    assert "exchange_impl" in msg and "xla" in msg
+    # ...and the pytree wrapper propagates it (the path transport.exchange
+    # takes when DSMConfig.exchange_impl == "pallas")
+    with pytest.raises(TP.PallasUnavailableError):
+        TP.exchange({"a": jnp.zeros(8, jnp.int32)}, AXIS, 4)
+
+
+def test_exchange_pallas_non32bit_lane_names_the_knob(eight_devices):
+    """A 16-bit lane cannot ride the packed int32 buffer: the typed
+    ExchangeLaneError says so and names exchange_impl="xla"."""
+    from sherman_tpu.parallel import transport_pallas as TP
+
+    n = 4
+    mesh = make_mesh(n)
+    spec = jax.sharding.PartitionSpec(AXIS)
+    arr = np.zeros(n * n * 8, np.int16)
+
+    def inner(x):
+        return transport.exchange(x, AXIS, impl="pallas")
+
+    fn = jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=(spec,),
+                               out_specs=spec, check_vma=False))
+    with pytest.raises(TP.ExchangeLaneError) as ei:
+        fn(arr)
+    msg = str(ei.value)
+    assert "int16" in msg and "exchange_impl" in msg and "xla" in msg
