@@ -56,7 +56,10 @@ Environment knobs:
 
 ``bench.py --chaos-drill`` runs the data-plane chaos drill
 (tools/chaos_drill.py: fault injection -> lease/scrub detection ->
-recovery) instead of the benchmark — see README "Robustness".
+recovery) instead of the benchmark; ``bench.py --recovery-drill`` runs
+the recovery-plane drill (tools/recovery_drill.py: traffic -> crash ->
+chain restore + journal replay with measured RPO/RTO -> targeted
+repair) — see README "Robustness".
 
 Read combining: a zipf-0.99 batch of 4 M ops contains ~1-2 M distinct
 keys (~2-4x dedup depending on keyspace size).  The engine already
@@ -1015,6 +1018,19 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import chaos_drill
         chaos_drill.main(sys.argv[1:])
+        return
+
+    if "--recovery-drill" in sys.argv:
+        # Recovery lane: the end-to-end durability drill (traffic ->
+        # crash -> restore chain + journal replay with measured RPO/RTO
+        # -> targeted repair of injected corruption) instead of the
+        # throughput benchmark.  tools/recovery_drill.py owns the
+        # sequence; it prints its own one-line JSON receipt.
+        sys.argv.remove("--recovery-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import recovery_drill
+        recovery_drill.main(sys.argv[1:])
         return
 
     # persistent compilation cache: kernel compiles cost 20-40 s each over

@@ -325,6 +325,21 @@ class FaultPlan:
         self._undo.clear()
         return n
 
+    def corrupted_pool_rows(self) -> list[int]:
+        """Global pool rows of every POOL word this plan corrupted
+        (pending undo) — the ground-truth damage set a recovery drill
+        hands to targeted repair alongside the scrubber's flagged set
+        (the scrubber only flags what a pass has SEEN violate).
+        Convert with :meth:`rows_to_addrs`."""
+        return sorted({row for space, row, *_ in self._undo
+                       if space == "pool"})
+
+    @staticmethod
+    def rows_to_addrs(rows, pages_per_node: int) -> list[int]:
+        """Global pool rows -> packed page addresses."""
+        return [bits.make_addr(int(r) // pages_per_node,
+                               int(r) % pages_per_node) for r in rows]
+
     @property
     def exhausted(self) -> bool:
         return all(f.fired for f in self.faults)

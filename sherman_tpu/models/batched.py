@@ -45,6 +45,7 @@ from sherman_tpu.ops import bits, layout, pallas_page
 from sherman_tpu.parallel import dsm as D
 from sherman_tpu.parallel import transport
 from sherman_tpu.parallel.mesh import AXIS
+from sherman_tpu.utils import journal as J
 
 # Per-key insert status codes (reply of one insert step).
 ST_INVALID = 0      # inactive slot (padding)
@@ -773,7 +774,18 @@ def _resolve_leaves(pool, counters, khi, klo, root, active, start, *,
             jnp.where(found, vh, 0), jnp.where(found, vl, 0))
 
 
-def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
+def _mark_dirty_pages(dirty, page_idx, active):
+    """OR ``active`` rows' (owner-local) target pages into the dirty
+    shard — the delta-checkpoint feed.  Marks the pages the apply MAY
+    write (lock-blocked / deduped rows over-mark: a spare delta row,
+    never a missed one)."""
+    P = dirty.shape[0]
+    rows = jnp.where(active & (page_idx >= 0) & (page_idx < P),
+                     page_idx, P)
+    return dirty.at[rows].set(True, mode="drop")
+
+
+def _route_and_apply(pool, locks, counters, dirty, apply_fn, addr, eligible,
                      fields, *, cfg: DSMConfig, axis_name: str):
     """Ship ``eligible`` requests to their owner nodes and apply.
 
@@ -781,20 +793,25 @@ def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
     directly; multi-node bucketizes by owner, all_to_all-exchanges the
     request fields, applies on the owner, and routes statuses back.
     ``fields`` are the per-request arrays ``apply_fn`` expects beyond
-    active/addr.  Returns (pool, counters, status_raw [B], extra) where
-    status_raw is the apply status for eligible routed rows and ST_RETRY
-    for rows that missed the bucket capacity (full RDMA send queue moral
-    equivalent) — callers mask inactive rows to ST_INVALID.  ``extra`` is
-    the apply_fn's optional 4th output (e.g. the split log), which stays
-    owner-node-local (no reply routing).
+    active/addr.  Returns (pool, counters, dirty, status_raw [B], extra)
+    where status_raw is the apply status for eligible routed rows and
+    ST_RETRY for rows that missed the bucket capacity (full RDMA send
+    queue moral equivalent) — callers mask inactive rows to ST_INVALID;
+    ``dirty`` is the per-node dirty-page mask with this step's write
+    targets marked (delta-checkpoint feed; ``None`` = untracked, passed
+    through).  ``extra`` is the apply_fn's optional 4th output (e.g. the
+    split log), which stays owner-node-local (no reply routing).
     """
     N, cap = cfg.machine_nr, cfg.step_capacity
     if N == 1:
         inc = {"active": eligible, "addr": addr, **fields}
+        if dirty is not None:
+            dirty = _mark_dirty_pages(dirty, bits.addr_page(addr), eligible)
         out = apply_fn(pool, locks, counters, inc, cfg=cfg)
         pool, counters, st = out[:3]
         extra = out[3] if len(out) > 3 else None
-        return pool, counters, jnp.where(eligible, st, ST_RETRY), extra
+        return (pool, counters, dirty,
+                jnp.where(eligible, st, ST_RETRY), extra)
 
     dest = bits.addr_node(addr)
     bucket_idx, routed = transport.bucketize(dest, eligible, N, cap)
@@ -802,20 +819,24 @@ def _route_and_apply(pool, locks, counters, apply_fn, addr, eligible,
     out = {k: transport.scatter_to_buckets(v, bucket_idx, N * cap)
            for k, v in out_fields.items()}
     inc = transport.exchange(out, axis_name, impl=cfg.exchange_impl)
+    if dirty is not None:
+        dirty = _mark_dirty_pages(dirty, bits.addr_page(inc["addr"]),
+                                  inc["active"])
     aout = apply_fn(pool, locks, counters, inc, cfg=cfg)
     pool, counters, st = aout[:3]
     extra = aout[3] if len(aout) > 3 else None
     rep = transport.exchange({"st": st}, axis_name,
                              impl=cfg.exchange_impl)
     safe_b = jnp.where(routed, bucket_idx, 0)
-    return (pool, counters,
+    return (pool, counters, dirty,
             jnp.where(eligible & routed, rep["st"][safe_b], ST_RETRY),
             extra)
 
 
-def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
-                     start=None, fresh=None, *, cfg: DSMConfig, iters: int,
-                     axis_name: str = AXIS, update_only: bool = False):
+def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
+                     active, start=None, fresh=None, *, cfg: DSMConfig,
+                     iters: int, axis_name: str = AXIS,
+                     update_only: bool = False, dirty=None):
     """One batched insert step: descend + route to owners + leaf apply.
 
     With ``fresh`` (per-node pre-allocated pages), full leaves split
@@ -823,6 +844,12 @@ def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
     ``update_only`` compiles the steady-state kernel (see
     :func:`leaf_apply_spmd`).  Returns (pool, counters, status [B]) per
     this node's key shard — plus the log when ``fresh`` is given.
+
+    ``dirty`` (keyword-only): the node's dirty-page mask shard; when
+    given, target leaves and granted split pages mark it and it rides
+    the return tuple after ``counters`` (the delta-checkpoint feed —
+    the ENGINE passes it; raw harness compositions that leave it None
+    are outside the durability contract).
     """
     # NOTE: threading the descent's round-1 pages into the apply (to skip
     # its snapshot gather) was measured SLOWER (+24 ms at 2 M rows):
@@ -833,14 +860,20 @@ def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root, active,
         iters=iters, axis_name=axis_name)
     apply_fn = functools.partial(leaf_apply_spmd, fresh=fresh,
                                  update_only=update_only)
-    pool, counters, status, log = _route_and_apply(
-        pool, locks, counters, apply_fn, addr, done,
+    if fresh is not None and dirty is not None:
+        # granted split pages are written owner-side this step; marking
+        # every OFFERED grant over-marks unconsumed ones (spare delta
+        # rows, never a miss)
+        dirty = _mark_dirty_pages(dirty, bits.addr_page(fresh), fresh != 0)
+    pool, counters, dirty, status, log = _route_and_apply(
+        pool, locks, counters, dirty, apply_fn, addr, done,
         {"khi": khi, "klo": klo, "vhi": vhi, "vlo": vlo},
         cfg=cfg, axis_name=axis_name)
     status = jnp.where(active, status, ST_INVALID)
+    state = (pool, counters) if dirty is None else (pool, counters, dirty)
     if fresh is not None:
-        return pool, counters, status, log
-    return pool, counters, status
+        return (*state, status, log)
+    return (*state, status)
 
 
 # ---------------------------------------------------------------------------
@@ -904,18 +937,23 @@ def leaf_delete_apply_spmd(pool, locks, counters, inc, *, cfg: DSMConfig):
 
 def delete_step_spmd(pool, locks, counters, khi, klo, root, active,
                      start=None, *, cfg: DSMConfig, iters: int,
-                     axis_name: str = AXIS):
+                     axis_name: str = AXIS, dirty=None):
     """One batched delete step: descend + route to owners + slot clear.
 
-    Returns (pool, counters, status [B]) per this node's key shard.
+    Returns (pool, counters, status [B]) per this node's key shard —
+    with ``dirty`` threaded after ``counters`` when given (see
+    :func:`insert_step_spmd`).
     """
     counters, done, addr, _, _, _ = _resolve_leaves(
         pool, counters, khi, klo, root, active, start, cfg=cfg, iters=iters,
         axis_name=axis_name)
-    pool, counters, status, _ = _route_and_apply(
-        pool, locks, counters, leaf_delete_apply_spmd, addr, done,
+    pool, counters, dirty, status, _ = _route_and_apply(
+        pool, locks, counters, dirty, leaf_delete_apply_spmd, addr, done,
         {"khi": khi, "klo": klo}, cfg=cfg, axis_name=axis_name)
-    return pool, counters, jnp.where(active, status, ST_INVALID)
+    status = jnp.where(active, status, ST_INVALID)
+    if dirty is None:
+        return pool, counters, status
+    return pool, counters, dirty, status
 
 
 # ---------------------------------------------------------------------------
@@ -926,7 +964,7 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
                     active_r, active_w, start=None, *, cfg: DSMConfig,
                     iters: int, axis_name: str = AXIS,
                     write_lo: int | None = None,
-                    update_only: bool = False):
+                    update_only: bool = False, dirty=None):
     """One fused step of searches (``active_r``) and upserts (``active_w``).
 
     The reference interleaves reads and writes per thread from one open
@@ -942,7 +980,9 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
     writer).
 
     Returns (pool, counters, status [B], done_r [B], found [B], vhi [B],
-    vlo [B]); status is ST_* for write keys, done_r/found/v* cover reads.
+    vlo [B]); status is ST_* for write keys, done_r/found/v* cover
+    reads.  With ``dirty`` given it rides after ``counters``, write
+    targets marked (see :func:`insert_step_spmd`).
 
     ``write_lo`` (static): when the caller lays each node's shard out as
     ``[reads | writes]`` with writes in ``[write_lo:]``, the apply runs on
@@ -966,8 +1006,8 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
     else:
         w = slice(write_lo, None)
         pad = write_lo
-    pool, counters, st_w, _ = _route_and_apply(
-        pool, locks, counters,
+    pool, counters, dirty, st_w, _ = _route_and_apply(
+        pool, locks, counters, dirty,
         functools.partial(leaf_apply_spmd, update_only=update_only),
         addr[w], (done & active_w)[w],
         {"khi": khi[w], "klo": klo[w], "vhi": vhi[w], "vlo": vlo[w]},
@@ -976,7 +1016,9 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
         st_w = jnp.concatenate(
             [jnp.full(pad, ST_INVALID, jnp.int32), st_w])
     status = jnp.where(active_w, st_w, ST_INVALID)
-    return pool, counters, status, done_r, found, rvh, rvl
+    if dirty is None:
+        return pool, counters, status, done_r, found, rvh, rvl
+    return pool, counters, dirty, status, done_r, found, rvh, rvl
 
 
 # ---------------------------------------------------------------------------
@@ -1051,6 +1093,15 @@ class BatchedEngine:
         self._reclaim_mutex = threading.Lock()
         self._parent_descend_cache: dict = {}
         self.router = None
+        # Optional write-ahead op journal (utils/journal.py, attached by
+        # the recovery plane): every engine write op appends ONE batch
+        # record of its APPLIED rows before returning — the record is
+        # durable before the caller sees the ack, so recovery = restore
+        # chain + replay journal loses zero acknowledged ops (RPO 0).
+        # None (default) costs one `is None` test per op.  Single-writer
+        # contract: record order must match apply order, so journaled
+        # engines are driven from one thread (the drill/serving shape).
+        self.journal = None
         # Graceful degradation (data-plane failure story): once flipped,
         # every mutating entry point raises DegradedError (typed write
         # rejection) while searches keep serving; exit = checkpoint
@@ -1114,6 +1165,16 @@ class BatchedEngine:
     def _require_writable(self) -> None:
         if self._degraded_reason is not None:
             raise DegradedError(self._degraded_reason)
+
+    def attach_journal(self, journal) -> None:
+        """Attach (or detach, with ``None``) the write-ahead op journal;
+        see the ``journal`` attribute's contract in ``__init__``."""
+        self.journal = journal
+
+    def _journal_applied(self, kind: int, keys, values=None) -> None:
+        if self.journal is None or keys.size == 0:
+            return
+        self.journal.append(kind, keys, values)
 
     def _iters(self) -> int:
         # STATIC descent budget: max height + chase slack.  Deliberately
@@ -1192,7 +1253,8 @@ class BatchedEngine:
         fn = self._insert_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
-            in_specs = [spec, spec, spec, spec, spec, spec, spec, rep, spec]
+            in_specs = [spec, spec, spec, spec, spec, spec, spec, spec,
+                        rep, spec]
             if with_start:
                 in_specs.append(spec)
             if with_fresh:
@@ -1201,23 +1263,23 @@ class BatchedEngine:
                                           "new_addr", "old_hhi",
                                           "old_hlo")}
 
-            def kernel(pool, locks, counters, khi, klo, vhi, vlo, root,
-                       active, *rest):
+            def kernel(pool, locks, counters, dirty, khi, klo, vhi, vlo,
+                       root, active, *rest):
                 start = rest[0] if with_start else None
                 fresh = rest[-1] if with_fresh else None
                 return insert_step_spmd(
-                    pool, locks, counters, khi, klo, vhi, vlo, root, active,
-                    start, fresh, cfg=self.cfg, iters=iters,
-                    update_only=update_only)
+                    pool, locks, counters, khi, klo, vhi, vlo,
+                    root, active, start, fresh, cfg=self.cfg, iters=iters,
+                    update_only=update_only, dirty=dirty)
 
             sm = jax.shard_map(
                 kernel,
                 mesh=self.dsm.mesh,
                 in_specs=tuple(in_specs),
-                out_specs=((spec, spec, spec, log_spec) if with_fresh
-                           else (spec, spec, spec)),
+                out_specs=((spec, spec, spec, spec, log_spec) if with_fresh
+                           else (spec, spec, spec, spec)),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3))
             self._insert_cache[key] = fn
         return fn
 
@@ -1226,17 +1288,24 @@ class BatchedEngine:
         fn = self._delete_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
-            in_specs = [spec, spec, spec, spec, spec, rep, spec]
+            in_specs = [spec, spec, spec, spec, spec, spec, rep, spec]
             if with_start:
                 in_specs.append(spec)
+
+            def kernel(pool, locks, counters, dirty, khi, klo, root,
+                       active, *rest):
+                start = rest[0] if with_start else None
+                return delete_step_spmd(
+                    pool, locks, counters, khi, klo, root, active, start,
+                    cfg=self.cfg, iters=iters, dirty=dirty)
+
             sm = jax.shard_map(
-                functools.partial(delete_step_spmd, cfg=self.cfg,
-                                  iters=iters),
+                kernel,
                 mesh=self.dsm.mesh,
                 in_specs=tuple(in_specs),
-                out_specs=(spec, spec, spec),
+                out_specs=(spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3))
             self._delete_cache[key] = fn
         return fn
 
@@ -1251,19 +1320,27 @@ class BatchedEngine:
         fn = self._mixed_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
-            in_specs = [spec, spec, spec, spec, spec, spec, spec, rep,
-                        spec, spec]
+            in_specs = [spec, spec, spec, spec, spec, spec, spec, spec,
+                        rep, spec, spec]
             if with_start:
                 in_specs.append(spec)
+
+            def kernel(pool, locks, counters, dirty, khi, klo, vhi, vlo,
+                       root, active_r, active_w, *rest):
+                start = rest[0] if with_start else None
+                return mixed_step_spmd(
+                    pool, locks, counters, khi, klo, vhi, vlo, root,
+                    active_r, active_w, start, cfg=self.cfg, iters=iters,
+                    write_lo=write_lo, update_only=update_only,
+                    dirty=dirty)
+
             sm = jax.shard_map(
-                functools.partial(mixed_step_spmd, cfg=self.cfg,
-                                  iters=iters, write_lo=write_lo,
-                                  update_only=update_only),
+                kernel,
                 mesh=self.dsm.mesh,
                 in_specs=tuple(in_specs),
-                out_specs=(spec, spec, spec, spec, spec, spec, spec),
+                out_specs=(spec, spec, spec, spec, spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2))
+            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3))
             self._mixed_cache[key] = fn
         return fn
 
@@ -1313,15 +1390,22 @@ class BatchedEngine:
             args.append(self._shard(self.router.host_start(khi, klo)))
         with obs.span("engine.mixed.descend_lock_apply", n=int(n)):
             with self._step_mutex:
-                (self.dsm.pool, self.dsm.counters, status, done_r, found,
-                 rvh, rvl) = fn(self.dsm.pool, self.dsm.locks,
-                                self.dsm.counters, *args)
+                (self.dsm.pool, self.dsm.counters, self.dsm.dirty, status,
+                 done_r, found, rvh, rvl) = fn(
+                    self.dsm.pool, self.dsm.locks, self.dsm.counters,
+                    self.dsm.dirty, *args)
             status, done_r, found, rvh, rvl = self._unshard(
                 status, done_r, found, rvh, rvl)
         status = np.array(status[:n])  # writable: retry outcomes land here
         done_r = done_r[:n]
         found = np.array(found[:n])
         out_vals = np.array(bits.pairs_to_keys(rvh[:n], rvl[:n]))
+        # journal the fast-path applied writes BEFORE the retry branch:
+        # retried rows apply in later steps through insert() (which
+        # journals its own record), so appending here keeps record order
+        # == apply order even for same-key duplicates across the classes
+        fast_app = ~is_read & (status == ST_APPLIED)
+        self._journal_applied(J.J_UPSERT, keys[fast_app], values[fast_app])
         miss_r = is_read & ~done_r
         if miss_r.any():
             v2, f2 = self.search(keys[miss_r])
@@ -1571,10 +1655,17 @@ class BatchedEngine:
         total = self.cfg.machine_nr * self.B
         stats = {"applied": 0, "superseded": 0, "host_path": 0, "rounds": 0,
                  "st_locked": 0, "lock_timeouts": 0, "lock_timeout_keys": []}
+        applied_rows = np.zeros(n, bool)
         for i in range(0, n, total):
-            self._insert_chunk(keys[i:i + total], values[i:i + total],
-                               max_rounds, stats)
+            applied_rows[i:i + total] = self._insert_chunk(
+                keys[i:i + total], values[i:i + total], max_rounds, stats)
         self.flush_parents()
+        # ONE journal batch record of the rows that actually landed
+        # (superseded duplicates carry the winner's value — excluded;
+        # lock-timeout rejections never applied — excluded), durable
+        # before the caller sees the stats ack
+        self._journal_applied(J.J_UPSERT, keys[applied_rows],
+                              values[applied_rows])
         return stats
 
     def _get_parent_descend(self, iters: int, stop_level: int = 1):
@@ -1828,10 +1919,14 @@ class BatchedEngine:
             self._pending_parents.append((int(sk[i]), int(new_addr[i])))
 
     def _insert_chunk(self, keys, values, max_rounds, stats):
+        """-> applied [n] bool: rows whose OWN value landed in the pool
+        (device fast path or host fallback) — the journal's record set.
+        Superseded duplicates and lock-timeout rejections stay False."""
         import os
         import time as _t
         dbg = os.environ.get("SHERMAN_DEBUG_INSERT")
         n = keys.shape[0]
+        applied_rows = np.zeros(n, bool)
         pending = np.ones(n, bool)
         # consecutive rounds each row spent blocked on a HELD page lock
         # (bounded lock retry: see the ST_LOCKED handling below)
@@ -1851,7 +1946,7 @@ class BatchedEngine:
                 print(f"[ins] round {round_i} pending={pending.sum()} "
                       f"t={_t.time():.1f}", flush=True)
             if not pending.any():
-                return
+                return applied_rows
             n_before = int(pending.sum())
             stats["rounds"] += 1
             idx = np.nonzero(pending)[0]
@@ -1898,13 +1993,15 @@ class BatchedEngine:
                           n=int(idx.shape[0]), round=round_i):
                 with self._step_mutex:  # launch-only (prep above)
                     if with_fresh:
-                        self.dsm.pool, self.dsm.counters, status, log = fn(
+                        (self.dsm.pool, self.dsm.counters, self.dsm.dirty,
+                         status, log) = fn(
                             self.dsm.pool, self.dsm.locks,
-                            self.dsm.counters, *args)
+                            self.dsm.counters, self.dsm.dirty, *args)
                     else:
-                        self.dsm.pool, self.dsm.counters, status = fn(
+                        (self.dsm.pool, self.dsm.counters, self.dsm.dirty,
+                         status) = fn(
                             self.dsm.pool, self.dsm.locks,
-                            self.dsm.counters, *args)
+                            self.dsm.counters, self.dsm.dirty, *args)
                         log = None
                 status = self._unshard(status)[:idx.shape[0]]
             if dbg:
@@ -1929,6 +2026,7 @@ class BatchedEngine:
 
             stats["applied"] += int((status == ST_APPLIED).sum())
             stats["superseded"] += int((status == ST_SUPERSEDED).sum())
+            applied_rows[idx[status == ST_APPLIED]] = True
             done = (status == ST_APPLIED) | (status == ST_SUPERSEDED)
             pending[idx[done]] = False
 
@@ -1967,6 +2065,7 @@ class BatchedEngine:
             for j in idx[bad]:
                 self.tree.insert(int(keys[j]), int(values[j]))
                 stats["host_path"] += 1
+                applied_rows[j] = True
                 pending[j] = False
             if bad.any():
                 self.tree._refresh_root()
@@ -1997,6 +2096,8 @@ class BatchedEngine:
         for j in np.nonzero(pending)[0]:
             self.tree.insert(int(keys[j]), int(values[j]))
             stats["host_path"] += 1
+            applied_rows[j] = True
+        return applied_rows
 
     def _recover_wedged_locks(self, keys: np.ndarray) -> np.ndarray:
         """Lock-lease recovery for keys blocked on held page locks:
@@ -2420,6 +2521,10 @@ class BatchedEngine:
         for i in range(0, n, total):
             out[i:i + total] = self._delete_chunk(keys[i:i + total],
                                                   max_rounds)
+        # journal the deletes that actually cleared a slot (not-found
+        # rows are no-ops; replaying them would also be, but keeping the
+        # record set == applied set keeps replay accounting exact)
+        self._journal_applied(J.J_DELETE, keys[out])
         return out
 
     def _delete_chunk(self, keys, max_rounds) -> np.ndarray:
@@ -2442,9 +2547,10 @@ class BatchedEngine:
             with obs.span("engine.delete.descend_lock_apply",
                           n=int(idx.shape[0])):
                 with self._step_mutex:  # launch-only (prep above)
-                    self.dsm.pool, self.dsm.counters, status = fn(
+                    (self.dsm.pool, self.dsm.counters, self.dsm.dirty,
+                     status) = fn(
                         self.dsm.pool, self.dsm.locks, self.dsm.counters,
-                        *args)
+                        self.dsm.dirty, *args)
                 status = self._unshard(status)[:idx.shape[0]]
 
             found_out[idx[status == ST_APPLIED]] = True
@@ -2724,6 +2830,8 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
         tree.dsm.pool, mk(leaf_rows), flat(khi), flat(klo), flat(vhi),
         flat(vlo), mk(live), mk(lhi), mk(llo), mk(hhi), mk(hlo), mk(sib),
         per_leaf=per_leaf)
+    # direct installs bypass the step path: mark for delta checkpoints
+    tree.dsm.mark_dirty_rows(leaf_rows)
 
     all_pages = []
     all_addrs = []
@@ -2793,6 +2901,7 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
         rows = _addr_rows(flat_addrs, cfg.pages_per_node)
         tree.dsm.pool = _install_pages(tree.dsm.pool, mk(rows),
                                        mk(flat_pages))
+        tree.dsm.mark_dirty_rows(rows)
 
     # Install root (bulk load is cluster-quiescent) and POISON the old root:
     # clients holding a stale root handle recover through the B-link chase

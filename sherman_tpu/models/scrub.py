@@ -22,8 +22,12 @@ acts on what it finds:
 Entry-level violations (a torn fver/rver slot, an out-of-fence slot)
 stay contained: the page is fenced off from writers and counted, the
 engine keeps serving.  The documented exit from degraded mode is
-``utils.checkpoint.restore`` + re-validate — ``tools/chaos_drill.py``
-runs the whole inject -> detect -> recover -> re-validate sequence.
+TARGETED REPAIR (``recovery.RecoveryPlane.targeted_repair``: restore
+only the flagged pages from the checkpoint chain, scrub-recertify,
+replay the op journal), with a full ``utils.checkpoint`` chain restore
+as the fallback when repair cannot re-certify —
+``tools/recovery_drill.py`` runs the repair sequence,
+``tools/chaos_drill.py`` the full-restore one.
 
 Metrics: ``scrub.passes``, ``scrub.pages_checked``,
 ``scrub.violations`` (counters), ``scrub.quarantined`` (gauge).
@@ -134,6 +138,11 @@ class Scrubber:
             # the page wedged its lock) is revoked, then retaken
             self.tree._try_revoke_lease(la, old)
         return False
+
+    def damaged_addrs(self) -> list[int]:
+        """Every page address this scrubber has flagged (any violation
+        class) — the targeted-repair input set."""
+        return sorted(self.flagged)
 
     def release_quarantine(self) -> int:
         """Drop every quarantine lock (after repair + re-validation

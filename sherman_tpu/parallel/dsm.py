@@ -518,6 +518,20 @@ class DSM(_HostOps):
         self.pool = _zeros((N * P, PAGE_WORDS), jnp.int32)
         self.locks = _zeros((N * L,), jnp.int32)
         self.counters = _zeros((N * N_COUNTERS,), jnp.uint32)
+        # Dirty-page tracking (the recovery plane's delta-checkpoint
+        # feed, utils/checkpoint.checkpoint_delta): pages written since
+        # the last checkpoint artifact.  Two tiers, united at save time:
+        # - ``dirty``: a pool-sharded device mask the engine's compiled
+        #   write programs OR into owner-side (leaf applies, splits,
+        #   deletes — their target pages never surface host-side);
+        # - ``_dirty_host``: a host set of global pool rows, marked at
+        #   the DSM.step boundary from the (host-visible) request batch
+        #   — one address-set union per control-plane step — plus
+        #   explicit marks for direct installs (bulk_load).
+        # Chaos corruption pokes bypass both on purpose: injected damage
+        # is not a legal write and must NOT leak into delta artifacts.
+        self.dirty = _zeros((N * P,), jnp.bool_)
+        self._dirty_host: set[int] = set()
 
         spec = jax.sharding.PartitionSpec(AXIS)
         in_specs = (spec, spec, spec,
@@ -580,6 +594,7 @@ class DSM(_HostOps):
         Thread-safe: one step at a time (the state arrays are donated).
         """
         _OBS_HOST_STEPS.inc()
+        self._mark_dirty_from_reqs(reqs)
         with self._step_mutex:
             if self.chaos is None:
                 return self._step_locked(reqs)
@@ -618,6 +633,71 @@ class DSM(_HostOps):
         :class:`~sherman_tpu.chaos.FaultPlan`; its step indices count
         host steps from the moment of installation."""
         self.chaos = plan
+
+    # -- dirty-page tracking (delta-checkpoint feed) -------------------------
+
+    _POOL_WRITE_OPS = (OP_WRITE, OP_WRITE_WORD, OP_CAS, OP_FAA,
+                       OP_MASKED_CAS, OP_MASKED_FAA)
+
+    def _mark_dirty_from_reqs(self, reqs) -> None:
+        """One address-set union per host step: every pool-space request
+        that CAN mutate its page marks that page dirty (CAS losers
+        over-mark — a harmless extra delta row, never a missed one).
+        Pure numpy (no device trip); out-of-range addresses are the
+        requests _apply refuses with ok=0 — skipped here too.
+        Multihost: deltas are unsupported there (dirty_rows raises, the
+        collective checkpoint never clears) — don't grow an
+        unconsumable set on a long-running server."""
+        if self.multihost:
+            return
+        op = np.asarray(reqs["op"]).ravel()
+        wr = np.isin(op, self._POOL_WRITE_OPS) \
+            & (np.asarray(reqs["space"]).ravel() == SPACE_POOL)
+        if not wr.any():
+            return
+        a = np.asarray(reqs["addr"]).ravel()[wr].astype(np.int64) \
+            & 0xFFFFFFFF
+        node = a >> CFG.ADDR_PAGE_BITS
+        page = a & CFG.ADDR_PAGE_MASK
+        ok = (node < self.cfg.machine_nr) & (page < self.cfg.pages_per_node)
+        rows = node[ok] * self.cfg.pages_per_node + page[ok]
+        self._dirty_host.update(int(r) for r in np.unique(rows))
+
+    def mark_dirty_rows(self, rows) -> None:
+        """Explicitly mark global pool rows dirty (direct pool installs
+        — bulk_load — whose writes bypass the step/request path).
+        No-op on multihost (deltas unsupported: nothing ever consumes
+        or clears the set there)."""
+        if self.multihost:
+            return
+        self._dirty_host.update(int(r) for r in np.asarray(rows).ravel())
+
+    def dirty_rows(self) -> np.ndarray:
+        """Sorted global pool rows written since the last clear: the
+        device mask (engine write programs) united with the host set
+        (DSM.step boundary + direct installs).  Single-process only —
+        multihost deltas are unsupported (full per-host checkpoints)."""
+        if self.multihost:
+            raise RuntimeError("dirty_rows is single-process only")
+        dev = np.nonzero(np.asarray(self.dirty))[0].astype(np.int64)
+        if not self._dirty_host:
+            return dev
+        host = np.fromiter(self._dirty_host, np.int64,
+                           len(self._dirty_host))
+        return np.union1d(dev, host)
+
+    def clear_dirty(self) -> None:
+        """Reset both dirty tiers (a checkpoint artifact captured them)."""
+        N, P = self.cfg.machine_nr, self.cfg.pages_per_node
+        if not self.multihost:
+            self.dirty = jax.device_put(jnp.zeros(N * P, jnp.bool_),
+                                        self.shard)
+        else:
+            self.dirty = jax.make_array_from_callback(
+                (N * P,), self.shard,
+                lambda idx: np.zeros(self.shard.shard_shape((N * P,)),
+                                     bool))
+        self._dirty_host.clear()
 
     # -- host convenience ops (control plane / slow paths / tests) -----------
     # Each builds a small batch and steps once; requests are spread over
@@ -741,6 +821,8 @@ class ReplicatedDSM(_HostOps):
                      lambda s, v: setattr(s._dsm, "locks", v))
     counters = property(lambda s: s._dsm.counters,
                         lambda s, v: setattr(s._dsm, "counters", v))
+    dirty = property(lambda s: s._dsm.dirty,
+                     lambda s, v: setattr(s._dsm, "dirty", v))
     cfg = property(lambda s: s._dsm.cfg)
     mesh = property(lambda s: s._dsm.mesh)
     shard = property(lambda s: s._dsm.shard)
@@ -752,6 +834,12 @@ class ReplicatedDSM(_HostOps):
 
     def counter_snapshot(self) -> dict[str, int]:
         return self._dsm.counter_snapshot()
+
+    def mark_dirty_rows(self, rows) -> None:
+        self._dsm.mark_dirty_rows(rows)
+
+    def clear_dirty(self) -> None:
+        self._dsm.clear_dirty()
 
     def _batch(self, rows: list[dict]) -> Replies:
         from jax.experimental import multihost_utils as mhu

@@ -1,0 +1,337 @@
+"""Recovery plane — checkpoint chains + op journal + targeted repair.
+
+PR 3 made the failure story detection-rich (chaos injection, lock-lease
+recovery, online scrubbing, degraded mode) but recovery-poor: the only
+documented exit was a FULL cluster restore — minutes of unavailability
+and every op since the last checkpoint lost, for a single flipped word.
+This module is the recovery half, coordinating three primitives so that
+recovery time scales with the *damage*, not the pool:
+
+- **incremental checkpoints** (``utils/checkpoint.checkpoint_delta``):
+  cheap frequent deltas of only the pages written since the previous
+  chain link (the DSM's dirty tracking), chained by the (nonce, seq,
+  crc) epoch machinery with per-array CRCs;
+- the **write-ahead op journal** (``utils/journal.py``): one CRC-framed
+  batch record per acknowledged engine write op, fsync'd before the
+  ack, so ``restore chain + replay journal`` loses zero acknowledged
+  ops (RPO 0);
+- **targeted repair**: degraded mode's real exit — restore only the
+  quarantined/violating pages from the chain, re-certify with a scrub
+  pass, exit degraded, and catch the repaired pages up by replaying the
+  journal.  Structure-changing damage that a local repair cannot mend
+  fails TYPED (:class:`TargetedRepairFailed`) and the caller falls back
+  to the full-restore path — never a silently wrong pool.
+
+On-disk layout under one recovery directory (single-process meshes —
+the chaos/drill tier; multihost deployments use the collective full
+checkpoint path)::
+
+    base.npz                     full checkpoint (chain link 0)
+    delta-<cid>-000001.npz ...   delta links, in order
+    journal-<cid>-000001.wal ... op journal segments (segment k holds
+                                 the ops acknowledged after chain link k)
+
+``<cid>`` is the chain id (the base epoch's random nonce), so artifacts
+of a superseded chain can never be mistaken for the live one: after a
+crash + recover, the plane re-bases (new cid) and stale files are both
+ignored by discovery and swept.
+
+The crash contract, window by window:
+
+- crash before a journal append completes: the op was never acked; the
+  torn tail is truncated at replay (``journal.truncated_tails``);
+- crash after append, before the engine returns: the op replays — "ack
+  may lag apply" (at-least-once), never the reverse;
+- crash mid-checkpoint: ``_savez_atomic`` leaves the previous artifact
+  intact, the tmp orphan is swept at the next save;
+- crash between a checkpoint and its journal rotation: the old segment
+  overlaps the new link; in-order replay is convergent (upsert/delete
+  idempotency), so replaying it is correct, just redundant.
+
+``tools/recovery_drill.py`` (``bench.py --recovery-drill``) rehearses
+the whole sequence end to end and publishes measured ``recovery.rpo_ops``
+/ ``recovery.rto_ms``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+
+import numpy as np
+
+from sherman_tpu import obs
+from sherman_tpu.utils import checkpoint as CK
+from sherman_tpu.utils import journal as J
+
+_OBS_RPO = obs.gauge("recovery.rpo_ops")
+_OBS_RTO = obs.gauge("recovery.rto_ms")
+_OBS_RECOVERS = obs.counter("recovery.recovers")
+_OBS_REPAIRS = obs.counter("recovery.targeted_repairs")
+_OBS_REPAIR_FAILS = obs.counter("recovery.targeted_repair_failures")
+_OBS_PAGES_REPAIRED = obs.counter("recovery.pages_repaired")
+
+
+class TargetedRepairFailed(RuntimeError):
+    """Chain-based page repair could not re-certify the pool (structure
+    changed since the chain tip, or damage beyond the repaired set):
+    the engine STAYS degraded and the caller falls back to a full
+    restore (``RecoveryPlane.recover``)."""
+
+
+def _cid_of(epoch) -> str:
+    return f"{int(np.asarray(epoch).ravel()[0]) & 0xFFFFFFFF:08x}"
+
+
+class RecoveryPlane:
+    """Durability coordinator over one (cluster, tree, engine) triple.
+
+    Lifecycle: construct -> :meth:`checkpoint_base` (starts the chain
+    and the journal; from here every engine write op is journaled) ->
+    periodic :meth:`checkpoint_delta` -> on crash,
+    :meth:`RecoveryPlane.recover`; on data-plane corruption caught by
+    the scrubber, :meth:`targeted_repair`.
+    """
+
+    def __init__(self, cluster, tree, eng, directory: str,
+                 journal_sync: bool = True):
+        if cluster.dsm.multihost:
+            raise RuntimeError("RecoveryPlane is single-process only")
+        self.cluster = cluster
+        self.tree = tree
+        self.eng = eng
+        self.dir = directory
+        self.journal_sync = bool(journal_sync)
+        os.makedirs(directory, exist_ok=True)
+        self.base_path = os.path.join(directory, "base.npz")
+        self.cid: str | None = None
+        self.delta_paths: list[str] = []
+        self._tip_epoch = None
+        self._segment = 0
+
+    # -- artifact naming ------------------------------------------------------
+
+    def _delta_path(self, k: int) -> str:
+        return os.path.join(self.dir, f"delta-{self.cid}-{k:06d}.npz")
+
+    def _journal_path(self, k: int) -> str:
+        return os.path.join(self.dir, f"journal-{self.cid}-{k:06d}.wal")
+
+    @staticmethod
+    def _discover(directory: str):
+        """-> (cid, delta_paths, journal_paths) of the on-disk chain
+        anchored at base.npz; stale-cid artifacts are ignored."""
+        base = os.path.join(directory, "base.npz")
+        if not os.path.exists(base):
+            raise FileNotFoundError(
+                f"{directory}: no base.npz — nothing to recover")
+        epoch = CK._load_arrays(base, keys=("epoch",)).get("epoch")
+        if epoch is None:
+            raise CK.CheckpointCorruptError(
+                f"{base}: base carries no epoch (pre-recovery-plane "
+                "artifact) — cannot anchor a chain")
+        cid = _cid_of(epoch)
+        deltas = sorted(glob.glob(
+            os.path.join(directory, f"delta-{cid}-*.npz")))
+        journals = sorted(glob.glob(
+            os.path.join(directory, f"journal-{cid}-*.wal")))
+        return cid, deltas, journals
+
+    def _sweep_stale(self) -> int:
+        """Remove artifacts whose cid is not the live chain's (a
+        superseded chain after a re-base)."""
+        n = 0
+        for f in glob.glob(os.path.join(self.dir, "delta-*.npz")) \
+                + glob.glob(os.path.join(self.dir, "journal-*.wal")):
+            name = os.path.basename(f)
+            if self.cid is not None and f"-{self.cid}-" in name:
+                continue
+            try:
+                os.unlink(f)
+                n += 1
+            except OSError:
+                pass
+        return n
+
+    # -- saving ---------------------------------------------------------------
+
+    def _rotate_journal(self, k: int) -> None:
+        """Start journal segment ``k`` (ops after chain link ``k``) and
+        retire the previous segment — its ops are captured by the
+        artifact that was just made durable."""
+        old = self.eng.journal
+        self.eng.attach_journal(J.Journal(self._journal_path(k),
+                                          sync=self.journal_sync))
+        self._segment = k
+        if old is not None:
+            old.close()
+        for f in glob.glob(os.path.join(self.dir,
+                                        f"journal-{self.cid}-*.wal")):
+            if f != self._journal_path(k):
+                try:
+                    os.unlink(f)
+                except OSError:
+                    pass
+
+    def checkpoint_base(self) -> dict:
+        """Full checkpoint -> new chain (new cid); sweeps the superseded
+        chain's artifacts and starts journal segment 1."""
+        self.eng.flush_parents()  # deferred parent entries are state
+        epoch = CK.checkpoint(self.cluster, self.base_path)
+        self.cid = _cid_of(epoch)
+        self._tip_epoch = epoch
+        self.delta_paths = []
+        self._sweep_stale()
+        self._rotate_journal(1)
+        return {"path": self.base_path, "cid": self.cid,
+                "bytes": os.path.getsize(self.base_path)}
+
+    def checkpoint_delta(self) -> dict:
+        """Delta link: only the pages written since the previous link,
+        then journal rotation.  Falls back to :meth:`checkpoint_base`
+        when no chain exists yet."""
+        if self.cid is None:
+            return self.checkpoint_base()
+        self.eng.flush_parents()
+        k = len(self.delta_paths) + 1
+        path = self._delta_path(k)
+        info = CK.checkpoint_delta(self.cluster, path,
+                                   parent_epoch=self._tip_epoch)
+        self.delta_paths.append(path)
+        self._tip_epoch = info["epoch"]
+        self._rotate_journal(k + 1)
+        info["path"] = path
+        return info
+
+    def close(self) -> None:
+        if self.eng.journal is not None:
+            self.eng.journal.close()
+            self.eng.attach_journal(None)
+
+    # -- full recovery --------------------------------------------------------
+
+    @classmethod
+    def recover(cls, directory: str, mesh=None, batch_per_node: int = 512,
+                tcfg=None, journal_sync: bool = True,
+                attach_router: bool = True):
+        """Rebuild a serving engine from the on-disk chain + journal.
+
+        restore(base + deltas) -> replay journal segments in order ->
+        re-base (fresh chain capturing the replayed state).  Returns
+        (plane, cluster, tree, eng, receipt) with the receipt carrying
+        the per-phase wall times and replay counts — the drill turns
+        these into the published RTO.
+        """
+        from sherman_tpu.models.batched import BatchedEngine
+        from sherman_tpu.models.btree import Tree
+
+        t0 = time.perf_counter()
+        cid, deltas, journals = cls._discover(directory)
+        cluster = CK.restore_chain(os.path.join(directory, "base.npz"),
+                                   deltas, mesh=mesh)
+        t_restore = time.perf_counter()
+        tree = Tree(cluster)
+        eng = BatchedEngine(tree, batch_per_node=batch_per_node, tcfg=tcfg)
+        if attach_router:
+            eng.attach_router()
+        replay_stats = {"records": 0, "rows": 0, "upserts": 0,
+                        "deletes": 0, "segments": 0}
+        # replay ALL live-chain segments ascending: in-order replay is
+        # convergent, so a segment overlapping its checkpoint (crash
+        # between save and rotation) is redundant, never wrong
+        for seg in journals:
+            st = J.replay(seg, eng)
+            for k2, v in st.items():
+                replay_stats[k2] += v
+            replay_stats["segments"] += 1
+        t_replay = time.perf_counter()
+        plane = cls(cluster, tree, eng, directory,
+                    journal_sync=journal_sync)
+        plane.checkpoint_base()  # re-base: fresh chain, stale cid swept
+        t_end = time.perf_counter()
+        _OBS_RECOVERS.inc()
+        receipt = {
+            "chain": {"cid": cid, "deltas": len(deltas)},
+            "restore_ms": round((t_restore - t0) * 1e3, 1),
+            "replay_ms": round((t_replay - t_restore) * 1e3, 1),
+            "rebase_ms": round((t_end - t_replay) * 1e3, 1),
+            "total_ms": round((t_end - t0) * 1e3, 1),
+            "replay": replay_stats,
+        }
+        return plane, cluster, tree, eng, receipt
+
+    # -- targeted repair (degraded mode's real exit) --------------------------
+
+    def targeted_repair(self, scrubber=None, addrs=(),
+                        verify_structure: bool = True) -> dict:
+        """Restore only the damaged pages from the chain, re-certify,
+        exit degraded, replay the journal to catch the repaired pages
+        up.  ``addrs``: extra packed page addresses beyond the
+        scrubber's flagged set.  Raises :class:`TargetedRepairFailed`
+        (engine stays degraded) when the scrub pass does not come back
+        clean — the caller falls back to :meth:`recover`.
+        """
+        from sherman_tpu.models.validate import scrub_pass
+        from sherman_tpu.ops import bits
+        from sherman_tpu.parallel import dsm as D
+
+        if self.cid is None:
+            raise RuntimeError("no chain: checkpoint_base() first")
+        t0 = time.perf_counter()
+        damaged = sorted(set(int(a) for a in addrs)
+                         | (set(scrubber.flagged) if scrubber is not None
+                            else set()))
+        if not damaged:
+            return {"pages": 0, "ok": True, "repair_ms": 0.0}
+        P = self.cluster.cfg.pages_per_node
+        rows = [bits.addr_node(a) * P + bits.addr_page(a) for a in damaged]
+        pages = CK.read_chain_rows(self.base_path, self.delta_paths, rows)
+        # raw DSM page writes: unaffected by the scrubber's quarantine
+        # locks (those fence TREE writers), marked dirty for the next
+        # delta by the host-step boundary union
+        self.tree.dsm.write_rows([
+            {"op": D.OP_WRITE, "addr": a, "woff": 0,
+             "nw": pages.shape[1], "payload": pages[i]}
+            for i, a in enumerate(damaged)])
+        _OBS_PAGES_REPAIRED.inc(len(damaged))
+        # re-certify BEFORE exiting degraded: the whole pool must scrub
+        # clean — a repair that only moved the damage fails typed here
+        res = scrub_pass(self.tree)
+        if res["violations"]:
+            _OBS_REPAIR_FAILS.inc()
+            raise TargetedRepairFailed(
+                f"scrub still reports {res['violations']} violating "
+                f"page(s) after repairing {len(damaged)} "
+                f"({res['classes']}); falling back to full recover() "
+                "is the documented exit")
+        if scrubber is not None:
+            scrubber.release_quarantine()
+        self.eng.exit_degraded()
+        # content catch-up: ops acknowledged since the chain tip live in
+        # the journal; replaying them (journal detached — replay must
+        # not re-journal itself) rebuilds the repaired pages' lost
+        # writes; untouched pages just re-apply their own values
+        seg, self.eng.journal = self.eng.journal, None
+        try:
+            if seg is not None:
+                seg.close()
+            replay_stats = J.replay(self._journal_path(self._segment),
+                                    self.eng) \
+                if os.path.exists(self._journal_path(self._segment)) \
+                else {"records": 0, "rows": 0}
+        finally:
+            # reopen the segment for appends (replay only truncated torn
+            # tails; the records themselves stay — recovery replays them
+            # again idempotently if we crash later)
+            self.eng.attach_journal(J.Journal(
+                self._journal_path(self._segment),
+                sync=self.journal_sync))
+        out = {"pages": len(damaged), "ok": True,
+               "replay": replay_stats,
+               "repair_ms": round((time.perf_counter() - t0) * 1e3, 1)}
+        if verify_structure:
+            from sherman_tpu.models.validate import check_structure_device
+            out["structure"] = check_structure_device(self.tree)
+        _OBS_REPAIRS.inc()
+        return out

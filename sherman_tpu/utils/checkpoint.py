@@ -24,17 +24,35 @@ of the old incarnation survives.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import zlib
 
 import numpy as np
 
 from sherman_tpu import config as _C
+from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig
 
 _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
                "step_capacity", "host_step_capacity", "chunk_pages",
                "exchange_impl", "gather_impl")
+
+# fsync indirection for tests (patching os.fsync itself would also
+# intercept interpreter/numpy internals)
+_fsync = os.fsync
+
+_OBS_FULL_SAVES = obs.counter("ckpt.full_saves")
+_OBS_DELTA_SAVES = obs.counter("ckpt.delta_saves")
+_OBS_DELTA_PAGES = obs.counter("ckpt.delta_pages")
+_OBS_DELTA_BYTES = obs.counter("ckpt.delta_bytes")
+_OBS_ORPHANS = obs.counter("ckpt.orphans_swept")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint artifact failed its content CRC / framing / chain
+    pairing — corruption is detected at restore time, never served."""
 
 # Page-layout fingerprint stamped into every checkpoint: the pool is raw
 # words, so restoring across a layout change (e.g. round 4's packed
@@ -53,6 +71,13 @@ def cfg_to_json(cfg) -> bytes:
 
 
 def cfg_from_json(raw) -> DSMConfig:
+    """Saved cfg JSON -> DSMConfig, under the _CFG_FIELDS forward-compat
+    contract: fields ABSENT from the JSON (a checkpoint written before
+    the field existed, e.g. pre-``gather_impl``) take the DSMConfig
+    default — never a KeyError; fields this build does NOT know (a
+    checkpoint written by a newer build) refuse loudly — silently
+    dropping a semantic knob could reinterpret the pool."""
+    import dataclasses
     d = json.loads(bytes(raw).decode())
     tag = d.pop("_layout", None)
     if tag != LAYOUT_TAG:
@@ -60,6 +85,12 @@ def cfg_from_json(raw) -> DSMConfig:
             f"checkpoint page layout {tag or 'unstamped'!r} does not match "
             f"this build's {LAYOUT_TAG!r}; re-create the checkpoint (raw "
             "page words cannot be reinterpreted across layouts)")
+    known = {f.name for f in dataclasses.fields(DSMConfig)}
+    unknown = sorted(set(d) - known)
+    if unknown:
+        raise RuntimeError(
+            f"checkpoint cfg carries unknown fields {unknown} (written "
+            "by a newer build?); refusing to drop config knobs silently")
     return DSMConfig(**d)
 
 
@@ -71,7 +102,7 @@ def _local_block(arr) -> np.ndarray:
     return np.concatenate([np.asarray(s.data) for s in shards])
 
 
-def checkpoint(cluster, path: str) -> None:
+def checkpoint(cluster, path: str):
     """Write the cluster's full state to ``path`` (.npz).
 
     Multi-host clusters write one shard file per host
@@ -98,15 +129,26 @@ def checkpoint(cluster, path: str) -> None:
                 what="collective checkpoint save",
                 diagnostics=lambda: cluster.dsm.counter_snapshot()):
             _checkpoint_multihost(cluster, path)
-        return
+        return None
     dsm = cluster.dsm
-    _savez_atomic(
-        path, 0,
+    man = _manifest(cluster)
+    # Epoch on single-host full checkpoints too: the (nonce, seq, crc)
+    # triple is what delta artifacts chain their parent_epoch to.
+    seq = cluster.keeper.mem_fetch_and_add("checkpoint_epoch")
+    epoch = make_epoch(man, seq)
+    arrays = dict(
         pool=np.asarray(dsm.pool),
         locks=np.asarray(dsm.locks),
         counters=np.asarray(dsm.counters),
-        **_manifest(cluster),
+        epoch=epoch,
+        **man,
     )
+    arrays["integrity"] = _integrity(arrays)
+    _savez_atomic(path, 0, **arrays)
+    _OBS_FULL_SAVES.inc()
+    # A full save captures everything: dirty tracking restarts here.
+    dsm.clear_dirty()
+    return epoch
 
 
 def _checkpoint_multihost(cluster, path: str) -> None:
@@ -142,18 +184,21 @@ def _checkpoint_multihost(cluster, path: str) -> None:
             f"checkpoint epoch {all_ep.tolist()} (divergent checkpoint "
             "counts or manifests — the replicated-driver invariant is "
             "broken); the previous checkpoint is left intact")
-    _savez_atomic(
-        f"{path}.host{me}.npz", me,
+    shard_arrays = dict(
         pool=_local_block(dsm.pool),
         locks=_local_block(dsm.locks),
         counters=_local_block(dsm.counters),
         nodes=np.asarray(list(dsm.local_nodes), np.int64),
         epoch=epoch,
     )
-    _savez_atomic(
-        path, me,
+    shard_arrays["integrity"] = _integrity(shard_arrays)
+    _savez_atomic(f"{path}.host{me}.npz", me, **shard_arrays)
+    man_arrays = dict(
         multihost=np.asarray([jax.process_count()], np.int64),
         epoch=epoch, **man)
+    man_arrays["integrity"] = _integrity(man_arrays)
+    _savez_atomic(path, me, **man_arrays)
+    _OBS_FULL_SAVES.inc()
     cluster.keeper.barrier("checkpoint")
 
 
@@ -172,12 +217,98 @@ def make_epoch(man: dict, seq: int, nonce: int | None = None) -> np.ndarray:
     return np.asarray([nonce, seq, np.uint32(dig).view(np.int32)], np.int32)
 
 
+def _sweep_tmp_orphans(path: str) -> int:
+    """Remove ``<path>.tmp*.npz`` leftovers from a writer that crashed
+    mid-:func:`_savez_atomic` (before its os.replace).  Returns the
+    count removed.  Safe by construction: a live writer's tmp file only
+    exists inside its own _savez_atomic call, which sweeps BEFORE
+    creating it; concurrent writers to one path are already excluded by
+    the single-saver contract."""
+    n = 0
+    for orphan in glob.glob(glob.escape(path) + ".tmp*.npz"):
+        try:
+            os.unlink(orphan)
+            n += 1
+        except OSError:
+            pass  # raced with another sweeper: gone either way
+    if n:
+        _OBS_ORPHANS.inc(n)
+    return n
+
+
 def _savez_atomic(path: str, tag: int, **arrays) -> None:
-    """np.savez_compressed via tmp + atomic replace: a crash mid-write
-    never clobbers an existing checkpoint file."""
+    """np.savez_compressed via tmp + fsync + atomic replace + directory
+    fsync: a crash mid-write never clobbers an existing checkpoint file,
+    and a completed save survives power loss (the data AND the rename
+    are both on disk before return).  Stale tmp orphans from a previous
+    crash are swept first."""
+    _sweep_tmp_orphans(path)
     tmp = f"{path}.tmp{tag}.npz"
-    np.savez_compressed(tmp, **arrays)
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+        f.flush()
+        _fsync(f.fileno())
     os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        _fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def _integrity(arrays: dict) -> np.ndarray:
+    """Per-array content CRCs, stored alongside the arrays so restore
+    detects corruption instead of serving it (npz member checksums
+    cover the compressed stream; this covers the decoded content, one
+    named CRC per array — a typed CheckpointCorruptError names the
+    damaged array)."""
+    crcs = {k: int(np.uint32(zlib.crc32(
+        np.ascontiguousarray(v).tobytes())))
+        for k, v in arrays.items()}
+    return np.frombuffer(json.dumps(crcs).encode(), np.uint8).copy()
+
+
+def _verify_integrity(arrays: dict, path: str) -> None:
+    """Check every loaded array against the artifact's stored CRC map
+    (legacy artifacts without one pass — integrity is opt-out only by
+    age).  Raises :class:`CheckpointCorruptError` naming the array."""
+    blob = arrays.get("integrity")
+    if blob is None:
+        return
+    try:
+        crcs = json.loads(bytes(np.asarray(blob)).decode())
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable integrity map ({e})") from e
+    for k, v in arrays.items():
+        if k == "integrity" or k not in crcs:
+            continue
+        got = int(np.uint32(zlib.crc32(np.ascontiguousarray(v).tobytes())))
+        if got != int(crcs[k]):
+            raise CheckpointCorruptError(
+                f"{path}: array {k!r} failed its content CRC "
+                f"({got:#x} != stored {int(crcs[k]):#x}) — the artifact "
+                "is corrupt; restore from another chain link")
+
+
+def _load_arrays(path: str, keys=None) -> dict:
+    """np.load + materialize (+ CRC verify) with typed failure: any
+    unreadable/torn/corrupt artifact surfaces as
+    :class:`CheckpointCorruptError`, never a stack of zipfile/zlib
+    internals half-way through a restore."""
+    try:
+        with np.load(path) as z:
+            names = z.files if keys is None else \
+                [k for k in z.files if k in set(keys) | {"integrity"}]
+            out = {k: np.asarray(z[k]) for k in names}
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable checkpoint artifact "
+            f"({type(e).__name__}: {e})") from e
+    _verify_integrity(out, path)
+    return out
 
 
 # The manifest schema (one source of truth: _manifest() must emit exactly
@@ -224,26 +355,34 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
         from sherman_tpu.utils import failure
         with failure.Watchdog.maybe(what="collective checkpoint restore"):
             return _restore_multihost(path, mesh, keeper, clear_locks)
-    with np.load(path) as z:
-        cfg = cfg_from_json(z["cfg"])
-        saved_mh = int(z["multihost"][0]) if "multihost" in z else 0
-        if saved_mh != 0:  # durability check: must survive python -O
-            raise RuntimeError(
-                "multi-host checkpoint needs a multi-host cluster (pass "
-                "init_multihost()'s keeper on every host)")
-        cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
-        dsm = cluster.dsm
-        dsm.pool = jax.device_put(z["pool"], dsm.shard)
-        locks = z["locks"]
-        if clear_locks:
-            locks = np.zeros_like(locks)
-        dsm.locks = jax.device_put(locks, dsm.shard)
-        dsm.counters = jax.device_put(z["counters"], dsm.shard)
-        _restore_directories(cluster, z)
+    z = _load_arrays(path)
+    if "delta" in z:
+        raise CheckpointCorruptError(
+            f"{path} is a DELTA artifact: restore its chain with "
+            "restore_chain(base, deltas) — a delta alone holds only the "
+            "pages written since its parent")
+    cfg = cfg_from_json(z["cfg"])
+    saved_mh = int(z["multihost"][0]) if "multihost" in z else 0
+    if saved_mh != 0:  # durability check: must survive python -O
+        raise RuntimeError(
+            "multi-host checkpoint needs a multi-host cluster (pass "
+            "init_multihost()'s keeper on every host)")
+    cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
+    dsm = cluster.dsm
+    dsm.pool = jax.device_put(z["pool"], dsm.shard)
+    locks = z["locks"]
+    if clear_locks:
+        locks = np.zeros_like(locks)
+    dsm.locks = jax.device_put(locks, dsm.shard)
+    dsm.counters = jax.device_put(z["counters"], dsm.shard)
+    _restore_directories(cluster, z)
     return cluster
 
 
 def _restore_directories(cluster, man) -> None:
+    """SET the directory/allocator state to the manifest's (replace, not
+    merge: the free pool is cleared first, so chain restores can apply
+    successive manifests without double-reclaiming pages)."""
     from sherman_tpu.ops import bits as _bits
     by_node = {int(n): i for i, n in enumerate(man["dir_nodes"])}
     free_by_node: dict[int, list[int]] = {}
@@ -258,6 +397,7 @@ def _restore_directories(cluster, man) -> None:
         d.allocator._next = int(man["dir_next"][i])
         d.root_ptr = int(man["dir_root"][i][0])
         d.root_level = int(man["dir_root"][i][1])
+        d.allocator._free.clear()
         if free_by_node.get(d.node_id):
             d.allocator.reclaim(free_by_node[d.node_id])
 
@@ -287,11 +427,11 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     # would be wasteful
     man_keys = set(_MANIFEST_FIELDS) | {"multihost", "epoch"}
     try:
-        with np.load(path) as z:
-            man = {k: np.asarray(z[k]) for k in z.files if k in man_keys}
-        with np.load(f"{path}.host{me}.npz") as h:
-            shard = {k: np.asarray(h[k]) for k in h.files}
-    except Exception as e:  # missing/torn file: report via the gather
+        # typed + CRC-verified loads (corruption surfaces here and rides
+        # the status gather like any other host-local load failure)
+        man = _load_arrays(path, keys=man_keys)
+        shard = _load_arrays(f"{path}.host{me}.npz")
+    except Exception as e:  # missing/torn/corrupt file: report via gather
         err = f"{type(e).__name__}: {e}"
     loads_ok = int(man is not None and shard is not None and "cfg" in man)
     pair_ok, saved_mh = 1, -1
@@ -348,3 +488,177 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     dsm.counters = glob(shard["counters"])
     _restore_directories(cluster, man)
     return cluster
+
+
+# ---------------------------------------------------------------------------
+# Incremental (delta) checkpoints — the recovery plane's cheap-frequent
+# half (utils/journal.py is the replayable-log half; sherman_tpu/recovery.py
+# coordinates both).  A delta saves only the pages written since the
+# previous chain link (the DSM's dirty tracking: device-marked by the
+# engine's write programs, host-marked at the DSM.step boundary), plus
+# the full (tiny) locks/counters/manifest state, chained by the same
+# (nonce, seq, crc) epoch machinery the multihost save uses: each delta
+# records its parent's epoch, and restore refuses out-of-order or
+# mixed-chain links.  Single-process meshes only (the chaos/drill tier);
+# multihost deployments checkpoint full per-host shards.
+# ---------------------------------------------------------------------------
+
+def checkpoint_delta(cluster, path: str, parent_epoch) -> dict:
+    """Save a delta artifact chained onto ``parent_epoch`` (the epoch
+    returned by the previous :func:`checkpoint` / :func:`checkpoint_delta`
+    of this chain).  Clears the DSM's dirty tracking on success.
+    Returns {"pages", "bytes", "epoch"}."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    if cluster.keeper.is_multihost or cluster.dsm.multihost:
+        raise RuntimeError(
+            "delta checkpoints are single-process only; multihost "
+            "deployments take full per-host checkpoints")
+    if parent_epoch is None:
+        raise ValueError(
+            "checkpoint_delta needs the parent artifact's epoch "
+            "(returned by checkpoint()/checkpoint_delta())")
+    import jax.numpy as jnp
+    dsm = cluster.dsm
+    rows = dsm.dirty_rows()
+    man = _manifest(cluster)
+    seq = cluster.keeper.mem_fetch_and_add("checkpoint_epoch")
+    epoch = make_epoch(man, seq)
+    # gather the dirty pages DEVICE-side: the d2h transfer is then
+    # O(dirty pages) like the artifact, not O(pool) — at the 100 M-key
+    # config a full-pool materialization would cost the whole 4.3 GB
+    # tunnel transfer per "cheap frequent delta"
+    pages = (np.asarray(dsm.pool[jnp.asarray(rows)]) if rows.size
+             else np.zeros((0, _C.PAGE_WORDS), np.int32))
+    arrays = dict(
+        delta=np.asarray([1], np.int64),
+        parent_epoch=np.asarray(parent_epoch, np.int32).ravel(),
+        epoch=epoch,
+        delta_rows=rows.astype(np.int64),
+        delta_pages=pages,
+        locks=np.asarray(dsm.locks),
+        counters=np.asarray(dsm.counters),
+        **man,
+    )
+    arrays["integrity"] = _integrity(arrays)
+    _savez_atomic(path, 0, **arrays)
+    dsm.clear_dirty()
+    _OBS_DELTA_SAVES.inc()
+    _OBS_DELTA_PAGES.inc(int(rows.size))
+    size = os.path.getsize(path)
+    _OBS_DELTA_BYTES.inc(size)
+    return {"pages": int(rows.size), "bytes": int(size), "epoch": epoch}
+
+
+def _check_delta_link(z: dict, path: str, base_cfg_raw: bytes,
+                      prev_epoch, n_rows_max: int) -> None:
+    """Chain-pairing + sanity rules for one delta artifact."""
+    if "delta" not in z:
+        raise CheckpointCorruptError(
+            f"{path}: not a delta artifact (chain links after the base "
+            "must be checkpoint_delta outputs)")
+    if bytes(np.asarray(z["cfg"])) != base_cfg_raw:
+        raise CheckpointCorruptError(
+            f"{path}: delta cfg does not match the chain's base cfg — "
+            "links from different clusters cannot be mixed")
+    pe = np.asarray(z["parent_epoch"]).ravel()
+    prev = np.asarray(prev_epoch).ravel()
+    if pe.shape != prev.shape or not (pe == prev).all():
+        raise CheckpointCorruptError(
+            f"{path}: parent epoch {pe.tolist()} does not pair with the "
+            f"previous chain link's epoch {prev.tolist()} (wrong order, "
+            "a skipped link, or artifacts from different chains)")
+    rows = np.asarray(z["delta_rows"])
+    pages = np.asarray(z["delta_pages"])
+    if rows.ndim != 1 or pages.shape != (rows.size, _C.PAGE_WORDS):
+        raise CheckpointCorruptError(
+            f"{path}: delta rows/pages shape mismatch "
+            f"({rows.shape} vs {pages.shape})")
+    if rows.size and (rows.min() < 0 or rows.max() >= n_rows_max):
+        raise CheckpointCorruptError(
+            f"{path}: delta rows outside the pool "
+            f"[0, {n_rows_max}) — corrupt row index")
+
+
+def restore_chain(base_path: str, delta_paths, mesh=None,
+                  clear_locks: bool = True):
+    """Rebuild a live Cluster from ``base`` + ordered delta artifacts.
+
+    Every artifact is CRC-verified and the (nonce, seq, crc) epoch chain
+    is checked link by link — a corrupted, reordered or foreign link
+    raises :class:`CheckpointCorruptError` instead of materializing a
+    silently wrong pool.  The LAST link's locks/counters/allocator
+    manifest win (each link carries the full small state).
+    -> Cluster."""
+    import jax
+    import jax.numpy as jnp
+
+    cluster = restore(base_path, mesh=mesh, clear_locks=clear_locks)
+    if not delta_paths:
+        return cluster
+    dsm = cluster.dsm
+    base = _load_arrays(base_path, keys=("cfg", "epoch"))
+    if "epoch" not in base:
+        raise CheckpointCorruptError(
+            f"{base_path}: base carries no epoch (pre-chain legacy "
+            "checkpoint) — take a fresh base to start a delta chain")
+    base_cfg_raw = bytes(np.asarray(base["cfg"]))
+    prev_epoch = np.asarray(base["epoch"])
+    n_rows = dsm.pool.shape[0]
+    for path in delta_paths:
+        z = _load_arrays(path)
+        _check_delta_link(z, path, base_cfg_raw, prev_epoch, n_rows)
+        rows = np.asarray(z["delta_rows"], np.int64)
+        if rows.size:
+            dsm.pool = jax.device_put(
+                dsm.pool.at[jnp.asarray(rows)].set(
+                    jnp.asarray(z["delta_pages"])), dsm.shard)
+        locks = np.asarray(z["locks"])
+        if clear_locks:
+            locks = np.zeros_like(locks)
+        dsm.locks = jax.device_put(locks, dsm.shard)
+        dsm.counters = jax.device_put(np.asarray(z["counters"]), dsm.shard)
+        _restore_directories(cluster, z)
+        prev_epoch = np.asarray(z["epoch"])
+    # restored state predates the crash-lost dirty tracking: callers
+    # start a fresh chain (RecoveryPlane re-bases after replay)
+    dsm.clear_dirty()
+    return cluster
+
+
+def read_chain_rows(base_path: str, delta_paths, rows) -> np.ndarray:
+    """Reconstruct the CONTENT of specific pool rows as of the chain's
+    tip, without materializing a cluster: the latest link containing a
+    row wins, the base covers everything else.  The targeted-repair
+    primitive (sherman_tpu/recovery.py): recovery cost scales with the
+    damage, not the pool.  -> pages [len(rows), PAGE_WORDS] int32."""
+    rows = np.asarray(rows, np.int64)
+    base = _load_arrays(base_path)
+    if "delta" in base:
+        raise CheckpointCorruptError(
+            f"{base_path}: chain base must be a full checkpoint")
+    pool = np.asarray(base["pool"])
+    if rows.size and (rows.min() < 0 or rows.max() >= pool.shape[0]):
+        raise CheckpointCorruptError(
+            f"repair rows outside the pool [0, {pool.shape[0]})")
+    out = pool[rows].copy()
+    base_cfg_raw = bytes(np.asarray(base["cfg"]))
+    prev_epoch = np.asarray(base["epoch"]) if "epoch" in base else None
+    for path in delta_paths:
+        z = _load_arrays(path, keys=("delta", "cfg", "epoch",
+                                     "parent_epoch", "delta_rows",
+                                     "delta_pages"))
+        if prev_epoch is None:
+            raise CheckpointCorruptError(
+                f"{base_path}: base carries no epoch to chain from")
+        _check_delta_link(z, path, base_cfg_raw, prev_epoch,
+                          pool.shape[0])
+        drows = np.asarray(z["delta_rows"], np.int64)
+        dpages = np.asarray(z["delta_pages"])
+        pos = {int(r): i for i, r in enumerate(drows)}
+        for i, r in enumerate(rows.tolist()):
+            j = pos.get(int(r))
+            if j is not None:
+                out[i] = dpages[j]
+        prev_epoch = np.asarray(z["epoch"])
+    return out

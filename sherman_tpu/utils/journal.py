@@ -1,0 +1,260 @@
+"""Write-ahead op journal — the replayable half of the recovery plane.
+
+The reference has no durability story at all (SURVEY.md §5); our
+checkpoints (``utils/checkpoint.py``) bound the loss window to
+"everything since the last save".  This module closes that window: an
+append-only journal of CRC-framed, length-prefixed **batch records**,
+one per acknowledged engine write op (op kind + the rows it actually
+applied), fsync'd before the op is acknowledged.  Recovery is then
+
+    restore checkpoint chain  +  replay journal in record order
+
+and the loss of *acknowledged* ops (RPO) is zero: an op is acked only
+after its record is durable, and replay re-applies records onto the
+restored pool.  Replay is convergent because the engine's write ops are
+idempotent in-order (insert is an upsert — last writer per key wins;
+delete clears; re-running a prefix that already landed re-produces the
+same state), so segments may safely be replayed from any checkpoint at
+or before their first record.
+
+Frame format (little-endian, after the 8-byte file magic)::
+
+    [u32 length | u32 crc32(payload) | payload]
+    payload = u8 kind | u8 x 3 pad | u32 nrows | keys u64[n] (| vals u64[n])
+
+Torn-tail contract (crash mid-append): a frame that runs past EOF, or
+whose CRC fails **at the very tail**, is a partially flushed append —
+readers truncate it away (``journal.truncated_tails``) and the journal
+stays usable.  A CRC failure with more bytes *after* the frame is
+content corruption, not a torn append: readers raise the typed
+:class:`JournalCorruptError` — a corrupt journal must never silently
+replay wrong rows (``tests/test_fuzz.py`` storms both cases).
+
+Observability: ``journal.appends`` / ``journal.rows`` /
+``journal.bytes`` / ``journal.fsyncs`` / ``journal.truncated_tails`` /
+``journal.replayed_records`` / ``journal.replayed_rows``.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+from sherman_tpu import obs
+
+MAGIC = b"SHJRNL01"
+_HDR = struct.Struct("<II")          # length, crc32(payload)
+_PAY = struct.Struct("<BxxxI")       # kind, nrows
+
+J_UPSERT = 1   # keys + values (engine insert / mixed write rows)
+J_DELETE = 2   # keys only
+KINDS = (J_UPSERT, J_DELETE)
+
+# One frame is one engine-op batch; anything claiming more than this is
+# a corrupt length word, not a real record (the engine chunks batches
+# far below it).
+MAX_PAYLOAD = 1 << 30
+
+_OBS_APPENDS = obs.counter("journal.appends")
+_OBS_ROWS = obs.counter("journal.rows")
+_OBS_BYTES = obs.counter("journal.bytes")
+_OBS_FSYNCS = obs.counter("journal.fsyncs")
+_OBS_TORN = obs.counter("journal.truncated_tails")
+_OBS_RP_RECORDS = obs.counter("journal.replayed_records")
+_OBS_RP_ROWS = obs.counter("journal.replayed_rows")
+
+# indirection for tests (monkeypatching os.fsync itself would also
+# intercept numpy/jax internals)
+_fsync = os.fsync
+
+
+class JournalCorruptError(RuntimeError):
+    """A journal frame failed its CRC (or framing) with further bytes
+    following it — content corruption, not a torn tail.  Replay refuses
+    rather than applying rows it cannot trust."""
+
+
+def encode_record(kind: int, keys, values=None) -> bytes:
+    """One framed record (header + payload) for ``append``/tests."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown journal record kind {kind}")
+    keys = np.ascontiguousarray(keys, np.uint64)
+    payload = _PAY.pack(kind, keys.size) + keys.tobytes()
+    if kind == J_UPSERT:
+        values = np.ascontiguousarray(values, np.uint64)
+        if values.shape != keys.shape:
+            raise ValueError("journal upsert needs one value per key")
+        payload += values.tobytes()
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _decode_payload(payload: bytes, off: int):
+    """payload bytes -> (kind, keys, values|None); raises on bad shape."""
+    kind, n = _PAY.unpack_from(payload)
+    body = payload[_PAY.size:]
+    want = n * 8 * (2 if kind == J_UPSERT else 1)
+    if kind not in KINDS or len(body) != want:
+        raise JournalCorruptError(
+            f"journal record at byte {off}: kind={kind} nrows={n} does "
+            f"not match its {len(body)}-byte body")
+    keys = np.frombuffer(body[: n * 8], np.uint64).copy()
+    vals = (np.frombuffer(body[n * 8:], np.uint64).copy()
+            if kind == J_UPSERT else None)
+    return kind, keys, vals
+
+
+class Journal:
+    """Appender for one journal segment file.
+
+    ``sync=True`` (default) fsyncs every append — the RPO-zero
+    contract; ``sync=False`` trades durability of the last few records
+    for throughput (still torn-tail-safe).  Thread-safe appends; one
+    writer process per file.
+    """
+
+    def __init__(self, path: str, sync: bool = True):
+        self.path = path
+        self.sync = bool(sync)
+        self._lock = threading.Lock()
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._f = open(path, "ab")
+        if fresh:
+            self._f.write(MAGIC)
+            self._f.flush()
+            if self.sync:
+                _fsync(self._f.fileno())
+                # make the DIRECTORY ENTRY durable too: records fsync'd
+                # into a file whose name is lost to power failure are
+                # RPO > 0 that recovery cannot even detect
+                dfd = os.open(os.path.dirname(os.path.abspath(path)),
+                              os.O_RDONLY)
+                try:
+                    _fsync(dfd)
+                finally:
+                    os.close(dfd)
+
+    def append(self, kind: int, keys, values=None) -> int:
+        """Append one batch record; returns bytes written.  Durable on
+        return when ``sync`` (the ack gate for RPO zero)."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        if keys.size == 0:
+            return 0  # nothing applied: no record
+        rec = encode_record(kind, keys, values)
+        with self._lock:
+            if self._f.closed:
+                raise RuntimeError(f"journal {self.path} is closed")
+            self._f.write(rec)
+            self._f.flush()
+            if self.sync:
+                _fsync(self._f.fileno())
+                _OBS_FSYNCS.inc()
+        _OBS_APPENDS.inc()
+        _OBS_ROWS.inc(int(keys.size))
+        _OBS_BYTES.inc(len(rec))
+        return len(rec)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                if self.sync:
+                    _fsync(self._f.fileno())
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: str, truncate_torn: bool = False) -> list[tuple]:
+    """Parse a segment -> [(kind, keys, values|None), ...].
+
+    Applies the torn-tail contract (see module docstring):
+    partially-appended tail frames are dropped (and physically truncated
+    from the file when ``truncate_torn`` — recovery does this so the
+    next appender starts from a clean frame boundary); mid-file
+    corruption raises :class:`JournalCorruptError`.
+    """
+    with open(path, "rb") as f:
+        blob = f.read()
+    if len(blob) < len(MAGIC):
+        # a file torn inside the magic itself: an append never succeeded
+        _truncate(path, 0, len(blob), truncate_torn)
+        return []
+    if blob[: len(MAGIC)] != MAGIC:
+        raise JournalCorruptError(
+            f"{path}: bad journal magic {blob[:8]!r}")
+    out: list[tuple] = []
+    off = len(MAGIC)
+    size = len(blob)
+    while off < size:
+        if off + _HDR.size > size:
+            _truncate(path, off, size, truncate_torn)  # torn header
+            break
+        length, crc = _HDR.unpack_from(blob, off)
+        end = off + _HDR.size + length
+        if length > MAX_PAYLOAD:
+            if end > size or end < 0:
+                # the claimed frame runs past EOF: equally consistent
+                # with a torn length word — tail rule applies
+                _truncate(path, off, size, truncate_torn)
+                break
+            raise JournalCorruptError(
+                f"{path}: frame at byte {off} claims {length} bytes "
+                f"(> {MAX_PAYLOAD}) with bytes following")
+        if end > size:
+            _truncate(path, off, size, truncate_torn)  # torn payload
+            break
+        payload = blob[off + _HDR.size: end]
+        if zlib.crc32(payload) != crc:
+            if end == size:
+                # tail frame with bad CRC: torn append (length landed,
+                # payload only partially)
+                _truncate(path, off, size, truncate_torn)
+                break
+            raise JournalCorruptError(
+                f"{path}: CRC mismatch at byte {off} with "
+                f"{size - end} bytes following — content corruption, "
+                "refusing to replay")
+        out.append(_decode_payload(payload, off))
+        off = end
+    return out
+
+
+def _truncate(path: str, off: int, size: int, do_truncate: bool) -> None:
+    _OBS_TORN.inc()
+    # a file torn inside the magic itself keeps nothing (a fresh
+    # appender then rewrites the magic); otherwise cut at the last
+    # clean frame boundary
+    keep = off if off >= len(MAGIC) else 0
+    if do_truncate and size > keep:
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+            f.flush()
+            _fsync(f.fileno())
+
+
+def replay(path: str, eng) -> dict:
+    """Re-apply one segment's records through a (writable) engine, in
+    record order.  The engine's own journaling must be detached by the
+    caller (RecoveryPlane does) so replay does not re-journal itself.
+    Returns {"records", "rows", "upserts", "deletes"}."""
+    stats = {"records": 0, "rows": 0, "upserts": 0, "deletes": 0}
+    for kind, keys, vals in read_records(path, truncate_torn=True):
+        if kind == J_UPSERT:
+            eng.insert(keys, vals)
+            stats["upserts"] += 1
+        else:
+            eng.delete(keys)
+            stats["deletes"] += 1
+        stats["records"] += 1
+        stats["rows"] += int(keys.size)
+        _OBS_RP_RECORDS.inc()
+        _OBS_RP_ROWS.inc(int(keys.size))
+    return stats

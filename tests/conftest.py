@@ -163,9 +163,9 @@ def run_insert_kernel(eng, keys, vals, *, use_router=None, with_fresh=True,
         if with_fresh:
             args.append(eng._shard(np.zeros(
                 eng.cfg.machine_nr * eng.split_slots, np.int32)))
-            dsm.pool, dsm.counters, st, _log = fn(
-                dsm.pool, dsm.locks, dsm.counters, *args)
+            dsm.pool, dsm.counters, dsm.dirty, st, _log = fn(
+                dsm.pool, dsm.locks, dsm.counters, dsm.dirty, *args)
         else:
-            dsm.pool, dsm.counters, st = fn(
-                dsm.pool, dsm.locks, dsm.counters, *args)
+            dsm.pool, dsm.counters, dsm.dirty, st = fn(
+                dsm.pool, dsm.locks, dsm.counters, dsm.dirty, *args)
     return eng._unshard(st)[:n]

@@ -1,5 +1,7 @@
 """Checkpoint/restore round-trip tests (beyond-reference durability)."""
 
+import os
+
 import numpy as np
 
 from sherman_tpu.cluster import Cluster
@@ -62,3 +64,93 @@ def test_restore_clears_stale_locks(eight_devices, tmp_path):
     t2 = Tree(c2)
     t2.insert(5, 51)  # would deadlock if the stale lock survived
     assert t2.search(5) == 51
+
+
+def test_savez_atomic_fsyncs_and_sweeps_orphans(eight_devices, tmp_path,
+                                                monkeypatch):
+    """Durability contract of _savez_atomic: the tmp file AND the
+    directory are fsync'd around the atomic replace, and stale
+    ``*.tmp*.npz`` orphans from a crashed prior save are swept."""
+    calls = []
+    real_fsync = ckpt._fsync
+    monkeypatch.setattr(ckpt, "_fsync", lambda fd: (calls.append(fd),
+                                                    real_fsync(fd))[1])
+    path = str(tmp_path / "c.npz")
+    orphan = path + ".tmp0.npz"
+    open(orphan, "wb").write(b"leftover from a crashed writer")
+    ckpt._savez_atomic(path, 0, x=np.arange(5))
+    assert not os.path.exists(orphan), "stale tmp orphan not swept"
+    # one fsync for the tmp file's data, one for the directory rename
+    assert len(calls) >= 2
+    with np.load(path) as z:
+        np.testing.assert_array_equal(z["x"], np.arange(5))
+    # a crash between write and replace leaves only a tmp; next save
+    # sweeps it and the real file stays the previous good one
+    open(path + ".tmp0.npz", "wb").write(b"torn")
+    ckpt._savez_atomic(path, 0, x=np.arange(3))
+    assert not os.path.exists(path + ".tmp0.npz")
+    with np.load(path) as z:
+        np.testing.assert_array_equal(z["x"], np.arange(3))
+
+
+def test_cfg_backcompat_missing_fields_apply_defaults():
+    """The _CFG_FIELDS forward-compat contract: a cfg JSON written
+    before gather_impl/exchange_impl existed (PR 4 added persistence)
+    restores with the dataclass defaults — never a KeyError — and every
+    _CFG_FIELDS entry keeps a default so the contract holds for future
+    fields too; unknown (newer-build) fields refuse loudly."""
+    import dataclasses
+    import json as _json
+
+    # every persisted field must be optional in DSMConfig (the pin)
+    by_name = {f.name: f for f in dataclasses.fields(DSMConfig)}
+    for name in ckpt._CFG_FIELDS:
+        f = by_name[name]
+        assert f.default is not dataclasses.MISSING \
+            or f.default_factory is not dataclasses.MISSING, (
+                f"_CFG_FIELDS entry {name!r} has no default: old "
+                "checkpoints without it could not restore")
+
+    old = {"machine_nr": 2, "pages_per_node": 256, "locks_per_node": 64,
+           "step_capacity": 64, "host_step_capacity": 32,
+           "chunk_pages": 32, "_layout": ckpt.LAYOUT_TAG}
+    cfg = ckpt.cfg_from_json(_json.dumps(old).encode())
+    assert cfg.machine_nr == 2
+    assert cfg.gather_impl == "xla" and cfg.exchange_impl == "xla"
+
+    newer = dict(old, frobnication_impl="quantum")
+    import pytest
+    with pytest.raises(RuntimeError, match="frobnication_impl"):
+        ckpt.cfg_from_json(_json.dumps(newer).encode())
+    # round-trip of the current writer still carries ALL fields
+    d = _json.loads(ckpt.cfg_to_json(DSMConfig()).decode())
+    assert set(d) == set(ckpt._CFG_FIELDS) | {"_layout"}
+
+
+def test_restore_detects_content_corruption(eight_devices, tmp_path):
+    """Per-array CRCs: content corruption that survives the zip layer
+    fails typed at restore (CheckpointCorruptError), never served."""
+    import pytest
+
+    cfg = DSMConfig(machine_nr=1, pages_per_node=256, locks_per_node=64,
+                    step_capacity=64, chunk_pages=32)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    tree.insert(5, 50)
+    path = str(tmp_path / "c.npz")
+    ckpt.checkpoint(cluster, path)
+
+    # rewrite the artifact with one flipped pool word but the ORIGINAL
+    # integrity map — the exact shape of silent at-rest corruption
+    z = dict(np.load(path))
+    z["pool"] = np.array(z["pool"])
+    z["pool"][1, 7] ^= 1
+    np.savez_compressed(path, **z)
+    with pytest.raises(ckpt.CheckpointCorruptError, match="pool"):
+        ckpt.restore(path)
+
+    # an unreadable (truncated) artifact is typed too
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 2])
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.restore(path)

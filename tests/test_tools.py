@@ -212,11 +212,32 @@ def test_churn_bench_driver(eight_devices, capsys):
 
 def test_ckpt_bench_driver(eight_devices, capsys):
     """Checkpoint/restore cycle driver (CPU smoke of
-    tools/ckpt_bench.py): the cycle must round-trip and verify."""
+    tools/ckpt_bench.py): the full cycle round-trips AND the delta A/B
+    (engine traffic -> checkpoint_delta -> chain restore) verifies with
+    the delta's size a small fraction of the full artifact's."""
     import json
 
     import ckpt_bench
-    ckpt_bench.main(["--keys", "30000", "--sample", "3000", "--validate"])
+    ckpt_bench.main(["--keys", "30000", "--sample", "3000", "--validate",
+                     "--delta-ops", "1500"])
     r = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert r["keys"] == 30000 and r["verify_sample"] == 3000
     assert r["checkpoint_s"] is not None and r["validate_s"] is not None
+    d = r["delta"]
+    assert d["ops"] == 1500 and d["pages"] > 0
+    assert d["npz_bytes"] < r["npz_bytes"] / 2, \
+        "delta artifact not meaningfully smaller than the full one"
+
+
+def test_recovery_drill_driver(eight_devices, capsys):
+    # the full recovery drill: acked traffic -> crash (torn journal
+    # tail) -> chain restore + journal replay (RPO 0, measured RTO) ->
+    # chaos corruption -> targeted repair exits degraded without a
+    # full restore
+    import recovery_drill
+    r = recovery_drill.main(["--keys", "2500", "--nodes", "4"])
+    assert r["ok"] and r["rpo_ops"] == 0 and r["rto_ms"] > 0
+    assert r["journal"]["truncated_tails"] >= 1
+    assert r["delta1"]["pages"] > 0
+    assert r["repair"]["pages"] >= 1
+    assert "RECOVERY-DRILL PASS" in capsys.readouterr().err

@@ -334,10 +334,11 @@ def main(argv=None) -> dict:
             return found
         if mfn is not None:
             # fused step: searches and upserts share one descent
-            (dsm.pool, dsm.counters, status, done_r, found, vh, vl) = mfn(
-                dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
-                b["vhi"], b["vlo"], root, b["act_r"], b["act_w"],
-                b["start"])
+            (dsm.pool, dsm.counters, dsm.dirty, status, done_r, found,
+             vh, vl) = mfn(
+                dsm.pool, dsm.locks, dsm.counters, dsm.dirty, b["khi"],
+                b["klo"], b["vhi"], b["vlo"], root, b["act_r"],
+                b["act_w"], b["start"])
             if combine:
                 _, _, _, cst = fan(found, vh, vl, status, b["inv"])
                 return cst
@@ -349,9 +350,9 @@ def main(argv=None) -> dict:
             return found
         # steady-state writes update warm keys in place (no splits); a
         # split-heavy load would drive inserts through eng.insert instead
-        dsm.pool, dsm.counters, status = wfn(
-            dsm.pool, dsm.locks, dsm.counters, b["khi"], b["klo"],
-            b["vhi"], b["vlo"], root, b["act_w"], b["start"])
+        dsm.pool, dsm.counters, dsm.dirty, status = wfn(
+            dsm.pool, dsm.locks, dsm.counters, dsm.dirty, b["khi"],
+            b["klo"], b["vhi"], b["vlo"], root, b["act_w"], b["start"])
         if combine:
             _, _, _, cst = fan(zero_dev, zero_dev, zero_dev, status,
                                b["inv"])

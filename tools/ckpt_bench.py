@@ -4,19 +4,23 @@
 Builds the north-star tree (default 100 M synthetic keys, the bench.py
 config), then times one full cycle: ``checkpoint(cluster, path)`` ->
 ``restore(path)`` -> post-restore verification (a key sample searched
-through a fresh engine + the device structure validator).  Prints ONE
-JSON line with the wall times and sizes.
+through a fresh engine + the device structure validator).  With
+``--delta-ops N`` (default on) it also measures the INCREMENTAL side:
+N engine upserts after the base, one ``checkpoint_delta`` (only the
+dirty pages), and a chain restore — the delta-vs-full A/B the recovery
+plane's "cheap frequent deltas" claim rests on.  Prints a side-by-side
+table on stderr and ONE JSON line (receipt) with all wall times/sizes.
 
 The reference has no durability story at any scale (SURVEY.md §5); this
 pins the cost of ours at the full benchmark config, where the pool is
-multi-GB — checkpoint is one d2h of the sharded pool + tiny metadata,
-restore one h2d.  On this environment both transfers ride the access
-tunnel; the JSON publishes the npz byte size so a co-located host can
-be priced from its own link rate.
+multi-GB — a full checkpoint is one d2h of the sharded pool + tiny
+metadata, a delta only the written pages.  On this environment both
+transfers ride the access tunnel; the JSON publishes byte sizes so a
+co-located host can be priced from its own link rate.
 
 Run (real chip):  python tools/ckpt_bench.py --keys 100000000
 CPU smoke:        SHERMAN_PLATFORM=cpu python tools/ckpt_bench.py \\
-                      --keys 50000 --sample 5000
+                      --keys 50000 --sample 5000 --delta-ops 4000
 """
 
 from __future__ import annotations
@@ -47,7 +51,13 @@ def main(argv=None) -> None:
     ap.add_argument("--validate", action="store_true",
                     help="run the whole-pool device validator on the "
                          "restored tree too (adds its own wall time)")
+    ap.add_argument("--delta-ops", type=int, default=None,
+                    help="engine upserts between base and delta "
+                         "checkpoint (default keys/100 capped at 1 M; "
+                         "0 disables the delta A/B)")
     args = ap.parse_args(argv)
+    if args.delta_ops is None:
+        args.delta_ops = min(max(args.keys // 100, 1000), 1_000_000)
 
     jax = setup_platform(1)
     jax.config.update("jax_compilation_cache_dir", os.path.join(
@@ -88,13 +98,49 @@ def main(argv=None) -> None:
 
     td = args.dir or tempfile.mkdtemp(prefix="sherman_ckpt_")
     path = os.path.join(td, "bench.npz")
+    dpath = os.path.join(td, "bench.delta1.npz")
+    delta = None
     try:
         t0 = time.time()
-        CK.checkpoint(cluster, path)
+        epoch = CK.checkpoint(cluster, path)
         ckpt_s = time.time() - t0
         size = os.path.getsize(path)
         print(f"# checkpoint {ckpt_s:.1f}s ({size / 1e9:.2f} GB)",
               file=sys.stderr, flush=True)
+
+        # delta A/B: N engine upserts dirty a bounded page set; the
+        # delta saves ONLY those pages — the "cheap frequent deltas"
+        # half of the recovery plane, priced at this scale
+        dkeys = None
+        if args.delta_ops:
+            # traffic-engine batch sized to the op count: the CPU smoke
+            # then compiles a small insert program, the chip run a real
+            # one
+            eng0 = batched.BatchedEngine(
+                tree, batch_per_node=min(65_536,
+                                         max(1024, args.delta_ops)))
+            eng0.attach_router()
+            # a CLUSTERED working set (contiguous key range): the delta
+            # then covers the touched leaves, not every leaf — a uniform
+            # spray of N ops over N*40 keys would dirty the whole tree
+            # and measure nothing but a full save with extra steps
+            dkeys = keys[: min(args.delta_ops, args.keys)]
+            t0 = time.time()
+            st = eng0.insert(dkeys, dkeys ^ np.uint64(0x5EED))
+            traffic_s = time.time() - t0
+            assert st["lock_timeouts"] == 0
+            t0 = time.time()
+            dinfo = CK.checkpoint_delta(cluster, dpath,
+                                        parent_epoch=epoch)
+            delta = {"ops": int(dkeys.size),
+                     "traffic_s": round(traffic_s, 1),
+                     "pages": dinfo["pages"],
+                     "npz_bytes": dinfo["bytes"],
+                     "checkpoint_s": round(time.time() - t0, 2)}
+            print(f"# delta checkpoint {delta['checkpoint_s']}s "
+                  f"({delta['pages']} pages, "
+                  f"{delta['npz_bytes'] / 1e6:.1f} MB)",
+                  file=sys.stderr, flush=True)
 
         # release the ORIGINAL pool before restoring: at the 100 M-key
         # config two resident pools (4.3 GB each) plus the validator's
@@ -103,9 +149,11 @@ def main(argv=None) -> None:
         cluster.dsm.pool.delete()
         del tree
         t0 = time.time()
-        c2 = CK.restore(path, mesh=mesh)
+        c2 = CK.restore_chain(path, [dpath] if delta else [], mesh=mesh)
         restore_s = time.time() - t0
-        print(f"# restore {restore_s:.1f}s", file=sys.stderr, flush=True)
+        print(f"# restore {restore_s:.1f}s"
+              + (" (chain: base + 1 delta)" if delta else ""),
+              file=sys.stderr, flush=True)
 
         t2 = Tree(c2)
         e2 = batched.BatchedEngine(t2, batch_per_node=65_536)
@@ -116,7 +164,19 @@ def main(argv=None) -> None:
         probe = keys[idx]
         got, found = e2.search(probe)
         assert found.all(), f"restore lost {int((~found).sum())} keys"
-        np.testing.assert_array_equal(got, probe ^ np.uint64(0xDEADBEEF))
+        if dkeys is not None:
+            # delta-written values win where the probe overlaps them
+            upd = np.isin(probe, dkeys)
+            np.testing.assert_array_equal(
+                got[upd], probe[upd] ^ np.uint64(0x5EED))
+            np.testing.assert_array_equal(
+                got[~upd], probe[~upd] ^ np.uint64(0xDEADBEEF))
+            gd, fd = e2.search(dkeys)
+            assert fd.all()
+            np.testing.assert_array_equal(gd, dkeys ^ np.uint64(0x5EED))
+        else:
+            np.testing.assert_array_equal(got,
+                                          probe ^ np.uint64(0xDEADBEEF))
         verify_s = time.time() - t0
         validate_s = None
         if args.validate:
@@ -127,12 +187,24 @@ def main(argv=None) -> None:
             assert info["keys"] == args.keys
     finally:
         if args.dir is None:
+            for f in (path, dpath):
+                try:
+                    os.unlink(f)
+                except OSError:
+                    pass
             try:
-                os.unlink(path)
                 os.rmdir(td)
             except OSError:
                 pass
 
+    if delta:
+        print("# {:>10s} {:>12s} {:>12s}".format("", "full", "delta"),
+              file=sys.stderr)
+        print("# {:>10s} {:>12.2f} {:>12.2f}".format(
+            "save (s)", ckpt_s, delta["checkpoint_s"]), file=sys.stderr)
+        print("# {:>10s} {:>12.3f} {:>12.3f}".format(
+            "size (GB)", size / 1e9, delta["npz_bytes"] / 1e9),
+            file=sys.stderr, flush=True)
     print(json.dumps({
         "metric": "checkpoint_restore_at_scale",
         "value": round(ckpt_s + restore_s, 1),
@@ -146,6 +218,7 @@ def main(argv=None) -> None:
         "verify_sample": int(probe.shape[0]),
         "verify_s": round(verify_s, 1),
         "validate_s": round(validate_s, 1) if validate_s else None,
+        "delta": delta,
     }))
 
 

@@ -113,9 +113,9 @@ def main(argv=None) -> dict:
 
     # one real engine step first: correctness spot check
     ifn = eng._get_insert(iters, True, with_fresh=False, update_only=True)
-    dsm.pool, dsm.counters, st = ifn(
-        dsm.pool, dsm.locks, dsm.counters, khi_d, klo_d, vhi_d, vlo_d,
-        root, act_d, start_d)
+    dsm.pool, dsm.counters, dsm.dirty, st = ifn(
+        dsm.pool, dsm.locks, dsm.counters, dsm.dirty, khi_d, klo_d,
+        vhi_d, vlo_d, root, act_d, start_d)
     ok = np.isin(np.asarray(st), (batched.ST_APPLIED, batched.ST_SUPERSEDED))
     assert ok.all(), f"profile batch: {np.unique(np.asarray(st))}"
     chain_cost("insert_step_update_only", mk_insert_loop(True),
