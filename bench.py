@@ -229,13 +229,16 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     phase_k = int(os.environ.get("SHERMAN_BENCH_PHASE_K", 4))
     want_phases = os.environ.get("SHERMAN_BENCH_PHASES", "1") != "0"
 
-    def run_windowed(n_steps, advance):
+    def run_windowed(n_steps, advance, finish=None):
         """Dispatch n_steps with a bounded in-flight window: block on
         the carry from W steps back (PJRT allocates a step's output
         buffers at ENQUEUE time — ~100 queued steps pinned ~7 GB of
         prep intermediates and ran 5-20x slower at the 100 M-key pool;
         W=8-16 measured optimal), then drain the final carry.  Returns
-        elapsed seconds.
+        elapsed seconds.  ``finish`` (optional) runs INSIDE the timed
+        window after the last dispatch and returns the carry to drain
+        — the pipelined staged step flushes its pending verify there,
+        so its receipts cover every dispatched batch.
 
         The window blocks on carry[1] ('ok') — a SERVE output — not
         carry[0] (step_idx, produced by the PREP program).  The prep
@@ -266,6 +269,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                 pend.append(c[1])
                 if len(pend) > W:
                     jax.block_until_ready(pend.popleft())
+            if finish is not None:
+                c = finish()
             jax.block_until_ready(c)
             return time.time() - t0
     if combine and salt is not None:
@@ -343,6 +348,9 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             carry = new_carry()
             counters, carry = step_fn(pool, counters, table_d, rtable_d,
                                       rkey_d, carry)
+            # pipelined mode: receipts lag one batch — flush the
+            # pending verify (identity for the other fusion modes)
+            carry = step_fn.drain(carry)
             jax.block_until_ready(carry)
             w_ok = int(np.asarray(carry[1]))
             w_corr = int(np.asarray(carry[2]))
@@ -355,6 +363,13 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                 nonlocal counters, carry
                 counters, carry = step_fn(pool, counters, table_d,
                                           rtable_d, rkey_d, carry)
+                return carry
+
+            def finish_ro():
+                # inside the timed window: the pipelined pipeline's
+                # final verify is part of the work being measured
+                nonlocal carry
+                carry = step_fn.drain(carry)
                 return carry
 
             # The access tunnel intermittently degrades a freshly
@@ -376,7 +391,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                 carry = new_carry()
                 with obs.span("bench.sustained_dev",
                               attempt=_attempt + 1, steps=dev_steps):
-                    dev_elapsed = run_windowed(dev_steps, adv_ro)
+                    dev_elapsed = run_windowed(dev_steps, adv_ro,
+                                               finish=finish_ro)
                 _, d_ok, d_corr, d_sum_nu, d_max_nu = (
                     int(np.asarray(x)) for x in carry)
                 assert d_ok == 1, "device-staged: unique overflow mid-run"
@@ -417,11 +433,12 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                     sus_dev_phase_ms, counters = step_fn.phase_profile(
                         pool, counters, table_d, rtable_d, rkey_d,
                         reps=phase_k)
-                for _n, _ms in sus_dev_phase_ms.items():
-                    obs.histogram(f"staged.{_n}_ms").record(_ms)
+                from sherman_tpu.workload.device_prep import \
+                    record_phase_obs
+                record_phase_obs("staged", sus_dev_phase_ms)
                 print("# staged-step phases (chained-delta, K="
                       f"{phase_k}, fusion {sus_dev_fusion}): "
-                      + ", ".join(f"{n} {ms:.1f} ms" for n, ms in
+                      + ", ".join(f"{n} {ms:.2f}" for n, ms in
                                   sus_dev_phase_ms.items()),
                       file=sys.stderr)
         # SUSTAINED end-to-end (the reference's open-loop contract,
@@ -725,6 +742,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     # observe its own step's writes.  Runs LAST: it rewrites values, so
     # every key ^ 0xDEADBEEF check above must already have happened.
     sus_mixed_ops_s = sus_mixed_ms = sus_mixed_combine = m_attempts = None
+    sus_mixed_fusion = None
     if combine and salt is not None \
             and os.environ.get("SHERMAN_BENCH_DEVMIXED", "1") != "0":
         from sherman_tpu.workload.device_prep import make_staged_mixed_step
@@ -740,9 +758,11 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         mstep, (new_mc, mt_d, mrt_d, mrk_d) = mk(dev_rb=cap_r0,
                                                  dev_wb=cap_w0)
         sus_mixed_sampler = mstep.sampler  # effective (fallback-aware)
+        sus_mixed_fusion = mstep.fusion  # chained | pipelined
         mc = new_mc()
         pool, counters, mc = mstep(pool, tree.dsm.locks, counters, mt_d,
                                    mrt_d, mrk_d, mc)
+        mc = mstep.drain(mc)  # pipelined receipts lag one batch
         jax.block_until_ready(mc)
         m_ok, m_cr, m_cw, _, m_mr, m_mw = (
             int(np.asarray(x)) for x in mc[1:7])
@@ -764,6 +784,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                 dev_rb=rcap, dev_wb=wcap, staged=(mt_d, mrt_d, mrk_d))
         pool, counters, mc = mstep(pool, tree.dsm.locks, counters, mt_d,
                                    mrt_d, mrk_d, mc)
+        mc = mstep.drain(mc)
         jax.block_until_ready(mc)
         b_cr, b_cw, b_snu = (int(np.asarray(x)) for x in
                              (mc[2], mc[3], mc[4]))
@@ -773,6 +794,11 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             nonlocal pool, counters, mc
             pool, counters, mc = mstep(pool, tree.dsm.locks, counters,
                                        mt_d, mrt_d, mrk_d, mc)
+            return mc
+
+        def finish_mixed():
+            nonlocal mc
+            mc = mstep.drain(mc)
             return mc
 
         # same tunnel-degradation retry as the read-only staged loop
@@ -785,7 +811,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         for _attempt in range(3):
             with obs.span("bench.sustained_mixed",
                           attempt=_attempt + 1, steps=m_steps):
-                m_elapsed = run_windowed(m_steps, adv_mixed)
+                m_elapsed = run_windowed(m_steps, adv_mixed,
+                                         finish=finish_mixed)
             tree.dsm.pool, tree.dsm.counters = pool, counters
             m_ok, m_cr, m_cw, m_snu = (int(np.asarray(x))
                                        for x in mc[1:5])
@@ -820,11 +847,11 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                     pool, tree.dsm.locks, counters, mt_d, mrt_d, mrk_d,
                     reps=phase_k)
             tree.dsm.pool, tree.dsm.counters = pool, counters
-            for _n, _ms in sus_mixed_phase_ms.items():
-                obs.histogram(f"staged_mixed.{_n}_ms").record(_ms)
+            from sherman_tpu.workload.device_prep import record_phase_obs
+            record_phase_obs("staged_mixed", sus_mixed_phase_ms)
             print("# mixed-step phases (chained-delta, K="
                   f"{phase_k}): "
-                  + ", ".join(f"{n} {ms:.1f} ms" for n, ms in
+                  + ", ".join(f"{n} {ms:.2f}" for n, ms in
                               sus_mixed_phase_ms.items()),
                   file=sys.stderr)
 
@@ -951,6 +978,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # which page-engine implementation served every device step of
         # this run (DSMConfig.gather_impl — the descent/apply kernels)
         "sus_dev_gather_impl": cfg.gather_impl,
+        "sus_mixed_fusion": sus_mixed_fusion,
         # every impl knob that shaped this run's compiled programs, in
         # ONE block (round-5 lesson: sampler-mode ambiguity showed impl
         # knobs must live in the artifact, not the log)
@@ -958,6 +986,12 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             "gather_impl": cfg.gather_impl,
             "exchange_impl": cfg.exchange_impl,
             "staged_fusion": staged_fusion(),
+            # software-pipeline depth of the staged step: 2 = the
+            # two-deep pipelined mode (verify k-1 / prep k+1 dispatched
+            # behind serve k), 1 = the sequential forms.  Derived from
+            # the KNOB, not the (possibly skipped) staged phase, so the
+            # config block stays self-consistent
+            "pipeline_depth": 2 if staged_fusion() == "pipelined" else 1,
         },
         # pallas-vs-xla chained-delta ms of the page kernels (None when
         # the A/B was skipped; also in obs as kernels.*_ms histograms).
@@ -970,7 +1004,11 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         if kernel_phase_ms else None,
         "kernel_phase_rows": kr if kernel_phase_ms else None,
         # per-phase staged-step attribution, chained-delta timed (ms):
-        # aligned -> {prep, serve_fanout, verify}; chained -> {prep,
+        # aligned -> {prep, serve_fanout, verify}; pipelined -> the
+        # aligned keys + the OVERLAP RECEIPT {wall_ms: drained
+        # pipelined wall/step, bubble_ms: wall - serve (work not
+        # hidden behind the serve bound), overlap_efficiency:
+        # 1 - wall/(prep+serve+verify), a ratio}; chained -> {prep,
         # serve_fanout_verify}; fused -> {fused_step}.  Phases measure
         # each program STANDALONE — the pipelined loop overlaps prep
         # with serve, so the sum can exceed sus_dev_ms_per_step.

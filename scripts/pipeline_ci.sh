@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# Pipeline CI lane: pin the two-deep staged pipeline + journal group
+# commit on the CPU mesh.
+#
+# Runs (1) the fast-tier pipeline + group-commit tests (pipelined vs
+# aligned/chained bit-identical receipts — read-only, mixed, and after
+# a split-triggering write burst; the program-identity pin extended to
+# the pipelined serve; the overlap-receipt shape; group-commit
+# ordering/coalescing incl. the torn-tail fuzz round), (2) the
+# profile_staged2 pipelined smoke (anatomy + the aligned-vs-pipelined
+# mode-wall table), and (3) a receipt-identity pin: the same staged
+# PRNG stream must produce the same drained carry through the aligned
+# and pipelined dispatch orders — the property every pipelined
+# throughput claim rests on.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== pipeline fast tier (bit-identity, program pin, overlap) =="
+python -m pytest tests/test_device_prep.py \
+    -k "pipelined or modes_agree" -q -m ''
+
+echo "== group-commit fast tier (ordering, coalescing, RPO 0, fuzz) =="
+python -m pytest tests/test_recovery.py -k "journal or group_commit" \
+    -q -m ''
+python -m pytest \
+    tests/test_fuzz.py::test_fuzz_journal_group_commit_order_and_torn_tail \
+    -q -m ''
+
+echo "== profile_staged2 pipelined smoke (anatomy + mode walls) =="
+python -m pytest tests/test_tools.py::test_profile_staged2_pipelined \
+    tests/test_tools.py::test_ckpt_bench_journal_group_commit_ab -q -m ''
+
+echo "== receipt-identity pin (aligned vs pipelined, drained) =="
+python - <<'EOF'
+import numpy as np
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.ops import bits
+from sherman_tpu.workload.device_prep import make_staged_step
+
+import jax
+
+salt = 0x5E17_AB1E_5A17
+n_keys, B, S = 20_000, 2048, 4
+cfg = DSMConfig(machine_nr=1, pages_per_node=2048, locks_per_node=512,
+                step_capacity=B, chunk_pages=32)
+cluster = Cluster(cfg)
+tree = Tree(cluster)
+eng = batched.BatchedEngine(tree, batch_per_node=B)
+ranks = np.arange(n_keys, dtype=np.uint64)
+keys = bits.mix64_np(ranks ^ np.uint64(salt))
+order = np.argsort(keys)
+batched.bulk_load(tree, keys[order],
+                  (keys ^ np.uint64(0xDEADBEEF))[order], fill=0.8)
+eng.attach_router()
+out = {}
+for fusion in ("aligned", "pipelined"):
+    step, (new_carry, tb, rt, rk) = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=B, dev_b=B,
+        log2_bins=16, fusion=fusion)
+    if fusion == "pipelined":
+        assert step.jserve is eng._get_search_fanout(eng._iters())
+        assert step.pipeline_depth == 2
+    carry = new_carry()
+    counters = eng.dsm.counters
+    for _ in range(S):
+        counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                               carry)
+    carry = step.drain(carry)
+    jax.block_until_ready(carry)
+    eng.dsm.counters = counters
+    out[fusion] = tuple(int(np.asarray(x)) for x in carry)
+assert out["aligned"] == out["pipelined"], out
+assert out["aligned"][2] == S * B, out
+print("receipt-identical:", out["aligned"])
+EOF
+echo "PIPELINE-CI PASS"

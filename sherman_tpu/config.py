@@ -67,6 +67,16 @@ def staged_fusion() -> str:
       serve's input layouts/donation/HLO match the host-staged case by
       construction (the round-6 answer to BENCHMARKS.md's round-5
       "known headroom" suspects).
+    - ``pipelined``: the SAME three programs as ``aligned`` (same
+      compiled serve object — the CI program-identity pin extends to
+      it), dispatched as a two-deep software pipeline: while the
+      device serves batch k, the host has already dispatched prep for
+      batch k+1 and consumes (verifies) batch k-1's materialized
+      answers, so the prep/verify walls hide behind the serve wherever
+      the backend overlaps independent programs.  Per-batch receipts
+      stay bit-identical to ``aligned`` (pipeline drained via
+      ``step.drain``).  Stays non-default until the queued chip A/B
+      lands (BENCHMARKS.md "Chip-session queue").
     - ``chained``: the round-5 two-program form (fan-out + verification
       fused into the serve program), kept for A/B measurement.
     - ``fused``: one jitted program — the CPU-mesh regression form
@@ -78,9 +88,10 @@ def staged_fusion() -> str:
     toolchain)."""
     import os
     v = os.environ.get("SHERMAN_STAGED_FUSION", "aligned").lower()
-    if v not in ("aligned", "chained", "fused"):
+    if v not in ("aligned", "pipelined", "chained", "fused"):
         raise ValueError(
-            f"SHERMAN_STAGED_FUSION={v!r}: want aligned|chained|fused")
+            f"SHERMAN_STAGED_FUSION={v!r}: want "
+            "aligned|pipelined|chained|fused")
     return v
 
 

@@ -95,7 +95,8 @@ class RecoveryPlane:
     """
 
     def __init__(self, cluster, tree, eng, directory: str,
-                 journal_sync: bool = True):
+                 journal_sync: bool = True,
+                 group_commit_ms: float = 0.0):
         if cluster.dsm.multihost:
             raise RuntimeError("RecoveryPlane is single-process only")
         self.cluster = cluster
@@ -103,6 +104,10 @@ class RecoveryPlane:
         self.eng = eng
         self.dir = directory
         self.journal_sync = bool(journal_sync)
+        # bounded-delay journal group commit (utils/journal.py): acks
+        # still gate on a covering fsync (RPO 0 by construction), but
+        # concurrent ops coalesce into one fsync per window
+        self.group_commit_ms = float(group_commit_ms)
         os.makedirs(directory, exist_ok=True)
         self.base_path = os.path.join(directory, "base.npz")
         self.cid: str | None = None
@@ -161,8 +166,9 @@ class RecoveryPlane:
         retire the previous segment — its ops are captured by the
         artifact that was just made durable."""
         old = self.eng.journal
-        self.eng.attach_journal(J.Journal(self._journal_path(k),
-                                          sync=self.journal_sync))
+        self.eng.attach_journal(J.Journal(
+            self._journal_path(k), sync=self.journal_sync,
+            group_commit_ms=self.group_commit_ms))
         self._segment = k
         if old is not None:
             old.close()
@@ -214,7 +220,8 @@ class RecoveryPlane:
     @classmethod
     def recover(cls, directory: str, mesh=None, batch_per_node: int = 512,
                 tcfg=None, journal_sync: bool = True,
-                attach_router: bool = True):
+                attach_router: bool = True,
+                group_commit_ms: float = 0.0):
         """Rebuild a serving engine from the on-disk chain + journal.
 
         restore(base + deltas) -> replay journal segments in order ->
@@ -247,7 +254,8 @@ class RecoveryPlane:
             replay_stats["segments"] += 1
         t_replay = time.perf_counter()
         plane = cls(cluster, tree, eng, directory,
-                    journal_sync=journal_sync)
+                    journal_sync=journal_sync,
+                    group_commit_ms=group_commit_ms)
         plane.checkpoint_base()  # re-base: fresh chain, stale cid swept
         t_end = time.perf_counter()
         _OBS_RECOVERS.inc()
@@ -326,7 +334,8 @@ class RecoveryPlane:
             # again idempotently if we crash later)
             self.eng.attach_journal(J.Journal(
                 self._journal_path(self._segment),
-                sync=self.journal_sync))
+                sync=self.journal_sync,
+                group_commit_ms=self.group_commit_ms))
         out = {"pages": len(damaged), "ok": True,
                "replay": replay_stats,
                "repair_ms": round((time.perf_counter() - t0) * 1e3, 1)}

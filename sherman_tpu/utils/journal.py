@@ -30,9 +30,19 @@ content corruption, not a torn append: readers raise the typed
 :class:`JournalCorruptError` — a corrupt journal must never silently
 replay wrong rows (``tests/test_fuzz.py`` storms both cases).
 
+Group commit (``Journal(sync=True, group_commit_ms=...)``): bounded-
+delay batched acks — multiple ops' records coalesce into one fsync
+before ANY of their acks release, so RPO stays zero by construction
+while the fsync cost amortizes across the group (the prerequisite for
+a pipelined or multi-client write path, which a per-op fsync would
+re-serialize).  See the :class:`Journal` docstring for the
+leader/follower protocol and the measured ack-latency tradeoff.
+
 Observability: ``journal.appends`` / ``journal.rows`` /
-``journal.bytes`` / ``journal.fsyncs`` / ``journal.truncated_tails`` /
-``journal.replayed_records`` / ``journal.replayed_rows``.
+``journal.bytes`` / ``journal.fsyncs`` (real fsyncs — under group
+commit ``appends/fsyncs`` is the coalescing ratio) /
+``journal.truncated_tails`` / ``journal.replayed_records`` /
+``journal.replayed_rows``.
 """
 
 from __future__ import annotations
@@ -78,6 +88,16 @@ class JournalCorruptError(RuntimeError):
     rather than applying rows it cannot trust."""
 
 
+class JournalSyncError(RuntimeError):
+    """An fsync on this journal failed, poisoning it: on Linux a failed
+    fsync CONSUMES the writeback error and may drop the dirty pages, so
+    a retried fsync on the same fd can return success without the
+    records ever reaching disk — releasing an ack on that retry would
+    be silent RPO > 0.  Every append after the failure raises this
+    (chained to the original OSError); rotate to a fresh segment
+    (``RecoveryPlane._rotate_journal``) to resume."""
+
+
 def encode_record(kind: int, keys, values=None) -> bytes:
     """One framed record (header + payload) for ``append``/tests."""
     if kind not in KINDS:
@@ -110,16 +130,63 @@ def _decode_payload(payload: bytes, off: int):
 class Journal:
     """Appender for one journal segment file.
 
-    ``sync=True`` (default) fsyncs every append — the RPO-zero
-    contract; ``sync=False`` trades durability of the last few records
-    for throughput (still torn-tail-safe).  Thread-safe appends; one
-    writer process per file.
+    ``sync=True`` (default) makes every append durable before it
+    returns — the RPO-zero contract; ``sync=False`` trades durability
+    of the last few records for throughput (still torn-tail-safe).
+    Thread-safe appends; one writer process per file.
+
+    **Group commit** (``group_commit_ms > 0``, with ``sync=True``):
+    bounded-delay batched acks.  An append still BLOCKS until an fsync
+    covers its record — RPO zero holds by construction — but instead
+    of one fsync per record, the first committer of a group becomes
+    the LEADER: it holds the commit open for up to ``group_commit_ms``
+    so concurrent appends can join (their records land in the OS file
+    during the window), then issues ONE fsync covering everything
+    written and releases every joined ack at once.  A per-op fsync
+    re-serializes any pipelined or multi-client write path on the
+    fsync latency; group commit amortizes it at the cost of up to
+    ``group_commit_ms`` of added ack latency — the measured tradeoff
+    is published by ``tools/ckpt_bench.py`` (acks/s, added ack
+    latency, acks per fsync) and the recovery drill pins RPO 0 with
+    the knob on.  ``journal.fsyncs`` counts REAL fsyncs, so
+    ``journal.appends / journal.fsyncs`` is the measured coalescing
+    ratio.
+
+    The window only opens UNDER CONTENTION: a leader with no other
+    appender in flight (tracked at ``append`` entry) skips the wait
+    entirely, so a lone writer pays per-op-fsync latency — not
+    ``group_commit_ms`` per ack — while concurrent writers always get
+    the full window to coalesce into.
+
+    Failure contract: a raising fsync POISONS the journal (see
+    :class:`JournalSyncError`) — the failed append raises, every
+    parked follower raises, and every later append raises until a
+    fresh segment is opened.  Retrying the fsync instead would be
+    unsound: Linux reports a writeback error to ONE fsync caller and
+    may drop the dirty pages, so the retry can spuriously succeed
+    over records that never hit disk.
     """
 
-    def __init__(self, path: str, sync: bool = True):
+    def __init__(self, path: str, sync: bool = True,
+                 group_commit_ms: float = 0.0):
         self.path = path
         self.sync = bool(sync)
+        self.group_commit_ms = float(group_commit_ms)
         self._lock = threading.Lock()
+        # group-commit state (guarded by _lock via the condition):
+        # records are sequenced as they hit the OS file; an ack may
+        # only release once _synced_seq covers its sequence number
+        self._commit_cv = threading.Condition(self._lock)
+        self._written_seq = 0
+        self._synced_seq = 0
+        self._leader = False
+        self._failed: BaseException | None = None  # fsync poison
+        # appenders currently inside append() (own lock: counted at
+        # ENTRY, before the main lock, so writers blocked on it still
+        # register) — a leader holds the commit window open only when
+        # this shows company; a lone writer fsyncs immediately
+        self._entrants = 0
+        self._entrants_lock = threading.Lock()
         fresh = not os.path.exists(path) or os.path.getsize(path) == 0
         self._f = open(path, "ab")
         if fresh:
@@ -139,31 +206,107 @@ class Journal:
 
     def append(self, kind: int, keys, values=None) -> int:
         """Append one batch record; returns bytes written.  Durable on
-        return when ``sync`` (the ack gate for RPO zero)."""
+        return when ``sync`` (the ack gate for RPO zero) — via one
+        fsync per record, or one fsync per group under
+        ``group_commit_ms``."""
         keys = np.ascontiguousarray(keys, np.uint64)
         if keys.size == 0:
             return 0  # nothing applied: no record
         rec = encode_record(kind, keys, values)
-        with self._lock:
-            if self._f.closed:
-                raise RuntimeError(f"journal {self.path} is closed")
-            self._f.write(rec)
-            self._f.flush()
-            if self.sync:
-                _fsync(self._f.fileno())
-                _OBS_FSYNCS.inc()
+        with self._entrants_lock:
+            self._entrants += 1
+        try:
+            with self._lock:
+                if self._f.closed:
+                    raise RuntimeError(f"journal {self.path} is closed")
+                if self._failed is not None:
+                    raise JournalSyncError(
+                        f"journal {self.path} poisoned by an earlier "
+                        "fsync failure; rotate to a fresh segment") \
+                        from self._failed
+                self._f.write(rec)
+                self._f.flush()
+                self._written_seq += 1
+                seq = self._written_seq
+                if self.sync and self.group_commit_ms <= 0:
+                    try:
+                        _fsync(self._f.fileno())
+                    except BaseException as e:
+                        self._failed = e
+                        raise
+                    self._synced_seq = seq
+                    _OBS_FSYNCS.inc()
+            if self.sync and self.group_commit_ms > 0:
+                self._commit(seq)
+        finally:
+            with self._entrants_lock:
+                self._entrants -= 1
         _OBS_APPENDS.inc()
         _OBS_ROWS.inc(int(keys.size))
         _OBS_BYTES.inc(len(rec))
         return len(rec)
 
+    def _commit(self, seq: int) -> None:
+        """Block until an fsync covers record ``seq`` (leader/follower
+        group commit; see the class docstring)."""
+        with self._commit_cv:
+            while self._synced_seq < seq:
+                if self._failed is not None:
+                    # a leader's fsync failed after our record was
+                    # written: the kernel may have dropped our dirty
+                    # pages and consumed the error, so NO retry can
+                    # prove durability — raise, never ack
+                    raise JournalSyncError(
+                        f"journal {self.path} poisoned by an fsync "
+                        "failure; this record is NOT durable") \
+                        from self._failed
+                if self._leader:
+                    # a leader's commit is in flight: its fsync will
+                    # cover this record iff it was written before the
+                    # leader snapshots; either way the notify wakes us
+                    self._commit_cv.wait(1.0)
+                    continue
+                self._leader = True
+                if self._entrants > 1:
+                    # the commit window: release the lock so concurrent
+                    # appends can land and join this group.  Skipped
+                    # when no other appender is in flight — a lone
+                    # writer must not pay the window per ack for
+                    # coalescing that cannot happen.
+                    self._commit_cv.wait(self.group_commit_ms / 1e3)
+                cover = self._written_seq
+                try:
+                    if not self._f.closed:
+                        try:
+                            _fsync(self._f.fileno())
+                        except BaseException as e:
+                            # advance NOTHING and poison: a raising
+                            # fsync must not release any follower's
+                            # ack, now or via a spuriously-succeeding
+                            # retry (silent RPO > 0 — the exact loss
+                            # the per-op path cannot produce)
+                            self._failed = e
+                            raise
+                        _OBS_FSYNCS.inc()
+                    self._synced_seq = max(self._synced_seq, cover)
+                finally:
+                    self._leader = False
+                    self._commit_cv.notify_all()
+
     def close(self) -> None:
         with self._lock:
             if not self._f.closed:
                 self._f.flush()
-                if self.sync:
+                if self.sync and self._failed is None:
+                    # a poisoned journal skips the final fsync: it
+                    # could spuriously succeed over dropped pages, and
+                    # parked followers raise off _failed regardless
                     _fsync(self._f.fileno())
+                    # release any followers parked on the condition:
+                    # the final fsync covered everything written
+                    self._synced_seq = self._written_seq
                 self._f.close()
+            self._commit_cv.notify_all()
 
     def __enter__(self):
         return self

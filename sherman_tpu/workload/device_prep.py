@@ -329,6 +329,72 @@ def _delta_ms(loop, reps: int) -> float:
     return max(0.0, (t2 - t1) / reps * 1e3)
 
 
+def overlap_receipt(prep_ms: float, serve_ms: float, verify_ms: float,
+                    wall_ms: float) -> dict:
+    """The round-8 OVERLAP RECEIPT, computed in exactly one place (the
+    read-only and mixed pipelined phase profiles and the
+    profile_staged2 mode table all publish it): ``bubble_ms`` = wall −
+    serve (the work NOT hidden behind the serve bound) and
+    ``overlap_efficiency`` = 1 − wall/(prep+serve+verify) (0 = fully
+    serial dispatch, (prep+verify)/sum = perfect hiding)."""
+    serial = prep_ms + serve_ms + verify_ms
+    return {
+        "wall_ms": wall_ms,
+        "bubble_ms": max(0.0, wall_ms - serve_ms),
+        "overlap_efficiency": (1.0 - wall_ms / serial
+                               if serial > 0 else 0.0),
+    }
+
+
+def record_phase_obs(prefix: str, phases: dict) -> None:
+    """Route one phase/overlap dict into obs — the SINGLE copy of the
+    routing every publisher (bench read-only + mixed, profile_staged2)
+    shares: ``overlap_efficiency`` is a ratio and lands in a gauge;
+    every wall cost lands in a ``<prefix>.<name>_ms`` histogram
+    (``wall_ms``/``bubble_ms`` already carry the unit)."""
+    from sherman_tpu import obs
+
+    for name, v in phases.items():
+        if name == "overlap_efficiency":
+            obs.gauge(f"{prefix}.overlap_efficiency").set(v)
+        else:
+            h = name if name.endswith("_ms") else f"{name}_ms"
+            obs.histogram(f"{prefix}.{h}").record(v)
+
+
+def _two_deep_slot(jverify):
+    """The pipelined modes' pending-slot protocol, in ONE copy shared
+    by the read-only and mixed steps (the slot tuple contents and the
+    verify program differ; the stateful contract must not): ``fold``
+    folds a pending batch's verify inputs (if any) into the receipts,
+    ``put`` parks batch k's, ``drain`` flushes the slot so the carry
+    is bit-identical to the sequential mode's, ``reset`` clears it
+    without folding (``new_carry()`` — a fresh receipts stream must
+    not fold a stale batch left by an undrained previous run)."""
+    pend = {"slot": None}
+
+    def fold(rcarry):
+        if pend["slot"] is not None:
+            rcarry = jverify(rcarry, *pend["slot"])
+        return rcarry
+
+    def put(*slot):
+        pend["slot"] = slot
+
+    def drain(carry):
+        step_idx, *rcarry = carry
+        rcarry = tuple(rcarry)
+        if pend["slot"] is not None:
+            rcarry = jverify(rcarry, *pend["slot"])
+            pend["slot"] = None
+        return (step_idx,) + rcarry
+
+    def reset():
+        pend["slot"] = None
+
+    return fold, put, drain, reset
+
+
 def _rank_sampler(sampler: str, n_keys: int, theta: float,
                   log2_bins: int):
     """-> (rank(tpair, w), effective_name) for the chosen sampler.
@@ -391,6 +457,31 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
       eliminating the cross-program layout / shard_map-fusion suspects
       of BENCHMARKS.md round-5 "known headroom"; the receipts
       arithmetic moves to its own elementwise ``verify`` program.
+    - ``"pipelined"``: the SAME three compiled programs as ``aligned``
+      (the serve is the same ``_get_search_fanout`` program OBJECT, so
+      the CI program-identity pin extends to this mode), dispatched as
+      a TWO-DEEP software pipeline: call k first folds batch k-1's
+      already-materialized serve outputs through ``verify`` (consuming
+      the pending slot), then dispatches ``prep`` for batch k into the
+      slot the verify just released, then the serve — so while the
+      device serves batch k-1, the host has already queued batch k's
+      prep and batch k-2's verify, and a backend that overlaps
+      independent programs hides the prep + verify walls behind the
+      serve.  Double-buffered: at most TWO batches' staging arrays are
+      alive (the in-flight prep outputs and the pending verify
+      inputs); no extra pool or batch copies are materialized, and
+      donation stays exactly the serve program's own
+      (:func:`sherman_tpu.config.donate_argnums`-gated).  Receipts lag
+      one batch in the returned carry; ``step.drain(carry)`` flushes
+      the pending verify, after which the carry is BIT-IDENTICAL to S
+      ``aligned`` steps' (same programs, same fold order).  A fresh
+      ``new_carry()`` also resets the pipeline (a fresh receipts
+      stream must not fold a stale pending batch).  CONTRACT: the
+      pending slot lives on the STEP object, so one pipelined step
+      drives ONE carry stream at a time — interleaving two carries
+      through the same step folds one stream's pending batch into the
+      other's receipts; build a second step (``staged=`` reuses the
+      resident tables) for a second stream.
     - ``"chained"``: the round-5 two-program form (``prep -> serve``
       with fan-out + verification fused into the serve program), kept
       for continuity and A/B measurement against ``aligned``.
@@ -411,9 +502,15 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     step consumes it.  Step attributes: ``step.fusion``,
     ``step.sampler``, ``step.programs`` (name -> jitted program in
     dispatch order), ``step.n_programs``, ``step.phase_profile``
-    (chained-delta per-phase wall costs), plus per-mode handles
-    (``step.jprep`` / ``step.jserve`` / ``step.jverify`` /
-    ``step.jfused``)."""
+    (chained-delta per-phase wall costs; in ``pipelined`` mode the
+    dict also carries the OVERLAP RECEIPT — ``wall_ms`` the drained
+    pipelined wall per step, ``bubble_ms`` = wall − serve, the host
+    work not hidden behind the serve bound, and
+    ``overlap_efficiency`` = 1 − wall/(prep + serve + verify), 0 =
+    fully serial), ``step.drain`` (flush the pending verify; identity
+    for non-pipelined modes), ``step.pipeline_depth`` (2 for
+    ``pipelined``, else 1), plus per-mode handles (``step.jprep`` /
+    ``step.jserve`` / ``step.jverify`` / ``step.jfused``)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -422,9 +519,9 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     from sherman_tpu.parallel import transport
 
     fusion = fusion or C.staged_fusion()
-    if fusion not in ("aligned", "chained", "fused"):
+    if fusion not in ("aligned", "pipelined", "chained", "fused"):
         raise ValueError(
-            f"fusion={fusion!r}: want aligned|chained|fused")
+            f"fusion={fusion!r}: want aligned|pipelined|chained|fused")
     router = eng.router
     assert router is not None, "attach_router() first"
     cfg = eng.cfg
@@ -502,8 +599,9 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
 
     mesh = dsm.mesh
     root_rep = None
+    _pipe_reset = None  # pipelined mode installs its slot reset here
 
-    if fusion == "aligned":
+    if fusion in ("aligned", "pipelined"):
         def prep(tpair, rtable, rkey, step_idx):
             skhi, sklo, ukhi, uklo, start, active, seg, n_uniq = \
                 prep_core(tpair, rtable, rkey, step_idx)
@@ -533,15 +631,44 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
             out_specs=(rep,) * 4, check_vma=False))
         root_rep = _rep_put(dsm, root)
 
-        def step(pool, counters, tpair, rtable, rkey, carry):
-            step_idx, *rcarry = carry
-            (step_idx, skhi, sklo, khi, klo, start, active, inv,
-             nu) = jprep(tpair, rtable, rkey, step_idx)
-            counters, done, found, vhi, vlo = jserve(
-                pool, counters, khi, klo, root_rep, active, start, inv)
-            rcarry = jverify(tuple(rcarry), skhi, sklo, found, vhi,
-                             vlo, nu)
-            return counters, (step_idx,) + tuple(rcarry)
+        if fusion == "aligned":
+            def step(pool, counters, tpair, rtable, rkey, carry):
+                step_idx, *rcarry = carry
+                (step_idx, skhi, sklo, khi, klo, start, active, inv,
+                 nu) = jprep(tpair, rtable, rkey, step_idx)
+                counters, done, found, vhi, vlo = jserve(
+                    pool, counters, khi, klo, root_rep, active, start,
+                    inv)
+                rcarry = jverify(tuple(rcarry), skhi, sklo, found, vhi,
+                                 vlo, nu)
+                return counters, (step_idx,) + tuple(rcarry)
+        else:  # pipelined: two-deep software pipeline, same 3 programs
+            # the pending slot (:func:`_two_deep_slot`): batch k-1's
+            # verify inputs — device handles only, the serve outputs
+            # are already materializing when the slot is consumed.
+            # After S steps + drain the carry is bit-identical to S
+            # aligned steps'.
+            _fold, _put, _drain, _pipe_reset = _two_deep_slot(jverify)
+
+            def step(pool, counters, tpair, rtable, rkey, carry):
+                step_idx, *rcarry = carry
+                # 1. consume batch k-1: fold its answers into the
+                #    receipts — off the serve(k-1) -> serve(k) path
+                rcarry = _fold(tuple(rcarry))
+                # 2. prep batch k into the slot verify just released
+                #    (independent of the in-flight serve: a backend
+                #    that overlaps programs runs it behind the serve)
+                (step_idx, skhi, sklo, khi, klo, start, active, inv,
+                 nu) = jprep(tpair, rtable, rkey, step_idx)
+                # 3. serve batch k — the SAME compiled program object
+                #    aligned (and the host-staged phase) dispatches
+                counters, done, found, vhi, vlo = jserve(
+                    pool, counters, khi, klo, root_rep, active, start,
+                    inv)
+                _put(skhi, sklo, found, vhi, vlo, nu)
+                return counters, (step_idx,) + rcarry
+
+            step.drain = _drain
 
         step.jprep, step.jserve, step.jverify = jprep, jserve, jverify
         programs = {"prep": jprep, "serve_fanout": jserve,
@@ -614,9 +741,16 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
 
     step.fusion, step.sampler = fusion, sampler
     step.programs, step.n_programs = programs, len(programs)
+    step.pipeline_depth = 2 if fusion == "pipelined" else 1
+    if not hasattr(step, "drain"):
+        step.drain = lambda carry: carry  # nothing pending off-pipeline
 
     def new_carry():
-        """Fresh device-resident carry."""
+        """Fresh device-resident carry.  Also resets the pipelined
+        mode's pending slot: a fresh receipts stream must not fold a
+        stale batch left by an undrained previous run."""
+        if _pipe_reset is not None:
+            _pipe_reset()
         return tuple(_rep_put(dsm, v)
                      for v in (np.uint32(0), np.int32(1), np.int32(0),
                                np.int32(0), np.int32(0)))
@@ -657,7 +791,7 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         out["prep"] = _delta_ms(prep_loop, reps)
         arrs = jprep(tpair, rtable, rkey, new_carry()[0])[1:]
         jax.block_until_ready(arrs)
-        if fusion == "aligned":
+        if fusion in ("aligned", "pipelined"):
             skhi, sklo, khi, klo, start, active, inv, nu = arrs
 
             def serve_loop(k):
@@ -680,6 +814,26 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                 jax.block_until_ready(rc)
 
             out["verify"] = _delta_ms(verify_loop, reps)
+            if fusion == "pipelined":
+                # OVERLAP RECEIPT (:func:`overlap_receipt`): the
+                # drained pipelined wall per step (same chained-delta
+                # method) against the serial sum of the standalone
+                # phase walls just measured
+                def pipe_loop(k):
+                    c = new_carry()
+                    for _ in range(k):
+                        box["c"], c = step(pool, box["c"], tpair,
+                                           rtable, rkey, c)
+                    c = step.drain(c)
+                    jax.block_until_ready(c)
+
+                # warm both carry variants (fresh new_carry() inputs
+                # vs threaded program outputs are distinct jit cache
+                # entries) so no trace lands inside the delta
+                pipe_loop(2)
+                out.update(overlap_receipt(
+                    out["prep"], out["serve_fanout"], out["verify"],
+                    _delta_ms(pipe_loop, reps)))
         else:  # chained
 
             def sv_loop(k):
@@ -702,7 +856,8 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
                            batch: int, read_ratio: float, dev_rb: int,
                            dev_wb: int, log2_bins: int = 20,
                            check_xor: int = 0xDEADBEEF, seed: int = 13,
-                           staged=None, sampler: str = "table"):
+                           staged=None, sampler: str = "table",
+                           fusion: str | None = None):
     """Device-staged sustained MIXED loop (YCSB-A/B shape): the same
     nothing-shipped open loop as :func:`make_staged_step`, but each step
     carries both point lookups and in-place updates through ONE fused
@@ -744,7 +899,25 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     is already bumped when serve runs, so the linearization check keeps
     a separate one).  After S steps ``n_correct_reads ==
     S * R * machine_nr`` and ``n_ok_writes == S * (batch - R) *
-    machine_nr`` or the phase is void."""
+    machine_nr`` or the phase is void.
+
+    ``fusion`` picks the program structure, mirroring
+    :func:`make_staged_step`'s knob on the mixed loop's two credible
+    forms (default: ``pipelined`` iff ``SHERMAN_STAGED_FUSION`` says
+    so, else ``chained`` — the mixed loop has no separate "aligned"
+    comparator, its chained serve IS the canonical fused
+    ``mixed_step_spmd`` program):
+
+    - ``"chained"`` (default): prep -> serve, receipts folded inside
+      the serve program (the round-5 form).
+    - ``"pipelined"``: prep -> serve -> verify as a TWO-DEEP software
+      pipeline — the receipts arithmetic moves to its own program fed
+      from a pending slot one batch behind, exactly like the read-only
+      pipelined mode, and the write batch k's journal-relevant apply
+      still happens in serve order (the pipeline reorders only the
+      RECEIPTS fold, never the pool writes).  Same arithmetic, same
+      fold order: after ``step.drain`` the carry is bit-identical to
+      ``chained``'s."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -812,17 +985,17 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
                 act_w, start, rskhi, rsklo, rseg, r_nu[None],
                 wseg, w_nu[None])
 
-    def serve(pool, locks, counters, rcarry, akhi, aklo, vhi, vlo, act_r,
-              act_w, start, rskhi, rsklo, rseg, r_nu_a, wseg, w_nu_a):
-        ok, n_corr_r, n_ok_w, sum_nu, max_nu_r, max_nu_w, sidx = rcarry
-        r_nu, w_nu = r_nu_a[0], w_nu_a[0]
+    def serve_fanout_core(pool, locks, counters, akhi, aklo, vhi, vlo,
+                          act_r, act_w, start, rseg, wseg):
+        """The mixed serve minus receipts: fused descent/apply + the
+        monotone per-client fan-out of read answers and write statuses
+        (GLOBAL indices on multi-node meshes).  Shared verbatim by the
+        chained and pipelined forms so their pools and receipts cannot
+        diverge."""
         pool, counters, status, done_r, found, rvh, rvl = mixed_step_spmd(
             pool, locks, counters, i32(akhi), i32(aklo), i32(vhi),
             i32(vlo), root, act_r, act_w, start, cfg=cfg, iters=iters,
             write_lo=dev_rb, update_only=True)
-        # read fan-out (monotone gather, sorted client order) + the
-        # on-device linearization check: value must decode to a strictly
-        # earlier step (writers stamp step+1; bulk decodes to 0)
         ans = jnp.stack([found.astype(jnp.int32), rvh, rvl,
                          jnp.zeros_like(rvh)], axis=-1)[:dev_rb]
         stat_w = status[dev_rb:]
@@ -834,11 +1007,18 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
             wseg = wseg + node.astype(jnp.int32) * dev_wb
         out = jnp.take_along_axis(
             ans, jnp.clip(rseg, 0, ans.shape[0] - 1)[:, None], axis=0)
+        st_cli = jnp.take_along_axis(
+            stat_w, jnp.clip(wseg, 0, stat_w.shape[0] - 1), axis=0)
+        return pool, counters, out, st_cli
+
+    def verify_mixed_core(rcarry, rskhi, rsklo, out, st_cli, r_nu, w_nu):
+        """Receipts: the on-device linearization check (a read's value
+        must decode to a strictly earlier step — writers stamp step+1,
+        bulk decodes to 0) + the write-status audit."""
+        ok, n_corr_r, n_ok_w, sum_nu, max_nu_r, max_nu_w, sidx = rcarry
         dec_hi = u32(out[:, 1]) ^ rskhi ^ cx_hi
         dec_lo = u32(out[:, 2]) ^ rsklo ^ cx_lo
         corr_r = ((out[:, 0] != 0) & (dec_hi == 0) & (dec_lo <= sidx))
-        st_cli = jnp.take_along_axis(
-            stat_w, jnp.clip(wseg, 0, stat_w.shape[0] - 1), axis=0)
         ok_w = ((st_cli == ST_APPLIED)
                 | ((st_cli == ST_SUPERSEDED) if N > 1
                    else jnp.zeros_like(st_cli, bool)))
@@ -854,44 +1034,109 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
             step_ok = lax.pmin(step_ok, AXIS)
         else:
             sum_inc, max_r, max_w = r_nu + w_nu, r_nu, w_nu
-        rcarry = (jnp.minimum(ok, step_ok), n_corr_r + inc_r,
-                  n_ok_w + inc_w, sum_nu + sum_inc,
-                  jnp.maximum(max_nu_r, max_r),
-                  jnp.maximum(max_nu_w, max_w),
-                  sidx + jnp.uint32(1))
-        return pool, counters, rcarry
+        return (jnp.minimum(ok, step_ok), n_corr_r + inc_r,
+                n_ok_w + inc_w, sum_nu + sum_inc,
+                jnp.maximum(max_nu_r, max_r),
+                jnp.maximum(max_nu_w, max_w),
+                sidx + jnp.uint32(1))
 
+    fusion = fusion or ("pipelined" if C.staged_fusion() == "pipelined"
+                        else "chained")
+    if fusion not in ("chained", "pipelined"):
+        raise ValueError(f"mixed fusion={fusion!r}: want "
+                         "chained|pipelined")
     mesh = dsm.mesh
+    _pipe_reset = None
     prep_sm = jax.shard_map(
         prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
         out_specs=(rep,) + (spec,) * 13, check_vma=False)
     jprep = jax.jit(prep_sm)
-    serve_sm = jax.shard_map(
-        serve, mesh=mesh,
-        in_specs=(spec, spec, spec, (rep,) * 7) + (spec,) * 13,
-        out_specs=(spec, spec, (rep,) * 7), check_vma=False)
-    # pool + counters donated; rcarry is NOT (callers block the
-    # dispatch window on carry[1] — see the read-only step's note)
-    jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2))
 
-    def step(pool, locks, counters, tpair, rtable, rkey, carry):
-        step_idx, *rcarry = carry
-        step_idx, *arrs = jprep(tpair, rtable, rkey, step_idx)
-        pool, counters, rcarry = jserve(pool, locks, counters,
-                                        tuple(rcarry), *arrs)
-        return pool, counters, (step_idx,) + tuple(rcarry)
+    if fusion == "chained":
+        def serve(pool, locks, counters, rcarry, akhi, aklo, vhi, vlo,
+                  act_r, act_w, start, rskhi, rsklo, rseg, r_nu_a, wseg,
+                  w_nu_a):
+            pool, counters, out, st_cli = serve_fanout_core(
+                pool, locks, counters, akhi, aklo, vhi, vlo, act_r,
+                act_w, start, rseg, wseg)
+            rcarry = verify_mixed_core(rcarry, rskhi, rsklo, out,
+                                       st_cli, r_nu_a[0], w_nu_a[0])
+            return pool, counters, rcarry
 
-    step.jprep, step.jserve = jprep, jserve
+        serve_sm = jax.shard_map(
+            serve, mesh=mesh,
+            in_specs=(spec, spec, spec, (rep,) * 7) + (spec,) * 13,
+            out_specs=(spec, spec, (rep,) * 7), check_vma=False)
+        # pool + counters donated; rcarry is NOT (callers block the
+        # dispatch window on carry[1] — see the read-only step's note)
+        jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2))
+
+        def step(pool, locks, counters, tpair, rtable, rkey, carry):
+            step_idx, *rcarry = carry
+            step_idx, *arrs = jprep(tpair, rtable, rkey, step_idx)
+            pool, counters, rcarry = jserve(pool, locks, counters,
+                                            tuple(rcarry), *arrs)
+            return pool, counters, (step_idx,) + tuple(rcarry)
+
+        step.jprep, step.jserve = jprep, jserve
+        step.programs = {"prep": jprep, "serve_fanout_verify": jserve}
+    else:  # pipelined: receipts fold one batch behind the serve
+        def serve_p(pool, locks, counters, akhi, aklo, vhi, vlo, act_r,
+                    act_w, start, rseg, wseg):
+            return serve_fanout_core(pool, locks, counters, akhi, aklo,
+                                     vhi, vlo, act_r, act_w, start,
+                                     rseg, wseg)
+
+        serve_sm = jax.shard_map(
+            serve_p, mesh=mesh, in_specs=(spec,) * 12,
+            out_specs=(spec,) * 4, check_vma=False)
+        jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2))
+
+        def verify_p(rcarry, rskhi, rsklo, out, st_cli, r_nu_a, w_nu_a):
+            return verify_mixed_core(rcarry, rskhi, rsklo, out, st_cli,
+                                     r_nu_a[0], w_nu_a[0])
+
+        verify_sm = jax.shard_map(
+            verify_p, mesh=mesh,
+            in_specs=((rep,) * 7,) + (spec,) * 6,
+            out_specs=(rep,) * 7, check_vma=False)
+        jverify = jax.jit(verify_sm)
+        _fold, _put, _drain, _pipe_reset = _two_deep_slot(jverify)
+
+        def step(pool, locks, counters, tpair, rtable, rkey, carry):
+            step_idx, *rcarry = carry
+            # consume batch k-1's fanned-out answers/statuses; the
+            # POOL writes of batch k-1 already landed in serve order —
+            # the pipeline reorders only the receipts fold
+            rcarry = _fold(tuple(rcarry))
+            (step_idx, akhi, aklo, vhi, vlo, act_r, act_w, start,
+             rskhi, rsklo, rseg, r_nu_a, wseg, w_nu_a) = jprep(
+                tpair, rtable, rkey, step_idx)
+            pool, counters, out, st_cli = jserve(
+                pool, locks, counters, akhi, aklo, vhi, vlo, act_r,
+                act_w, start, rseg, wseg)
+            _put(rskhi, rsklo, out, st_cli, r_nu_a, w_nu_a)
+            return pool, counters, (step_idx,) + rcarry
+
+        step.drain = _drain
+        step.jprep, step.jserve, step.jverify = jprep, jserve, jverify
+        step.programs = {"prep": jprep, "serve_fanout": jserve,
+                         "verify": jverify}
+
     step.sampler = sampler
-    step.fusion = "chained"
-    step.programs = {"prep": jprep, "serve_fanout_verify": jserve}
+    step.fusion = fusion
     step.n_programs = len(step.programs)
+    step.pipeline_depth = 2 if fusion == "pipelined" else 1
+    if not hasattr(step, "drain"):
+        step.drain = lambda carry: carry
 
     def new_carry():
         """(step_idx, ok, n_correct_reads, n_ok_writes, sum_nuniq,
         max_nuniq_r, max_nuniq_w, serve_step_idx) — serve keeps its own
         step counter (last slot) so its linearization check cannot read
         prep's already-bumped one."""
+        if _pipe_reset is not None:
+            _pipe_reset()
         return tuple(_rep_put(dsm, v)
                      for v in (np.uint32(0), np.int32(1), np.int32(0),
                                np.int32(0), np.int32(0), np.int32(0),
@@ -919,14 +1164,57 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
         arrs = jprep(tpair, rtable, rkey, new_carry()[0])[1:]
         jax.block_until_ready(arrs)
 
-        def sv_loop(k):
+        if fusion == "chained":
+            def sv_loop(k):
+                rc = tuple(new_carry()[1:])
+                for _ in range(k):
+                    box["p"], box["c"], rc = jserve(box["p"], locks,
+                                                    box["c"], rc, *arrs)
+                jax.block_until_ready(rc)
+
+            out["serve_fanout_verify"] = _delta_ms(sv_loop, reps)
+            return out, box["p"], box["c"]
+
+        # pipelined: attribute the split serve and verify programs,
+        # then the drained pipelined wall (the overlap receipt — see
+        # the read-only step's phase_profile)
+        (akhi, aklo, vhi, vlo, act_r, act_w, start, rskhi, rsklo,
+         rseg, r_nu_a, wseg, w_nu_a) = arrs
+
+        def serve_loop(k):
+            o = None
+            for _ in range(k):
+                box["p"], box["c"], o, st = jserve(
+                    box["p"], locks, box["c"], akhi, aklo, vhi, vlo,
+                    act_r, act_w, start, rseg, wseg)
+            jax.block_until_ready(o)
+
+        out["serve_fanout"] = _delta_ms(serve_loop, reps)
+        box["p"], box["c"], o, st = jserve(
+            box["p"], locks, box["c"], akhi, aklo, vhi, vlo, act_r,
+            act_w, start, rseg, wseg)
+
+        def verify_loop(k):
             rc = tuple(new_carry()[1:])
             for _ in range(k):
-                box["p"], box["c"], rc = jserve(box["p"], locks,
-                                                box["c"], rc, *arrs)
+                rc = jverify(rc, rskhi, rsklo, o, st, r_nu_a, w_nu_a)
             jax.block_until_ready(rc)
 
-        out["serve_fanout_verify"] = _delta_ms(sv_loop, reps)
+        out["verify"] = _delta_ms(verify_loop, reps)
+
+        def pipe_loop(k):
+            c = new_carry()
+            for _ in range(k):
+                box["p"], box["c"], c = step(box["p"], locks, box["c"],
+                                             tpair, rtable, rkey, c)
+            c = step.drain(c)
+            jax.block_until_ready(c)
+
+        # warm both carry variants (see the read-only overlap receipt)
+        pipe_loop(2)
+        out.update(overlap_receipt(out["prep"], out["serve_fanout"],
+                                   out["verify"],
+                                   _delta_ms(pipe_loop, reps)))
         return out, box["p"], box["c"]
 
     step.phase_profile = phase_profile

@@ -125,25 +125,30 @@ def _build_engine(n_keys, salt, machine_nr=1, B=4096):
 
 
 def test_staged_fusion_modes_agree(eight_devices):
-    """All three program structures of the staged step (aligned /
-    chained / fused) are the same computation: same PRNG stream, same
-    receipts.  aligned's serve is the engine's host-staged program;
+    """All four program structures of the staged step (aligned /
+    pipelined / chained / fused) are the same computation: same PRNG
+    stream, same receipts.  aligned's serve is the engine's host-staged
+    program; pipelined is the two-deep software pipeline over the SAME
+    three programs (drained here, so its receipts cover every batch);
     chained is the round-5 form; fused is one program."""
     import jax
     salt = 0x5E17_AB1E_5A17
     n_keys, batch, S = 20_000, 2048, 3
     eng = _build_engine(n_keys, salt, B=batch)
     results = {}
-    for fusion in ("aligned", "chained", "fused"):
+    for fusion in ("aligned", "pipelined", "chained", "fused"):
         step, (new_carry, tb, rt, rk) = make_staged_step(
             eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
             dev_b=batch, log2_bins=16, fusion=fusion)
         assert step.fusion == fusion
+        assert step.pipeline_depth == (2 if fusion == "pipelined"
+                                       else 1)
         carry = new_carry()
         counters = eng.dsm.counters
         for _ in range(S):
             counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
                                    carry)
+        carry = step.drain(carry)  # identity off-pipeline
         jax.block_until_ready(carry)
         eng.dsm.counters = counters
         results[fusion] = tuple(int(np.asarray(x)) for x in carry)
@@ -195,6 +200,166 @@ def test_staged_aligned_serve_is_host_staged_program(eight_devices):
         dev_b=batch, log2_bins=16, fusion="aligned")
     assert step.jserve is eng._get_search_fanout(eng._iters())
     assert list(step.programs) == ["prep", "serve_fanout", "verify"]
+
+
+def test_staged_pipelined_serve_is_host_staged_program(eight_devices):
+    """The program-identity pin EXTENDS to the pipelined mode: its
+    serve is the same compiled object as aligned's (= the engine's
+    host-staged fan-out program), so the aligned CI pin covers the
+    pipelined serve by construction."""
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch = 20_000, 2048
+    eng = _build_engine(n_keys, salt, B=batch)
+    step, _ = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16, fusion="pipelined")
+    assert step.jserve is eng._get_search_fanout(eng._iters())
+    assert list(step.programs) == ["prep", "serve_fanout", "verify"]
+    assert step.pipeline_depth == 2 and callable(step.drain)
+
+
+def test_staged_pipelined_receipts_lag_then_drain(eight_devices):
+    """Per-step pipelined receipts lag exactly one batch (the pending
+    slot); drain catches them up; new_carry() resets an undrained
+    pipeline so a stale batch can never leak into a fresh stream."""
+    import jax
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch, S = 20_000, 2048, 3
+    eng = _build_engine(n_keys, salt, B=batch)
+    step, (new_carry, tb, rt, rk) = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16, fusion="pipelined")
+    counters = eng.dsm.counters
+    carry = new_carry()
+    for k in range(S):
+        counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                               carry)
+        jax.block_until_ready(carry)
+        assert int(np.asarray(carry[2])) == k * batch  # lags one batch
+    # leave the pipeline UNDRAINED: a fresh carry must reset the slot
+    carry = new_carry()
+    for _ in range(2):
+        counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                               carry)
+    carry = step.drain(carry)
+    carry = step.drain(carry)  # idempotent: slot already flushed
+    jax.block_until_ready(carry)
+    eng.dsm.counters = counters
+    assert int(np.asarray(carry[2])) == 2 * batch, \
+        "stale pending batch leaked into the fresh receipts stream"
+
+
+def test_staged_pipelined_matches_aligned_after_splits(eight_devices):
+    """Bit-identity survives a split-triggering write burst: insert a
+    fresh key range through the engine (device splits reshape leaves
+    and internals), re-seed the router, rebuild both steps — receipts
+    must still be bit-identical and fully verified (stale-start descent
+    recovers via the B-link chase either way)."""
+    import jax
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch, S = 20_000, 2048, 2
+    eng = _build_engine(n_keys, salt, B=batch)
+
+    def run(fusion):
+        step, (new_carry, tb, rt, rk) = make_staged_step(
+            eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+            dev_b=batch, log2_bins=16, fusion=fusion)
+        carry = new_carry()
+        counters = eng.dsm.counters
+        for _ in range(S):
+            counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                                   carry)
+        carry = step.drain(carry)
+        jax.block_until_ready(carry)
+        eng.dsm.counters = counters
+        return tuple(int(np.asarray(x)) for x in carry)
+
+    before = {f: run(f) for f in ("aligned", "pipelined")}
+    assert before["aligned"] == before["pipelined"]
+    assert before["aligned"][2] == S * batch
+    # split-triggering burst: a DENSE key range outside the synthetic
+    # keyspace lands in a handful of leaves and must split them
+    # repeatedly — 1500 contiguous keys cannot fit in the couple of
+    # leaves covering that range (LEAF_CAP 49), so >= ~30 splits are
+    # structural certainty.  The staged batches never sample these
+    # keys, so the verified receipts stay exact; what changes is the
+    # page layout the descent walks.
+    ranks = np.arange(n_keys, dtype=np.uint64)
+    synth = _mix64_np(ranks ^ np.uint64(salt))
+    fresh = (np.uint64(1) << np.uint64(61)) \
+        + np.arange(1500, dtype=np.uint64)
+    fresh = np.setdiff1d(fresh, synth)
+    st = eng.insert(fresh, fresh ^ np.uint64(0x5EED))
+    assert st["lock_timeouts"] == 0
+    got, found = eng.search(fresh)
+    assert found.all()
+    # the engine notes splits to the live router; rebuilding the steps
+    # (inside run()) re-snapshots its table for the staged probe
+    after = {f: run(f) for f in ("aligned", "pipelined")}
+    assert after["aligned"] == after["pipelined"], after
+    assert after["aligned"][2] == S * batch
+
+
+def test_staged_pipelined_mixed_matches_chained(eight_devices):
+    """The mixed staged loop's pipelined form (receipts one batch
+    behind the fused descent/apply serve) is bit-identical to chained
+    after drain — carries AND pool content (the pipeline must reorder
+    only the receipts fold, never the writes)."""
+    import jax
+    from sherman_tpu.workload.device_prep import make_staged_mixed_step
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch, S = 20_000, 2048, 3
+    R = 1024
+    results, probes = {}, {}
+    probe_keys = _mix64_np(
+        np.arange(0, n_keys, 7, dtype=np.uint64) ^ np.uint64(salt))
+    for fusion in ("chained", "pipelined"):
+        eng = _build_engine(n_keys, salt, B=batch)
+        step, (new_carry, tb, rt, rk) = make_staged_mixed_step(
+            eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+            read_ratio=0.5, dev_rb=R, dev_wb=batch - R, log2_bins=16,
+            fusion=fusion)
+        assert step.fusion == fusion
+        carry = new_carry()
+        dsm = eng.dsm
+        pool, counters = dsm.pool, dsm.counters
+        for _ in range(S):
+            pool, counters, carry = step(pool, dsm.locks, counters, tb,
+                                         rt, rk, carry)
+        carry = step.drain(carry)
+        jax.block_until_ready(carry)
+        dsm.pool, dsm.counters = pool, counters
+        results[fusion] = tuple(int(np.asarray(x)) for x in carry)
+        got, found = eng.search(probe_keys)
+        assert found.all()
+        probes[fusion] = got
+    assert results["chained"] == results["pipelined"], results
+    si, ok, n_corr_r, n_ok_w, *_ = results["chained"]
+    assert si == S and ok == 1
+    assert n_corr_r == S * R and n_ok_w == S * (batch - R)
+    np.testing.assert_array_equal(probes["chained"],
+                                  probes["pipelined"])
+
+
+def test_staged_pipelined_phase_profile_overlap_receipt(eight_devices):
+    """The pipelined phase profile carries the OVERLAP RECEIPT bench.py
+    publishes: the aligned phase keys + wall_ms / bubble_ms /
+    overlap_efficiency, with bubble >= 0 and efficiency <= 1."""
+    salt = 0x5E17_AB1E_5A17
+    n_keys, batch = 20_000, 2048
+    eng = _build_engine(n_keys, salt, B=batch)
+    step, (new_carry, tb, rt, rk) = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=batch,
+        dev_b=batch, log2_bins=16, fusion="pipelined")
+    phases, counters = step.phase_profile(eng.dsm.pool, eng.dsm.counters,
+                                          tb, rt, rk, reps=1)
+    eng.dsm.counters = counters
+    assert set(phases) == {"prep", "serve_fanout", "verify", "wall_ms",
+                           "bubble_ms", "overlap_efficiency"}
+    assert phases["wall_ms"] >= 0.0 and phases["bubble_ms"] >= 0.0
+    assert phases["overlap_efficiency"] <= 1.0
+    assert phases["bubble_ms"] >= phases["wall_ms"] \
+        - phases["serve_fanout"] - 1e-9
 
 
 def test_staged_phase_profile_keys(eight_devices):
@@ -333,7 +498,7 @@ def test_staged_mixed_multinode(eight_devices):
         f"{S * 512 * 8 - n_ok_w} write clients unapplied across the mesh"
 
 
-@pytest.mark.parametrize("fusion", ["aligned", "chained"])
+@pytest.mark.parametrize("fusion", ["aligned", "pipelined", "chained"])
 def test_staged_step_multinode(eight_devices, fusion):
     import jax
     salt = 0x5E17_AB1E_5A17
@@ -350,6 +515,7 @@ def test_staged_step_multinode(eight_devices, fusion):
     for _ in range(S):
         counters, carry = step(dsm.pool, counters, table_d, rtable_d,
                                rkey_d, carry)
+    carry = step.drain(carry)
     jax.block_until_ready(carry)
     dsm.counters = counters
     step_idx, ok, n_correct, sum_nu, max_nu = map(

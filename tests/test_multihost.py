@@ -388,6 +388,7 @@ pool, counters = dsm.pool, dsm.counters
 for _ in range(S):
     pool, counters, mc = mstep(pool, dsm.locks, counters, mtb, mrt,
                                mrk, mc)
+mc = mstep.drain(mc)  # pipelined-mode receipts lag a batch
 jax.block_until_ready(mc)
 dsm.pool, dsm.counters = pool, counters
 msi, mok, n_corr_r, n_ok_w, *_rest = (int(np.asarray(x)) for x in mc)

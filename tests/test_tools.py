@@ -174,6 +174,53 @@ def test_profile_staged2_driver(eight_devices, capsys, monkeypatch):
     assert r["phase_ms"] == j["phase_ms"]
 
 
+def test_profile_staged2_pipelined(eight_devices, capsys, monkeypatch):
+    """Round-8 smoke: FUSION=pipelined anatomy carries the overlap
+    receipt (wall/bubble/efficiency ride phase_ms), the mode table
+    prices aligned vs pipelined through the same windowed loop, and
+    pipeline_depth lands in the JSON."""
+    import json
+
+    for k, v in (("KEYS", "20000"), ("B", "8192"), ("DEVB", "8192"),
+                 ("K", "2"), ("STEPS", "4"), ("W", "2"),
+                 ("FUSION", "pipelined"),
+                 ("MODES", "aligned,pipelined")):
+        monkeypatch.setenv(k, v)
+    import profile_staged2
+    r = profile_staged2.main()
+    out = capsys.readouterr().out
+    j = json.loads(out.strip().splitlines()[-1])
+    assert j["metric"] == "staged_step_anatomy"
+    assert j["fusion"] == "pipelined" and j["n_programs"] == 3
+    assert j["pipeline_depth"] == 2
+    assert {"prep", "serve_fanout", "verify", "wall_ms", "bubble_ms",
+            "overlap_efficiency"} <= set(j["phase_ms"])
+    assert set(j["modes"]) == {"aligned", "pipelined"}
+    for row in j["modes"].values():
+        assert row["wall_ms"] >= 0 and row["bubble_ms"] >= 0
+        assert row["overlap_efficiency"] <= 1.0
+    assert r["modes"] == j["modes"]
+
+
+def test_ckpt_bench_journal_group_commit_ab(eight_devices, capsys):
+    """The group-commit A/B rides the ckpt driver: per-op fsync vs
+    bounded-delay windows, with the >= 2x acks-per-fsync coalescing
+    pin at group_commit_ms=2 asserted inside the driver."""
+    import json
+
+    import ckpt_bench
+    ckpt_bench.main(["--keys", "20000", "--sample", "1000",
+                     "--delta-ops", "0", "--journal-ab-threads", "4",
+                     "--journal-ab-appends", "12"])
+    r = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    jab = r["journal_group_commit"]
+    assert set(jab) == {"per_op", "gc_0.5ms", "gc_2ms"}
+    assert jab["per_op"]["acks_per_fsync"] == 1.0
+    assert jab["gc_2ms"]["acks_per_fsync"] >= 2.0
+    for row in jab.values():
+        assert row["acks"] == 48 and row["acks_per_s"] > 0
+
+
 def test_profile_gather_driver(eight_devices, capsys):
     """Page-kernel A/B driver (CPU smoke of tools/profile_gather.py):
     the side-by-side table must cover every kernel phase for both
@@ -237,6 +284,9 @@ def test_recovery_drill_driver(eight_devices, capsys):
     import recovery_drill
     r = recovery_drill.main(["--keys", "2500", "--nodes", "4"])
     assert r["ok"] and r["rpo_ops"] == 0 and r["rto_ms"] > 0
+    # RPO 0 measured WITH journal group commit on (the round-8 pin)
+    assert r["group_commit_ms"] > 0
+    assert r["journal"]["appends"] >= r["journal"]["fsyncs"] > 0
     assert r["journal"]["truncated_tails"] >= 1
     assert r["delta1"]["pages"] > 0
     assert r["repair"]["pages"] >= 1

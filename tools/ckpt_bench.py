@@ -18,6 +18,15 @@ metadata, a delta only the written pages.  On this environment both
 transfers ride the access tunnel; the JSON publishes byte sizes so a
 co-located host can be priced from its own link rate.
 
+It also prices the journal's **group-commit A/B** (round-8): per-op
+fsync vs ``Journal(sync=True, group_commit_ms=...)`` under a
+multi-writer append load shaped like the recovery drill's batch
+records — acks/s, mean/p99 ack latency, the added ack latency vs the
+per-op baseline, and the measured acks-per-fsync coalescing ratio
+(asserted >= 2x at ``group_commit_ms=2`` — the receipt the pipelined
+write path's "writes ride the group commit" claim rests on; RPO 0
+itself is pinned by the recovery drill, which runs with the knob on).
+
 Run (real chip):  python tools/ckpt_bench.py --keys 100000000
 CPU smoke:        SHERMAN_PLATFORM=cpu python tools/ckpt_bench.py \\
                       --keys 50000 --sample 5000 --delta-ops 4000
@@ -40,6 +49,89 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from common import setup_platform  # noqa: E402
 
 
+def journal_group_commit_ab(threads: int = 4, appends: int = 24,
+                            rows: int = 256,
+                            modes=(0.0, 0.5, 2.0)) -> dict:
+    """The group-commit A/B: ``threads`` concurrent writers each
+    appending ``appends`` drill-shaped batch records (``rows`` u64
+    key/value pairs — the recovery drill's record scale) through one
+    Journal per mode.  Every append blocks until its record is covered
+    by an fsync (RPO 0 in every mode); the A/B prices what that ack
+    costs: per-op fsync re-serializes the writers on the fsync
+    latency, group commit coalesces a window of acks into one fsync.
+    Returns {mode_label: {acks_per_s, ack_mean_ms, ack_p99_ms,
+    added_ack_ms, fsyncs, acks_per_fsync}}."""
+    import shutil
+    import tempfile
+    import threading
+
+    from sherman_tpu import obs
+    from sherman_tpu.utils import journal as J
+
+    td = tempfile.mkdtemp(prefix="sherman_jab_")
+    rng = np.random.default_rng(17)
+    # one key/value block per (thread, append): identical across modes
+    # so the three files carry the same bytes
+    blocks = rng.integers(1, 1 << 60, (threads, appends, rows),
+                          dtype=np.uint64)
+    results: dict = {}
+    try:
+        for gc in modes:
+            label = "per_op" if gc == 0 else f"gc_{gc:g}ms"
+            path = os.path.join(td, f"{label}.wal")
+            snap0 = obs.snapshot()
+            j = J.Journal(path, sync=True, group_commit_ms=gc)
+            lat: list = []
+            lock = threading.Lock()
+
+            def writer(t):
+                mine = []
+                for i in range(appends):
+                    ks = blocks[t, i]
+                    t0 = time.perf_counter()
+                    j.append(J.J_UPSERT, ks, ks ^ np.uint64(0x5EED))
+                    mine.append(time.perf_counter() - t0)
+                with lock:
+                    lat.extend(mine)
+
+            ths = [threading.Thread(target=writer, args=(t,))
+                   for t in range(threads)]
+            t0 = time.perf_counter()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            j.close()
+            d = obs.delta(snap0, obs.snapshot())
+            n = threads * appends
+            assert len(J.read_records(path)) == n, \
+                "group-commit A/B lost records"
+            fsyncs = int(d.get("journal.fsyncs", 0))
+            lat.sort()
+            results[label] = {
+                "group_commit_ms": gc,
+                "acks": n,
+                "acks_per_s": round(n / elapsed, 1),
+                "ack_mean_ms": round(1e3 * sum(lat) / len(lat), 3),
+                "ack_p99_ms": round(
+                    1e3 * lat[int(0.99 * (len(lat) - 1))], 3),
+                "fsyncs": fsyncs,
+                "acks_per_fsync": round(n / max(1, fsyncs), 2),
+            }
+            os.unlink(path)
+    finally:
+        # a failed mode leaves its .wal behind: remove the whole
+        # tempdir, contents and all
+        shutil.rmtree(td, ignore_errors=True)
+    base = results.get("per_op", {}).get("ack_mean_ms", 0.0)
+    for r in results.values():
+        # the group-commit tradeoff, made explicit: acks coalesce at
+        # the cost of up to group_commit_ms of added ack latency
+        r["added_ack_ms"] = round(r["ack_mean_ms"] - base, 3)
+    return results
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--keys", type=int, default=100_000_000)
@@ -55,6 +147,11 @@ def main(argv=None) -> None:
                     help="engine upserts between base and delta "
                          "checkpoint (default keys/100 capped at 1 M; "
                          "0 disables the delta A/B)")
+    ap.add_argument("--journal-ab-threads", type=int, default=4,
+                    help="concurrent writers in the journal "
+                         "group-commit A/B (0 disables it)")
+    ap.add_argument("--journal-ab-appends", type=int, default=24,
+                    help="records per writer in the group-commit A/B")
     args = ap.parse_args(argv)
     if args.delta_ops is None:
         args.delta_ops = min(max(args.keys // 100, 1000), 1_000_000)
@@ -205,6 +302,31 @@ def main(argv=None) -> None:
         print("# {:>10s} {:>12.3f} {:>12.3f}".format(
             "size (GB)", size / 1e9, delta["npz_bytes"] / 1e9),
             file=sys.stderr, flush=True)
+
+    jab = None
+    if args.journal_ab_threads > 0:
+        jab = journal_group_commit_ab(threads=args.journal_ab_threads,
+                                      appends=args.journal_ab_appends)
+        print("# journal group-commit A/B ({} writers x {} records):"
+              .format(args.journal_ab_threads, args.journal_ab_appends),
+              file=sys.stderr)
+        print("# {:>10s} {:>9s} {:>12s} {:>11s} {:>12s} {:>14s}".format(
+            "mode", "acks/s", "ack_mean_ms", "ack_p99_ms",
+            "added_ack_ms", "acks_per_fsync"), file=sys.stderr)
+        for label, r in jab.items():
+            print("# {:>10s} {:>9.0f} {:>12.3f} {:>11.3f} {:>12.3f} "
+                  "{:>14.2f}".format(label, r["acks_per_s"],
+                                     r["ack_mean_ms"], r["ack_p99_ms"],
+                                     r["added_ack_ms"],
+                                     r["acks_per_fsync"]),
+                  file=sys.stderr, flush=True)
+        g2 = jab.get("gc_2ms")
+        if g2 is not None and args.journal_ab_threads >= 2:
+            # the round-8 acceptance pin: bounded-delay group commit
+            # must actually coalesce under a multi-writer load
+            assert g2["acks_per_fsync"] >= 2.0, \
+                f"group commit failed to coalesce: {g2}"
+
     print(json.dumps({
         "metric": "checkpoint_restore_at_scale",
         "value": round(ckpt_s + restore_s, 1),
@@ -219,6 +341,10 @@ def main(argv=None) -> None:
         "verify_s": round(verify_s, 1),
         "validate_s": round(validate_s, 1) if validate_s else None,
         "delta": delta,
+        # per-op-fsync vs bounded-delay group commit (acks/s, ack
+        # latency, coalescing ratio); RPO 0 in every mode — the drill
+        # pins it with the knob ON
+        "journal_group_commit": jab,
     }))
 
 

@@ -8,7 +8,7 @@ routed serve fed host-staged inputs measured 72-84 ms.  This driver is
 the attribution tool for that gap:
 
 - builds the staged step in any fusion mode (``FUSION`` env:
-  aligned | chained | fused — see ``config.staged_fusion``),
+  aligned | pipelined | chained | fused — see ``config.staged_fusion``),
 - times the FULL pipelined step (bounded dispatch window, the honest
   loop shape bench.py runs),
 - attributes per-phase costs with the chained-delta method
@@ -21,10 +21,19 @@ the attribution tool for that gap:
   serve dispatches, so staged-vs-host serve cost is an apples-to-apples
   diff by construction,
 - records every region as an obs span / histogram and prints the
-  side-by-side prep-vs-serve table plus ONE JSON line.
+  side-by-side prep-vs-serve table plus ONE JSON line,
+- runs the MODE WALL table (round-8): aligned vs ``pipelined`` (the
+  two-deep software pipeline — verify k-1 / prep k+1 dispatched behind
+  serve k) through the same bounded-window loop, each with its
+  ``bubble_ms`` (wall − serve: the work not hidden behind the serve
+  bound) and ``overlap_efficiency`` (1 − wall/(prep+serve+verify))
+  against ONE shared phase attribution — the JSON ``modes`` block is
+  the CPU receipt for BENCHMARKS' Round-8 and the input to the queued
+  pipelined-vs-aligned chip A/B.
 
 Env knobs: KEYS (10 M), B (4 M), DEVB, K (delta reps, 8), FUSION,
-SAMPLER (analytic), W (dispatch window, 8), STEPS (pipelined steps, 24).
+SAMPLER (analytic), W (dispatch window, 8), STEPS (pipelined steps, 24),
+MODES (mode-wall table, default "aligned,pipelined"; "" disables).
 """
 
 import json
@@ -125,40 +134,64 @@ def main():
     # bench.py uses (PJRT allocates output buffers at enqueue; block on
     # the LAST program's carry from W steps back)
     from collections import deque
-    carry = new_carry()
-    counters, carry = step(pool, counters, table_d, rtable_d, rkey_d,
-                           carry)
-    jax.block_until_ready(carry)
-    assert int(np.asarray(carry[1])) == 1, "warmup: unique overflow"
-    assert int(np.asarray(carry[2])) == batch, "warmup: wrong answers"
-    carry = new_carry()
-    pend = deque()
-    with obs.span("profile.full_step_pipelined", steps=n_steps,
-                  fusion=fusion):
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            counters, carry = step(pool, counters, table_d, rtable_d,
-                                   rkey_d, carry)
-            pend.append(carry[1])
-            if len(pend) > W:
-                jax.block_until_ready(pend.popleft())
-        jax.block_until_ready(carry)
-        full_ms = (time.perf_counter() - t0) / n_steps * 1e3
-    assert int(np.asarray(carry[1])) == 1
-    assert int(np.asarray(carry[2])) == n_steps * batch, \
-        "pipelined window: receipts failed"
+
+    def windowed_wall(stp, nc, box, span_name):
+        """Bounded-window wall per step of one staged-step build,
+        chained-delta timed (STEPS and 2*STEPS windowed dispatches,
+        cost = (t_2K - t_K)/K — same methodology as the phases, so
+        the loop-invocation constant [first-dispatch program load,
+        carry staging] cancels and wall-vs-phase comparisons are
+        apples to apples).  Receipts verified on every invocation
+        (drained — the pipelined mode's receipts lag a batch until
+        ``stp.drain``)."""
+        state = {}
+
+        def loop(k):
+            carry = nc()
+            pend = deque()
+            for _ in range(k):
+                box["c"], carry = stp(pool, box["c"], table_d,
+                                      rtable_d, rkey_d, carry)
+                pend.append(carry[1])
+                if len(pend) > W:
+                    jax.block_until_ready(pend.popleft())
+            carry = stp.drain(carry)
+            jax.block_until_ready(carry)
+            assert int(np.asarray(carry[1])) == 1, \
+                "windowed loop: unique overflow"
+            assert int(np.asarray(carry[2])) == k * batch, \
+                "windowed loop: receipts failed"
+            state["steps"] = k
+
+        # warm BOTH carry variants before the delta: step 1 consumes a
+        # fresh new_carry() (host-put shardings), step 2+ the threaded
+        # program outputs — two jit cache entries, and the second's
+        # trace must not land inside the first timed invocation
+        loop(2)
+        with obs.span(span_name, steps=n_steps, fusion=stp.fusion):
+            wall = device_prep._delta_ms(loop, n_steps)
+        assert state["steps"] == 2 * n_steps  # every batch verified
+        return wall
+
+    cbox = {"c": counters}
+    full_ms = windowed_wall(step, new_carry, cbox,
+                            "profile.full_step_pipelined")
+    counters = cbox["c"]
     obs.histogram("staged.full_step_ms").record(full_ms)
-    print(f"{'full_step':20s} {full_ms:9.1f} ms/step (pipelined, W={W}, "
-          f"receipts verified)", file=sys.stderr)
+    print(f"{'full_step':20s} {full_ms:9.1f} ms/step (windowed W={W}, "
+          f"chained-delta, receipts verified)", file=sys.stderr)
 
     # B. per-phase attribution (chained-delta; obs histograms under
     # staged.<phase>_ms)
     with obs.span("profile.phase_attribution", reps=K, fusion=fusion):
         phase_ms, counters = step.phase_profile(pool, counters, table_d,
                                                 rtable_d, rkey_d, reps=K)
+    device_prep.record_phase_obs("staged", phase_ms)
     for name, ms in phase_ms.items():
-        obs.histogram(f"staged.{name}_ms").record(ms)
-        print(f"{name:20s} {ms:9.1f} ms", file=sys.stderr)
+        if name == "overlap_efficiency":  # a ratio, not a wall
+            print(f"{name:20s} {ms:9.2f}", file=sys.stderr)
+        else:
+            print(f"{name:20s} {ms:9.1f} ms", file=sys.stderr)
 
     # C. host-staged serve comparator: the engine fan-out program on one
     # pre-staged batch of the same width.  In 'aligned' mode this is the
@@ -226,6 +259,67 @@ def main():
         print("# no serve-only ratio for fused runs (one program; "
               "prep+verify inseparable)", file=sys.stderr)
 
+    # D. mode wall table (round-8): aligned vs the two-deep pipelined
+    # form through the SAME bounded-window loop.  The three compiled
+    # programs are SHARED between the modes by construction (pipelined
+    # reuses the aligned serve object), so ONE phase attribution prices
+    # both: bubble_ms = wall - serve (work not hidden behind the serve
+    # bound), overlap_efficiency = 1 - wall/(prep+serve+verify).
+    modes_env = os.environ.get("MODES", "aligned,pipelined")
+    modes = {}
+    if modes_env.strip():
+        want = [m.strip() for m in modes_env.split(",") if m.strip()]
+        by_mode = {}
+        for mode in want:
+            if mode == fusion:
+                by_mode[mode] = (step, new_carry)
+            else:
+                s2, (nc2, *_r) = device_prep.make_staged_step(
+                    eng, n_keys=n_keys, theta=theta, salt=salt,
+                    batch=batch, dev_b=dev_b, sampler=sampler,
+                    fusion=mode, staged=(table_d, rtable_d, rkey_d))
+                by_mode[mode] = (s2, nc2)
+        if {"prep", "serve_fanout", "verify"} <= set(phase_ms):
+            attr = phase_ms
+        else:  # anatomy ran chained/fused: attribute the shared
+            #    3-program form once for the table
+            s_al, nc_al = by_mode.get("aligned", (None, None))
+            if s_al is None:
+                s_al, (nc_al, *_r) = device_prep.make_staged_step(
+                    eng, n_keys=n_keys, theta=theta, salt=salt,
+                    batch=batch, dev_b=dev_b, sampler=sampler,
+                    fusion="aligned", staged=(table_d, rtable_d,
+                                              rkey_d))
+            with obs.span("profile.mode_attribution", reps=K):
+                attr, counters = s_al.phase_profile(
+                    pool, counters, table_d, rtable_d, rkey_d, reps=K)
+        serial = attr["prep"] + attr["serve_fanout"] + attr["verify"]
+        print(f"#\n# mode walls (W={W}, {n_steps} steps; serial sum "
+              f"{serial:.1f} ms = prep {attr['prep']:.1f} + serve "
+              f"{attr['serve_fanout']:.1f} + verify "
+              f"{attr['verify']:.1f})", file=sys.stderr)
+        print(f"# {'mode':12s} {'wall_ms':>9s} {'bubble_ms':>10s} "
+              f"{'overlap_eff':>12s}", file=sys.stderr)
+        for mode in want:
+            s2, nc2 = by_mode[mode]
+            cbox = {"c": counters}
+            wall = (full_ms if mode == fusion else windowed_wall(
+                s2, nc2, cbox, f"profile.mode_wall_{mode}"))
+            counters = cbox["c"]
+            rec = device_prep.overlap_receipt(
+                attr["prep"], attr["serve_fanout"], attr["verify"],
+                wall)
+            row = {"wall_ms": round(rec["wall_ms"], 2),
+                   "bubble_ms": round(rec["bubble_ms"], 2),
+                   "overlap_efficiency":
+                   round(rec["overlap_efficiency"], 3)}
+            modes[mode] = row
+            obs.histogram(f"staged.{mode}_wall_ms").record(wall)
+            print(f"# {mode:12s} {row['wall_ms']:9.1f} "
+                  f"{row['bubble_ms']:10.1f} "
+                  f"{row['overlap_efficiency']:12.3f}", file=sys.stderr)
+    dsm.counters = counters
+
     out = {
         "metric": "staged_step_anatomy",
         "fusion": fusion,
@@ -238,6 +332,11 @@ def main():
         # there is no separable staged serve to compare
         "staged_vs_host_serve_ratio": round(gap, 3)
         if gap is not None else None,
+        # per-mode bounded-window walls + overlap receipts (round-8):
+        # {mode: {wall_ms, bubble_ms, overlap_efficiency}} — the
+        # pipelined-vs-aligned side of the queued chip A/B
+        "modes": modes or None,
+        "pipeline_depth": step.pipeline_depth,
         "keys": n_keys, "batch": batch, "dev_b": dev_b,
         "window": W, "delta_reps": K,
         # per-phase obs spans/histograms of this run (staged.* keys)
