@@ -53,6 +53,22 @@ Environment knobs:
   SHERMAN_BENCH_KERNEL_ROWS  row count of that kernel A/B (default
                          2_097_152 — the BENCHMARKS.md phase-table
                          scale).
+  SHERMAN_METRICS_PORT   arm the stdlib Prometheus scrape endpoint on
+                         this port for the run's duration (GET
+                         /metrics; obs/export.py MetricsServer).
+  SHERMAN_PROM_FILE      rewrite a Prometheus textfile at this path
+                         every SHERMAN_PROM_INTERVAL_S (default 10)
+                         seconds — the node-exporter textfile-collector
+                         deployment shape (atomic tmp+rename writes).
+  SHERMAN_SLO=0          disable the per-op-class SLO observers (the
+                         obs-on/off A/B knob; the "slo" JSON section is
+                         then empty).
+  SHERMAN_BLACKBOX_DIR   arm the flight recorder's auto-dump (bundle on
+                         degraded entry / typed error / watchdog fire).
+
+The JSON carries ``schema_version`` (2: adds the per-op-class ``slo``
+section) — the field-by-field schema is documented in the BENCHMARKS.md
+appendix "Bench JSON schema".
 
 ``bench.py --chaos-drill`` runs the data-plane chaos drill
 (tools/chaos_drill.py: fault injection -> lease/scrub detection ->
@@ -406,6 +422,10 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                       f"({dev_elapsed / dev_steps * 1e3:.0f} ms/step — "
                       f"tunnel program-cache thrash), retrying",
                       file=sys.stderr)
+            # SLO accounting: the accepted attempt's whole drained
+            # window, attributed to the read class at once (the staged
+            # dispatch path itself carries zero obs work per step)
+            step_fn.record_slo(dev_steps, dev_elapsed)
             sustained_ops_s = dev_steps * batch / dev_elapsed
             sus_dev_ms_per_step = dev_elapsed / dev_steps * 1e3
             sus_dev_combine = dev_steps * batch / max(1, d_sum_nu)
@@ -481,6 +501,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         jax.block_until_ready(found)
         sus_elapsed = time.time() - t0
         obs.get_tracer().record("bench.sustained_host", sus_elapsed)
+        obs.observe("read", sus_steps * batch, sus_elapsed,
+                    batches=sus_steps)
         assert bool(np.asarray(done)[:last_nu].all()), \
             "sustained: stragglers"
         sus_host_ops_s = sus_steps * batch / sus_elapsed
@@ -660,6 +682,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     np.asarray(jnp.ravel(found)[0])  # true pipeline drain
     elapsed = time.time() - t0
     obs.get_tracer().record("bench.throughput_window", elapsed)
+    # SLO: the pre-staged throughput window is read-class traffic too
+    obs.observe("read", steps * batch, elapsed, batches=steps)
     n_last = n_uniq[(steps - 1) % n_batches]
     assert bool(np.asarray(done)[:n_last].all()), "lookups did not converge"
 
@@ -830,6 +854,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                   file=sys.stderr)
             b_cr, b_cw, b_snu = (int(np.asarray(x)) for x in
                                  (mc[2], mc[3], mc[4]))
+        mstep.record_slo(m_steps, m_elapsed)  # SLO: mixed-class window
         sus_mixed_ops_s = m_steps * batch / m_elapsed
         sus_mixed_ms = m_elapsed / m_steps * 1e3
         sus_mixed_combine = m_steps * batch / max(1, m_snu)
@@ -921,7 +946,18 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                                     "bench_batch": batch})
     obs_sec = obs.obs_section()
     obs_sec["trace_file"] = trace_file
+    # per-op-class SLO window (obs/slo.py): amortized per-op latency
+    # percentiles + windowed ops/s per class, fed by every timed window
+    # above — the width x latency frontier data the serving front
+    # door's adaptive batcher will consume
+    slo_sec = {cls: {k: (round(v, 3) if isinstance(v, float) else v)
+                     for k, v in stats.items()}
+               for cls, stats in obs.slo_window().items()}
     return {
+        # bench JSON schema version (see BENCHMARKS.md appendix):
+        # 2 = adds the "slo" section + schema_version itself; artifacts
+        # without the field are schema 1 (r01-r05)
+        "schema_version": 2,
         "metric": "ycsb_c_zipf%.2f_lookup_throughput" % theta,
         "value": round(client_ops_s),
         "unit": "ops/s",
@@ -1041,6 +1077,9 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # snapshot (incl. dsm.* device op/byte counters), per-phase span
         # stats, and the Perfetto-loadable trace file of this run
         "obs": obs_sec,
+        # per-op-class SLO window: {class: {ops_s, p50_ms, p99_ms,
+        # p999_ms, window_ops, ops_total, batches_total}}
+        "slo": slo_sec,
     }
 
 
@@ -1086,7 +1125,22 @@ def main() -> None:
     secs = float(os.environ.get("SHERMAN_BENCH_SECS", 10))
     theta = float(os.environ.get("SHERMAN_BENCH_THETA", 0.99))
     combine_env = os.environ.get("SHERMAN_BENCH_COMBINE", "").lower()
-    out = run(n_keys, batch, secs, theta, combine_env)
+    # exposition knobs: live scrape endpoint + Prometheus textfile (see
+    # the docstring) — metrics leave the process during the run, not
+    # just in the final JSON
+    from sherman_tpu import obs as _obs
+    srv = _obs.maybe_serve_http()
+    prom_path = os.environ.get("SHERMAN_PROM_FILE")
+    prom = _obs.PeriodicExporter(
+        prom_path, float(os.environ.get("SHERMAN_PROM_INTERVAL_S", 10)),
+        fmt="prom").start() if prom_path else None
+    try:
+        out = run(n_keys, batch, secs, theta, combine_env)
+    finally:
+        if prom is not None:
+            prom.stop()
+        if srv is not None:
+            srv.stop()
     print(json.dumps(out))
 
 

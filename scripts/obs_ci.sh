@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Observability CI lane: pin the SLO telemetry plane on the CPU mesh.
+#
+# Runs (1) the obs + slo fast tier (registry snapshot-vs-increment
+# fuzz, Chrome-trace schema, per-op-class SLO trackers + engine wiring,
+# flight recorder, Prometheus exposition, perfgate pass/flag pins, the
+# obs-on/off staged-wall < 2% cost pin), (2) the flight-recorder drill:
+# the chaos drill with the black box armed — the dump must contain the
+# injected fault, the degraded transition and the recovery step IN
+# ORDER (the drill asserts it and the receipt records it), and (3) the
+# perf-regression gate: green against the committed r05 receipt, RED
+# against a synthetically degraded (-20%) one — the gate is pinned in
+# both directions so it can neither rot green nor cry wolf.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== obs + slo fast tier =="
+python -m pytest tests/test_obs.py tests/test_slo.py -q
+
+echo "== flight-recorder drill (black box must show inject -> degrade -> recover) =="
+BB_DIR=$(mktemp -d)/blackbox
+SHERMAN_BLACKBOX_DIR="$BB_DIR" \
+    python bench.py --chaos-drill --keys "${SHERMAN_DRILL_KEYS:-3000}"
+ls "$BB_DIR"/blackbox-*.json >/dev/null
+python - "$BB_DIR" <<'EOF'
+import glob, json, sys
+dump = sorted(glob.glob(sys.argv[1] + "/blackbox-*-chaos_drill.json"))[-1]
+evs = json.load(open(dump))["otherData"]["flight_events"]
+seq = {}
+for k in ("chaos.inject", "engine.degraded_enter", "checkpoint.restore"):
+    seq[k] = next(e["seq"] for e in evs if e["kind"] == k)
+assert seq["chaos.inject"] < seq["engine.degraded_enter"] \
+    < seq["checkpoint.restore"], seq
+print("black box ordered:", seq)
+EOF
+
+echo "== perf gate: green on the committed r05 receipt =="
+python tools/perfgate.py --receipt BENCH_r05.json
+
+echo "== perf gate: RED on a -20% degraded receipt =="
+python - <<'EOF'
+import json, os, subprocess, sys, tempfile
+d = json.load(open("BENCH_r05.json"))["parsed"]
+for k in ("value", "client_ops_s", "sustained_ops_s", "sus_mixed_ops_s"):
+    if d.get(k):
+        d[k] = round(d[k] * 0.8)
+p = os.path.join(tempfile.mkdtemp(prefix="perfgate_ci_"), "degraded.json")
+json.dump(d, open(p, "w"))
+rc = subprocess.run([sys.executable, "tools/perfgate.py",
+                     "--receipt", p]).returncode
+assert rc == 1, f"perfgate must flag a -20% receipt (rc={rc})"
+print("degraded receipt flagged (rc=1)")
+EOF
+echo "OBS-CI PASS"

@@ -185,6 +185,10 @@ class FaultPlan:
             self.injected += 1
             _OBS_TOTAL.inc()
             _OBS[f.kind].inc()
+            # black box: every injection is a flight event, so a drill's
+            # dump shows WHAT was injected before WHAT was detected
+            obs.record_event("chaos.inject", fault=f.kind, step=step,
+                             addr=int(f.addr), slot=int(f.slot))
         return reqs, post
 
     def on_replies(self, dsm, reqs, rep):

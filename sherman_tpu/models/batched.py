@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,8 @@ from jax import lax
 
 from sherman_tpu import config as C
 from sherman_tpu import obs
+from sherman_tpu.obs import recorder as FR
+from sherman_tpu.obs import slo as SLO
 from sherman_tpu.config import DSMConfig, TreeConfig
 from sherman_tpu.models.btree import META_ADDR
 from sherman_tpu.ops import bits, layout, pallas_page
@@ -89,6 +92,15 @@ class DegradedError(RuntimeError):
 # degraded-mode gauge + lock-timeout counter (data-plane failure story)
 _OBS_DEGRADED = obs.gauge("engine.degraded")
 _OBS_LOCK_TIMEOUTS = obs.counter("engine.lock_timeouts")
+
+
+def _slo_observe(op_class: str, ops: int, t0: float | None) -> None:
+    """Attribute one host-path batch wall to its SLO op class (the
+    amortized per-op latency model: a client op's completion latency IS
+    its batch's wall).  ``t0`` None = a retry/chunk frame whose parent
+    (or whose own chunks) already account the ops."""
+    if t0 is not None and ops:
+        SLO.observe(op_class, int(ops), time.perf_counter() - t0)
 
 
 # ---------------------------------------------------------------------------
@@ -1154,6 +1166,11 @@ class BatchedEngine:
             self._degraded_reason = reason
             _OBS_DEGRADED.set(1)
             obs.counter("engine.degraded_entries").inc()
+            # black box: the transition is a flight event, and entering
+            # degraded auto-dumps the bundle (env-gated, debounced) so
+            # the postmortem starts from the moment the engine gave up
+            FR.record_event("engine.degraded_enter", reason=reason)
+            FR.auto_dump("degraded_entry")
 
     def exit_degraded(self) -> None:
         """Clear degraded mode — only after the damage is actually gone
@@ -1161,9 +1178,13 @@ class BatchedEngine:
         is the reference sequence."""
         self._degraded_reason = None
         _OBS_DEGRADED.set(0)
+        FR.record_event("engine.degraded_exit")
 
     def _require_writable(self) -> None:
         if self._degraded_reason is not None:
+            FR.record_event("engine.typed_error", error="DegradedError",
+                            reason=self._degraded_reason)
+            FR.auto_dump("typed_error")
             raise DegradedError(self._degraded_reason)
 
     def attach_journal(self, journal) -> None:
@@ -1359,6 +1380,7 @@ class BatchedEngine:
         writes.  (The bench drivers bypass this wrapper and treat
         fast-path misses as open-loop misses.)
         """
+        t_slo = time.perf_counter()
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
@@ -1438,6 +1460,9 @@ class BatchedEngine:
                 to = np.isin(keys[idx_w],
                              np.asarray(st["lock_timeout_keys"], np.uint64))
                 status[idx_w[to]] = ST_LOCK_TIMEOUT
+        # the whole fused batch (incl. any retry sub-batches, which also
+        # report under their own classes) is the mixed class's wall
+        _slo_observe("mixed", n, t_slo)
         return out_vals, found, status
 
     # -- helpers -------------------------------------------------------------
@@ -1506,6 +1531,10 @@ class BatchedEngine:
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
 
+        # SLO accounting: one batch wall per top-level call (chunks and
+        # straggler retries fold into their parent's wall; _depth > 0
+        # frames never observe)
+        t_slo = time.perf_counter() if _depth == 0 else None
         khi, klo = bits.keys_to_pairs(keys)
         (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
         active, _ = self._pad(np.ones(n, bool))
@@ -1534,7 +1563,9 @@ class BatchedEngine:
             miss = ~done
             v2, f2 = self.search(keys[miss], _depth=_depth + 1)
             vals[miss], fnd[miss] = v2, f2
+            _slo_observe("read", n, t_slo)
             return vals, fnd
+        _slo_observe("read", n, t_slo)
         return bits.pairs_to_keys(vhi[:n], vlo[:n]), found[:n]
 
     def _get_search_fanout(self, iters: int):
@@ -1598,8 +1629,10 @@ class BatchedEngine:
         use_device = (self.router is not None
                       and 0 < uk.size <= self.B * self.cfg.machine_nr)
         if not use_device:
+            # host fan-out: search() attributes the unique-set batch
             vals, found = self.search(uk)
             return vals[inv], found[inv]
+        t_slo = time.perf_counter()
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
             raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
         self._check_replicated(keys)
@@ -1629,8 +1662,10 @@ class BatchedEngine:
             done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
         if not bool(done[: uk.size].all()):
             # straggler rescue (stale seeds / growth): host fan-out path
+            # (search() attributes the rescue batch to the read class)
             vals, fnd = self.search(uk)
             return vals[inv], fnd[inv]
+        _slo_observe("read", n, t_slo)
         return (bits.pairs_to_keys(vhi[:n], vlo[:n]), found[:n])
 
     def insert(self, keys, values, max_rounds: int | None = None) -> dict:
@@ -1644,6 +1679,7 @@ class BatchedEngine:
         lock_timeouts, keys listed in lock_timeout_keys).
         """
         self._require_writable()
+        t_slo = time.perf_counter()
         if max_rounds is None:
             max_rounds = self.tcfg.insert_rounds
         keys = np.asarray(keys, np.uint64)
@@ -1666,6 +1702,9 @@ class BatchedEngine:
         # before the caller sees the stats ack
         self._journal_applied(J.J_UPSERT, keys[applied_rows],
                               values[applied_rows])
+        # the wall includes flush_parents + the durable journal append —
+        # insert's ack latency, which is what an SLO target governs
+        _slo_observe("insert", n, t_slo)
         return stats
 
     def _get_parent_descend(self, iters: int, stop_level: int = 1):
@@ -2503,12 +2542,18 @@ class BatchedEngine:
         # of collective host reads — divergent bounds would desync them
         self._check_replicated(
             np.asarray([b for r in ranges for b in r], np.uint64))
-        return range_query_many(self, ranges)
+        t_slo = time.perf_counter()
+        out = range_query_many(self, ranges)
+        # scans: one op per range (row counts vary per range; the SLO
+        # unit is the client request, as for every other class)
+        _slo_observe("scan", len(ranges), t_slo)
+        return out
 
     def delete(self, keys, max_rounds: int | None = None) -> np.ndarray:
         """Batched delete (``Tree::del`` parity).  Returns found bool [n]
         (True where the key existed and was removed)."""
         self._require_writable()
+        t_slo = time.perf_counter()
         if max_rounds is None:
             max_rounds = self.tcfg.insert_rounds
         keys = np.asarray(keys, np.uint64)
@@ -2525,6 +2570,7 @@ class BatchedEngine:
         # rows are no-ops; replaying them would also be, but keeping the
         # record set == applied set keeps replay accounting exact)
         self._journal_applied(J.J_DELETE, keys[out])
+        _slo_observe("delete", n, t_slo)
         return out
 
     def _delete_chunk(self, keys, max_rounds) -> np.ndarray:

@@ -207,6 +207,10 @@ class Tree:
         _, won = self.dsm.masked_cas(la, 0, observed, 0, bits.LEASE_MASK,
                                      space=D.SPACE_LOCK)
         (_OBS_LEASE_REVOKED if won else _OBS_LEASE_REVOKE_LOST).inc()
+        if won:
+            obs.record_event("lease.revoked", lock_word=int(la),
+                             owner=int(owner),
+                             epoch=int(bits.lease_epoch(observed)))
         return True  # lost race = someone else revoked/acquired: retry
 
     def _deadlock_report(self, la: int, old: int) -> RuntimeError:

@@ -101,6 +101,9 @@ class Scrubber:
         _OBS_VIOLATIONS.inc(len(new))
         for addr, mask in new:
             self.flagged[addr] = self.flagged.get(addr, 0) | mask
+            obs.record_event("scrub.violation", addr=hex(addr),
+                             mask=self._mask_names(mask),
+                             structural=bool(mask & SCRUB_STRUCTURAL))
             contained = self._quarantine_page(addr) if self.quarantine \
                 else False
             if mask & SCRUB_STRUCTURAL:
@@ -133,6 +136,8 @@ class Scrubber:
             if won or old == self.ctx.lease:
                 self._held_words.add(la)
                 obs.counter("scrub.pages_quarantined").inc()
+                obs.record_event("scrub.quarantine", addr=hex(addr),
+                                 lock_word=int(la))
                 return True
             # a DEAD holder (e.g. the same fault storm that corrupted
             # the page wedged its lock) is revoked, then retaken

@@ -13,23 +13,44 @@ instrumentation surface every layer reports through:
   recording and Chrome-trace-event JSON export (loadable in
   ``chrome://tracing`` / Perfetto), absorbing the legacy
   :class:`StepTrace` micro-tracer.
-- :mod:`sherman_tpu.obs.export` — JSONL periodic snapshots and the
-  one-call :func:`~sherman_tpu.obs.export.dump` used by ``bench.py``.
+- :mod:`sherman_tpu.obs.slo` — the SLO telemetry layer: per-op-class
+  (read/insert/delete/mixed/scan) amortized latency with sliding-window
+  ops/s and p50/p99/p999, fed by every batch wall (engine entry points
+  + the device-staged step factories' ``record_slo``).  Registered as
+  the ``slo.*`` pull collector.
+- :mod:`sherman_tpu.obs.recorder` — the black-box flight recorder: a
+  bounded ring of structured events (chaos injections, lease
+  revocations, degraded transitions, journal poisonings,
+  recovery/repair steps, span closes) with env-gated auto-dump bundles
+  (Chrome trace + events JSONL) on degraded entry, typed-error raise,
+  or watchdog fire.
+- :mod:`sherman_tpu.obs.export` — JSONL periodic snapshots, the
+  one-call :func:`~sherman_tpu.obs.export.dump` used by ``bench.py``,
+  Prometheus text exposition (textfile mode + optional stdlib HTTP
+  scrape endpoint behind ``SHERMAN_METRICS_PORT``).
 
 Wired-in sources: the DSM registers its device op/byte counters as a
 pull collector (``dsm.*`` keys in every snapshot), the transports count
 collective builds and payload bytes, the batched engine wraps its
-combine/descend/apply phases in spans, and the host B+Tree counts index
-cache hits/misses/invalidations.
+combine/descend/apply phases in spans AND attributes every host-path
+batch wall to its op class, and the host B+Tree counts index cache
+hits/misses/invalidations.
 """
 
 from __future__ import annotations
 
-from sherman_tpu.obs.export import dump, obs_section, write_snapshot_jsonl
+from sherman_tpu.obs.export import (MetricsServer, PeriodicExporter, dump,
+                                    maybe_serve_http, obs_section,
+                                    prometheus_text, write_prometheus,
+                                    write_snapshot_jsonl)
+from sherman_tpu.obs.recorder import (FlightRecorder, auto_dump,
+                                      get_recorder, record_event)
 from sherman_tpu.obs.registry import (Counter, Gauge, Histogram,
                                       MetricsRegistry, counter, delta, gauge,
                                       get_registry, histogram,
                                       register_collector, snapshot)
+from sherman_tpu.obs.slo import (LatencyTracker, SloTracker, WindowedRate,
+                                 get_slo, observe, observe_op, slo_window)
 from sherman_tpu.obs.spans import (SpanTracer, StepTrace, device_trace,
                                    get_tracer, span)
 
@@ -38,5 +59,10 @@ __all__ = [
     "counter", "gauge", "histogram", "snapshot", "delta",
     "register_collector", "get_registry",
     "SpanTracer", "StepTrace", "device_trace", "get_tracer", "span",
-    "dump", "obs_section", "write_snapshot_jsonl",
+    "dump", "obs_section", "write_snapshot_jsonl", "PeriodicExporter",
+    "prometheus_text", "write_prometheus", "MetricsServer",
+    "maybe_serve_http",
+    "LatencyTracker", "WindowedRate", "SloTracker",
+    "get_slo", "observe", "observe_op", "slo_window",
+    "FlightRecorder", "get_recorder", "record_event", "auto_dump",
 ]

@@ -151,6 +151,13 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
+    def metrics(self) -> list:
+        """The live metric objects (typed view — the Prometheus
+        exposition needs counter/gauge/histogram kinds, which the flat
+        snapshot erases)."""
+        with self._lock:
+            return list(self._metrics.values())
+
     def register_collector(self, prefix: str,
                            fn: Callable[[], dict]) -> None:
         """Merge ``fn()`` (a flat name -> number dict) into every

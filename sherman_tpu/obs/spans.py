@@ -90,6 +90,10 @@ class SpanTracer:
         self._t0 = time.perf_counter()
         self.max_events = max_events
         self.dropped = 0
+        # optional span-close subscriber (name, dur_s, depth) — the
+        # flight recorder's feed; called OUTSIDE the lock, per span
+        # completion (per phase, not per op)
+        self.on_close = None
 
     def _stack(self) -> list:
         st = getattr(self._tls, "stack", None)
@@ -118,15 +122,23 @@ class SpanTracer:
 
     def _record(self, name, t0, t1, depth, args) -> None:
         tid = threading.get_ident()
+        # an after-the-fact record() may claim a start BEFORE the
+        # tracer's epoch; clip the exported event to the trace window
+        # (negative ts breaks the Chrome trace-event contract) while
+        # the aggregate keeps the true duration
+        e0 = max(t0, self._t0)
         with self._lock:
             a = self._agg[name]
             a[0] += 1
             a[1] += t1 - t0
             if len(self._events) < self.max_events:
-                self._events.append((name, t0 - self._t0, t1 - t0, tid,
+                self._events.append((name, e0 - self._t0, t1 - e0, tid,
                                      depth, args))
             else:
                 self.dropped += 1
+        cb = self.on_close
+        if cb is not None:
+            cb(name, t1 - t0, depth)
 
     # -- views ---------------------------------------------------------------
 

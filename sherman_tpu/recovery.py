@@ -190,6 +190,8 @@ class RecoveryPlane:
         self.delta_paths = []
         self._sweep_stale()
         self._rotate_journal(1)
+        obs.record_event("recovery.checkpoint_base", cid=self.cid,
+                         bytes=os.path.getsize(self.base_path))
         return {"path": self.base_path, "cid": self.cid,
                 "bytes": os.path.getsize(self.base_path)}
 
@@ -208,6 +210,8 @@ class RecoveryPlane:
         self._tip_epoch = info["epoch"]
         self._rotate_journal(k + 1)
         info["path"] = path
+        obs.record_event("recovery.checkpoint_delta", cid=self.cid,
+                         link=k, pages=int(info.get("pages", -1)))
         return info
 
     def close(self) -> None:
@@ -259,6 +263,11 @@ class RecoveryPlane:
         plane.checkpoint_base()  # re-base: fresh chain, stale cid swept
         t_end = time.perf_counter()
         _OBS_RECOVERS.inc()
+        obs.record_event(
+            "recovery.recover", cid=cid, deltas=len(deltas),
+            segments=replay_stats["segments"],
+            replayed_records=replay_stats["records"],
+            total_ms=round((t_end - t0) * 1e3, 1))
         receipt = {
             "chain": {"cid": cid, "deltas": len(deltas)},
             "restore_ms": round((t_restore - t0) * 1e3, 1),
@@ -292,6 +301,9 @@ class RecoveryPlane:
                             else set()))
         if not damaged:
             return {"pages": 0, "ok": True, "repair_ms": 0.0}
+        obs.record_event("recovery.targeted_repair_begin",
+                         pages=len(damaged),
+                         addrs=[hex(a) for a in damaged[:16]])
         P = self.cluster.cfg.pages_per_node
         rows = [bits.addr_node(a) * P + bits.addr_page(a) for a in damaged]
         pages = CK.read_chain_rows(self.base_path, self.delta_paths, rows)
@@ -308,6 +320,9 @@ class RecoveryPlane:
         res = scrub_pass(self.tree)
         if res["violations"]:
             _OBS_REPAIR_FAILS.inc()
+            obs.record_event("recovery.targeted_repair_failed",
+                             pages=len(damaged),
+                             violations=int(res["violations"]))
             raise TargetedRepairFailed(
                 f"scrub still reports {res['violations']} violating "
                 f"page(s) after repairing {len(damaged)} "
@@ -343,4 +358,8 @@ class RecoveryPlane:
             from sherman_tpu.models.validate import check_structure_device
             out["structure"] = check_structure_device(self.tree)
         _OBS_REPAIRS.inc()
+        obs.record_event("recovery.targeted_repair", pages=len(damaged),
+                         repair_ms=out["repair_ms"],
+                         replayed_records=int(
+                             out["replay"].get("records", 0)))
         return out

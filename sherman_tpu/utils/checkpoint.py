@@ -146,6 +146,7 @@ def checkpoint(cluster, path: str):
     arrays["integrity"] = _integrity(arrays)
     _savez_atomic(path, 0, **arrays)
     _OBS_FULL_SAVES.inc()
+    obs.record_event("checkpoint.save", path=path, seq=int(seq))
     # A full save captures everything: dirty tracking restarts here.
     dsm.clear_dirty()
     return epoch
@@ -376,6 +377,9 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
     dsm.locks = jax.device_put(locks, dsm.shard)
     dsm.counters = jax.device_put(z["counters"], dsm.shard)
     _restore_directories(cluster, z)
+    # flight event: a restore is the recovery step every drill's black
+    # box must show after the degraded transition
+    obs.record_event("checkpoint.restore", path=path)
     return cluster
 
 

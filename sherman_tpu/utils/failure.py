@@ -171,6 +171,17 @@ class Watchdog:
 
     def _fire(self):
         self.fired = True
+        # black box FIRST: the default action is os._exit, so the flight
+        # recorder's dump (env-gated; force bypasses the debounce — this
+        # process is about to die) is the postmortem's only record.
+        # Lazy import: failure.py stays importable without the obs tree.
+        try:
+            from sherman_tpu.obs import recorder as _fr
+            _fr.record_event("watchdog.fired", what=self.what,
+                             timeout_s=self.timeout_s)
+            _fr.auto_dump("watchdog", force=True)
+        except Exception:
+            pass  # the watchdog's exit must never be blocked by obs
         msg = (f"[sherman watchdog] '{self.what}' exceeded "
                f"{self.timeout_s:g}s deadline")
         if self.diagnostics is not None:

@@ -233,6 +233,8 @@ class Journal:
                         _fsync(self._f.fileno())
                     except BaseException as e:
                         self._failed = e
+                        obs.record_event("journal.poisoned",
+                                         path=self.path, error=repr(e))
                         raise
                     self._synced_seq = seq
                     _OBS_FSYNCS.inc()
@@ -286,6 +288,10 @@ class Journal:
                             # retry (silent RPO > 0 — the exact loss
                             # the per-op path cannot produce)
                             self._failed = e
+                            obs.record_event("journal.poisoned",
+                                             path=self.path,
+                                             error=repr(e),
+                                             group_commit=True)
                             raise
                         _OBS_FSYNCS.inc()
                     self._synced_seq = max(self._synced_seq, cover)
@@ -372,6 +378,8 @@ def read_records(path: str, truncate_torn: bool = False) -> list[tuple]:
 
 def _truncate(path: str, off: int, size: int, do_truncate: bool) -> None:
     _OBS_TORN.inc()
+    obs.record_event("journal.torn_tail", path=path, at_byte=off,
+                     dropped_bytes=size - off, truncated=do_truncate)
     # a file torn inside the magic itself keeps nothing (a fresh
     # appender then rewrites the magic); otherwise cut at the last
     # clean frame boundary

@@ -745,6 +745,19 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     if not hasattr(step, "drain"):
         step.drain = lambda carry: carry  # nothing pending off-pipeline
 
+    # SLO accounting hook (obs/slo.py): the staged loop is an open read
+    # loop of `batch` client ops per step; the driver attributes a whole
+    # DRAINED window at once (per-batch wall = elapsed / n_steps — the
+    # amortized per-op latency model), so the per-step dispatch path
+    # carries ZERO extra obs work.
+    step.slo_class = "read"
+
+    def record_slo(n_steps: int, elapsed_s: float) -> None:
+        from sherman_tpu.obs import slo as _slo
+        _slo.observe("read", n_steps * batch, elapsed_s, batches=n_steps)
+
+    step.record_slo = record_slo
+
     def new_carry():
         """Fresh device-resident carry.  Also resets the pipelined
         mode's pending slot: a fresh receipts stream must not fold a
@@ -1129,6 +1142,16 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     step.pipeline_depth = 2 if fusion == "pipelined" else 1
     if not hasattr(step, "drain"):
         step.drain = lambda carry: carry
+
+    # SLO hook (see make_staged_step): the fused read/write batch is the
+    # mixed class's wall, attributed per drained window by the driver
+    step.slo_class = "mixed"
+
+    def record_slo(n_steps: int, elapsed_s: float) -> None:
+        from sherman_tpu.obs import slo as _slo
+        _slo.observe("mixed", n_steps * batch, elapsed_s, batches=n_steps)
+
+    step.record_slo = record_slo
 
     def new_carry():
         """(step_idx, ok, n_correct_reads, n_ok_writes, sum_nuniq,

@@ -84,6 +84,82 @@ def test_collector_merge_and_error_isolation():
     assert "src.a" not in reg.snapshot()
 
 
+def test_snapshot_vs_increment_fuzz_undercounts_never_crashes():
+    """The documented lock-free-hot-path contract, pinned by storm:
+    concurrent inc/record during snapshot()/delta() may UNDERCOUNT
+    (increments are not atomic RMWs) but must never raise, corrupt a
+    histogram's invariants, or over-count."""
+    reg = MetricsRegistry()
+    c = reg.counter("storm.ops")
+    h = reg.histogram("storm.lat")
+    g = reg.gauge("storm.depth")
+    N_THREADS, N_INCS = 4, 5_000
+    stop = threading.Event()
+    errors: list = []
+
+    def incer():
+        try:
+            for i in range(N_INCS):
+                c.inc()
+                h.record(i % 1000)
+                g.set(i)
+        except Exception as e:  # pragma: no cover - the failure mode
+            errors.append(e)
+
+    def snapper():
+        try:
+            while not stop.is_set():
+                snap = reg.snapshot()
+                assert 0 <= snap["storm.ops"] <= N_THREADS * N_INCS
+                hs = snap["storm.lat"]
+                assert 0 <= hs["count"] <= N_THREADS * N_INCS
+                delta(snap, reg.snapshot())
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=incer) for _ in range(N_THREADS)]
+    ss = [threading.Thread(target=snapper) for _ in range(2)]
+    for t in ss + ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    for t in ss:
+        t.join()
+    assert not errors, errors
+    final = reg.snapshot()
+    # everything joined: the final snapshot is exact (undercount can
+    # only happen to a reader racing a writer, never after quiescence
+    # on CPython's per-op atomic int adds)
+    assert 0 < final["storm.ops"] <= N_THREADS * N_INCS
+    assert final["storm.lat"]["count"] == sum(h.buckets)
+
+
+def test_collector_raises_mid_storm_isolated():
+    """A collector that raises INTERMITTENTLY (the donated-buffer-
+    mid-step shape) is recorded under _collector_errors on its bad
+    snapshots and contributes normally on its good ones — the other
+    metrics never disappear either way."""
+    reg = MetricsRegistry()
+    reg.counter("solid").inc(3)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] % 2:
+            raise RuntimeError("donated buffer mid-step")
+        return {"ok": 1}
+
+    reg.register_collector("flaky", flaky)
+    bad = reg.snapshot()
+    good = reg.snapshot()
+    assert bad["solid"] == good["solid"] == 3
+    assert any("flaky" in e for e in bad["_collector_errors"])
+    assert good["flaky.ok"] == 1 and "_collector_errors" not in good
+    # delta() skips the underscore bookkeeping keys entirely
+    assert "_collector_errors" not in delta(bad, good)
+
+
 # -- spans -------------------------------------------------------------------
 
 def test_legacy_steptrace_api_still_works():
@@ -134,6 +210,58 @@ def test_chrome_trace_roundtrips_through_json(tmp_path):
     a, b = by_name["phase_a"], by_name["phase_b"]
     assert a["ts"] <= b["ts"]
     assert b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-3
+
+
+def test_chrome_trace_event_schema_perfetto_loadable(tmp_path):
+    """Validate the emitted trace-event JSON against the Chrome
+    trace-event spec's required fields/types so
+    bench_logs/trace_last.json stays loadable in Perfetto: complete
+    ("X") events with numeric microsecond ts/dur, integer pid/tid, and
+    child events properly NESTED inside their parents' [ts, ts+dur]
+    intervals (the X-event encoding of B/E nesting)."""
+    tr = SpanTracer()
+    with tr.span("root", step=1):
+        with tr.span("child_a"):
+            with tr.span("grandchild"):
+                pass
+        with tr.span("child_b"):
+            pass
+    tr.record("after_the_fact", 0.001)
+    path = tr.export_chrome(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["displayTimeUnit"] in ("ms", "ns")
+    by_name = {}
+    for e in doc["traceEvents"]:
+        # required fields of an "X" (complete) event, with their types
+        assert e["ph"] == "X", e
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["tid"], int)
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e.get("cat", ""), str)
+        if "args" in e:
+            assert isinstance(e["args"], dict)
+        by_name[e["name"]] = e
+
+    def contains(outer, inner, tol_us=1e-3):
+        return (outer["ts"] <= inner["ts"] + tol_us
+                and inner["ts"] + inner["dur"]
+                <= outer["ts"] + outer["dur"] + tol_us)
+
+    root = by_name["root"]
+    assert contains(root, by_name["child_a"])
+    assert contains(root, by_name["child_b"])
+    assert contains(by_name["child_a"], by_name["grandchild"])
+    # siblings on one thread never interleave
+    a, b = by_name["child_a"], by_name["child_b"]
+    assert a["ts"] + a["dur"] <= b["ts"] + 1e-3
+    assert root["args"] == {"step": 1}
+    # the whole document survives a strict JSON round trip (Perfetto's
+    # parser rejects NaN/Inf, which json.dumps would emit unquoted)
+    json.loads(json.dumps(doc, allow_nan=False))
 
 
 def test_span_recording_thread_safe():

@@ -105,6 +105,12 @@ def test_chaos_drill_driver(eight_devices, capsys):
     assert r["host_revoked"] >= 1 and r["engine_revoked"] >= 1
     assert r["lock_timeouts"] == 4
     assert r["scrub"]["violations"] >= 1
+    # the black-box receipt: the flight-recorder dump exists and shows
+    # inject -> degraded -> restore in order (the drill asserts the
+    # ordering itself; the receipt records it)
+    import os
+    assert r["blackbox"]["ordered"] and os.path.exists(
+        r["blackbox"]["path"])
     assert "CHAOS-DRILL PASS" in capsys.readouterr().err
 
 

@@ -69,6 +69,11 @@ def main(argv=None) -> dict:
 
     t0 = time.time()
     out: dict = {"metric": "chaos_drill", "seed": a.seed, "ok": False}
+    # black box: the drill's flight recorder holds ONLY its own story
+    # (fresh ring), and the receipt below proves the dump shows inject
+    # -> degrade -> recover in order — the postmortem contract
+    rec = obs.get_recorder()
+    rec.clear()
     cluster, tree, eng = build_cluster(
         a.nodes, pages_for_keys(a.keys), batch_per_node=512,
         locks_per_node=1024, chunk_pages=64)
@@ -169,6 +174,40 @@ def main(argv=None) -> dict:
     out["restored"] = info
     d = obs.delta(snap0, obs.snapshot())
     out["chaos_injected"] = int(d.get("chaos.faults_injected", 0))
+
+    # -- black box: dump + assert the postmortem ORDER -----------------------
+    # the bundle must show the injected fault, the degraded transition
+    # and the recovery step in sequence — scattered counters cannot
+    bb_dir = os.environ.get("SHERMAN_BLACKBOX_DIR") or os.path.join(
+        tempfile.mkdtemp(prefix="sherman_drill_"), "blackbox")
+    bb_path = rec.dump("chaos_drill", bb_dir)
+    evs = rec.events()
+
+    def first_seq(kind, after=-1):
+        return next((e["seq"] for e in evs if e["kind"] == kind
+                     and e["seq"] > after), None)
+
+    s_inject = first_seq("chaos.inject")
+    s_degraded = first_seq("engine.degraded_enter")
+    s_typed = first_seq("engine.typed_error")
+    s_restore = first_seq("checkpoint.restore")
+    assert s_inject is not None, "no chaos.inject event in the black box"
+    assert s_degraded is not None and s_degraded > s_inject, \
+        "degraded transition missing or out of order in the black box"
+    assert s_restore is not None and s_restore > s_degraded, \
+        "recovery step missing or out of order in the black box"
+    with open(bb_path) as f:
+        bundle = json.load(f)
+    bkinds = [e["kind"] for e in bundle["otherData"]["flight_events"]]
+    assert "chaos.inject" in bkinds and "engine.degraded_enter" in bkinds
+    out["blackbox"] = {
+        "path": bb_path,
+        "events": len(evs),
+        "order": {"inject": s_inject, "degraded": s_degraded,
+                  "typed_error": s_typed, "restore": s_restore},
+        "ordered": True,
+    }
+
     out["elapsed_s"] = round(time.time() - t0, 1)
     out["ok"] = True
     print(json.dumps(out))

@@ -18,6 +18,13 @@ This driver measures, per width:
                    CALIBRATED per-sync access-tunnel cost share; both raw
                    and adjusted are printed.  On a co-located host the
                    adjustment is ~0 and raw == adjusted.
+
+Percentiles (round 7+) come from ``obs/slo.py`` trackers — the same
+log-bucketed streaming estimator the SLO plane publishes — instead of
+ad-hoc numpy arrays, so the latency-bracket chip re-capture and the
+serving-side SLO window report through ONE code path (rank-interpolated
+within <= 12.5% buckets; each row also gains p999 fields and an ``slo``
+sub-dict with the tracker's own window view).
 - ``ops_s``      — width / pipe_ms.
 - ``p50_model``  — 1.5 x span (formation wait + service); the measured
                    span is the same quantity bench.py's p50 reports at
@@ -104,6 +111,7 @@ def main() -> None:
     from sherman_tpu.config import LEAF_CAP, DSMConfig, TreeConfig
     from sherman_tpu.models import batched
     from sherman_tpu.models.btree import Tree
+    from sherman_tpu.obs import slo as SLO
     from sherman_tpu.ops import bits
 
     n_keys = args.keys
@@ -186,16 +194,21 @@ def main() -> None:
         jax.block_until_ready(found)
         pipe_ms = (time.time() - t1) / N * 1e3
 
-        # block-amortized spans
-        spans = []
+        # block-amortized spans -> the SLO plane's own streaming
+        # tracker (one estimator for the latency bench AND the serving
+        # window; W ops per step at the per-step span is the same
+        # amortized-wall attribution bench.py's slo section uses)
+        span_t = SLO.SloTracker(window_s=3600.0)
         for b in range(args.blocks):
             t1 = time.time()
             for i in range(args.kblk):
                 counters, done, found, vhi, vlo = step(i, counters)
             jax.block_until_ready(found)
-            spans.append((time.time() - t1) / args.kblk * 1e3)
-        raw50 = float(np.percentile(spans, 50))
-        raw99 = float(np.percentile(spans, 99))
+            span_t.observe("read", W * args.kblk, time.time() - t1,
+                           batches=args.kblk)
+        span_w = span_t.window()["read"]
+        raw50 = span_w["p50_ms"]
+        raw99 = span_w["p99_ms"]
         adj = sync_ms / args.kblk
         span50 = max(pipe_ms, raw50 - adj)
         span99 = max(pipe_ms, raw99 - adj)
@@ -244,7 +257,11 @@ def main() -> None:
         # through unchanged.
         n_samp = min(args.blocks, max(16, 2000 // stride))
         n_ol = n_samp * stride
-        lat_raw = []
+        # open-loop samples stream into slo.LatencyTracker pairs (raw /
+        # sync-adjusted) — the bracket's two ends through the same
+        # estimator the SLO plane publishes
+        ol_raw_t = SLO.LatencyTracker()
+        ol_adj_t = SLO.LatencyTracker()
         # Admission pacing: perf_counter_ns SPIN-WAIT, not time.sleep.
         # ms-granularity sleep cannot pace sub-ms batch periods — the
         # round-5 16 K row was below this host's ADMISSION floor purely
@@ -278,7 +295,9 @@ def main() -> None:
                 jax.block_until_ready(found)
                 t_c = time.perf_counter_ns()
                 mean_arrival = t_b + int((i - 0.5) * T_ns)
-                lat_raw.append((t_c - mean_arrival) / 1e6)
+                raw_ms = (t_c - mean_arrival) / 1e6
+                ol_raw_t.record(raw_ms / 1e3)
+                ol_adj_t.record(max(0.0, raw_ms - sync_ms) / 1e3)
                 # RE-ANCHOR the admission schedule by the OBSERVER's
                 # stall only (~sync_ms): without it, admissions accrue
                 # against the drain-stalled clock and every later
@@ -302,11 +321,11 @@ def main() -> None:
         # +-T/2 around the batch mean.  p50 is unaffected (symmetric);
         # p99 adds ~0.48*T (the 98th pct of U[-T/2, T/2]) — published
         # op-level, not batch-level.
-        p50_raw_m = float(np.percentile(lat_raw, 50))
-        p99_raw_m = float(np.percentile(lat_raw, 99)) + 0.48 * T * 1e3
-        adj_l = [max(0.0, x - sync_ms) for x in lat_raw]
-        p50_meas = float(np.percentile(adj_l, 50))
-        p99_meas = float(np.percentile(adj_l, 99)) + 0.48 * T * 1e3
+        p50_raw_m = ol_raw_t.percentile_ms(50)
+        p99_raw_m = ol_raw_t.percentile_ms(99) + 0.48 * T * 1e3
+        p50_meas = ol_adj_t.percentile_ms(50)
+        p99_meas = ol_adj_t.percentile_ms(99) + 0.48 * T * 1e3
+        n_lat = ol_raw_t.count
         row = {
             "width": W,
             "pipe_ms": round(pipe_ms, 2),
@@ -322,7 +341,16 @@ def main() -> None:
             "p99_measured_raw_ms": round(p99_raw_m, 2),
             "p50_measured_ms": round(p50_meas, 2),
             "p99_measured_ms": round(p99_meas, 2),
-            "ol_samples": len(lat_raw),
+            # SLO-plane extras: the tracker resolves p999 for free, and
+            # the span tracker's window is published whole so this row
+            # and bench.py's "slo" section are the same estimator
+            "span_p999_ms": round(span_t.window()["read"]["p999_ms"], 2),
+            "p999_measured_raw_ms": round(
+                ol_raw_t.percentile_ms(99.9) + 0.48 * T * 1e3, 2),
+            "slo": {k: round(float(v), 3)
+                    for k, v in span_w.items()},
+            "percentile_source": "obs.slo.LatencyTracker",
+            "ol_samples": n_lat,
             "ol_stride": stride,
             "ol_rho": rho,
             "sync_share_ms": round(adj, 2),
@@ -345,7 +373,7 @@ def main() -> None:
               f"{span99:5.2f}; open-loop p50 model {1.5 * span50:5.2f} ms "
               f"vs MEASURED [{p50_meas:5.2f}, {p50_raw_m:6.2f}] ms "
               f"(p99 [{p99_meas:5.2f}, {p99_raw_m:6.2f}], "
-              f"{len(lat_raw)} samples, stride {stride}, rho {rho}; "
+              f"{n_lat} samples, stride {stride}, rho {rho}; "
               f"adm jitter p50 {adm_p50:.3f} / p99 {adm_p99:.3f} ms, "
               f"spin {spin_ns / 1e6:.2f} ms, "
               f"{'feasible' if adm_ok else 'NOT FEASIBLE'})",
