@@ -64,11 +64,26 @@ Environment knobs:
                          obs-on/off A/B knob; the "slo" JSON section is
                          then empty).
   SHERMAN_BLACKBOX_DIR   arm the flight recorder's auto-dump (bundle on
-                         degraded entry / typed error / watchdog fire).
+                         degraded entry / typed error / watchdog fire /
+                         steady-state compile retrace).
+  SHERMAN_DEVICE_OBS=0   disable the white-box device plane (compile
+                         ledger + retrace detector, HBM accountant,
+                         roofline receipts; the "device" JSON section
+                         is then absent).
+  SHERMAN_BENCH_DEVICE_MEMORY=0  skip the per-program
+                         memory_analysis in the roofline receipts (it
+                         pays one AOT compile per staged program; the
+                         persistent compilation cache absorbs it on
+                         repeat runs).
+  SHERMAN_PEAK_GBPS / SHERMAN_PEAK_TFLOPS  override the device peak
+                         table the roofline fractions divide by
+                         (unknown device kinds publish absolute
+                         achieved rates only).
 
 The JSON carries ``schema_version`` (2: adds the per-op-class ``slo``
-section) — the field-by-field schema is documented in the BENCHMARKS.md
-appendix "Bench JSON schema".
+section; 3: adds the white-box ``device`` section — compile ledger,
+roofline receipts, memory watermarks) — the field-by-field schema is
+documented in the BENCHMARKS.md appendix "Bench JSON schema".
 
 ``bench.py --chaos-drill`` runs the data-plane chaos drill
 (tools/chaos_drill.py: fault injection -> lease/scrub detection ->
@@ -118,6 +133,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     import jax.numpy as jnp
 
     from sherman_tpu import obs
+    from sherman_tpu.obs import device as dev_obs
     from sherman_tpu.cluster import Cluster
     from sherman_tpu.config import (DSMConfig, LEAF_CAP, TreeConfig,
                                     staged_fusion)
@@ -241,7 +257,16 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     sus_dev_degraded = None  # final staged attempt still over threshold
     sus_dev_fusion = None  # compiled-program structure of the staged step
     sus_dev_phase_ms = sus_mixed_phase_ms = None  # per-phase attribution
+    staged_labels = mixed_labels = None  # phase -> compile-ledger label
     sort_ms = None  # staged-phase start-sort cost (native combine only)
+    # white-box device plane (obs/device.py): the compile ledger
+    # observes every jit compilation from here on (the jax.monitoring
+    # listener attaches once); run_windowed SEALS it around each timed
+    # window, so a steady-state retrace becomes a counted event + a
+    # black-box dump instead of a mystery p99 cliff.
+    # SHERMAN_DEVICE_OBS=0 kills the plane (the "device" JSON section
+    # is then absent).
+    ledger = dev_obs.get_ledger()
     phase_k = int(os.environ.get("SHERMAN_BENCH_PHASE_K", 4))
     want_phases = os.environ.get("SHERMAN_BENCH_PHASES", "1") != "0"
 
@@ -279,16 +304,21 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         with failure.Watchdog.maybe(
                 what=f"device-step window ({n_steps} steps)",
                 diagnostics=tree.dsm.counter_snapshot):
-            t0 = time.time()
-            for _ in range(n_steps):
-                c = advance()
-                pend.append(c[1])
-                if len(pend) > W:
-                    jax.block_until_ready(pend.popleft())
-            if finish is not None:
-                c = finish()
-            jax.block_until_ready(c)
-            return time.time() - t0
+            # SEALED steady state: warmup compiled every program this
+            # loop dispatches, so any compile observed inside the timed
+            # window is a retrace — counted in device.retraces, flight-
+            # recorded, and red in perfgate (obs/device.py)
+            with ledger.sealed_scope():
+                t0 = time.time()
+                for _ in range(n_steps):
+                    c = advance()
+                    pend.append(c[1])
+                    if len(pend) > W:
+                        jax.block_until_ready(pend.popleft())
+                if finish is not None:
+                    c = finish()
+                jax.block_until_ready(c)
+                return time.time() - t0
     if combine and salt is not None:
         # static unique capacity: gather cost is per-row, so round up only
         # to the next 8192 (NOT a power of two — a 2^k pad can cost >10%);
@@ -361,7 +391,18 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                                  sampler=dev_sampler)
             dev_sampler = step_fn.sampler  # effective (fallback-aware)
             sus_dev_fusion = step_fn.fusion  # aligned|chained|fused
+            staged_labels = step_fn.phase_labels  # roofline join keys
             carry = new_carry()
+            counters, carry = step_fn(pool, counters, table_d, rtable_d,
+                                      rkey_d, carry)
+            # second warmup step on the THREADED carry: the step
+            # programs' output avals differ from new_carry()'s
+            # host-staged arrays (two jit cache entries — see
+            # profile_staged2's windowed_wall note), so a single-step
+            # warmup would leave the threaded-carry variants to compile
+            # INSIDE the first sealed timed window — a compile wall in
+            # the published number AND a false steady-state retrace
+            # (the ledger caught exactly this)
             counters, carry = step_fn(pool, counters, table_d, rtable_d,
                                       rkey_d, carry)
             # pipelined mode: receipts lag one batch — flush the
@@ -371,8 +412,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             w_ok = int(np.asarray(carry[1]))
             w_corr = int(np.asarray(carry[2]))
             assert w_ok == 1, "device-staged warmup: unique overflow"
-            assert w_corr == batch, \
-                f"device-staged warmup: {batch - w_corr} ops wrong"
+            assert w_corr == 2 * batch, \
+                f"device-staged warmup: {2 * batch - w_corr} ops wrong"
             dev_steps = max(32, min(96, int(secs / 0.1)))
 
             def adv_ro():
@@ -783,6 +824,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                                                  dev_wb=cap_w0)
         sus_mixed_sampler = mstep.sampler  # effective (fallback-aware)
         sus_mixed_fusion = mstep.fusion  # chained | pipelined
+        mixed_labels = mstep.phase_labels  # stable across the cap rebuild
         mc = new_mc()
         pool, counters, mc = mstep(pool, tree.dsm.locks, counters, mt_d,
                                    mrt_d, mrk_d, mc)
@@ -953,11 +995,45 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     slo_sec = {cls: {k: (round(v, 3) if isinstance(v, float) else v)
                      for k, v in stats.items()}
                for cls, stats in obs.slo_window().items()}
+    # white-box device plane (obs/device.py): compile-ledger summary
+    # (programs/compiles/retraces — steady-state retraces MUST be 0:
+    # run_windowed sealed every timed window, so any nonzero count is
+    # the silent-retrace hazard and perfgate goes red on it), roofline
+    # receipts joining each staged phase's chained-delta wall with its
+    # compiled program's cost_analysis() byte/flop floor, and the
+    # HBM/host memory gauges with the run's peak watermark.
+    # SHERMAN_DEVICE_OBS=0 kills the plane (section absent);
+    # SHERMAN_BENCH_DEVICE_MEMORY=0 skips the per-program
+    # memory_analysis (it pays an AOT compile per program — the
+    # persistent compilation cache absorbs it on repeat runs).
+    device_sec = None
+    if dev_obs.enabled():
+        peaks = dev_obs.device_peaks()
+        want_mem = os.environ.get("SHERMAN_BENCH_DEVICE_MEMORY",
+                                  "1") != "0"
+        roofs = {}
+        if sus_dev_phase_ms and staged_labels:
+            roofs["staged"] = dev_obs.rooflines(
+                sus_dev_phase_ms, staged_labels, memory=want_mem,
+                peaks=peaks, ledger=ledger)
+        if sus_mixed_phase_ms and mixed_labels:
+            roofs["staged_mixed"] = dev_obs.rooflines(
+                sus_mixed_phase_ms, mixed_labels, memory=want_mem,
+                peaks=peaks, ledger=ledger)
+        device_sec = {
+            "compile_source": ledger.attach(),
+            "ledger": ledger.summary(),
+            "peaks": peaks,
+            "rooflines": roofs or None,
+            "memory": dev_obs.get_accountant().gauges(),
+        }
     return {
         # bench JSON schema version (see BENCHMARKS.md appendix):
-        # 2 = adds the "slo" section + schema_version itself; artifacts
-        # without the field are schema 1 (r01-r05)
-        "schema_version": 2,
+        # 2 = adds the "slo" section + schema_version itself; 3 = adds
+        # the "device" section (compile ledger, rooflines, memory
+        # watermarks); artifacts without the field are schema 1
+        # (r01-r05)
+        "schema_version": 3,
         "metric": "ycsb_c_zipf%.2f_lookup_throughput" % theta,
         "value": round(client_ops_s),
         "unit": "ops/s",
@@ -1080,6 +1156,14 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         # per-op-class SLO window: {class: {ops_s, p50_ms, p99_ms,
         # p999_ms, window_ops, ops_total, batches_total}}
         "slo": slo_sec,
+        # white-box device plane: {compile_source, ledger {programs,
+        # compiles, compile_ms_total, retraces, sealed_windows,
+        # entries}, peaks, rooflines {staged, staged_mixed:
+        # {phase: {program, wall_ms, flops, bytes, achieved_gbytes_s,
+        # achieved_*_frac (TPU only), bound, memory}}}, memory
+        # {hbm_*_bytes, host_*_bytes, hbm_total/peak_bytes}}.  None
+        # when SHERMAN_DEVICE_OBS=0.
+        "device": device_sec,
     }
 
 

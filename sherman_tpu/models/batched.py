@@ -40,6 +40,7 @@ from jax import lax
 
 from sherman_tpu import config as C
 from sherman_tpu import obs
+from sherman_tpu.obs import device as DEV
 from sherman_tpu.obs import recorder as FR
 from sherman_tpu.obs import slo as SLO
 from sherman_tpu.config import DSMConfig, TreeConfig
@@ -1255,7 +1256,10 @@ class BatchedEngine:
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(1))
+            # compile-ledger wrap (obs/device.py): the WRAPPER is what
+            # the cache holds, so program-identity pins keep holding
+            fn = DEV.wrap_program("engine.search",
+                                  jax.jit(sm, donate_argnums=C.donate_argnums(1)))
             self._search_cache[key] = fn
         return fn
 
@@ -1300,7 +1304,9 @@ class BatchedEngine:
                 out_specs=((spec, spec, spec, spec, log_spec) if with_fresh
                            else (spec, spec, spec, spec)),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3))
+            fn = DEV.wrap_program(
+                "engine.insert",
+                jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3)))
             self._insert_cache[key] = fn
         return fn
 
@@ -1326,7 +1332,9 @@ class BatchedEngine:
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3))
+            fn = DEV.wrap_program(
+                "engine.delete",
+                jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3)))
             self._delete_cache[key] = fn
         return fn
 
@@ -1361,7 +1369,9 @@ class BatchedEngine:
                 in_specs=tuple(in_specs),
                 out_specs=(spec, spec, spec, spec, spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3))
+            fn = DEV.wrap_program(
+                "engine.mixed",
+                jax.jit(sm, donate_argnums=C.donate_argnums(0, 2, 3)))
             self._mixed_cache[key] = fn
         return fn
 
@@ -1604,7 +1614,9 @@ class BatchedEngine:
                 kernel, mesh=self.dsm.mesh,
                 in_specs=(spec, spec, spec, spec, rep, spec, spec, spec),
                 out_specs=(spec, spec, spec, spec, spec), check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(1))
+            fn = DEV.wrap_program(
+                "engine.search_fanout",
+                jax.jit(sm, donate_argnums=C.donate_argnums(1)))
             self._search_cache[("fanout", iters)] = fn
         return fn
 
@@ -1719,7 +1731,9 @@ class BatchedEngine:
                 in_specs=(spec, spec, spec, spec, rep, spec),
                 out_specs=(spec, spec, spec, spec),
                 check_vma=False)
-            fn = jax.jit(sm, donate_argnums=C.donate_argnums(1))
+            fn = DEV.wrap_program(
+                "engine.parent_descend",
+                jax.jit(sm, donate_argnums=C.donate_argnums(1)))
             self._parent_descend_cache[key] = fn
         return fn
 

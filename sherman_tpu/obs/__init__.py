@@ -21,9 +21,18 @@ instrumentation surface every layer reports through:
 - :mod:`sherman_tpu.obs.recorder` — the black-box flight recorder: a
   bounded ring of structured events (chaos injections, lease
   revocations, degraded transitions, journal poisonings,
-  recovery/repair steps, span closes) with env-gated auto-dump bundles
-  (Chrome trace + events JSONL) on degraded entry, typed-error raise,
-  or watchdog fire.
+  recovery/repair steps, compile retraces, span closes) with env-gated
+  auto-dump bundles (Chrome trace + events JSONL) on degraded entry,
+  typed-error raise, watchdog fire, or steady-state retrace.
+- :mod:`sherman_tpu.obs.device` — the white-box device-telemetry
+  plane: the compile ledger (every jit compilation as a structured
+  {program, shape signature, compile ms} entry, with the post-seal
+  steady-state retrace detector), the HBM/live-buffer accountant
+  (pool/journal/checkpoint byte gauges with a peak watermark,
+  per-program ``memory_analysis``), and roofline receipts
+  (``cost_analysis`` flops/bytes joined with measured phase walls into
+  achieved-fraction-of-peak).  Registered as the ``device.`` pull
+  collector beside ``slo.``; ``SHERMAN_DEVICE_OBS=0`` kills it.
 - :mod:`sherman_tpu.obs.export` — JSONL periodic snapshots, the
   one-call :func:`~sherman_tpu.obs.export.dump` used by ``bench.py``,
   Prometheus text exposition (textfile mode + optional stdlib HTTP
@@ -39,6 +48,11 @@ hits/misses/invalidations.
 
 from __future__ import annotations
 
+from sherman_tpu.obs.device import (CompileLedger, MemoryAccountant,
+                                    device_peaks, get_accountant,
+                                    get_ledger, program_cost,
+                                    program_memory, roofline, rooflines,
+                                    wrap_program)
 from sherman_tpu.obs.export import (MetricsServer, PeriodicExporter, dump,
                                     maybe_serve_http, obs_section,
                                     prometheus_text, write_prometheus,
@@ -65,4 +79,7 @@ __all__ = [
     "LatencyTracker", "WindowedRate", "SloTracker",
     "get_slo", "observe", "observe_op", "slo_window",
     "FlightRecorder", "get_recorder", "record_event", "auto_dump",
+    "CompileLedger", "MemoryAccountant", "get_ledger", "get_accountant",
+    "wrap_program", "program_cost", "program_memory", "roofline",
+    "rooflines", "device_peaks",
 ]

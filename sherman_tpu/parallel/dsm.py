@@ -580,6 +580,16 @@ class DSM(_HostOps):
         obs.register_collector(
             "dsm", lambda: (lambda d: d.counter_snapshot() if d is not None
                             else {})(ref()))
+        # HBM accountant (obs/device.py): the DSM's device-resident
+        # arrays ARE the pool-side HBM footprint — register them as
+        # weakref-bound byte sources so ``device.hbm_*`` gauges and the
+        # peak watermark track the live buffers (a dead DSM reports 0
+        # and drops out; the step-donated handles are re-read per
+        # snapshot, so rotation through donation is invisible here).
+        acct = obs.get_accountant()
+        for _src in ("pool", "locks", "counters", "dirty"):
+            acct.register(_src, (lambda r=ref, n=_src: (
+                getattr(r(), n).nbytes if r() is not None else 0)))
 
     # -- raw step ------------------------------------------------------------
 

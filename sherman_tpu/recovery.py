@@ -114,6 +114,28 @@ class RecoveryPlane:
         self.delta_paths: list[str] = []
         self._tip_epoch = None
         self._segment = 0
+        # host-memory accountant source (obs/device.py): total on-disk
+        # bytes of the chain's artifacts (base + deltas + journals) as
+        # ``device.host_checkpoints_bytes``; weakref-bound so a closed
+        # plane drops to 0 instead of pinning the directory scan.
+        import weakref
+
+        from sherman_tpu.obs import device as _dev
+
+        def _chain_bytes(r=weakref.ref(self)) -> int:
+            p = r()
+            if p is None:
+                return 0
+            total = 0
+            for f in glob.glob(os.path.join(p.dir, "*")):
+                try:
+                    total += os.path.getsize(f)
+                except OSError:
+                    pass  # artifact swept mid-scan
+            return total
+
+        _dev.get_accountant().register("checkpoints", _chain_bytes,
+                                       kind="host")
 
     # -- artifact naming ------------------------------------------------------
 

@@ -189,6 +189,19 @@ class Journal:
         self._entrants_lock = threading.Lock()
         fresh = not os.path.exists(path) or os.path.getsize(path) == 0
         self._f = open(path, "ab")
+        # host-memory accountant source (obs/device.py): the live
+        # segment's on-disk bytes as ``device.host_journal_bytes``.
+        # Re-registering under the one name is the rotation contract —
+        # a fresh segment supersedes its ancestor's gauge.
+        import weakref
+
+        from sherman_tpu.obs import device as _dev
+        _ref = weakref.ref(self)
+        _dev.get_accountant().register(
+            "journal", (lambda r=_ref: (
+                os.path.getsize(r().path)
+                if r() is not None and os.path.exists(r().path) else 0)),
+            kind="host")
         if fresh:
             self._f.write(MAGIC)
             self._f.flush()

@@ -63,6 +63,7 @@ from __future__ import annotations
 import numpy as np
 
 from sherman_tpu import config as C
+from sherman_tpu.obs import device as DEV
 from sherman_tpu.ops import bits
 
 
@@ -614,21 +615,26 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
             return (step_idx + np.uint32(1), skhi, sklo, i32(ukhi),
                     i32(uklo), start, active, seg, n_uniq[None])
 
-        jprep = jax.jit(jax.shard_map(
+        # compile-ledger wraps (obs/device.py): the staged programs are
+        # the serve path's white-box unit of account — a post-seal
+        # compile on ANY of them is the silent-retrace hazard
+        jprep = DEV.wrap_program("staged.prep", jax.jit(jax.shard_map(
             prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
-            out_specs=(rep,) + (spec,) * 8, check_vma=False))
+            out_specs=(rep,) + (spec,) * 8, check_vma=False)))
         # the serve is the ENGINE's host-staged program object: same jit
         # cache entry, same donation, same HLO as the throughput phase
+        # (already ledger-wrapped at the engine cache site — wrap() is
+        # idempotent, so the identity pin keeps holding)
         jserve = eng._get_search_fanout(iters)
 
         def verify(rcarry, skhi, sklo, found, vhi, vlo, n_uniq_a):
             return verify_core(rcarry, skhi, sklo, found, vhi, vlo,
                                n_uniq_a[0])
 
-        jverify = jax.jit(jax.shard_map(
+        jverify = DEV.wrap_program("staged.verify", jax.jit(jax.shard_map(
             verify, mesh=mesh,
             in_specs=((rep,) * 4, spec, spec, spec, spec, spec, spec),
-            out_specs=(rep,) * 4, check_vma=False))
+            out_specs=(rep,) * 4, check_vma=False)))
         root_rep = _rep_put(dsm, root)
 
         if fusion == "aligned":
@@ -682,9 +688,9 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
             return (step_idx + np.uint32(1), skhi, sklo, ukhi, uklo,
                     start, active, seg, n_uniq[None])
 
-        jprep = jax.jit(jax.shard_map(
+        jprep = DEV.wrap_program("staged.prep", jax.jit(jax.shard_map(
             prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
-            out_specs=(rep,) + (spec,) * 8, check_vma=False))
+            out_specs=(rep,) + (spec,) * 8, check_vma=False)))
 
         def serve(pool, counters, rcarry, skhi, sklo, ukhi, uklo, start,
                   active, seg, n_uniq_a):
@@ -701,7 +707,9 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         # donate counters only: the prep intermediates' shapes cannot
         # alias any serve output (donating them just warns every
         # compile), and donating 4 replicated scalars saves nothing
-        jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(1))
+        jserve = DEV.wrap_program(
+            "staged.serve_fanout_verify",
+            jax.jit(serve_sm, donate_argnums=C.donate_argnums(1)))
 
         def step(pool, counters, tpair, rtable, rkey, carry):
             step_idx, *rcarry = carry
@@ -727,7 +735,9 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
             fused, mesh=mesh,
             in_specs=(spec, spec, (rep,) * 4, rep, rep, rep, rep),
             out_specs=(rep, spec, (rep,) * 4), check_vma=False)
-        jfused = jax.jit(fused_sm, donate_argnums=C.donate_argnums(1))
+        jfused = DEV.wrap_program(
+            "staged.fused_step",
+            jax.jit(fused_sm, donate_argnums=C.donate_argnums(1)))
 
         def step(pool, counters, tpair, rtable, rkey, carry):
             step_idx, *rcarry = carry
@@ -744,6 +754,12 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     step.pipeline_depth = 2 if fusion == "pipelined" else 1
     if not hasattr(step, "drain"):
         step.drain = lambda carry: carry  # nothing pending off-pipeline
+    # phase -> compile-ledger label, the join key the roofline receipts
+    # use (obs/device.rooflines: phase_profile walls x cost_analysis
+    # floors).  Overlap-receipt keys (wall_ms/bubble_ms/...) are
+    # deliberately absent — they are not programs.
+    step.phase_labels = {name: prog.label
+                         for name, prog in programs.items()}
 
     # SLO accounting hook (obs/slo.py): the staged loop is an open read
     # loop of `batch` client ops per step; the driver attributes a whole
@@ -1063,7 +1079,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     prep_sm = jax.shard_map(
         prep, mesh=mesh, in_specs=(rep, rep, rep, rep),
         out_specs=(rep,) + (spec,) * 13, check_vma=False)
-    jprep = jax.jit(prep_sm)
+    jprep = DEV.wrap_program("staged_mixed.prep", jax.jit(prep_sm))
 
     if fusion == "chained":
         def serve(pool, locks, counters, rcarry, akhi, aklo, vhi, vlo,
@@ -1082,7 +1098,9 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
             out_specs=(spec, spec, (rep,) * 7), check_vma=False)
         # pool + counters donated; rcarry is NOT (callers block the
         # dispatch window on carry[1] — see the read-only step's note)
-        jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2))
+        jserve = DEV.wrap_program(
+            "staged_mixed.serve_fanout_verify",
+            jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2)))
 
         def step(pool, locks, counters, tpair, rtable, rkey, carry):
             step_idx, *rcarry = carry
@@ -1103,7 +1121,9 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
         serve_sm = jax.shard_map(
             serve_p, mesh=mesh, in_specs=(spec,) * 12,
             out_specs=(spec,) * 4, check_vma=False)
-        jserve = jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2))
+        jserve = DEV.wrap_program(
+            "staged_mixed.serve_fanout",
+            jax.jit(serve_sm, donate_argnums=C.donate_argnums(0, 2)))
 
         def verify_p(rcarry, rskhi, rsklo, out, st_cli, r_nu_a, w_nu_a):
             return verify_mixed_core(rcarry, rskhi, rsklo, out, st_cli,
@@ -1113,7 +1133,8 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
             verify_p, mesh=mesh,
             in_specs=((rep,) * 7,) + (spec,) * 6,
             out_specs=(rep,) * 7, check_vma=False)
-        jverify = jax.jit(verify_sm)
+        jverify = DEV.wrap_program("staged_mixed.verify",
+                                   jax.jit(verify_sm))
         _fold, _put, _drain, _pipe_reset = _two_deep_slot(jverify)
 
         def step(pool, locks, counters, tpair, rtable, rkey, carry):
@@ -1142,6 +1163,9 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     step.pipeline_depth = 2 if fusion == "pipelined" else 1
     if not hasattr(step, "drain"):
         step.drain = lambda carry: carry
+    # roofline join key (see the read-only factory's phase_labels note)
+    step.phase_labels = {name: prog.label
+                         for name, prog in step.programs.items()}
 
     # SLO hook (see make_staged_step): the fused read/write batch is the
     # mixed class's wall, attributed per drained window by the driver

@@ -187,9 +187,11 @@ def test_lock_handover_bounded():
     results.append(lt.release(0))
     for t in ts:
         t.join()
-    # the last holder has no waiter -> False; and at least one mid-train
-    # False must appear once the train exceeds 8
-    assert results[-1] is False
+    # the true last release (empty queue) returns False and the train
+    # bound forces at least one mid-train False past 8 hand-overs — but
+    # append order can RACE release order between two workers (A hands
+    # to B, B releases+appends False before A appends True), so assert
+    # the COUNT of Falses, not a list position
     assert sum(r is False for r in results) >= 2
 
 

@@ -384,3 +384,33 @@ def test_engine_phases_recorded_as_spans(eight_devices):
         before, "engine.insert.descend_lock_apply")
     assert n(after, "engine.search.descend") > n(
         before, "engine.search.descend")
+
+
+def test_metrics_server_scrapes_slo_and_device_planes():
+    """End-to-end scrape over a real socket: GET /metrics on an
+    ephemeral port against the DEFAULT registry must expose the slo.
+    and device. pull collectors as parseable Prometheus gauges — the
+    deployment shape (node scraping the serving process), not the
+    renderer in isolation."""
+    import urllib.request
+    from sherman_tpu.obs import device as dev
+    from sherman_tpu.obs import export as obs_export
+
+    dev.get_ledger()                  # device. collector registered
+    obs.observe("read", 100, 0.010)   # slo.read window carries data
+    with obs_export.MetricsServer(port=0) as srv:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).read().decode()
+    # parse the text exposition: unlabeled lines are "<name> <number>"
+    metrics = {}
+    for line in body.splitlines():
+        if not line or line.startswith("#") or "{" in line:
+            continue
+        name, val = line.rsplit(" ", 1)
+        metrics[name] = float(val)  # malformed value -> test fails
+    assert metrics["sherman_device_programs"] >= 0
+    assert metrics["sherman_device_retraces"] >= 0
+    assert "sherman_device_hbm_total_bytes" in metrics
+    assert metrics["sherman_slo_read_ops_total"] >= 100
+    assert "sherman_slo_read_p99_ms" in metrics

@@ -479,6 +479,85 @@ def test_perfgate_incomparable_receipt_exits_2(tmp_path):
     assert pg.main(["--receipt", p]) == 2
 
 
+def test_perfgate_red_on_steady_state_retraces(tmp_path, capsys):
+    """Schema-3 device gate: a receipt whose compile ledger counted a
+    retrace inside a sealed window fails HARD (no noise margin) even
+    with every throughput metric at baseline."""
+    pg = _perfgate()
+    cand = pg.load_receipt(os.path.join(_repo_root(), "BENCH_r05.json"))
+    cand.pop("_round", None)
+    cand["device"] = {"ledger": {"retraces": 1}}
+    p = str(tmp_path / "retrace.json")
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 1
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert not res["metrics"]["device.retraces"]["ok"]
+    # zero retraces: the same receipt passes
+    cand["device"] = {"ledger": {"retraces": 0}}
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 0
+
+
+def test_perfgate_device_bytes_frac_drop_flagged_and_skips_old_rounds():
+    pg = _perfgate()
+
+    def mk(rnd, frac):
+        r = {"keys": 1000, "batch": 64, "value": 100,
+             "device": {"ledger": {"retraces": 0},
+                        "rooflines": {"staged": {"serve_fanout": {
+                            "available": True,
+                            "achieved_bytes_frac": frac}}}}}
+        if rnd is not None:
+            r["_round"] = rnd
+        return r
+
+    hist = [mk(8, 0.60), mk(9, 0.62)]
+    res = pg.gate(mk(None, 0.40), hist)  # a real fraction collapse
+    m = res["metrics"]["device.staged.serve_fanout.bytes_frac"]
+    assert not res["ok"] and not m["ok"] and m["baseline_round"] == 9
+    # noise-sized wiggle passes (same margin rule as the walls)
+    assert pg.gate(mk(None, 0.59), hist)["ok"]
+    # schema-1/2 history: the device comparison SKIPS, never crashes,
+    # and the receipt still gates green on the throughput metrics
+    old = [{"_round": 5, "keys": 1000, "batch": 64, "value": 100}]
+    res3 = pg.gate(mk(None, 0.5), old)
+    assert res3["ok"]
+    assert "skipped" in \
+        res3["metrics"]["device.staged.serve_fanout.bytes_frac"]
+
+
+def test_perfgate_vanished_device_fraction_is_red():
+    """A fraction a committed round published that the candidate
+    DROPPED is the limit of "silently sinking" — red when the candidate
+    still publishes other fractions, skipped when it publishes none
+    (unknown-peak backend: a platform difference, not a regression)."""
+    pg = _perfgate()
+
+    def mk(rnd, fracs):
+        r = {"keys": 1000, "batch": 64, "value": 100,
+             "device": {"ledger": {"retraces": 0},
+                        "rooflines": {"staged": {
+                            ph: {"available": True,
+                                 "achieved_bytes_frac": f}
+                            for ph, f in fracs.items()}}}}
+        if rnd is not None:
+            r["_round"] = rnd
+        return r
+
+    hist = [mk(8, {"serve_fanout": 0.60, "prep": 0.30})]
+    # candidate keeps prep but drops serve_fanout: hard red
+    res = pg.gate(mk(None, {"prep": 0.31}), hist)
+    m = res["metrics"]["device.staged.serve_fanout.bytes_frac"]
+    assert not res["ok"] and not m["ok"]
+    assert m["candidate"] is None and m["baseline"] == 0.60
+    assert "absent" in m["error"]
+    # candidate publishes NO fractions at all: skip, receipt stays green
+    res2 = pg.gate(mk(None, {}), hist)
+    assert res2["ok"]
+    assert "skipped" in \
+        res2["metrics"]["device.staged.serve_fanout.bytes_frac"]
+
+
 # -- the obs-cost pin (< 2% staged-step wall) ---------------------------------
 
 def test_staged_step_obs_cost_under_two_percent(eight_devices,

@@ -297,3 +297,53 @@ def test_recovery_drill_driver(eight_devices, capsys):
     assert r["delta1"]["pages"] > 0
     assert r["repair"]["pages"] >= 1
     assert "RECOVERY-DRILL PASS" in capsys.readouterr().err
+
+
+def test_device_report_driver(eight_devices, capsys, monkeypatch,
+                              tmp_path):
+    """White-box device report (CPU smoke of tools/device_report): the
+    sealed live loop holds the zero-retrace steady-state pin, every
+    staged phase gets a roofline receipt (no invented fractions on the
+    CPU backend), and the --receipt renderer round-trips its own
+    JSON."""
+    import json
+
+    for k, v in (("KEYS", "20000"), ("B", "8192"), ("DEVB", "8192"),
+                 ("K", "2"), ("STEPS", "4"), ("FUSION", "aligned")):
+        monkeypatch.setenv(k, v)
+    import device_report
+    r = device_report.main([])
+    out = capsys.readouterr()
+    j = json.loads(out.out.strip().splitlines()[-1])
+    assert j["metric"] == "device_report"
+    assert j["retraces"] == 0 and j["fusion"] == "aligned"
+    led = j["device"]["ledger"]
+    assert led["retraces"] == 0 and led["programs"] >= 3
+    labels = {e["label"] for e in led["entries"]}
+    assert {"staged.prep", "staged.verify",
+            "engine.search_fanout"} <= labels
+    roofs = j["device"]["rooflines"]["staged"]
+    assert set(roofs) == {"prep", "serve_fanout", "verify"}
+    for rec in roofs.values():
+        assert rec["program"] and rec["wall_ms"] >= 0
+        assert "achieved_bytes_frac" not in rec  # CPU: unknown peaks
+    assert j["device"]["memory"]["hbm_pool_bytes"] > 0
+    assert "# roofline receipts [staged]" in out.err
+    assert r["device"]["ledger"]["retraces"] == 0
+
+    # receipt mode: render a (driver-wrapped) schema-3 artifact
+    p = tmp_path / "BENCH_dev.json"
+    p.write_text(json.dumps(
+        {"n": 99, "parsed": {"schema_version": 3,
+                             "device": r["device"]}}))
+    r2 = device_report.main(["--receipt", str(p)])
+    out2 = capsys.readouterr()
+    assert r2["retraces"] == 0 and r2["schema_version"] == 3
+    assert "# compile ledger" in out2.err
+
+    # pre-schema-3 receipt: typed error JSON, no crash
+    p2 = tmp_path / "old.json"
+    p2.write_text(json.dumps({"schema_version": 2, "value": 1}))
+    r3 = device_report.main(["--receipt", str(p2)])
+    capsys.readouterr()
+    assert "no device section" in r3["error"]

@@ -30,6 +30,16 @@ Comparability rules (the trajectory's own lessons):
   candidate with NO comparable metric at all exits 2 (the gate cannot
   vouch for it).
 
+White-box device gates (schema_version 3, the ``device`` section): a
+candidate carrying the compile ledger goes RED on ``retraces > 0`` —
+bench.py seals the ledger around every timed window, so any counted
+retrace is a real steady-state recompile, a hard fail with no margin —
+and on an ``achieved_bytes_frac`` drop beyond the noise-margin rule on
+any roofline phase both sides publish.  Rounds without a ``device``
+section (schema 1/2, r01-r07) simply skip the device gates — older
+artifacts stay comparable on the throughput metrics, never crash the
+gate.
+
 Usage::
 
     python tools/perfgate.py --receipt BENCH_r05.json        # pass pin
@@ -93,6 +103,25 @@ def load_trajectory(repo: str) -> list[dict]:
     return sorted(rounds, key=lambda r: r["_round"])
 
 
+def _device_fracs(r: dict) -> dict:
+    """``{group.phase: achieved_bytes_frac}`` from a schema-3 receipt's
+    ``device.rooflines`` block; {} when the section (or the fraction —
+    unknown-peak devices publish absolute rates only) is absent."""
+    dev = r.get("device")
+    if not isinstance(dev, dict):
+        return {}
+    out = {}
+    for group, phases in (dev.get("rooflines") or {}).items():
+        if not isinstance(phases, dict):
+            continue
+        for phase, rec in phases.items():
+            f = (rec.get("achieved_bytes_frac")
+                 if isinstance(rec, dict) else None)
+            if isinstance(f, (int, float)) and f > 0:
+                out[f"{group}.{phase}"] = float(f)
+    return out
+
+
 def _comparable(cand: dict, r: dict, metric: str) -> bool:
     if r.get("keys") != cand.get("keys") \
             or r.get("batch") != cand.get("batch"):
@@ -106,6 +135,33 @@ def _comparable(cand: dict, r: dict, metric: str) -> bool:
                 or not cand.get("sus_dev_ms_per_step"):
             return False
     return True
+
+
+def _margin_entry(val: float, comp: list[tuple], higher: bool, *,
+                  spread_mult: float, min_margin: float) -> dict:
+    """One metric's noise-margin verdict from its ``(round, value)``
+    history: baseline = the latest comparable round, margin =
+    max(min_margin, spread_mult * max(calibrated, observed cross-round
+    spread)).  Shared by the throughput/wall loop and the device
+    bytes-frac gate so the two noise rules can't drift apart."""
+    base_round, baseline = comp[-1]
+    vals = [v for _, v in comp]
+    observed_spread = (max(vals) / min(vals) - 1.0) \
+        if min(vals) > 0 and len(vals) > 1 else 0.0
+    margin = max(min_margin,
+                 spread_mult * max(CALIBRATED_SPREAD, observed_spread))
+    ratio = val / baseline if baseline else 1.0
+    ok = ratio >= 1.0 - margin if higher else ratio <= 1.0 + margin
+    return {
+        "candidate": val,
+        "baseline": baseline,
+        "baseline_round": base_round,
+        "ratio": round(ratio, 4),
+        "margin": round(margin, 4),
+        "observed_spread": round(observed_spread, 4),
+        "direction": "higher" if higher else "lower",
+        "ok": ok,
+    }
 
 
 def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
@@ -127,38 +183,88 @@ def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
         if not comp:
             out["metrics"][name] = {"skipped": "no comparable round"}
             continue
-        baseline_round = comp[-1]
-        baseline = float(baseline_round[name])
-        vals = [float(r[name]) for r in comp]
-        observed_spread = (max(vals) / min(vals) - 1.0) \
-            if min(vals) > 0 and len(vals) > 1 else 0.0
-        margin = max(min_margin,
-                     spread_mult * max(CALIBRATED_SPREAD, observed_spread))
-        val = float(cand[name])
-        if higher:
-            ratio = val / baseline if baseline else 1.0
-            ok = ratio >= 1.0 - margin
-        else:
-            ratio = val / baseline if baseline else 1.0
-            ok = ratio <= 1.0 + margin
-        out["metrics"][name] = {
-            "candidate": val,
-            "baseline": baseline,
-            "baseline_round": baseline_round["_round"],
-            "ratio": round(ratio, 4),
-            "margin": round(margin, 4),
-            "observed_spread": round(observed_spread, 4),
-            "direction": "higher" if higher else "lower",
-            "ok": ok,
-        }
-        if not ok:
+        entry = _margin_entry(
+            float(cand[name]),
+            [(r["_round"], float(r[name])) for r in comp],
+            higher, spread_mult=spread_mult, min_margin=min_margin)
+        out["metrics"][name] = entry
+        if not entry["ok"]:
             out["ok"] = False
+    # the comparability contract is about the THROUGHPUT trajectory:
+    # device gates below are self-contained extras and must not rescue
+    # a receipt no committed round can vouch for
     gated = [n for n, d in out["metrics"].items() if "ok" in d]
     out["gated_metrics"] = gated
     if not gated:
         out["ok"] = False
         out["error"] = ("no comparable metric between the candidate and "
                         "the committed trajectory (keys/batch mismatch?)")
+
+    # -- white-box device gates (schema_version 3 "device" section) ----------
+    dev = cand.get("device")
+    if isinstance(dev, dict):
+        # steady-state retraces: bench.py seals the compile ledger
+        # around every timed window, so ANY counted retrace is a real
+        # silent recompile in steady state — a hard red, no noise
+        # margin (it is a count of a hazard, not a wall)
+        retr = int((dev.get("ledger") or {}).get("retraces", 0) or 0)
+        rok = retr == 0
+        out["metrics"]["device.retraces"] = {
+            "candidate": retr, "baseline": 0, "direction": "zero",
+            "ok": rok}
+        out["gated_metrics"].append("device.retraces")
+        if not rok:
+            out["ok"] = False
+        # achieved-bytes-fraction per published roofline phase: the
+        # serve programs' fraction-of-peak must not silently sink.
+        # Compare only against prior rounds that also publish the
+        # fraction (schema >= 3 AND a known-peak device) at the same
+        # keys/batch; everything older skips.
+        hist_fracs = [(r, _device_fracs(r)) for r in history
+                      if r.get("keys") == cand.get("keys")
+                      and r.get("batch") == cand.get("batch")]
+        cand_fracs = _device_fracs(cand)
+        for name, val in sorted(cand_fracs.items()):
+            comp = [(r["_round"], fr[name])
+                    for r, fr in hist_fracs if name in fr]
+            mkey = f"device.{name}.bytes_frac"
+            if not comp:
+                out["metrics"][mkey] = {
+                    "skipped": "no comparable schema-3 round"}
+                continue
+            entry = _margin_entry(val, comp, True,
+                                  spread_mult=spread_mult,
+                                  min_margin=min_margin)
+            out["metrics"][mkey] = entry
+            out["gated_metrics"].append(mkey)
+            if not entry["ok"]:
+                out["ok"] = False
+        # a fraction history published that the candidate DROPPED must
+        # not pass silently — vanishing entirely is the limit of
+        # "silently sinking".  A candidate publishing no fractions at
+        # all skips instead (unknown-peak backend or cost analysis
+        # unavailable wholesale: a platform difference, not a phase
+        # regression).
+        for name in sorted({n for _, fr in hist_fracs for n in fr}):
+            if name in cand_fracs:
+                continue
+            mkey = f"device.{name}.bytes_frac"
+            if not cand_fracs:
+                out["metrics"][mkey] = {
+                    "skipped": "candidate publishes no fractions"}
+                continue
+            base_round, baseline = [(r["_round"], fr[name])
+                                    for r, fr in hist_fracs
+                                    if name in fr][-1]
+            out["metrics"][mkey] = {
+                "candidate": None, "baseline": baseline,
+                "baseline_round": base_round, "direction": "higher",
+                "ok": False,
+                "error": "fraction published by a committed round is "
+                         "absent from the candidate",
+            }
+            out["gated_metrics"].append(mkey)
+            out["ok"] = False
     return out
 
 
@@ -194,10 +300,19 @@ def main(argv=None) -> int:
     print(json.dumps(res))
     if not a.json:
         for n, d in res["metrics"].items():
-            if "ok" in d:
+            if "ratio" in d:
                 print(f"# {n}: {d['candidate']:.6g} vs r"
                       f"{d['baseline_round']} {d['baseline']:.6g} "
                       f"(ratio {d['ratio']}, margin {d['margin']}, "
+                      f"{'ok' if d['ok'] else 'REGRESSION'})",
+                      file=sys.stderr)
+            elif "error" in d:  # vanished device fraction
+                print(f"# {n}: {d['error']} (baseline r"
+                      f"{d['baseline_round']} {d['baseline']:.6g}, "
+                      "REGRESSION)", file=sys.stderr)
+            elif "ok" in d:  # marginless hard gates (device.retraces)
+                print(f"# {n}: {d['candidate']} (must be "
+                      f"{d['baseline']}, "
                       f"{'ok' if d['ok'] else 'REGRESSION'})",
                       file=sys.stderr)
             else:
