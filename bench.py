@@ -127,6 +127,34 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 NORTH_STAR = 10_000_000  # ops/s/chip (BASELINE.md)
 
 
+@functools.lru_cache(maxsize=1)
+def _lint_clean() -> bool | None:
+    """True when the tree this bench ran from passes shermanlint
+    (stamped into the JSON ``config`` block; ``tools/perfgate.py``
+    warns on False).  AST-only — a couple of seconds, once per run —
+    and None, never a crash, when the linter itself cannot run."""
+    try:
+        import dataclasses
+        import pathlib
+
+        from sherman_tpu import analysis
+        root = pathlib.Path(os.path.dirname(os.path.abspath(__file__)))
+        # doc paths in the default registry are repo-relative; anchor
+        # them so the stamp is right regardless of the caller's cwd
+        reg = dataclasses.replace(
+            analysis.DEFAULT_REGISTRY,
+            readme=str(root / analysis.DEFAULT_REGISTRY.readme),
+            knob_docs=[str(root / d)
+                       for d in analysis.DEFAULT_REGISTRY.knob_docs])
+        baseline = analysis.load_baseline(root / ".shermanlint-baseline.json")
+        res = analysis.run(
+            [root / p for p in ("sherman_tpu", "tools", "bench.py")],
+            registry=reg, baseline=baseline, root=root)
+        return res.clean
+    except Exception:
+        return None
+
+
 def run(n_keys: int, batch: int, secs: float, theta: float,
         combine_env: str) -> dict:
     import jax
@@ -1104,6 +1132,12 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             # the KNOB, not the (possibly skipped) staged phase, so the
             # config block stays self-consistent
             "pipeline_depth": 2 if staged_fusion() == "pipelined" else 1,
+            # was this receipt produced from a shermanlint-clean tree?
+            # True/False, or None when the linter could not run.
+            # perfgate warns on False — a number from a
+            # convention-violating tree deserves an asterisk.  Optional
+            # field: schema stays 3.
+            "lint_clean": _lint_clean(),
         },
         # pallas-vs-xla chained-delta ms of the page kernels (None when
         # the A/B was skipped; also in obs as kernels.*_ms histograms).

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Static-analysis CI lane (PR 9): the shermanlint run, the per-rule
+# fixture tests, baseline freshness, and the README knob-table
+# freshness check.  See README "Static analysis".
+#
+# Any non-zero exit fails the lane: lint exit 1 = findings, exit 2 =
+# infrastructure rot (stale baseline entry, malformed pragma) — both
+# are regressions a PR must not merge with.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== shermanlint: full tree =="
+JAX_PLATFORMS=cpu python tools/shermanlint.py sherman_tpu/ tools/ bench.py
+
+echo "== knob inventory: README table fresh =="
+JAX_PLATFORMS=cpu python tools/knobs.py --check
+
+echo "== rule unit tests (fixtures, pragmas, baseline round-trip) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q -m 'not slow' \
+    -p no:cacheprovider
+
+echo "lint_ci: ALL GREEN"

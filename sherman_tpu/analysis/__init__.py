@@ -1,0 +1,44 @@
+"""shermanlint — AST-based enforcement of the repo's protocol invariants.
+
+Sherman's correctness rests on conventions the codebase bled for one PR
+at a time: kw-only ``dirty=`` threading for delta checkpoints (PR 5),
+typed errors instead of bare raises (PR 4), fsync-before-ack journaling
+(PR 5/6), sealed-window zero-retrace serving (PR 8), and hot paths that
+must not sync to host or allocate.  Each was enforced by review or
+after-the-fact dynamic detection; this package turns them into
+machine-checked rules that fail at commit time — before a violation
+costs a chip session.
+
+Stdlib-only by constraint AND by design (``ast``, ``dataclasses``,
+``pathlib``; this container has no ruff/mypy, and a linter that needs a
+dependency resolver to run will eventually not run).
+
+Layout:
+
+- :mod:`~sherman_tpu.analysis.core` — the framework: ``Finding``,
+  ``SourceFile`` (parse + pragma extraction + qualnames), the runner,
+  inline ``# shermanlint: disable=SLxxx <reason>`` suppression.
+- :mod:`~sherman_tpu.analysis.registry` — the repo-specific knowledge
+  the rules consult (which functions are hot, which primitives mutate
+  the pool, where the append path lives).  Tests swap in their own.
+- :mod:`~sherman_tpu.analysis.rules` — the seven rules, SL001-SL007.
+- :mod:`~sherman_tpu.analysis.baseline` — grandfathered findings with
+  a freshness contract: an entry whose file/line no longer matches is
+  an ERROR, never a silent skip.
+
+Run it: ``python tools/shermanlint.py sherman_tpu/ tools/ bench.py``.
+"""
+
+from sherman_tpu.analysis.baseline import (Baseline, BaselineError,
+                                           load_baseline, write_baseline)
+from sherman_tpu.analysis.core import (Finding, LintResult, Rule,
+                                       SourceFile, iter_py_files, run)
+from sherman_tpu.analysis.registry import DEFAULT_REGISTRY, Registry
+from sherman_tpu.analysis.rules import ALL_RULES, rule_catalog
+
+__all__ = [
+    "ALL_RULES", "Baseline", "BaselineError", "DEFAULT_REGISTRY",
+    "Finding", "LintResult", "Registry", "Rule", "SourceFile",
+    "iter_py_files", "load_baseline", "rule_catalog", "run",
+    "write_baseline",
+]

@@ -1,0 +1,139 @@
+"""The repo-specific knowledge shermanlint's rules consult.
+
+Rules are generic mechanisms ("no host sync in a registered hot
+function"); THIS module is where the repo names its hot functions,
+pool mutators, append paths and obs increment paths.  Patterns are
+``fnmatch`` globs over ``(repo-relative path, dotted qualname)`` —
+see :func:`sherman_tpu.analysis.core.match_scope`.
+
+Tests build their own :class:`Registry` pointing at fixture files, so
+every rule is exercised without depending on the live tree's content.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Registry:
+    # -- SL001: traced/per-step hot functions: no host syncs ------------------
+    hot_functions: list[tuple[str, str]] = field(default_factory=list)
+    #: attribute-chain roots whose reads are static Python config, not
+    #: device arrays — ``int(cfg.machine_nr)`` is fine in a hot body
+    static_roots: set[str] = field(default_factory=set)
+
+    # -- SL002: dirty-threading contract --------------------------------------
+    #: terminal callee/reference names that mutate the pool
+    pool_mutators: set[str] = field(default_factory=set)
+    #: compositions deliberately outside the durability contract
+    dirty_allowlist: list[tuple[str, str]] = field(default_factory=list)
+
+    # -- SL003: typed errors --------------------------------------------------
+    #: path globs where bare stdlib raises are banned (library code)
+    library_paths: list[str] = field(default_factory=list)
+    banned_raises: set[str] = field(default_factory=lambda: {
+        "ValueError", "RuntimeError", "AssertionError"})
+
+    # -- SL004: retrace hazards at jit dispatch sites -------------------------
+    #: callee-name globs whose RESULT is a compiled program
+    jit_factory_patterns: list[str] = field(default_factory=list)
+
+    # -- SL005: fsync-before-ack ----------------------------------------------
+    append_paths: list[tuple[str, str]] = field(default_factory=list)
+    fsync_names: set[str] = field(default_factory=lambda: {
+        "fsync", "_fsync", "fdatasync", "_commit"})
+    durable_write_names: set[str] = field(default_factory=lambda: {"write"})
+
+    # -- SL006: no allocation in obs increment paths --------------------------
+    obs_hot_functions: list[tuple[str, str]] = field(default_factory=list)
+
+    # -- SL007: documented knobs ----------------------------------------------
+    knob_prefix: str = "SHERMAN_"
+    readme: str = "README.md"
+    #: extra documentation files a knob may appear in instead
+    knob_docs: list[str] = field(default_factory=list)
+    #: when set, SL007 checks against this text instead of reading the
+    #: doc files — the hook fixture tests use
+    knob_doc_text: str | None = None
+
+
+DEFAULT_REGISTRY = Registry(
+    hot_functions=[
+        # the device-step programs: traced under jit/shard_map — a host
+        # sync here either breaks tracing or serializes every step
+        ("sherman_tpu/models/batched.py", "descend_spmd"),
+        ("sherman_tpu/models/batched.py", "search_routed_spmd"),
+        ("sherman_tpu/models/batched.py", "search_spmd"),
+        ("sherman_tpu/models/batched.py", "leaf_apply_spmd"),
+        ("sherman_tpu/models/batched.py", "leaf_delete_apply_spmd"),
+        ("sherman_tpu/models/batched.py", "_resolve_leaves"),
+        ("sherman_tpu/models/batched.py", "_route_and_apply"),
+        ("sherman_tpu/models/batched.py", "insert_step_spmd"),
+        ("sherman_tpu/models/batched.py", "delete_step_spmd"),
+        ("sherman_tpu/models/batched.py", "mixed_step_spmd"),
+        ("sherman_tpu/parallel/dsm.py", "dsm_step_spmd"),
+        ("sherman_tpu/parallel/dsm.py", "read_pages_spmd"),
+        ("sherman_tpu/parallel/dsm.py", "_word_apply"),
+        ("sherman_tpu/parallel/dsm.py", "_apply"),
+        # the staged serving loops' per-step dispatch closures (PR 2/6):
+        # one stray .item() here is a per-step device round-trip the
+        # 33.8 M ops/s number does not survive
+        ("sherman_tpu/workload/device_prep.py", "make_staged_step.step"),
+        ("sherman_tpu/workload/device_prep.py", "make_staged_step.prep"),
+        ("sherman_tpu/workload/device_prep.py", "make_staged_step.serve"),
+        ("sherman_tpu/workload/device_prep.py", "make_staged_step.fused"),
+        ("sherman_tpu/workload/device_prep.py", "make_staged_step.verify"),
+        ("sherman_tpu/workload/device_prep.py",
+         "make_staged_step.prep_core"),
+        ("sherman_tpu/workload/device_prep.py",
+         "make_staged_step.verify_core"),
+        # mixed factory: per-step dispatch closures + traced cores only
+        # (phase_profile / record_slo / new_carry are diagnostics that
+        # legitimately touch host)
+        ("sherman_tpu/workload/device_prep.py",
+         "make_staged_mixed_step.step"),
+        ("sherman_tpu/workload/device_prep.py",
+         "make_staged_mixed_step.prep"),
+        ("sherman_tpu/workload/device_prep.py",
+         "make_staged_mixed_step.serve*"),
+        ("sherman_tpu/workload/device_prep.py",
+         "make_staged_mixed_step.verify*"),
+        ("sherman_tpu/workload/device_prep.py", "_two_deep_slot.*"),
+    ],
+    static_roots={"cfg", "config", "self", "C", "D", "CFG", "bits",
+                  "layout"},
+    # the BOTTOM layer: these either scatter into the pool directly or
+    # take dirty positionally as traced-kernel arguments.  Everything
+    # composing them (insert/delete/mixed_step_spmd, engine closures)
+    # is checked for the kw-only dirty= contract.
+    pool_mutators={
+        "_route_and_apply", "leaf_apply_spmd", "leaf_delete_apply_spmd",
+        "writeback", "writeback_xla",
+    },
+    dirty_allowlist=[
+        # PR 5 contract: device_prep/profiler compositions leave
+        # dirty=None — bench-only, outside the durability contract
+        ("sherman_tpu/workload/device_prep.py", "*"),
+        ("sherman_tpu/chaos.py", "*"),
+        ("tools/profile_insert.py", "*"),
+        ("tools/profile_gather.py", "*"),
+        ("tools/profile_staged2.py", "*"),
+        ("tools/profile_prep.py", "*"),
+    ],
+    library_paths=["sherman_tpu/*"],
+    jit_factory_patterns=["_get_*", "*_jit", "wrap_program"],
+    append_paths=[
+        ("sherman_tpu/utils/journal.py", "Journal.append"),
+    ],
+    obs_hot_functions=[
+        ("sherman_tpu/obs/registry.py", "Counter.inc"),
+        ("sherman_tpu/obs/registry.py", "Gauge.set"),
+        ("sherman_tpu/obs/registry.py", "Gauge.add"),
+        ("sherman_tpu/obs/registry.py", "Histogram.record"),
+        ("sherman_tpu/obs/slo.py", "LatencyTracker.record"),
+        ("sherman_tpu/obs/slo.py", "WindowedRate.add"),
+        ("sherman_tpu/obs/slo.py", "SloTracker.observe"),
+    ],
+    knob_docs=["BENCHMARKS.md"],
+)

@@ -52,6 +52,7 @@ import numpy as np
 
 from sherman_tpu import config as C
 from sherman_tpu import obs
+from sherman_tpu.errors import ConfigError, MultiprocessUnsupportedError
 from sherman_tpu.ops import bits
 
 KINDS = ("torn_page", "flip_entry_ver", "wedge_lock", "drop_cas",
@@ -87,7 +88,7 @@ class Fault:
 
     def __post_init__(self):
         if self.kind not in KINDS:
-            raise ValueError(f"unknown fault kind {self.kind!r}; "
+            raise ConfigError(f"unknown fault kind {self.kind!r}; "
                              f"want one of {KINDS}")
 
 
@@ -118,7 +119,7 @@ class FaultPlan:
             seed = int(parts[1]) if len(parts) > 1 else 0
             n = int(parts[2]) if len(parts) > 2 else 3
             return cls.random(seed, n_faults=n)
-        raise ValueError(
+        raise ConfigError(
             f"SHERMAN_CHAOS={spec!r}: want a JSON fault list or "
             "'random:SEED[:N]'")
 
@@ -151,7 +152,7 @@ class FaultPlan:
         truthy when :meth:`on_replies` must post-process this step's
         replies (stale_read)."""
         if dsm.multihost:
-            raise RuntimeError(
+            raise MultiprocessUnsupportedError(
                 "chaos injection supports single-process meshes only")
         step = self._steps
         self._steps += 1

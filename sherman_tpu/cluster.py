@@ -16,6 +16,7 @@ from dataclasses import dataclass
 import jax
 
 from sherman_tpu.config import DSMConfig
+from sherman_tpu.errors import MultiprocessUnsupportedError
 from sherman_tpu.parallel.alloc import Directory, LocalAllocator
 from sherman_tpu.parallel.bootstrap import Keeper
 from sherman_tpu.parallel.dsm import DSM, ReplicatedDSM
@@ -126,7 +127,7 @@ class Cluster:
         corruption later.
         """
         if self.dsm.multihost and replicated is not True:
-            raise RuntimeError(
+            raise MultiprocessUnsupportedError(
                 "multi-host clients allocate from MIRRORED directories: "
                 "pass register_client(replicated=True) to acknowledge "
                 "that this client runs identical (replicated) control "

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import dataclasses
 
+from sherman_tpu.errors import ConfigError
+
 # ---------------------------------------------------------------------------
 # Word / page geometry.
 #
@@ -89,7 +91,7 @@ def staged_fusion() -> str:
     import os
     v = os.environ.get("SHERMAN_STAGED_FUSION", "aligned").lower()
     if v not in ("aligned", "pipelined", "chained", "fused"):
-        raise ValueError(
+        raise ConfigError(
             f"SHERMAN_STAGED_FUSION={v!r}: want "
             "aligned|pipelined|chained|fused")
     return v

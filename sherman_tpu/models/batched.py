@@ -40,6 +40,8 @@ from jax import lax
 
 from sherman_tpu import config as C
 from sherman_tpu import obs
+from sherman_tpu.errors import (ConfigError, KeyRangeError, ProtocolError,
+                                ShermanError, StateError)
 from sherman_tpu.obs import device as DEV
 from sherman_tpu.obs import recorder as FR
 from sherman_tpu.obs import slo as SLO
@@ -74,7 +76,7 @@ ST_LOCK_TIMEOUT = 8  # host-side terminal: the key's page lock was STILL
 _PW = C.PAGE_WORDS
 
 
-class DegradedError(RuntimeError):
+class DegradedError(ShermanError, RuntimeError):
     """Typed write rejection: the engine is in read-only degraded mode.
 
     Raised by every mutating engine entry point after unrecoverable
@@ -1053,7 +1055,7 @@ def _assert_replicated(multihost: bool, arrays, what: str) -> None:
     digs = np.asarray(mhu.process_allgather(
         np.asarray([dig], np.uint32))).ravel()
     if not (digs == np.uint32(dig)).all():
-        raise RuntimeError(
+        raise ProtocolError(
             f"multihost {what} diverged across processes: every process "
             "must drive identical request streams (replicated-driver SPMD)")
 
@@ -1393,7 +1395,7 @@ class BatchedEngine:
         t_slo = time.perf_counter()
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
-            raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+            raise KeyRangeError("keys outside [KEY_MIN, KEY_MAX]")
         values = np.asarray(values, np.uint64)
         is_read = np.asarray(is_read, bool)
         if not bool(np.asarray(is_read).all()):
@@ -1528,7 +1530,7 @@ class BatchedEngine:
         """
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
-            raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+            raise KeyRangeError("keys outside [KEY_MIN, KEY_MAX]")
         if _depth == 0 and not _checked:
             self._check_replicated(keys)
         n = keys.shape[0]
@@ -1646,7 +1648,7 @@ class BatchedEngine:
             return vals[inv], found[inv]
         t_slo = time.perf_counter()
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
-            raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+            raise KeyRangeError("keys outside [KEY_MIN, KEY_MAX]")
         self._check_replicated(keys)
         khi, klo = bits.keys_to_pairs(uk)
         (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
@@ -1696,7 +1698,7 @@ class BatchedEngine:
             max_rounds = self.tcfg.insert_rounds
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
-            raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+            raise KeyRangeError("keys outside [KEY_MIN, KEY_MAX]")
         values = np.asarray(values, np.uint64)
         self._check_replicated(keys, values)
         n = keys.shape[0]
@@ -2249,7 +2251,7 @@ class BatchedEngine:
             [quarantine_rounds, self._reclaim_state["round"],
              len(self._pending_parents)], np.uint64))
         if not self._reclaim_mutex.acquire(blocking=False):
-            raise RuntimeError(
+            raise StateError(
                 "reclaim_empty_leaves is not reentrant: another reclaim "
                 "pass is already running on this engine")
         try:
@@ -2572,7 +2574,7 @@ class BatchedEngine:
             max_rounds = self.tcfg.insert_rounds
         keys = np.asarray(keys, np.uint64)
         if keys.size and (keys.min() < C.KEY_MIN or keys.max() > C.KEY_MAX):
-            raise ValueError("keys outside [KEY_MIN, KEY_MAX]")
+            raise KeyRangeError("keys outside [KEY_MIN, KEY_MAX]")
         self._check_replicated(keys)
         n = keys.shape[0]
         total = self.cfg.machine_nr * self.B
@@ -2839,7 +2841,7 @@ def bulk_load(tree, keys, values, fill: float | None = None) -> dict:
     old_root = tree._root_addr
     old_pg = tree.dsm.read_page(old_root)
     if tree._root_level != 0 or layout.np_leaf_entries(old_pg):
-        raise ValueError("bulk_load requires an empty tree")
+        raise ConfigError("bulk_load requires an empty tree")
 
     keys = np.asarray(keys, np.uint64)
     values = np.asarray(values, np.uint64)

@@ -52,6 +52,7 @@ import numpy as np
 
 from sherman_tpu import config as C
 from sherman_tpu import obs
+from sherman_tpu.errors import ProtocolError
 from sherman_tpu.cluster import ClientContext, Cluster
 from sherman_tpu.ops import bits, layout
 from sherman_tpu.parallel import dsm as D
@@ -305,7 +306,7 @@ class Tree:
             # unwedged for diagnosis, then surface the protocol violation
             # instead of silently masking it.
             self.dsm.write_word(lock_addr, 0, 0, space=D.SPACE_LOCK)
-            raise RuntimeError(
+            raise ProtocolError(
                 f"local-lock hand-over invariant violated on {lock_addr:#x}"
                 ": can_handover said True but release did not pass the "
                 "lock (locks.cc contract breach)")

@@ -47,6 +47,8 @@ import jax
 import numpy as np
 
 from sherman_tpu import config as C
+
+from sherman_tpu.errors import TreeCorruptError
 from sherman_tpu.ops import bits, layout
 
 _STATS = ("keys", "leaves", "internal_pages", "retired", "bad_version",
@@ -459,7 +461,7 @@ def check_structure_device(tree) -> dict:
     if s["tails"] != 1:
         problems.append(f"tails={s['tails']} (want exactly 1)")
     if problems:
-        raise RuntimeError("tree structure invalid: " + ", ".join(problems))
+        raise TreeCorruptError("tree structure invalid: " + ", ".join(problems))
     return {"keys": s["keys"], "leaves": s["leaves"],
             "internal_pages": s["internal_pages"],
             "levels": tree._root_level + 1, "retired": s["retired"]}

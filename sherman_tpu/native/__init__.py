@@ -29,6 +29,9 @@ import tempfile
 
 import numpy as np
 
+from sherman_tpu.errors import (ConfigError, NativeBuildError,
+                                NativeUnavailableError, ShermanError)
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src")
 _BUILD = os.path.join(_DIR, "build")
@@ -62,7 +65,7 @@ def _build() -> None:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, _LIB)  # atomic under concurrent builders
     except subprocess.CalledProcessError as e:
-        raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+        raise NativeBuildError(f"native build failed:\n{e.stderr}") from e
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -150,7 +153,7 @@ def _u64p(a: np.ndarray):
 
 def _require() -> None:
     if not available():
-        raise RuntimeError(f"native library unavailable: {_load_error}")
+        raise NativeUnavailableError(f"native library unavailable: {_load_error}")
 
 
 class ZipfGen:
@@ -364,7 +367,7 @@ class BatchPrep:
             raise PrepOverflow(
                 f"batch exceeded unique capacity {self.capacity}")
         if n < 0:
-            raise ValueError("bad prep arguments")
+            raise ConfigError("bad prep arguments")
         buf.n_uniq = int(n)
         return buf
 
@@ -415,7 +418,7 @@ class BatchPrep:
             self._h = None
 
 
-class PrepOverflow(RuntimeError):
+class PrepOverflow(ShermanError, RuntimeError):
     """A batch's unique-key count exceeded the planned device width."""
 
 
@@ -441,7 +444,7 @@ def synthetic_keyspace(n_keys: int, salt: int):
     keys = np.sort(rank_to_key)
     if (np.diff(keys) == 0).any() or keys[0] < C.KEY_MIN \
             or keys[-1] > C.KEY_MAX:
-        raise ValueError(f"salt {salt} collides; pick another")
+        raise ConfigError(f"salt {salt} collides; pick another")
     return keys, rank_to_key
 
 

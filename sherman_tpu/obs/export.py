@@ -34,6 +34,7 @@ import threading
 import time
 
 from sherman_tpu.obs import registry as _registry
+from sherman_tpu.errors import ConfigError
 from sherman_tpu.obs import spans as _spans
 
 __all__ = ["dump", "obs_section", "write_snapshot_jsonl",
@@ -285,7 +286,7 @@ def maybe_serve_http(env: str = METRICS_PORT_ENV,
     try:
         port = int(raw)
     except ValueError:
-        raise ValueError(
+        raise ConfigError(
             f"{env}={raw!r} is not a port number; set e.g. 9095, or "
             "unset it to disable the scrape endpoint") from None
     if port <= 0:

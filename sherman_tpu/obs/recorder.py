@@ -47,6 +47,7 @@ import threading
 import time
 
 from sherman_tpu.obs import registry as _registry
+from sherman_tpu.errors import ConfigError
 from sherman_tpu.obs import spans as _spans
 
 __all__ = ["FlightRecorder", "get_recorder", "record_event", "auto_dump",
@@ -102,7 +103,7 @@ class FlightRecorder:
         ``$SHERMAN_BLACKBOX_DIR`` and must resolve to something."""
         directory = directory or os.environ.get(BLACKBOX_ENV)
         if not directory:
-            raise ValueError(
+            raise ConfigError(
                 f"flight-recorder dump needs a directory ({BLACKBOX_ENV} "
                 "unset and none passed)")
         os.makedirs(directory, exist_ok=True)

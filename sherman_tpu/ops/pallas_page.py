@@ -72,6 +72,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from sherman_tpu.errors import ShermanError
 from sherman_tpu import config as C
 from sherman_tpu import obs
 from sherman_tpu.ops import bits, layout
@@ -109,7 +110,7 @@ _OBS_WB_ROWS = obs.counter("kernels.writeback_rows_per_pass")
 _OBS_WB_LANES = obs.counter("kernels.writeback_lanes_traced")
 
 
-class PallasUnavailableError(RuntimeError):
+class PallasUnavailableError(ShermanError, RuntimeError):
     """Typed, actionable: the Pallas/Mosaic toolchain is missing but a
     config knob selected it.  Names the knob to flip back."""
 

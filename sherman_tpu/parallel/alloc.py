@@ -26,6 +26,7 @@ import threading
 import numpy as np
 
 from sherman_tpu.config import ADDR_PAGE_BITS, DSMConfig
+from sherman_tpu.errors import DoubleFreeError
 from sherman_tpu.ops import bits
 
 RESERVED_PAGES = 1
@@ -76,7 +77,7 @@ class GlobalAllocator:
             incoming = [int(p) for p in pages]
             dup = set(incoming) & set(self._free)
             if dup or len(set(incoming)) != len(incoming):
-                raise ValueError(
+                raise DoubleFreeError(
                     f"node {self.node_id}: double-free into the reclaim "
                     f"pool (duplicates: {sorted(dup)[:4]})")
             self._free.extend(incoming)

@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+from sherman_tpu.errors import ConfigError
+
 
 class Keeper:
     """In-process KV / barrier / sum with DSMKeeper's interface."""
@@ -121,7 +123,7 @@ def init_multihost(coordinator_address: str | None = None,
             try:
                 heartbeat_timeout_s = int(hb)
             except ValueError:
-                raise ValueError(
+                raise ConfigError(
                     f"SHERMAN_HEARTBEAT_S={hb!r} is not a whole number of "
                     "seconds; fix the env var (e.g. '10') or unset it to "
                     "keep jax's default") from None

@@ -40,6 +40,8 @@ import numpy as np
 from sherman_tpu import config as CFG
 from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig, PAGE_WORDS
+from sherman_tpu.errors import (ConfigError, MultiprocessUnsupportedError,
+                                ProtocolError)
 from sherman_tpu.ops import bits
 from sherman_tpu.parallel import transport
 from sherman_tpu.parallel.mesh import AXIS, make_mesh, node_sharding
@@ -342,7 +344,7 @@ class _HostOps:
         undersized step, and a bare assert would be stripped under
         python -O — masking lost writes as success."""
         if not bool(np.all(ok)):
-            raise RuntimeError(f"host DSM op failed: {what}")
+            raise ProtocolError(f"host DSM op failed: {what}")
 
     def read_page(self, addr: int) -> np.ndarray:
         r = self._batch([{"op": OP_READ, "addr": addr}])
@@ -489,7 +491,7 @@ class DSM(_HostOps):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.machine_nr)
         if self.mesh.devices.size != cfg.machine_nr:
-            raise ValueError("mesh size must equal cfg.machine_nr")
+            raise ConfigError("mesh size must equal cfg.machine_nr")
         self.shard = node_sharding(self.mesh)
         N, P, L = cfg.machine_nr, cfg.pages_per_node, cfg.locks_per_node
 
@@ -688,7 +690,7 @@ class DSM(_HostOps):
         (DSM.step boundary + direct installs).  Single-process only —
         multihost deltas are unsupported (full per-host checkpoints)."""
         if self.multihost:
-            raise RuntimeError("dirty_rows is single-process only")
+            raise MultiprocessUnsupportedError("dirty_rows is single-process only")
         dev = np.nonzero(np.asarray(self.dirty))[0].astype(np.int64)
         if not self._dirty_host:
             return dev
@@ -727,7 +729,7 @@ class DSM(_HostOps):
                 # step, and a data-dependent chunk count would desync the
                 # processes' step sequences (a silent cluster deadlock).
                 # Callers chunk identically on every host instead.
-                raise ValueError(
+                raise ConfigError(
                     f"multi-host host-API batch of {len(rows)} rows "
                     f"exceeds host_step_capacity={cap}: chunk the call "
                     "identically on every process (each chunk is one "

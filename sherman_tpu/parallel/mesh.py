@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from sherman_tpu.errors import ConfigError
+
 AXIS = "node"
 
 
@@ -18,7 +20,7 @@ def make_mesh(n_nodes: int | None = None) -> jax.sharding.Mesh:
     devs = jax.devices()
     n = n_nodes if n_nodes is not None else len(devs)
     if len(devs) < n:
-        raise ValueError(f"need {n} devices, have {len(devs)}")
+        raise ConfigError(f"need {n} devices, have {len(devs)}")
     return jax.sharding.Mesh(np.array(devs[:n]), (AXIS,))
 
 

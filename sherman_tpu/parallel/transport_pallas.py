@@ -54,9 +54,10 @@ except Exception:  # pragma: no cover
 
 from sherman_tpu import obs
 from sherman_tpu.ops.pallas_page import PallasUnavailableError
+from sherman_tpu.errors import ShermanError
 
 
-class ExchangeLaneError(TypeError):
+class ExchangeLaneError(ShermanError, TypeError):
     """Typed, actionable: a request field cannot ride the packed 32-bit
     exchange buffer.  Names the knob whose default path has no such
     constraint."""

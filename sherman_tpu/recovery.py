@@ -62,6 +62,8 @@ import time
 import numpy as np
 
 from sherman_tpu import obs
+from sherman_tpu.errors import (MultiprocessUnsupportedError, ShermanError,
+                                StateError)
 from sherman_tpu.utils import checkpoint as CK
 from sherman_tpu.utils import journal as J
 
@@ -73,7 +75,7 @@ _OBS_REPAIR_FAILS = obs.counter("recovery.targeted_repair_failures")
 _OBS_PAGES_REPAIRED = obs.counter("recovery.pages_repaired")
 
 
-class TargetedRepairFailed(RuntimeError):
+class TargetedRepairFailed(ShermanError, RuntimeError):
     """Chain-based page repair could not re-certify the pool (structure
     changed since the chain tip, or damage beyond the repaired set):
     the engine STAYS degraded and the caller falls back to a full
@@ -98,7 +100,7 @@ class RecoveryPlane:
                  journal_sync: bool = True,
                  group_commit_ms: float = 0.0):
         if cluster.dsm.multihost:
-            raise RuntimeError("RecoveryPlane is single-process only")
+            raise MultiprocessUnsupportedError("RecoveryPlane is single-process only")
         self.cluster = cluster
         self.tree = tree
         self.eng = eng
@@ -316,7 +318,7 @@ class RecoveryPlane:
         from sherman_tpu.parallel import dsm as D
 
         if self.cid is None:
-            raise RuntimeError("no chain: checkpoint_base() first")
+            raise StateError("no chain: checkpoint_base() first")
         t0 = time.perf_counter()
         damaged = sorted(set(int(a) for a in addrs)
                          | (set(scrubber.flagged) if scrubber is not None

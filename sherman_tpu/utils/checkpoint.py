@@ -34,6 +34,8 @@ import numpy as np
 from sherman_tpu import config as _C
 from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig
+from sherman_tpu.errors import (CheckpointFormatError, ConfigError,
+                                MultiprocessUnsupportedError, ShermanError)
 
 _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
                "step_capacity", "host_step_capacity", "chunk_pages",
@@ -50,7 +52,7 @@ _OBS_DELTA_BYTES = obs.counter("ckpt.delta_bytes")
 _OBS_ORPHANS = obs.counter("ckpt.orphans_swept")
 
 
-class CheckpointCorruptError(RuntimeError):
+class CheckpointCorruptError(ShermanError, RuntimeError):
     """A checkpoint artifact failed its content CRC / framing / chain
     pairing — corruption is detected at restore time, never served."""
 
@@ -81,14 +83,14 @@ def cfg_from_json(raw) -> DSMConfig:
     d = json.loads(bytes(raw).decode())
     tag = d.pop("_layout", None)
     if tag != LAYOUT_TAG:
-        raise RuntimeError(
+        raise CheckpointFormatError(
             f"checkpoint page layout {tag or 'unstamped'!r} does not match "
             f"this build's {LAYOUT_TAG!r}; re-create the checkpoint (raw "
             "page words cannot be reinterpreted across layouts)")
     known = {f.name for f in dataclasses.fields(DSMConfig)}
     unknown = sorted(set(d) - known)
     if unknown:
-        raise RuntimeError(
+        raise CheckpointFormatError(
             f"checkpoint cfg carries unknown fields {unknown} (written "
             "by a newer build?); refusing to drop config knobs silently")
     return DSMConfig(**d)
@@ -180,7 +182,7 @@ def _checkpoint_multihost(cluster, path: str) -> None:
     # the prior files untouched instead.
     all_ep = np.asarray(mhu.process_allgather(epoch))
     if not (all_ep == all_ep[0]).all():
-        raise RuntimeError(
+        raise CheckpointFormatError(
             "checkpoint aborted before writing: hosts disagree on the "
             f"checkpoint epoch {all_ep.tolist()} (divergent checkpoint "
             "counts or manifests — the replicated-driver invariant is "
@@ -365,7 +367,7 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
     cfg = cfg_from_json(z["cfg"])
     saved_mh = int(z["multihost"][0]) if "multihost" in z else 0
     if saved_mh != 0:  # durability check: must survive python -O
-        raise RuntimeError(
+        raise CheckpointFormatError(
             "multi-host checkpoint needs a multi-host cluster (pass "
             "init_multihost()'s keeper on every host)")
     cluster = Cluster(cfg, mesh=mesh, keeper=keeper)
@@ -457,18 +459,18 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     # durability-critical validation: explicit raises (a bare assert is
     # stripped under python -O and would silently restore torn state)
     if not (all_st[:, 0] == 1).all():
-        raise RuntimeError("a host failed to load its checkpoint files "
+        raise CheckpointFormatError("a host failed to load its checkpoint files "
                            f"({err or 'other host'})")
     if not (all_st[:, 1] == 1).all():
-        raise RuntimeError(
+        raise CheckpointFormatError(
             "a host holds a torn checkpoint (shard/manifest from different "
             "checkpoints or mixed legacy/tagged files)")
     if not (all_st[:, 2] == jax.process_count()).all():
-        raise RuntimeError(
+        raise CheckpointFormatError(
             f"checkpoint host count {sorted(set(all_st[:, 2].tolist()))} != "
             f"{jax.process_count()} restoring processes")
     if not (all_st[:, 3:] == all_st[0, 3:]).all():
-        raise RuntimeError(
+        raise CheckpointFormatError(
             "hosts hold checkpoints from different epochs (crashed "
             "mid-checkpoint?): refusing to mix")
 
@@ -480,7 +482,7 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
     all_nodes = np.asarray(mhu.process_allgather(
         np.asarray([nodes_ok], np.int32)))
     if not (all_nodes == 1).all():
-        raise RuntimeError("per-host node blocks changed since the "
+        raise CheckpointFormatError("per-host node blocks changed since the "
                            "checkpoint")
     spec = PartitionSpec(AXIS)
     glob = lambda x: mhu.host_local_array_to_global_array(x, dsm.mesh, spec)
@@ -515,11 +517,11 @@ def checkpoint_delta(cluster, path: str, parent_epoch) -> dict:
     if not path.endswith(".npz"):
         path += ".npz"
     if cluster.keeper.is_multihost or cluster.dsm.multihost:
-        raise RuntimeError(
+        raise MultiprocessUnsupportedError(
             "delta checkpoints are single-process only; multihost "
             "deployments take full per-host checkpoints")
     if parent_epoch is None:
-        raise ValueError(
+        raise ConfigError(
             "checkpoint_delta needs the parent artifact's epoch "
             "(returned by checkpoint()/checkpoint_delta())")
     import jax.numpy as jnp

@@ -66,8 +66,10 @@ import sys
 import threading
 import time
 
+from sherman_tpu.errors import ConfigError, ShermanError, StateError
 
-class PeerFailure(RuntimeError):
+
+class PeerFailure(ShermanError, RuntimeError):
     """A guarded collective's deadline expired because peers never
     arrived (dead OR stalled — the deadline cannot tell; if they are
     dead, the runtime's heartbeat detection will terminate this process
@@ -161,7 +163,7 @@ class Watchdog:
         try:
             timeout_s = float(raw)
         except ValueError:
-            raise ValueError(
+            raise ConfigError(
                 f"{env}={raw!r} is not a number of seconds; fix the env "
                 "var (e.g. '120') or unset it to disarm the watchdog"
             ) from None
@@ -267,7 +269,7 @@ class PreemptionGuard:
                 distributed.global_state.initialize_preemption_sync_manager()
             self._psm = distributed.global_state.preemption_sync_manager
             if self._psm is None:
-                raise RuntimeError(
+                raise StateError(
                     "preemption sync manager unavailable (jax config "
                     "jax_enable_preemption_service is off)")
         else:

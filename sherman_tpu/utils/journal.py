@@ -55,6 +55,7 @@ import zlib
 import numpy as np
 
 from sherman_tpu import obs
+from sherman_tpu.errors import ConfigError, ShermanError, StateError
 
 MAGIC = b"SHJRNL01"
 _HDR = struct.Struct("<II")          # length, crc32(payload)
@@ -82,13 +83,13 @@ _OBS_RP_ROWS = obs.counter("journal.replayed_rows")
 _fsync = os.fsync
 
 
-class JournalCorruptError(RuntimeError):
+class JournalCorruptError(ShermanError, RuntimeError):
     """A journal frame failed its CRC (or framing) with further bytes
     following it — content corruption, not a torn tail.  Replay refuses
     rather than applying rows it cannot trust."""
 
 
-class JournalSyncError(RuntimeError):
+class JournalSyncError(ShermanError, RuntimeError):
     """An fsync on this journal failed, poisoning it: on Linux a failed
     fsync CONSUMES the writeback error and may drop the dirty pages, so
     a retried fsync on the same fd can return success without the
@@ -101,13 +102,13 @@ class JournalSyncError(RuntimeError):
 def encode_record(kind: int, keys, values=None) -> bytes:
     """One framed record (header + payload) for ``append``/tests."""
     if kind not in KINDS:
-        raise ValueError(f"unknown journal record kind {kind}")
+        raise ConfigError(f"unknown journal record kind {kind}")
     keys = np.ascontiguousarray(keys, np.uint64)
     payload = _PAY.pack(kind, keys.size) + keys.tobytes()
     if kind == J_UPSERT:
         values = np.ascontiguousarray(values, np.uint64)
         if values.shape != keys.shape:
-            raise ValueError("journal upsert needs one value per key")
+            raise ConfigError("journal upsert needs one value per key")
         payload += values.tobytes()
     return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
 
@@ -231,7 +232,7 @@ class Journal:
         try:
             with self._lock:
                 if self._f.closed:
-                    raise RuntimeError(f"journal {self.path} is closed")
+                    raise StateError(f"journal {self.path} is closed")
                 if self._failed is not None:
                     raise JournalSyncError(
                         f"journal {self.path} poisoned by an earlier "

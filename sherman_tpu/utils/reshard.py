@@ -39,6 +39,7 @@ import numpy as np
 
 from sherman_tpu import config as C
 from sherman_tpu.config import DSMConfig
+from sherman_tpu.errors import ConfigError, ReshardError
 from sherman_tpu.parallel.dsm import N_COUNTERS
 from sherman_tpu.utils.checkpoint import (_CFG_FIELDS, _MANIFEST_FIELDS,
                                           _savez_atomic, cfg_from_json,
@@ -69,19 +70,19 @@ def _load_checkpoint(path: str):
         # would launder state from two different checkpoints into a
         # consistently-tagged output that restore then accepts
         if ("epoch" in man) != ("epoch" in blk):
-            raise RuntimeError(
+            raise ReshardError(
                 f"host {h} shard and the manifest disagree on epoch "
                 "tagging (mixed legacy/tagged files = torn checkpoint)")
         if "epoch" in blk and not np.array_equal(
                 blk["epoch"].ravel(), man["epoch"].ravel()):
-            raise RuntimeError(
+            raise ReshardError(
                 f"host {h} shard is from a different checkpoint epoch "
                 "than the manifest (torn checkpoint)")
         blocks.append(blk)
     blocks.sort(key=lambda b: int(b["nodes"][0]))
     nodes = np.concatenate([b["nodes"] for b in blocks])
     if not np.array_equal(nodes, np.arange(nodes.size)):
-        raise RuntimeError(f"host shards do not cover nodes 0..N-1: {nodes}")
+        raise ReshardError(f"host shards do not cover nodes 0..N-1: {nodes}")
     return (man,
             np.concatenate([b["pool"] for b in blocks]),
             np.concatenate([b["locks"] for b in blocks]),
@@ -103,13 +104,13 @@ def _map_ptrs(ptrs: np.ndarray, amap: np.ndarray, P_old: int,
     N_old = amap.size // P_old
     oob = live & ((node >= N_old) | (page >= P_old))
     if oob.any():
-        raise RuntimeError(
+        raise ReshardError(
             f"{what}: {int(oob.sum())} pointer(s) outside the source "
             f"address space (e.g. {ptrs[oob][:4].tolist()})")
     mapped = amap[np.clip(node * P_old + page, 0, amap.size - 1)]
     if (live & (mapped == 0)).any():
         bad = ptrs[live & (mapped == 0)][:4]
-        raise RuntimeError(
+        raise ReshardError(
             f"{what}: {int((live & (mapped == 0)).sum())} pointer(s) target "
             f"pages outside the live set (e.g. {bad.tolist()}) — source "
             "checkpoint is corrupt or allocator marks are wrong")
@@ -133,7 +134,7 @@ def reshard(src: str, dst: str, machine_nr: int, *,
     cfg_dict = {f: getattr(old_cfg, f) for f in _CFG_FIELDS}
     N_old, P_old = old_cfg.machine_nr, old_cfg.pages_per_node
     if pool.shape != (N_old * P_old, C.PAGE_WORDS):
-        raise RuntimeError(f"pool shape {pool.shape} does not match the "
+        raise ReshardError(f"pool shape {pool.shape} does not match the "
                            f"manifest config ({N_old}x{P_old} pages)")
 
     # 1. live rows per old node: [1, dir_next) — the bump allocators never
@@ -175,7 +176,7 @@ def reshard(src: str, dst: str, machine_nr: int, *,
                            **({"locks_per_node": locks_per_node}
                               if locks_per_node else {})})
     if per_new + 1 > pages_per_node:
-        raise ValueError(
+        raise ConfigError(
             f"{L} live pages need {per_new} pages/node on {machine_nr} "
             f"nodes; pages_per_node={pages_per_node} is too small")
     idx = np.arange(L, dtype=np.int64)
@@ -240,7 +241,7 @@ def reshard(src: str, dst: str, machine_nr: int, *,
                       counters=new_counters, **new_man)
     else:
         if machine_nr % hosts:
-            raise ValueError(f"hosts={hosts} must divide machine_nr="
+            raise ConfigError(f"hosts={hosts} must divide machine_nr="
                              f"{machine_nr} (contiguous node blocks)")
         nph = machine_nr // hosts
         epoch = make_epoch(new_man, 0)

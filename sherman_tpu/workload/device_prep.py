@@ -63,6 +63,7 @@ from __future__ import annotations
 import numpy as np
 
 from sherman_tpu import config as C
+from sherman_tpu.errors import ConfigError
 from sherman_tpu.obs import device as DEV
 from sherman_tpu.ops import bits
 
@@ -521,7 +522,7 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
 
     fusion = fusion or C.staged_fusion()
     if fusion not in ("aligned", "pipelined", "chained", "fused"):
-        raise ValueError(
+        raise ConfigError(
             f"fusion={fusion!r}: want aligned|pipelined|chained|fused")
     router = eng.router
     assert router is not None, "attach_router() first"
@@ -1072,7 +1073,7 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     fusion = fusion or ("pipelined" if C.staged_fusion() == "pipelined"
                         else "chained")
     if fusion not in ("chained", "pipelined"):
-        raise ValueError(f"mixed fusion={fusion!r}: want "
+        raise ConfigError(f"mixed fusion={fusion!r}: want "
                          "chained|pipelined")
     mesh = dsm.mesh
     _pipe_reset = None

@@ -265,6 +265,19 @@ def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
             }
             out["gated_metrics"].append(mkey)
             out["ok"] = False
+
+    # -- lint provenance (PR 9): warn, never gate --------------------------
+    # bench.py stamps config.lint_clean (shermanlint verdict of the tree
+    # the receipt ran from; optional — older schemas lack it).  A False
+    # means the number came from a convention-violating tree: worth an
+    # asterisk next to the receipt, but walls are walls — lint hygiene
+    # must not mask or manufacture a perf regression.
+    lint = (cand.get("config") or {}).get("lint_clean")
+    if lint is False:
+        out.setdefault("warnings", []).append(
+            "receipt produced from a tree WITH shermanlint findings "
+            "(config.lint_clean=false) — re-run `python "
+            "tools/shermanlint.py` and re-capture before committing")
     return out
 
 
@@ -299,6 +312,8 @@ def main(argv=None) -> int:
                min_margin=a.min_margin)
     print(json.dumps(res))
     if not a.json:
+        for w in res.get("warnings", ()):
+            print(f"# WARNING: {w}", file=sys.stderr)
         for n, d in res["metrics"].items():
             if "ratio" in d:
                 print(f"# {n}: {d['candidate']:.6g} vs r"

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""shermanlint — run the repo's invariant checker.
+
+Usage::
+
+    python tools/shermanlint.py sherman_tpu/ tools/ bench.py
+    python tools/shermanlint.py --json ...            # machine-readable
+    python tools/shermanlint.py --write-baseline ...  # grandfather now
+    python tools/shermanlint.py --no-baseline ...     # raw findings
+
+Exit codes: 0 clean, 1 findings, 2 infrastructure error (stale
+baseline entry, malformed pragma, unreadable baseline).  Stale
+baseline entries are ERRORS by design — a baseline that rots keeps
+suppressing whatever new violation drifts onto its line.
+
+The rule set, registries, and suppression pragma grammar live in
+``sherman_tpu/analysis/``; the README "Static analysis" section has
+the rule catalog and the lesson each rule encodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_BASELINE = REPO / ".shermanlint-baseline.json"
+DEFAULT_PATHS = ["sherman_tpu/", "tools/", "bench.py"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="AST invariant checker for the sherman_tpu repo")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report raw findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline "
+                         "(bootstrap path for a new rule; the committed "
+                         "target is an empty baseline)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON object on stdout")
+    ap.add_argument("--quiet", action="store_true",
+                    help="findings only, no summary line")
+    args = ap.parse_args(argv)
+
+    os.chdir(REPO)  # registry patterns + README lookup are repo-relative
+    from sherman_tpu import analysis
+
+    paths = args.paths or DEFAULT_PATHS
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = analysis.load_baseline(args.baseline)
+        except analysis.BaselineError as e:
+            print(f"shermanlint: {e}", file=sys.stderr)
+            return 2
+
+    res = analysis.run(paths, baseline=baseline, root=REPO)
+
+    if args.write_baseline:
+        analysis.write_baseline(args.baseline, res.findings)
+        print(f"shermanlint: wrote {len(res.findings)} entries to "
+              f"{args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "clean": res.clean,
+            "files_checked": res.files_checked,
+            "findings": [f.__dict__ for f in res.findings],
+            "pragma_errors": [f.__dict__ for f in res.pragma_errors],
+            "baseline_errors": res.baseline_errors,
+            "suppressed": len(res.suppressed),
+            "baselined": len(res.baselined),
+        }, indent=1))
+    else:
+        for f in res.findings:
+            print(f.render())
+        for f in res.pragma_errors:
+            print(f.render())
+        for msg in res.baseline_errors:
+            print(f"ERROR: {msg}")
+        if not args.quiet:
+            print(f"shermanlint: {res.files_checked} files, "
+                  f"{len(res.findings)} finding(s), "
+                  f"{len(res.suppressed)} suppressed, "
+                  f"{len(res.baselined)} baselined, "
+                  f"{len(res.pragma_errors)} pragma error(s), "
+                  f"{len(res.baseline_errors)} baseline error(s)")
+
+    if res.baseline_errors or res.pragma_errors:
+        return 2
+    return 0 if not res.findings else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
