@@ -79,6 +79,17 @@ Environment knobs:
                          table the roofline fractions divide by
                          (unknown device kinds publish absolute
                          achieved rates only).
+  SHERMAN_LEAF_CACHE     hot-key tier (models/leaf_cache.py): 0 (off,
+                         the shipped default), 1 (on, 65536 slots), or
+                         a slot count.  When on, the device-staged
+                         read loop runs a sealed cache_probe program
+                         in front of the serve (prefilled with the
+                         analytically hottest ranks) and the JSON
+                         gains the optional "cache" block — measured
+                         hit ratio next to the zipf-predicted one,
+                         residual batch width, hits/invalidations —
+                         with results pinned bit-identical to the
+                         uncached path.  Schema stays 3.
 
 The JSON carries ``schema_version`` (2: adds the per-op-class ``slo``
 section; 3: adds the white-box ``device`` section — compile ledger,
@@ -226,6 +237,27 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     router = eng.attach_router(int(lb_env) if lb_env else None)
     print(f"# bulk_load {time.time() - t0:.1f}s {stats} "
           f"router_lb={router.lb}", file=sys.stderr)
+    # hot-key tier (models/leaf_cache.py, SHERMAN_LEAF_CACHE; off by
+    # default until the chip receipts land): prefill the analytically
+    # hottest ranks — the zipf sampler's own ranking, so the analytic
+    # CDF at the admitted count predicts the measured hit ratio
+    from sherman_tpu.config import leaf_cache_slots
+    from sherman_tpu.workload.zipf import expected_hit_ratio
+    cache_cfg_slots = leaf_cache_slots()
+    leaf_cache = cache_fill = None
+    if cache_cfg_slots:
+        leaf_cache = eng.attach_leaf_cache(slots=cache_cfg_slots)
+        hot_src = rank_to_key if rank_to_key is not None else keys
+        t1 = time.time()
+        with obs.span("bench.cache_prefill", slots=leaf_cache.slots):
+            cache_fill = leaf_cache.fill(
+                np.asarray(hot_src[:leaf_cache.capacity], np.uint64))
+        print(f"# leaf cache: {leaf_cache.slots} slots, prefilled "
+              f"{cache_fill['placed']} hottest keys in "
+              f"{time.time() - t1:.1f}s ({cache_fill['failed']} window "
+              "overflows); predicted hit ratio "
+              f"{expected_hit_ratio(n_keys, theta, cache_fill['placed']):.4f}",
+              file=sys.stderr)
     if os.environ.get("SHERMAN_BENCH_VALIDATE"):
         # one-step device structure validation of the full benchmark
         # tree (every invariant, all pages — models/validate.py); raises
@@ -280,6 +312,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
 
     sustained_ops_s = sus_host_ops_s = None
     sus_prep_ms = sus_put_ms = sus_ms_per_step = None
+    sus_cache_hits = sus_cache_uhits = sus_cache_ops = None
+    sus_cache_resid_cap = None
     sus_dev_ms_per_step = sus_dev_combine = dev_attempts = None
     dev_sampler = sus_mixed_sampler = None
     sus_dev_degraded = None  # final staged attempt still over threshold
@@ -416,7 +450,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             step_fn, (new_carry, table_d, rtable_d, rkey_d) = \
                 make_staged_step(eng, n_keys=n_keys, theta=theta,
                                  salt=salt, batch=batch, dev_b=dev_b2,
-                                 sampler=dev_sampler)
+                                 sampler=dev_sampler,
+                                 leaf_cache=leaf_cache)
             dev_sampler = step_fn.sampler  # effective (fallback-aware)
             sus_dev_fusion = step_fn.fusion  # aligned|chained|fused
             staged_labels = step_fn.phase_labels  # roofline join keys
@@ -442,6 +477,42 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             assert w_ok == 1, "device-staged warmup: unique overflow"
             assert w_corr == 2 * batch, \
                 f"device-staged warmup: {2 * batch - w_corr} ops wrong"
+            if leaf_cache is not None:
+                # tighten the residual cap to the measured miss width
+                # (the mixed loop's cap-tightening dance): descent cost
+                # is per ROW of the compiled shape, so the serve must
+                # run at the width the misses actually need — 5% slack,
+                # 8192-rounded for compile-cache stability; overflow
+                # voids the phase via the ok receipt
+                w_nu = int(np.asarray(carry[3]))
+                w_hu = int(np.asarray(carry[6]))
+                resid = max(1, (w_nu - w_hu + 1) // 2)  # per warmup step
+                cap_r = min(dev_b2,
+                            -(-int(resid * 1.05) // 8192) * 8192)
+                sus_cache_resid_cap = cap_r
+                if cap_r < dev_b2:
+                    step_fn, (new_carry, table_d, rtable_d, rkey_d) = \
+                        make_staged_step(
+                            eng, n_keys=n_keys, theta=theta, salt=salt,
+                            batch=batch, dev_b=dev_b2,
+                            sampler=os.environ.get(
+                                "SHERMAN_BENCH_SAMPLER", "analytic"),
+                            leaf_cache=leaf_cache, dev_b_resid=cap_r,
+                            staged=(table_d, rtable_d, rkey_d))
+                    staged_labels = step_fn.phase_labels
+                    # re-warm BOTH carry variants of the rebuilt step
+                    carry = new_carry()
+                    counters, carry = step_fn(pool, counters, table_d,
+                                              rtable_d, rkey_d, carry)
+                    counters, carry = step_fn(pool, counters, table_d,
+                                              rtable_d, rkey_d, carry)
+                    carry = step_fn.drain(carry)
+                    jax.block_until_ready(carry)
+                    assert int(np.asarray(carry[1])) == 1, \
+                        "cache residual cap overflowed at warmup"
+                print(f"# leaf cache: residual serve width {cap_r} of "
+                      f"{dev_b2} unique rows ({resid}/step measured "
+                      "misses)", file=sys.stderr)
             dev_steps = max(32, min(96, int(secs / 0.1)))
 
             def adv_ro():
@@ -478,8 +549,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
                               attempt=_attempt + 1, steps=dev_steps):
                     dev_elapsed = run_windowed(dev_steps, adv_ro,
                                                finish=finish_ro)
-                _, d_ok, d_corr, d_sum_nu, d_max_nu = (
-                    int(np.asarray(x)) for x in carry)
+                d_ok, d_corr, d_sum_nu, d_max_nu = (
+                    int(np.asarray(x)) for x in carry[1:5])
                 assert d_ok == 1, "device-staged: unique overflow mid-run"
                 assert d_corr == dev_steps * batch, \
                     f"device-staged: {dev_steps * batch - d_corr} ops wrong"
@@ -495,6 +566,19 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             # window, attributed to the read class at once (the staged
             # dispatch path itself carries zero obs work per step)
             step_fn.record_slo(dev_steps, dev_elapsed)
+            if leaf_cache is not None:
+                # hot-key receipts of the ACCEPTED attempt (the carry
+                # was reset per attempt): client ops served from cache
+                # + unique rows removed from the serve
+                sus_cache_hits = int(np.asarray(carry[5]))
+                sus_cache_uhits = int(np.asarray(carry[6]))
+                sus_cache_ops = dev_steps * batch
+                print(f"# leaf cache: {sus_cache_hits}/{sus_cache_ops} "
+                      "client ops served from cache (hit ratio "
+                      f"{sus_cache_hits / sus_cache_ops:.4f}); residual "
+                      f"{(d_sum_nu - sus_cache_uhits) / dev_steps:.0f} "
+                      f"of {d_sum_nu / dev_steps:.0f} unique rows/step "
+                      "descended", file=sys.stderr)
             sustained_ops_s = dev_steps * batch / dev_elapsed
             sus_dev_ms_per_step = dev_elapsed / dev_steps * 1e3
             sus_dev_combine = dev_steps * batch / max(1, d_sum_nu)
@@ -1139,6 +1223,34 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             # field: schema stays 3.
             "lint_clean": _lint_clean(),
         },
+        # hot-key tier receipt (models/leaf_cache.py; None = cache off,
+        # the shipped default — optional block, schema stays 3).
+        # hit_ratio is MEASURED over the accepted device-staged
+        # attempt's client ops; hit_ratio_pred is the analytic Zipf CDF
+        # at the prefilled-key count (workload.zipf.expected_hit_ratio)
+        # — the two must agree within a few points or the table
+        # placement/invalidation story is broken.  perfgate treats the
+        # block as comparable-config metadata: cache-on sustained
+        # numbers never gate against cache-off rounds.
+        "cache": ({
+            "enabled": True,
+            "slots": leaf_cache.slots,
+            "capacity": leaf_cache.capacity,
+            "cached_keys": cache_fill["placed"] if cache_fill else 0,
+            "placement_failed": cache_fill["failed"] if cache_fill else 0,
+            "hits": sus_cache_hits,
+            "uniq_hits": sus_cache_uhits,
+            "client_ops": sus_cache_ops,
+            # residual serve width (dev_b_resid): the unique rows the
+            # cache-on serve actually descends per step, capped
+            "dev_b_resid": sus_cache_resid_cap,
+            "hit_ratio": round(sus_cache_hits / sus_cache_ops, 4)
+            if sus_cache_ops else None,
+            "hit_ratio_pred": round(expected_hit_ratio(
+                n_keys, theta, cache_fill["placed"]), 4)
+            if cache_fill else None,
+            "invalidations": leaf_cache.invalidations,
+        } if leaf_cache is not None else None),
         # pallas-vs-xla chained-delta ms of the page kernels (None when
         # the A/B was skipped; also in obs as kernels.*_ms histograms).
         # kernel_phase_rows records the row count the phases ran at —

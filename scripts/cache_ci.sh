@@ -1,0 +1,93 @@
+#!/usr/bin/env bash
+# Hot-key-tier CI lane: pin the versioned leaf/value cache
+# (sherman_tpu/models/leaf_cache.py) on the CPU mesh.
+#
+# Runs (1) the leaf-cache fast tier (probe/validate bit-identity vs the
+# uncached path incl. split/delete/mixed storms and the chaos round —
+# flipped entry versions must MISS, never lie — plus the flush
+# contracts: degraded entry, scrub quarantine, targeted repair, and
+# the sealed staged loop's zero-retrace pin with the cache_probe
+# program chained in via tools/device_report.py), and (2) a
+# theta-0.99 mini-bench smoke: the staged serving loop with the cache
+# prefilled from the analytically hottest ranks must measure
+# hit_ratio > 0, land within a few points of the zipf-predicted
+# ratio, and produce receipts BIT-IDENTICAL to the cache-off loop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== leaf-cache fast tier (bit-identity, invalidation, flushes, zero-retrace) =="
+python -m pytest tests/test_leaf_cache.py -q
+
+echo "== theta-0.99 mini-bench smoke (hit ratio > 0, receipts identical) =="
+python - <<'EOF'
+import numpy as np
+import jax
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.ops import bits
+from sherman_tpu.workload.device_prep import make_staged_step
+from sherman_tpu.workload.zipf import expected_hit_ratio
+
+salt = 0x5E17_AB1E_5A17
+n_keys, B, S = 20_000, 2048, 6
+cfg = DSMConfig(machine_nr=1, pages_per_node=2048, locks_per_node=512,
+                step_capacity=B, chunk_pages=32)
+cluster = Cluster(cfg)
+tree = Tree(cluster)
+eng = batched.BatchedEngine(tree, batch_per_node=B)
+ranks = np.arange(n_keys, dtype=np.uint64)
+keys = bits.mix64_np(ranks ^ np.uint64(salt))
+order = np.argsort(keys)
+batched.bulk_load(tree, keys[order],
+                  (keys ^ np.uint64(0xDEADBEEF))[order], fill=0.8)
+eng.attach_router()
+out = {}
+for label in ("off", "on"):
+    lc = None
+    if label == "on":
+        lc = eng.attach_leaf_cache(slots=2048)
+        hot = bits.mix64_np(np.arange(lc.capacity, dtype=np.uint64)
+                            ^ np.uint64(salt))
+        placed = lc.fill(hot)["placed"]
+    step, (new_carry, tb, rt, rk) = make_staged_step(
+        eng, n_keys=n_keys, theta=0.99, salt=salt, batch=B, dev_b=B,
+        log2_bins=16, fusion="aligned", leaf_cache=lc)
+    carry = new_carry()
+    counters = eng.dsm.counters
+    for _ in range(S):
+        counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                               carry)
+    carry = step.drain(carry)
+    jax.block_until_ready(carry)
+    eng.dsm.counters = counters
+    vals = tuple(int(np.asarray(x)) for x in carry)
+    assert vals[1] == 1 and vals[2] == S * B, vals
+    out[label] = vals[:5]
+    if lc is not None:
+        measured = vals[5] / (S * B)
+        pred = expected_hit_ratio(n_keys, 0.99, placed)
+        assert measured > 0, "cache-on loop served zero hits"
+        assert abs(measured - pred) < 0.05, (measured, pred)
+        print(f"hit ratio {measured:.4f} (zipf-predicted {pred:.4f}, "
+              f"{placed} keys cached)")
+    eng.detach_leaf_cache()
+assert out["off"] == out["on"], out
+print("receipts bit-identical cache-on vs cache-off:", out["off"])
+EOF
+
+echo "== aligned+cache mode attribution smoke (profile_staged2) =="
+KEYS=20000 B=8192 DEVB=8192 K=1 STEPS=4 FUSION=aligned SAMPLER=table \
+    MODES="aligned,aligned+cache" python tools/profile_staged2.py \
+    > /tmp/_cache_ci_profile.json
+python - <<'EOF'
+import json
+out = json.load(open("/tmp/_cache_ci_profile.json"))
+row = out["modes"]["aligned+cache"]
+assert "cache_probe_ms" in row, row
+print("aligned+cache attributed:", row)
+EOF
+echo "CACHE-CI PASS"

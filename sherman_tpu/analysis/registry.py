@@ -100,6 +100,16 @@ DEFAULT_REGISTRY = Registry(
         ("sherman_tpu/workload/device_prep.py",
          "make_staged_mixed_step.verify*"),
         ("sherman_tpu/workload/device_prep.py", "_two_deep_slot.*"),
+        # hot-key tier (PR 11): the probe/validate kernels are traced
+        # (a host sync breaks tracing), and the staged cache_probe
+        # closure rides the sealed per-step dispatch path
+        ("sherman_tpu/models/leaf_cache.py", "probe_rows"),
+        ("sherman_tpu/models/leaf_cache.py", "invalidation_mask"),
+        ("sherman_tpu/models/leaf_cache.py", "slot_hash"),
+        ("sherman_tpu/models/leaf_cache.py", "LeafCache._get_probe.kernel"),
+        ("sherman_tpu/models/leaf_cache.py", "LeafCache._get_fill.kernel"),
+        ("sherman_tpu/workload/device_prep.py",
+         "make_staged_step.cache_probe"),
     ],
     static_roots={"cfg", "config", "self", "C", "D", "CFG", "bits",
                   "layout"},
@@ -134,6 +144,10 @@ DEFAULT_REGISTRY = Registry(
         ("sherman_tpu/obs/slo.py", "LatencyTracker.record"),
         ("sherman_tpu/obs/slo.py", "WindowedRate.add"),
         ("sherman_tpu/obs/slo.py", "SloTracker.observe"),
+        # hot-key tier: the per-probed-batch accounting path (plain
+        # integer adds only — the cache.* collector allocates at PULL
+        # time, which is off the hot path)
+        ("sherman_tpu/models/leaf_cache.py", "LeafCache._note_probe"),
     ],
     knob_docs=["BENCHMARKS.md"],
 )

@@ -97,6 +97,34 @@ def staged_fusion() -> str:
     return v
 
 
+def leaf_cache_slots() -> int:
+    """Hot-key tier knob (``SHERMAN_LEAF_CACHE``): physical slot count
+    of the compute-side versioned leaf/value cache
+    (:mod:`sherman_tpu.models.leaf_cache`), 0 = disabled.
+
+    Off is the SHIPPED DEFAULT (standing guardrail: measurement-driven
+    flips — the hot-key receipts in BENCHMARKS.md decide the default).
+    ``SHERMAN_LEAF_CACHE=1`` enables the cache at the default table
+    size; any larger integer is the physical slot count (rounded up to
+    a power of two by the cache itself; admitted-key capacity is half
+    the slots — open addressing at load <= 0.5 keeps the bounded probe
+    window near-lossless)."""
+    import os
+    v = os.environ.get("SHERMAN_LEAF_CACHE", "0").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return 0
+    if v in ("1", "true", "on", "yes"):
+        return 65536
+    try:
+        n = int(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_LEAF_CACHE={v!r}: want 0/1 or a slot count")
+    if n < 0:
+        raise ConfigError(f"SHERMAN_LEAF_CACHE={n}: want >= 0")
+    return n
+
+
 @dataclasses.dataclass(frozen=True)
 class DSMConfig:
     """Cluster + memory-pool shape (reference ``Config.h:13-22``).

@@ -1108,6 +1108,13 @@ class BatchedEngine:
         self._reclaim_mutex = threading.Lock()
         self._parent_descend_cache: dict = {}
         self.router = None
+        # Optional hot-key tier (models/leaf_cache.py, attached by
+        # attach_leaf_cache / the SHERMAN_LEAF_CACHE knob): a versioned
+        # compute-side leaf/value cache probed in front of the descent
+        # by search/search_combined/mixed; write entry points invalidate
+        # it, degraded entry flushes it.  None (default) costs one
+        # `is None` test per read batch.
+        self.leaf_cache = None
         # Optional write-ahead op journal (utils/journal.py, attached by
         # the recovery plane): every engine write op appends ONE batch
         # record of its APPLIED rows before returning — the record is
@@ -1169,6 +1176,11 @@ class BatchedEngine:
             self._degraded_reason = reason
             _OBS_DEGRADED.set(1)
             obs.counter("engine.degraded_entries").inc()
+            # the hot-key tier must not serve answers certified against
+            # a pool the engine no longer trusts — flush wholesale (the
+            # cache is volatile by contract; see leaf_cache.py)
+            if self.leaf_cache is not None:
+                self.leaf_cache.flush()
             # black box: the transition is a flight event, and entering
             # degraded auto-dumps the bundle (env-gated, debounced) so
             # the postmortem starts from the moment the engine gave up
@@ -1237,6 +1249,29 @@ class BatchedEngine:
             r.seed_from_leaves(*leaf_dir)
         self.router = r
         return r
+
+    def attach_leaf_cache(self, slots: int | None = None,
+                          admit_every: int = 0):
+        """Create + attach the hot-key tier (models/leaf_cache.py): a
+        versioned compute-side leaf/value cache probed in front of the
+        descent by every read entry point.  ``slots`` defaults to the
+        ``SHERMAN_LEAF_CACHE`` knob (``config.leaf_cache_slots``;
+        65536 when the knob only says "on"); ``admit_every`` > 0 arms
+        frequency-based auto-admission every that-many observed read
+        batches (0 = manual ``fill`` — the staged bench drivers prefill
+        the analytically known hot set instead)."""
+        from sherman_tpu.models.leaf_cache import LeafCache
+        self.leaf_cache = LeafCache(self, slots=slots,
+                                    admit_every=admit_every)
+        return self.leaf_cache
+
+    def detach_leaf_cache(self) -> None:
+        """Drop the hot-key tier (reads go back to full descents).
+        The ``cache.`` collector unregisters with it — a scrape must
+        not keep publishing stats for a tier that no longer probes."""
+        if self.leaf_cache is not None:
+            obs.get_registry().unregister_collector("cache")
+        self.leaf_cache = None
 
     def _get_search(self, iters: int, with_start: bool):
         key = (iters, with_start)
@@ -1410,6 +1445,15 @@ class BatchedEngine:
         (vhi, _), (vlo, _) = self._pad(vhi), self._pad(vlo)
         ar, _ = self._pad(is_read)   # pad rows are neither read nor write
         aw, _ = self._pad(~is_read)
+        # hot-key tier: probe the READ rows only — hits see the same
+        # pre-step snapshot the fused descent's reads see (the probe
+        # runs before the step's writes apply), so the mixed
+        # linearization (resolved reads < writes) is unchanged
+        cache = self.leaf_cache
+        c_hit = c_vhi = c_vlo = None
+        if cache is not None and bool(is_read.any()):
+            c_hit, c_vhi, c_vlo = cache.probe(khi, klo, ar)
+            ar = ar & ~c_hit
         use_router = self.router is not None
         fn = self._get_mixed(self._iters(), use_router)
         # batch prep (router probe, host->device transfers) OUTSIDE the
@@ -1434,12 +1478,27 @@ class BatchedEngine:
         done_r = done_r[:n]
         found = np.array(found[:n])
         out_vals = np.array(bits.pairs_to_keys(rvh[:n], rvl[:n]))
+        if c_hit is not None and c_hit[:n].any():
+            # merge cache-served reads (probe active mask was the read
+            # rows, so hits are read rows by construction)
+            hits = c_hit[:n]
+            done_r = np.array(done_r)
+            done_r[hits] = True
+            found[hits] = True
+            out_vals[hits] = bits.pairs_to_keys(
+                c_vhi[:n], c_vlo[:n])[hits]
         # journal the fast-path applied writes BEFORE the retry branch:
         # retried rows apply in later steps through insert() (which
         # journals its own record), so appending here keeps record order
         # == apply order even for same-key duplicates across the classes
         fast_app = ~is_read & (status == ST_APPLIED)
         self._journal_applied(J.J_UPSERT, keys[fast_app], values[fast_app])
+        if cache is not None and bool((~is_read).any()):
+            # write-path invalidation hook: these keys' entry versions
+            # bump this step (conservative over the full write class — a
+            # spare invalidation, never a missed one; retried writes go
+            # through insert(), which invalidates its own keys)
+            cache.invalidate_keys(keys[~is_read])
         miss_r = is_read & ~done_r
         if miss_r.any():
             v2, f2 = self.search(keys[miss_r])
@@ -1550,6 +1609,16 @@ class BatchedEngine:
         khi, klo = bits.keys_to_pairs(keys)
         (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
         active, _ = self._pad(np.ones(n, bool))
+        # hot-key tier: probe the leaf/value cache in front of the
+        # descent — hits are pool-validated (bit-identical to a
+        # descent, see leaf_cache.py) and drop out of the device batch,
+        # so the existing search program serves the RESIDUAL active set
+        cache = self.leaf_cache if _depth == 0 and n else None
+        c_hit = c_vhi = c_vlo = None
+        if cache is not None:
+            cache.observe(keys)
+            c_hit, c_vhi, c_vlo = cache.probe(khi, klo, active)
+            active = active & ~c_hit
         # retries (depth > 0) bypass the index cache and descend from root
         use_router = self.router is not None and _depth == 0
         fn = self._get_search(self._iters(), use_router)
@@ -1565,6 +1634,18 @@ class BatchedEngine:
                     self.dsm.pool, self.dsm.counters, *args)
             done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
         done = done[:n]
+        if c_hit is not None and c_hit[:n].any():
+            # merge the cache hits back into the batch's answers (their
+            # device rows were inactive — the residual descent never
+            # touched them)
+            hits = c_hit[:n]
+            done = np.array(done)
+            done[hits] = True
+            found, vhi, vlo = (np.array(found), np.array(vhi),
+                               np.array(vlo))
+            found[:n][hits] = True  # found/v* keep the padded width
+            vhi[:n][hits] = c_vhi[:n][hits]
+            vlo[:n][hits] = c_vlo[:n][hits]
         if not done.all():
             assert _depth < 8, "search stragglers not converging"
             # stale cache / height growth / capacity overflow: refresh root,
@@ -1653,6 +1734,17 @@ class BatchedEngine:
         khi, klo = bits.keys_to_pairs(uk)
         (khi, _), (klo, _) = self._pad(khi), self._pad(klo)
         active, _ = self._pad(np.ones(uk.size, bool))
+        # hot-key tier: probe the unique set — cache hits leave the
+        # device batch (smaller residual descent); their answers merge
+        # back per CLIENT row below via the same inverse map the
+        # fan-out uses.  The admission sketch sees the raw (duplicated)
+        # key stream: frequency ranking needs the multiplicities.
+        cache = self.leaf_cache if uk.size else None
+        c_hit = c_vhi = c_vlo = None
+        if cache is not None:
+            cache.observe(keys)
+            c_hit, c_vhi, c_vlo = cache.probe(khi, klo, active)
+            active = active & ~c_hit
         # bucket the CLIENT width so varying request counts reuse one
         # compiled program per quantum (unique width is already fixed at
         # N*B); pad rows fan out slot 0 and are sliced off below.  The
@@ -1674,11 +1766,23 @@ class BatchedEngine:
                 self.dsm.counters, done, found, vhi, vlo = fn(
                     self.dsm.pool, self.dsm.counters, *args)
             done, found, vhi, vlo = self._unshard(done, found, vhi, vlo)
-        if not bool(done[: uk.size].all()):
+        hit_u = c_hit[:uk.size] if c_hit is not None else None
+        done_u = np.asarray(done[:uk.size]) if hit_u is None \
+            else (np.asarray(done[:uk.size]) | hit_u)
+        if not bool(done_u.all()):
             # straggler rescue (stale seeds / growth): host fan-out path
             # (search() attributes the rescue batch to the read class)
             vals, fnd = self.search(uk)
             return vals[inv], fnd[inv]
+        if hit_u is not None and hit_u.any():
+            # cache hits' device fan-out rows carried an inactive unique
+            # row — overwrite them client-side through the inverse map
+            chit = hit_u[inv]
+            found, vhi, vlo = (np.array(found), np.array(vhi),
+                               np.array(vlo))
+            found[:n][chit] = True
+            vhi[:n][chit] = c_vhi[:uk.size][inv][chit]
+            vlo[:n][chit] = c_vlo[:uk.size][inv][chit]
         _slo_observe("read", n, t_slo)
         return (bits.pairs_to_keys(vhi[:n], vlo[:n]), found[:n])
 
@@ -1716,6 +1820,11 @@ class BatchedEngine:
         # before the caller sees the stats ack
         self._journal_applied(J.J_UPSERT, keys[applied_rows],
                               values[applied_rows])
+        if self.leaf_cache is not None and n:
+            # write-path invalidation hook (entry versions bumped);
+            # whole batch, conservatively — superseded duplicates share
+            # their winner's key, rejected rows invalidate spare
+            self.leaf_cache.invalidate_keys(keys)
         # the wall includes flush_parents + the durable journal append —
         # insert's ack latency, which is what an SLO target governs
         _slo_observe("insert", n, t_slo)
@@ -2403,6 +2512,14 @@ class BatchedEngine:
             dsm._batch(out_rows)
         if mapping and self.router is not None:
             self.router.remap_addrs(mapping)
+        if mapping and self.leaf_cache is not None:
+            # reclaim rewrites the absorber's header and retires the
+            # empty page for eventual reuse: drop every cached entry on
+            # either side of each unlinked pair (the retired page holds
+            # no live keys, but a later reuse must never meet a stale
+            # cached position)
+            self.leaf_cache.invalidate_pages(
+                list(mapping.keys()) + list(mapping.values()))
 
         # parent-entry removal for unlinked pages (flush-style); only
         # cleaned pages advance to quarantine
@@ -2586,6 +2703,8 @@ class BatchedEngine:
         # rows are no-ops; replaying them would also be, but keeping the
         # record set == applied set keeps replay accounting exact)
         self._journal_applied(J.J_DELETE, keys[out])
+        if self.leaf_cache is not None and n:
+            self.leaf_cache.invalidate_keys(keys)
         _slo_observe("delete", n, t_slo)
         return out
 

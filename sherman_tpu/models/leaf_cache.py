@@ -1,0 +1,590 @@
+"""Hot-key tier: versioned compute-side leaf/value cache.
+
+At YCSB skew (zipf theta 0.99) a tiny fraction of keys absorbs most
+read traffic, yet the compute side caches only INTERNAL nodes (the
+router / ``IndexCache.h`` mirror): every repeat read of a hot key still
+pays a full descent plus a pool gather.  This module adds the missing
+tier — a bounded, fixed-shape hot-set table mapping
+
+    key -> (value, leaf addr, in-leaf slot, captured entry-version pair)
+
+probed by ONE vectorized device lookup in front of the descent.  Hits
+short-circuit the descent entirely; misses flow into the existing
+fan-out as the residual (smaller) active set.
+
+COHERENCE TOKEN — the entry-version halves the write path already
+bumps (the ``CONFIG_ENABLE_CRC`` fver/rver pair, packed 16/16 in one
+word, ``leaf_apply_spmd``) are exactly a cache-coherence token, so
+staleness is validated for free: every probe MATCH is re-certified
+against the live pool snapshot with a single page gather (the same
+one-page cost as the router's seeded round-1 read, instead of
+height-many descent gathers).  A hit requires ALL of:
+
+- the cached address still holds a LEVEL-0 page with consistent
+  front/rear page versions (splits and structural rewrites bump them);
+- the cached slot is LIVE (fver == rver != 0 — a flipped/torn entry
+  version, chaos's favorite fault, turns the hit into a miss, never a
+  wrong answer) and holds the probed KEY (splits re-sort slots, deletes
+  clear them — both turn into key mismatches);
+- the slot's packed version word AND value words equal the captured
+  ones (an in-place update bumps fver/rver; a split resets them — a
+  version that "matches again" after a reset is accepted only if the
+  value also matches, which is then bit-identical to what a descent
+  returns, because a live key is unique across the tree).
+
+Any probe match that fails validation is STALE: it is counted, the
+slot is scatter-invalidated on device, and the key falls back into the
+residual descent — so results are BIT-IDENTICAL to the uncached path
+by construction (pinned in CI, the same contract as ``gather_impl``).
+
+TABLE SHAPE — open addressing over ``slots`` (power of two) physical
+slots with a bounded probe window of ``window`` consecutive slots
+(the device probe is a fixed [B, window] gather — no data-dependent
+shapes, so the probe lives inside the SEALED zero-retrace serving
+loop).  Admitted-key capacity is ``slots // 2``: at load <= 0.5 with
+hottest-first host-side placement the window almost never overflows
+(overflowing keys simply stay uncached and are counted).
+
+ADMISSION is frequency-based: :meth:`LeafCache.observe` feeds a
+decayed top-K frequency sketch from the same key stream the zipf
+sampler produces (``search``/``search_combined`` feed it their batch
+histograms for free — the combine path already computes the unique
+counts), and every ``admit_every`` observed batches the top
+``capacity`` keys are re-resolved and the table rebuilt hottest-first.
+Benchmark drivers that KNOW the hot set (the synthetic zipf keyspace:
+rank r's key is ``mix64(r ^ salt)``) prefill it directly with
+:meth:`fill` — the analytic zipf CDF then predicts the hit ratio
+(:func:`sherman_tpu.workload.zipf.expected_hit_ratio`), published next
+to the measured one in the bench receipt.
+
+INVALIDATION SOURCES (all conservative — a spare invalidation is never
+a missed one; validation stays the authoritative guard):
+
+- the write path: engine ``insert``/``delete``/``mixed`` invalidate
+  their batch's write keys (the same keys whose entry versions bump);
+- the split/reclaim paths that rewrite leaves: reclaimed page
+  addresses drop every entry that points at them
+  (:meth:`invalidate_pages`); split-moved entries self-invalidate via
+  the version/key checks;
+- ``enter_degraded`` and scrub quarantine: a quarantined page's keys
+  must drop out of the cache (:meth:`invalidate_pages` from the
+  scrubber; degraded entry flushes wholesale);
+- stale probe matches invalidate their own slot on device.
+
+VOLATILITY CONTRACT — the cache is never checkpointed: recovery
+(``RecoveryPlane.recover`` builds a fresh engine) and targeted repair
+(explicit :meth:`flush`) always start cold; the journal replay path
+re-warms nothing.  Metrics ride the ``cache.`` pull collector
+(hits/misses/invalidations/evictions counters + hit-ratio gauge, the
+``slo.``-collector shape).
+
+The knob: ``config.leaf_cache_slots()`` / ``SHERMAN_LEAF_CACHE`` (off
+is the shipped default until the chip receipts land — standing
+guardrail: measurement-driven flips; the CPU receipts live in
+BENCHMARKS.md "Round-10").
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sherman_tpu import config as C
+from sherman_tpu import obs
+from sherman_tpu.errors import ConfigError
+from sherman_tpu.obs import device as DEV
+from sherman_tpu.ops import bits, layout
+from sherman_tpu.parallel import dsm as D
+from sherman_tpu.parallel.mesh import AXIS
+
+DEFAULT_WINDOW = 8  # open-addressing probe window (slots per key)
+
+
+# ---------------------------------------------------------------------------
+# Slot hash: device + bit-exact numpy twin (placement must agree with
+# the probe, or every fill would miss).
+# ---------------------------------------------------------------------------
+
+def slot_hash_np(khi: np.ndarray, klo: np.ndarray) -> np.ndarray:
+    """Host table hash of (hi, lo) int32 key pairs -> uint32 [B]
+    (``bits.hash32_np`` is the vectorized murmur3 twin — one constant
+    set shared with the device probe's :func:`slot_hash`)."""
+    h = bits.hash32_np(np.asarray(klo).view(np.uint32))
+    return bits.hash32_np(np.asarray(khi).view(np.uint32) ^ h)
+
+
+def slot_hash(khi, klo):
+    """Device twin of :func:`slot_hash_np` (int32 pairs -> uint32)."""
+    h = bits.hash32(klo)
+    return bits.hash32(
+        jnp.bitwise_xor(jnp.asarray(khi, jnp.int32),
+                        lax.bitcast_convert_type(h, jnp.int32)))
+
+
+# ---------------------------------------------------------------------------
+# The device probe core (shared by the engine probe program and the
+# staged serving loop's cache_probe program).
+# ---------------------------------------------------------------------------
+
+def probe_rows(pool, tbl, khi, klo, active, *, cfg, axis_name: str = AXIS):
+    """Vectorized probe + pool validation of one key batch.
+
+    ``tbl``: dict of replicated [S] int32 arrays (khi, klo, vhi, vlo,
+    ver, addr, slot); khi==klo==0 marks an empty slot (key 0 is below
+    ``KEY_MIN``, never a user key).  Returns per-row
+
+        (hit, vhi, vlo, stale, tidx)
+
+    ``hit``: the cached value is certified current against THIS pool
+    snapshot (serve it — bit-identical to a descent).  ``stale``: the
+    table matched but validation failed (invalidate slot ``tidx`` and
+    descend).  Cost: one [B, window] table gather + ONE page gather for
+    the matching rows — the same single-page read a router-seeded
+    round-1 descent pays, instead of height-many.
+    """
+    S = tbl["khi"].shape[0]
+    W = min(DEFAULT_WINDOW, S)
+    h = slot_hash(khi, klo)
+    idx = lax.bitcast_convert_type(h & jnp.uint32(S - 1), jnp.int32)
+    cand = (idx[:, None] + jnp.arange(W, dtype=jnp.int32)) \
+        & jnp.int32(S - 1)                                   # [B, W]
+    ck_hi, ck_lo = tbl["khi"][cand], tbl["klo"][cand]
+    m = (active[:, None] & (ck_hi == khi[:, None])
+         & (ck_lo == klo[:, None]) & ((ck_hi != 0) | (ck_lo != 0)))
+    # one-hot first match (placement keeps keys unique, so at most one)
+    first = m & (jnp.cumsum(m.astype(jnp.int32), axis=1) == 1)
+    pmatch = jnp.any(m, axis=1)
+    pick = lambda a: jnp.sum(jnp.where(first, a[cand], 0), axis=1)
+    c_addr, c_slot, c_ver = pick(tbl["addr"]), pick(tbl["slot"]), \
+        pick(tbl["ver"])
+    c_vhi, c_vlo = pick(tbl["vhi"]), pick(tbl["vlo"])
+    tidx = jnp.sum(jnp.where(first, cand, 0), axis=1)
+
+    # authoritative re-certification on the current snapshot — the
+    # entry-version coherence token plus the liveness/key/value checks
+    # (see the module docstring's hit contract)
+    if cfg.machine_nr == 1:
+        # narrow validation: 8 WORD gathers (headers + the slot's 5
+        # fields) instead of a 256-word page row per hit — on the CPU
+        # mesh this is the difference between the probe paying ~a full
+        # descent's bandwidth and paying ~3% of it (TPU gathers are
+        # per-row latency-bound, so both forms cost alike there)
+        P = pool.shape[0]
+        row = bits.addr_page(c_addr)
+        okr = pmatch & (row >= 0) & (row < P)
+        r = jnp.clip(row, 0, P - 1)
+        s = jnp.clip(c_slot, 0, C.LEAF_CAP - 1)
+        pv = pool[r, C.L_VER_W + s]
+        fv, rv = layout.ver_unpack(pv)
+        hit = (okr
+               & (pool[r, C.W_LEVEL] == 0)
+               & (pool[r, C.W_FRONT_VER] == pool[r, C.W_REAR_VER])
+               & (fv == rv) & (fv != 0)
+               & (pool[r, C.L_KHI_W + s] == khi)
+               & (pool[r, C.L_KLO_W + s] == klo)
+               & (pv == c_ver)
+               & (pool[r, C.L_VHI_W + s] == c_vhi)
+               & (pool[r, C.L_VLO_W + s] == c_vlo))
+    else:
+        # multi-node: the cached leaf may live on a peer — ship the
+        # page through the routed read exchange (requests are 1 word,
+        # only replies carry pages; one exchange round, like a seeded
+        # round-1 descent read)
+        page, okr = D.read_pages_spmd(pool, c_addr, cfg=cfg,
+                                      axis_name=axis_name, active=pmatch)
+        so = (jnp.arange(C.LEAF_CAP, dtype=jnp.int32)[None, :]
+              == jnp.clip(c_slot, 0, C.LEAF_CAP - 1)[:, None])
+        blk = lambda st: jnp.sum(
+            jnp.where(so, page[:, st:st + C.LEAF_CAP], 0), axis=-1)
+        pv = blk(C.L_VER_W)
+        fv, rv = layout.ver_unpack(pv)
+        hit = (pmatch & okr
+               & (layout.h_level(page) == 0)
+               & layout.page_consistent(page)
+               & (fv == rv) & (fv != 0)
+               & (blk(C.L_KHI_W) == khi) & (blk(C.L_KLO_W) == klo)
+               & (pv == c_ver)
+               & (blk(C.L_VHI_W) == c_vhi) & (blk(C.L_VLO_W) == c_vlo))
+    hit = hit & pmatch
+    stale = pmatch & ~hit
+    return (hit, jnp.where(hit, c_vhi, 0), jnp.where(hit, c_vlo, 0),
+            stale, tidx)
+
+
+def invalidation_mask(stale, tidx, n_slots: int, n_nodes: int,
+                      axis_name: str = AXIS):
+    """[S] int32 count of stale probe matches per table slot, psum'd
+    across the mesh so every node derives the SAME invalidation (the
+    table is replicated — a divergent update would desynchronize it)."""
+    inval = jnp.zeros(n_slots, jnp.int32).at[
+        jnp.where(stale, tidx, n_slots)].add(1, mode="drop")
+    if n_nodes > 1:
+        inval = lax.psum(inval, axis_name)
+    return inval
+
+
+class LeafCache:
+    """Batched, versioned hot-key value cache over a
+    :class:`~sherman_tpu.models.batched.BatchedEngine` (see the module
+    docstring for the protocol).  Attach via
+    ``engine.attach_leaf_cache()``; the engine's read entry points
+    probe it automatically and its write entry points invalidate it.
+    """
+
+    def __init__(self, eng, slots: int | None = None,
+                 window: int = DEFAULT_WINDOW, admit_every: int = 0):
+        if slots is None:
+            slots = C.leaf_cache_slots() or 65536
+        if slots < 2 * window:
+            slots = 2 * window
+        S = 1 << (int(slots) - 1).bit_length()  # round up to pow2
+        if window != DEFAULT_WINDOW:
+            raise ConfigError(
+                "leaf cache probe window is compiled into the probe "
+                f"program as DEFAULT_WINDOW={DEFAULT_WINDOW}")
+        self.eng = eng
+        self.cfg = eng.cfg
+        self.slots = S
+        self.window = window
+        #: admitted-key budget: load <= 0.5 keeps the bounded window
+        #: near-lossless under hottest-first placement
+        self.capacity = S // 2
+        #: auto-admission cadence in observed batches (0 = manual fill)
+        self.admit_every = int(admit_every)
+        # host mirror of the device table (placement/invalidation
+        # bookkeeping; the device copies are pushed lazily)
+        self._khi = np.zeros(S, np.int32)
+        self._klo = np.zeros(S, np.int32)
+        self._vhi = np.zeros(S, np.int32)
+        self._vlo = np.zeros(S, np.int32)
+        self._ver = np.zeros(S, np.int32)
+        self._addr = np.zeros(S, np.int32)
+        self._slot = np.zeros(S, np.int32)
+        self._keys = np.zeros(S, np.uint64)  # u64 view for isin lookups
+        self._dev: tuple | None = None
+        self._dirty = True
+        self._lock = threading.RLock()
+        self._probe_cache: dict = {}
+        self._fill_cache: dict = {}
+        # frequency sketch for auto-admission (decayed counts)
+        self._freq: dict[int, float] = {}
+        self._observed_batches = 0
+        # cache.* pull collector (the slo.-collector shape): counters +
+        # the hit-ratio gauge in every snapshot / scrape
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+        self.evictions = 0
+        self.fills = 0
+        self.placement_failures = 0
+        ref = weakref.ref(self)
+
+        def _collect():
+            c = ref()
+            return c.stats() if c is not None else {}
+
+        obs.register_collector("cache", _collect)
+
+    # -- metrics --------------------------------------------------------------
+
+    def _note_probe(self, hits: int, misses: int, stale: int) -> None:
+        """Hot-path accounting: plain integer adds only (the SL006
+        no-allocation contract — this runs once per probed batch)."""
+        self.hits += hits
+        self.misses += misses
+        self.invalidations += stale
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "invalidations": self.invalidations,
+            "evictions": self.evictions,
+            "fills": self.fills,
+            "placement_failures": self.placement_failures,
+            "hit_ratio": (self.hits / total) if total else 0.0,
+            "cached_keys": int((self._keys != 0).sum()),
+            "slots": self.slots,
+            "capacity": self.capacity,
+        }
+
+    # -- device table ---------------------------------------------------------
+
+    def _table_host(self) -> tuple:
+        return (self._khi, self._klo, self._vhi, self._vlo, self._ver,
+                self._addr, self._slot)
+
+    def device_tables(self) -> tuple:
+        """The 7 replicated device arrays (khi, klo, vhi, vlo, ver,
+        addr, slot), re-pushed from the host mirror when dirty.  The
+        staged serving loop stages these ONCE before its sealed window
+        (fixed [S] shapes — no data-dependent recompiles)."""
+        from sherman_tpu.workload.device_prep import _rep_put
+        with self._lock:
+            if self._dirty or self._dev is None:
+                self._dev = tuple(_rep_put(self.eng.dsm, a)
+                                  for a in self._table_host())
+                self._dirty = False
+            return self._dev
+
+    # -- probe ----------------------------------------------------------------
+
+    def _get_probe(self, width: int):
+        """The engine-path probe program: probe + validate + device-side
+        stale-slot invalidation, one compiled shape per batch width."""
+        fn = self._probe_cache.get(width)
+        if fn is None:
+            eng = self.eng
+            cfg, S = self.cfg, self.slots
+            N = cfg.machine_nr
+            spec, rep = eng._spec, eng._rep
+
+            def kernel(pool, tkhi, tklo, tvhi, tvlo, tver, taddr, tslot,
+                       khi, klo, active):
+                tbl = {"khi": tkhi, "klo": tklo, "vhi": tvhi,
+                       "vlo": tvlo, "ver": tver, "addr": taddr,
+                       "slot": tslot}
+                hit, vhi, vlo, stale, tidx = probe_rows(
+                    pool, tbl, khi, klo, active, cfg=cfg)
+                inval = invalidation_mask(stale, tidx, S, N)
+                keep = inval == 0
+                nh = jnp.sum(hit.astype(jnp.int32))
+                ns = jnp.sum(stale.astype(jnp.int32))
+                if N > 1:
+                    nh = lax.psum(nh, AXIS)
+                    ns = lax.psum(ns, AXIS)
+                return (hit, vhi, vlo, jnp.where(keep, tkhi, 0),
+                        jnp.where(keep, tklo, 0), nh, ns)
+
+            sm = jax.shard_map(
+                kernel, mesh=eng.dsm.mesh,
+                in_specs=(spec,) + (rep,) * 7 + (spec, spec, spec),
+                out_specs=(spec, spec, spec, rep, rep, rep, rep),
+                check_vma=False)
+            fn = DEV.wrap_program("engine.cache_probe", jax.jit(sm))
+            self._probe_cache[width] = fn
+        return fn
+
+    def probe(self, khi: np.ndarray, klo: np.ndarray, active: np.ndarray):
+        """Probe one PADDED batch (host int32 pairs + active mask of the
+        engine's ``machine_nr * B`` width) -> (hit, vhi, vlo) numpy
+        arrays of the same width.  Stale matches are invalidated on
+        device and counted; hits/misses land in the ``cache.``
+        collector."""
+        eng = self.eng
+        dev = self.device_tables()
+        fn = self._get_probe(khi.shape[0])
+        with eng._step_mutex:  # launch-only, like every engine step
+            out = fn(eng.dsm.pool, *dev, eng._shard(khi),
+                     eng._shard(klo), eng._shard(active))
+        hit, vhi, vlo, tkhi2, tklo2, nh, ns = out
+        with self._lock:
+            if not self._dirty:
+                # adopt the device-side invalidations; a concurrent host
+                # fill/invalidate marked dirty and supersedes them (the
+                # stale entries re-miss and re-invalidate next probe)
+                self._dev = (tkhi2, tklo2) + self._dev[2:]
+        hit, vhi, vlo = eng._unshard(hit, vhi, vlo)
+        nh_i = int(np.asarray(nh))
+        ns_i = int(np.asarray(ns))
+        self._note_probe(nh_i, int(active.sum()) - nh_i, ns_i)
+        return np.array(hit), np.array(vhi), np.array(vlo)
+
+    # -- fill (admission) -----------------------------------------------------
+
+    def _get_fill(self, iters: int, with_start: bool):
+        """Resolve program: descend candidate keys to their leaves and
+        capture (addr, slot, packed version, value) — the table fill's
+        one device pass (off the hot path)."""
+        key = (iters, with_start)
+        fn = self._fill_cache.get(key)
+        if fn is None:
+            from sherman_tpu.models.batched import _resolve_leaves
+            eng = self.eng
+            cfg = self.cfg
+            spec, rep = eng._spec, eng._rep
+            in_specs = [spec, spec, spec, spec, rep, spec]
+            if with_start:
+                in_specs.append(spec)
+
+            def kernel(pool, counters, khi, klo, root, active, *rest):
+                start = rest[0] if with_start else None
+                counters, done, addr, found, _, _ = _resolve_leaves(
+                    pool, counters, khi, klo, root, active, start,
+                    cfg=cfg, iters=iters, axis_name=AXIS)
+                page, okp = D.read_pages_spmd(pool, addr, cfg=cfg,
+                                              active=done & found)
+                f2, _, _, slot = layout.leaf_find_key(page, khi, klo)
+                ok = done & found & okp & f2
+                so = (jnp.arange(C.LEAF_CAP, dtype=jnp.int32)[None, :]
+                      == jnp.clip(slot, 0, C.LEAF_CAP - 1)[:, None])
+                blk = lambda s: jnp.sum(
+                    jnp.where(so, page[:, s:s + C.LEAF_CAP], 0), axis=-1)
+                z = lambda a: jnp.where(ok, a, 0)
+                return (counters, ok, z(addr), z(slot),
+                        z(blk(C.L_VER_W)), z(blk(C.L_VHI_W)),
+                        z(blk(C.L_VLO_W)))
+
+            sm = jax.shard_map(
+                kernel, mesh=eng.dsm.mesh, in_specs=tuple(in_specs),
+                out_specs=(spec,) * 7, check_vma=False)
+            fn = DEV.wrap_program(
+                "engine.cache_fill",
+                jax.jit(sm, donate_argnums=C.donate_argnums(1)))
+            self._fill_cache[key] = fn
+        return fn
+
+    def _resolve(self, keys: np.ndarray):
+        """-> (ok, addr, slot, ver, vhi, vlo) host arrays [len(keys)]:
+        each key's live leaf position + captured version/value, chunked
+        through the engine's padded batch width."""
+        eng = self.eng
+        n = keys.shape[0]
+        total = self.cfg.machine_nr * eng.B
+        outs = [np.zeros(n, bool)] + [np.zeros(n, np.int32)
+                                      for _ in range(5)]
+        use_router = eng.router is not None
+        fn = self._get_fill(eng._iters(), use_router)
+        for i in range(0, n, total):
+            chunk = keys[i:i + total]
+            khi, klo = bits.keys_to_pairs(chunk)
+            (khi, _), (klo, _) = eng._pad(khi), eng._pad(klo)
+            active, _ = eng._pad(np.ones(chunk.shape[0], bool))
+            args = [eng._shard(khi), eng._shard(klo),
+                    np.int32(eng.tree._root_addr), eng._shard(active)]
+            if use_router:
+                args.append(eng._shard(eng.router.host_start(khi, klo)))
+            with eng._step_mutex:
+                eng.dsm.counters, *res = fn(eng.dsm.pool,
+                                            eng.dsm.counters, *args)
+            res = eng._unshard(*res)
+            for o, r in zip(outs, res):
+                o[i:i + total] = np.asarray(r)[:chunk.shape[0]]
+        return tuple(outs)
+
+    def fill(self, keys) -> dict:
+        """Rebuild the table from ``keys`` (uint64, hottest FIRST — the
+        admission ranking).  Each key is resolved to its live leaf
+        position in one batched pass; placement is host-side open
+        addressing, hottest first, within the bounded window — window
+        overflow drops the key (counted, never silently resized).
+        Returns {"placed", "failed", "resolved"}."""
+        keys = np.asarray(keys, np.uint64)[:self.capacity]
+        ok, addr, slot, ver, vhi, vlo = self._resolve(keys) \
+            if keys.size else ((np.zeros(0, bool),) + (np.zeros(0, np.int32),) * 5)
+        khi, klo = bits.keys_to_pairs(keys)
+        h = slot_hash_np(khi, klo)
+        S, W = self.slots, self.window
+        nkhi = np.zeros(S, np.int32)
+        nklo = np.zeros(S, np.int32)
+        nvhi = np.zeros(S, np.int32)
+        nvlo = np.zeros(S, np.int32)
+        nver = np.zeros(S, np.int32)
+        naddr = np.zeros(S, np.int32)
+        nslot = np.zeros(S, np.int32)
+        nkeys = np.zeros(S, np.uint64)
+        placed = failed = 0
+        base = (h & np.uint32(S - 1)).astype(np.int64)
+        for i in np.nonzero(ok)[0].tolist():
+            for o in range(W):
+                j = int((base[i] + o) & (S - 1))
+                if nkeys[j] == 0:
+                    nkhi[j], nklo[j] = khi[i], klo[i]
+                    nvhi[j], nvlo[j] = vhi[i], vlo[i]
+                    nver[j], naddr[j], nslot[j] = ver[i], addr[i], slot[i]
+                    nkeys[j] = keys[i]
+                    placed += 1
+                    break
+            else:
+                failed += 1  # window full of hotter keys: stay uncached
+        with self._lock:
+            evicted = int(np.setdiff1d(
+                self._keys[self._keys != 0], nkeys,
+                assume_unique=False).size)
+            self.evictions += evicted
+            (self._khi, self._klo, self._vhi, self._vlo, self._ver,
+             self._addr, self._slot) = (nkhi, nklo, nvhi, nvlo, nver,
+                                        naddr, nslot)
+            self._keys = nkeys
+            self._dirty = True
+            self.fills += 1
+            self.placement_failures += failed
+        return {"placed": placed, "failed": failed,
+                "resolved": int(ok.sum())}
+
+    # -- admission (frequency sketch) ----------------------------------------
+
+    def observe(self, keys) -> None:
+        """Feed one read batch's key stream into the decayed frequency
+        sketch; every ``admit_every`` batches rebuild the table from
+        the top ``capacity`` keys.  No-op when ``admit_every == 0``
+        (manual :meth:`fill` drivers — e.g. the staged bench loop,
+        whose hot set is analytically known)."""
+        if self.admit_every <= 0:
+            return
+        uk, cnt = np.unique(np.asarray(keys, np.uint64),
+                            return_counts=True)
+        with self._lock:
+            f = self._freq
+            for k, c in zip(uk.tolist(), cnt.tolist()):
+                f[k] = f.get(k, 0.0) + c
+            self._observed_batches += 1
+            due = self._observed_batches % self.admit_every == 0
+            if due:
+                # decay + bound the sketch, then admit the top keys
+                top = sorted(f.items(), key=lambda kv: -kv[1])
+                self._freq = {k: v * 0.5
+                              for k, v in top[:4 * self.capacity]}
+                cand = np.array([k for k, _ in top[:self.capacity]],
+                                np.uint64)
+        if due and cand.size:
+            self.fill(cand)
+
+    # -- invalidation ---------------------------------------------------------
+
+    def invalidate_keys(self, keys) -> int:
+        """Drop every cached entry whose key is in ``keys`` (the write
+        path's hook — these keys' entry versions bump this step)."""
+        keys = np.asarray(keys, np.uint64)
+        if keys.size == 0:
+            return 0
+        with self._lock:
+            m = (self._keys != 0) & np.isin(self._keys, keys)
+            return self._clear(m)
+
+    def invalidate_pages(self, addrs) -> int:
+        """Drop every cached entry resident on the given packed page
+        addresses (split/reclaim rewrites, scrub quarantine)."""
+        a = np.asarray(list(addrs), np.int64).astype(np.int32)
+        if a.size == 0:
+            return 0
+        with self._lock:
+            m = (self._keys != 0) & np.isin(self._addr, a)
+            return self._clear(m)
+
+    def flush(self) -> int:
+        """Drop everything — the degraded-entry / recovery / targeted-
+        repair contract (the cache is volatile by design)."""
+        with self._lock:
+            return self._clear(self._keys != 0)
+
+    def _clear(self, m: np.ndarray) -> int:
+        n = int(m.sum())
+        if n:
+            for a in self._table_host():
+                a[m] = 0
+            self._keys[m] = 0
+            self._dirty = True
+            self.invalidations += n
+        return n
+
+    def cached_keys(self) -> np.ndarray:
+        """The currently admitted key set (uint64, unordered)."""
+        with self._lock:
+            return self._keys[self._keys != 0].copy()

@@ -104,6 +104,13 @@ class Scrubber:
             obs.record_event("scrub.violation", addr=hex(addr),
                              mask=self._mask_names(mask),
                              structural=bool(mask & SCRUB_STRUCTURAL))
+            # hot-key tier: a flagged (about-to-be-quarantined) page's
+            # keys must drop out of the leaf/value cache — the cache
+            # must never vouch for content the scrubber just impeached
+            # (structural damage additionally flushes wholesale via
+            # enter_degraded below)
+            if self.eng.leaf_cache is not None:
+                self.eng.leaf_cache.invalidate_pages([addr])
             contained = self._quarantine_page(addr) if self.quarantine \
                 else False
             if mask & SCRUB_STRUCTURAL:

@@ -175,6 +175,20 @@ def lock_index(addr, locks_per_node: int):
     return (hash32(addr) % jnp.uint32(locks_per_node)).astype(jnp.int32)
 
 
+def hash32_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized host twin of :func:`hash32` on uint32 arrays —
+    bit-exact, no device.  (Third sibling beside the device and scalar
+    forms so a constant tweak can never diverge them: the leaf cache's
+    host-side table placement must agree with its device probe.)"""
+    v = np.asarray(x).astype(np.uint32).copy()
+    v ^= v >> np.uint32(16)
+    v *= np.uint32(0x85EBCA6B)
+    v ^= v >> np.uint32(13)
+    v *= np.uint32(0xC2B2AE35)
+    v ^= v >> np.uint32(16)
+    return v
+
+
 def hash32_host(x: int) -> int:
     """Host scalar twin of :func:`hash32` — bit-exact, pure Python.  The
     host lock path hashes one address per lock acquisition; routing that

@@ -354,6 +354,14 @@ class RecoveryPlane:
                 "is the documented exit")
         if scrubber is not None:
             scrubber.release_quarantine()
+        # the hot-key tier is volatile across repair by contract: entry
+        # versions of the restored pages rolled BACK to chain-tip values
+        # (a state legal cached entries may coincidentally match), so
+        # the cache restarts cold here; degraded entry already flushed
+        # it, this pins the contract even for repairs driven without a
+        # degraded transition
+        if self.eng.leaf_cache is not None:
+            self.eng.leaf_cache.flush()
         self.eng.exit_degraded()
         # content catch-up: ops acknowledged since the chain tip live in
         # the journal; replaying them (journal detached — replay must

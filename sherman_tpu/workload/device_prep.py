@@ -418,7 +418,8 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                      batch: int, dev_b: int, log2_bins: int = 20,
                      check_xor: int = 0xDEADBEEF, seed: int = 11,
                      staged=None, sampler: str = "table",
-                     fusion: str | None = None):
+                     fusion: str | None = None, leaf_cache=None,
+                     dev_b_resid: int | None = None):
     """Build the device-staged serving step for ``eng`` (a
     :class:`~sherman_tpu.models.batched.BatchedEngine` with an attached
     router).
@@ -495,6 +496,30 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
       program PROVES no host round trip can hide between generation and
       serve — and for re-testing the pathology on new toolchains.
 
+    ``leaf_cache`` (optional; aligned/pipelined only): an attached
+    :class:`~sherman_tpu.models.leaf_cache.LeafCache` — a fourth
+    compiled program ``cache_probe`` (fixed table shapes, so the sealed
+    loop stays zero-retrace) runs between prep and serve: pool-validated
+    hot-key hits leave the unique batch, the probe COMPACTS the misses
+    into a ``dev_b_resid``-wide residual (descent cost is per ROW of
+    the compiled shape, so deactivating rows saves nothing — shrinking
+    the shape is the whole win), the serve descends only that residual,
+    and the verify program merges the cache answers back per client row
+    before the receipts arithmetic — so the drained receipts are
+    BIT-IDENTICAL to the uncached loop's, with two extra carry scalars
+    appended: ``sum_hits`` (client ops served from cache — the measured
+    hit ratio's numerator) and ``sum_hits_uniq`` (unique rows removed
+    from the serve — the residual-batch receipt).  ``dev_b_resid``
+    (default ``dev_b`` — no shrink) caps the per-node residual; a step
+    whose misses overflow it voids the phase through the ``ok``
+    receipt, the SAME contract as the ``dev_b`` unique cap (drivers
+    size it from a warmup step's measured residual, the mixed loop's
+    cap-tightening dance).  The cache's device tables are staged ONCE
+    (read-only sealed window: in-window stale entries just keep
+    missing, validation stays authoritative); ``step.phase_labels``
+    and the compile ledger carry the ``cache_probe`` label so the
+    probe's cost is attributable.
+
     In every mode the dispatched programs are chained back-to-back with
     no host work or transfer between them (the multi-program forms pass
     device-resident arrays only).  ``counters`` is donated; the rcarry
@@ -524,6 +549,11 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     if fusion not in ("aligned", "pipelined", "chained", "fused"):
         raise ConfigError(
             f"fusion={fusion!r}: want aligned|pipelined|chained|fused")
+    use_cache = leaf_cache is not None
+    if use_cache and fusion not in ("aligned", "pipelined"):
+        raise ConfigError(
+            f"leaf_cache requires fusion aligned|pipelined (got "
+            f"{fusion!r}): the probe is its own chained program")
     router = eng.router
     assert router is not None, "attach_router() first"
     cfg = eng.cfg
@@ -628,14 +658,104 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         # idempotent, so the identity pin keeps holding)
         jserve = eng._get_search_fanout(iters)
 
-        def verify(rcarry, skhi, sklo, found, vhi, vlo, n_uniq_a):
-            return verify_core(rcarry, skhi, sklo, found, vhi, vlo,
-                               n_uniq_a[0])
+        jcache = cache_tables = None
+        R_resid = int(dev_b_resid) if dev_b_resid else dev_b
+        if use_cache:
+            from sherman_tpu.models.leaf_cache import probe_rows
+            assert 0 < R_resid <= dev_b, \
+                "dev_b_resid caps the residual within the unique cap"
+            cache_tables = leaf_cache.device_tables()
 
-        jverify = DEV.wrap_program("staged.verify", jax.jit(jax.shard_map(
-            verify, mesh=mesh,
-            in_specs=((rep,) * 4, spec, spec, spec, spec, spec, spec),
-            out_specs=(rep,) * 4, check_vma=False)))
+            def cache_probe(pool, tkhi, tklo, tvhi, tvlo, tver, taddr,
+                            tslot, khi, klo, active, start, inv):
+                tbl = {"khi": tkhi, "klo": tklo, "vhi": tvhi,
+                       "vlo": tvlo, "ver": tver, "addr": taddr,
+                       "slot": tslot}
+                hit, cvhi, cvlo, _, _ = probe_rows(
+                    pool, tbl, khi, klo, active, cfg=cfg)
+                # read-only sealed window: no device-side slot
+                # invalidation here (the table arrays are staged
+                # constants) — a stale entry keeps missing and the pool
+                # validation stays the authoritative guard
+                resid = active & ~hit
+                n_resid = jnp.sum(resid.astype(jnp.int32))
+                # compact the misses to the [R_resid] residual the
+                # serve actually descends; overflowing rows drop and
+                # VOID the step via the ok receipt (n_resid check in
+                # verify), never silently mis-serve
+                sidx = jnp.nonzero(resid, size=R_resid,
+                                   fill_value=dev_b)[0].astype(jnp.int32)
+                valid = sidx < dev_b
+                ci = jnp.clip(sidx, 0, dev_b - 1)
+                # remap client fan-out indices onto the residual rows;
+                # hit clients land on row 0 (their garbage fan-out is
+                # overwritten by the verify merge)
+                remap = jnp.zeros(dev_b + 1, jnp.int32).at[
+                    jnp.where(valid, sidx, dev_b)].set(
+                    jnp.arange(R_resid, dtype=jnp.int32), mode="drop")
+                if N > 1:
+                    node = lax.axis_index(AXIS).astype(jnp.int32)
+                    loc = jnp.clip(inv - node * dev_b, 0, dev_b)
+                    inv_r = remap[loc] + node * R_resid
+                else:
+                    inv_r = remap[jnp.clip(inv, 0, dev_b)]
+                return (hit, cvhi, cvlo, khi[ci], klo[ci], start[ci],
+                        valid, inv_r, n_resid[None])
+
+            jcache = DEV.wrap_program(
+                "staged.cache_probe", jax.jit(jax.shard_map(
+                    cache_probe, mesh=mesh,
+                    in_specs=(spec,) + (rep,) * 7 + (spec,) * 5,
+                    out_specs=(spec,) * 9, check_vma=False)))
+
+        if not use_cache:
+            def verify(rcarry, skhi, sklo, found, vhi, vlo, n_uniq_a):
+                return verify_core(rcarry, skhi, sklo, found, vhi, vlo,
+                                   n_uniq_a[0])
+
+            jverify = DEV.wrap_program(
+                "staged.verify", jax.jit(jax.shard_map(
+                    verify, mesh=mesh,
+                    in_specs=((rep,) * 4, spec, spec, spec, spec, spec,
+                              spec),
+                    out_specs=(rep,) * 4, check_vma=False)))
+        else:
+            def verify(rcarry, skhi, sklo, found, vhi, vlo, n_uniq_a,
+                       seg, hit, cvhi, cvlo, n_resid_a):
+                """Cache-aware receipts: merge the cache answers back
+                per client row (the hit rows' serve outputs fanned out
+                residual row 0), then run the SAME receipts arithmetic
+                — plus the two hit accumulators and the residual-
+                overflow void (the dev_b_resid twin of the unique cap's
+                ok receipt)."""
+                (ok, n_correct, sum_nu, max_nu, hits_c,
+                 hits_u) = rcarry
+                ctab = jnp.stack([hit.astype(jnp.int32), cvhi, cvlo,
+                                  jnp.zeros_like(cvhi)], axis=-1)
+                if N > 1:
+                    ctab = transport.gather_rows(ctab, AXIS)
+                safe = jnp.clip(seg, 0, ctab.shape[0] - 1)
+                cout = jnp.take_along_axis(ctab, safe[:, None], axis=0)
+                chit = cout[:, 0] != 0
+                inc_hc = jnp.sum(chit.astype(jnp.int32))
+                inc_hu = jnp.sum(hit.astype(jnp.int32))
+                rok = (n_resid_a[0] <= R_resid).astype(jnp.int32)
+                if N > 1:
+                    inc_hc = lax.psum(inc_hc, AXIS)
+                    inc_hu = lax.psum(inc_hu, AXIS)
+                    rok = lax.pmin(rok, AXIS)
+                base = verify_core(
+                    (ok, n_correct, sum_nu, max_nu), skhi, sklo,
+                    found | chit, jnp.where(chit, cout[:, 1], vhi),
+                    jnp.where(chit, cout[:, 2], vlo), n_uniq_a[0])
+                return ((jnp.minimum(base[0], rok),) + base[1:]
+                        + (hits_c + inc_hc, hits_u + inc_hu))
+
+            jverify = DEV.wrap_program(
+                "staged.verify", jax.jit(jax.shard_map(
+                    verify, mesh=mesh,
+                    in_specs=((rep,) * 6,) + (spec,) * 11,
+                    out_specs=(rep,) * 6, check_vma=False)))
         root_rep = _rep_put(dsm, root)
 
         if fusion == "aligned":
@@ -643,11 +763,25 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                 step_idx, *rcarry = carry
                 (step_idx, skhi, sklo, khi, klo, start, active, inv,
                  nu) = jprep(tpair, rtable, rkey, step_idx)
+                if use_cache:
+                    # hot-key probe: validated hits leave the batch and
+                    # the misses compact into the [dev_b_resid]
+                    # residual the serve descends
+                    (hit, cvhi, cvlo, khi, klo, start, active, inv_s,
+                     nr) = jcache(pool, *cache_tables, khi, klo,
+                                  active, start, inv)
+                else:
+                    inv_s = inv
                 counters, done, found, vhi, vlo = jserve(
                     pool, counters, khi, klo, root_rep, active, start,
-                    inv)
-                rcarry = jverify(tuple(rcarry), skhi, sklo, found, vhi,
-                                 vlo, nu)
+                    inv_s)
+                if use_cache:
+                    rcarry = jverify(tuple(rcarry), skhi, sklo, found,
+                                     vhi, vlo, nu, inv, hit, cvhi,
+                                     cvlo, nr)
+                else:
+                    rcarry = jverify(tuple(rcarry), skhi, sklo, found,
+                                     vhi, vlo, nu)
                 return counters, (step_idx,) + tuple(rcarry)
         else:  # pipelined: two-deep software pipeline, same 3 programs
             # the pending slot (:func:`_two_deep_slot`): batch k-1's
@@ -667,12 +801,22 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                 #    that overlaps programs runs it behind the serve)
                 (step_idx, skhi, sklo, khi, klo, start, active, inv,
                  nu) = jprep(tpair, rtable, rkey, step_idx)
+                if use_cache:
+                    (hit, cvhi, cvlo, khi, klo, start, active, inv_s,
+                     nr) = jcache(pool, *cache_tables, khi, klo,
+                                  active, start, inv)
+                else:
+                    inv_s = inv
                 # 3. serve batch k — the SAME compiled program object
                 #    aligned (and the host-staged phase) dispatches
                 counters, done, found, vhi, vlo = jserve(
                     pool, counters, khi, klo, root_rep, active, start,
-                    inv)
-                _put(skhi, sklo, found, vhi, vlo, nu)
+                    inv_s)
+                if use_cache:
+                    _put(skhi, sklo, found, vhi, vlo, nu, inv, hit,
+                         cvhi, cvlo, nr)
+                else:
+                    _put(skhi, sklo, found, vhi, vlo, nu)
                 return counters, (step_idx,) + rcarry
 
             step.drain = _drain
@@ -680,6 +824,11 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         step.jprep, step.jserve, step.jverify = jprep, jserve, jverify
         programs = {"prep": jprep, "serve_fanout": jserve,
                     "verify": jverify}
+        if use_cache:
+            step.jcache = jcache
+            # dispatch order: prep -> cache_probe -> serve -> verify
+            programs = {"prep": jprep, "cache_probe": jcache,
+                        "serve_fanout": jserve, "verify": jverify}
 
     elif fusion == "chained":
         def prep(tpair, rtable, rkey, step_idx):
@@ -752,6 +901,9 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
 
     step.fusion, step.sampler = fusion, sampler
     step.programs, step.n_programs = programs, len(programs)
+    step.cache = use_cache
+    step.cache_slots = leaf_cache.slots if use_cache else None
+    step.dev_b_resid = R_resid if use_cache else None
     step.pipeline_depth = 2 if fusion == "pipelined" else 1
     if not hasattr(step, "drain"):
         step.drain = lambda carry: carry  # nothing pending off-pipeline
@@ -778,12 +930,16 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
     def new_carry():
         """Fresh device-resident carry.  Also resets the pipelined
         mode's pending slot: a fresh receipts stream must not fold a
-        stale batch left by an undrained previous run."""
+        stale batch left by an undrained previous run.  With the leaf
+        cache on, two hit accumulators (sum_hits, sum_hits_uniq) ride
+        at the END so every base field keeps its index."""
         if _pipe_reset is not None:
             _pipe_reset()
-        return tuple(_rep_put(dsm, v)
-                     for v in (np.uint32(0), np.int32(1), np.int32(0),
-                               np.int32(0), np.int32(0)))
+        vals = [np.uint32(0), np.int32(1), np.int32(0), np.int32(0),
+                np.int32(0)]
+        if use_cache:
+            vals += [np.int32(0), np.int32(0)]
+        return tuple(_rep_put(dsm, v) for v in vals)
 
     def phase_profile(pool, counters, tpair, rtable, rkey, reps: int = 4):
         """Per-phase wall-cost attribution of the staged step: each
@@ -823,24 +979,45 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
         jax.block_until_ready(arrs)
         if fusion in ("aligned", "pipelined"):
             skhi, sklo, khi, klo, start, active, inv, nu = arrs
+            inv_s = inv
+            hit = cvhi = cvlo = nr = None
+            if use_cache:
+                def cache_loop(k):
+                    o = None
+                    for _ in range(k):
+                        o = jcache(pool, *cache_tables, khi, klo,
+                                   active, start, inv)
+                    jax.block_until_ready(o)
+
+                out["cache_probe"] = _delta_ms(cache_loop, reps)
+                # the serve measures the COMPACTED residual — the
+                # width the live cache-on loop actually descends
+                (hit, cvhi, cvlo, khi, klo, start, active, inv_s,
+                 nr) = jcache(pool, *cache_tables, khi, klo, active,
+                              start, inv)
 
             def serve_loop(k):
                 o = None
                 for _ in range(k):
                     box["c"], done, f, vh, vl = jserve(
                         pool, box["c"], khi, klo, root_rep, active,
-                        start, inv)
+                        start, inv_s)
                     o = f
                 jax.block_until_ready(o)
 
             out["serve_fanout"] = _delta_ms(serve_loop, reps)
             box["c"], done, f, vh, vl = jserve(
-                pool, box["c"], khi, klo, root_rep, active, start, inv)
+                pool, box["c"], khi, klo, root_rep, active, start,
+                inv_s)
 
             def verify_loop(k):
                 rc = tuple(new_carry()[1:])
                 for _ in range(k):
-                    rc = jverify(rc, skhi, sklo, f, vh, vl, nu)
+                    if use_cache:
+                        rc = jverify(rc, skhi, sklo, f, vh, vl, nu,
+                                     inv, hit, cvhi, cvlo, nr)
+                    else:
+                        rc = jverify(rc, skhi, sklo, f, vh, vl, nu)
                 jax.block_until_ready(rc)
 
             out["verify"] = _delta_ms(verify_loop, reps)
@@ -848,7 +1025,10 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                 # OVERLAP RECEIPT (:func:`overlap_receipt`): the
                 # drained pipelined wall per step (same chained-delta
                 # method) against the serial sum of the standalone
-                # phase walls just measured
+                # phase walls just measured.  The cache probe sits on
+                # the prep side of the serve bound (it must finish
+                # before the serve's active mask exists), so its wall
+                # folds into the prep term.
                 def pipe_loop(k):
                     c = new_carry()
                     for _ in range(k):
@@ -862,7 +1042,8 @@ def make_staged_step(eng, *, n_keys: int, theta: float, salt: int,
                 # entries) so no trace lands inside the delta
                 pipe_loop(2)
                 out.update(overlap_receipt(
-                    out["prep"], out["serve_fanout"], out["verify"],
+                    out["prep"] + out.get("cache_probe", 0.0),
+                    out["serve_fanout"], out["verify"],
                     _delta_ms(pipe_loop, reps)))
         else:  # chained
 
@@ -947,7 +1128,14 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
       still happens in serve order (the pipeline reorders only the
       RECEIPTS fold, never the pool writes).  Same arithmetic, same
       fold order: after ``step.drain`` the carry is bit-identical to
-      ``chained``'s."""
+      ``chained``'s.
+
+    The hot-key leaf cache deliberately stays OUT of this loop: its
+    write half re-stamps the hot keys every step, so cached entries
+    would invalidate as fast as they fill (the read-only staged loop
+    and the engine's host ``mixed`` entry point are the cache's
+    consumers; a mixed-loop A/B belongs behind its own receipt if the
+    read ratio ever skews high enough to pay)."""
     import jax
     import jax.numpy as jnp
     from jax import lax

@@ -1,0 +1,563 @@
+"""Hot-key tier (models/leaf_cache.py) fast tier: bit-identity with the
+uncached path under read/write/delete/split storms, stale-version
+invalidation, degraded/quarantine/repair flushes, the sealed staged
+loop's zero-retrace pin with the cache_probe program chained in, and a
+chaos round — flipped entry-version faults must cause MISSES, never
+wrong answers (the validation gather is the authoritative guard; the
+cached version pair is the coherence token).
+"""
+
+import numpy as np
+import pytest
+
+from sherman_tpu import chaos as CH
+from sherman_tpu import obs
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig, TreeConfig
+from sherman_tpu.models import batched, leaf_cache as LC
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.models.scrub import Scrubber
+from sherman_tpu.ops import bits
+from sherman_tpu.workload.zipf import ZipfGen, expected_hit_ratio
+
+SALT = 0x5E17_AB1E_5A17
+
+
+def make(nr=1, pages=2048, cap=512, B=256, **tcfg):
+    cfg = DSMConfig(machine_nr=nr, pages_per_node=pages,
+                    locks_per_node=512, step_capacity=cap, chunk_pages=32)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B,
+                                tcfg=TreeConfig(**tcfg) if tcfg else None)
+    return cluster, tree, eng
+
+
+def load(tree, eng, n=3000, step=3, router=True):
+    keys = np.arange(100, 100 + n * step, step, dtype=np.uint64)
+    vals = keys * np.uint64(7)
+    batched.bulk_load(tree, keys, vals)
+    if router:
+        eng.attach_router()
+    return keys, vals
+
+
+# -- hash + analytic helpers --------------------------------------------------
+
+def test_slot_hash_np_matches_device(eight_devices):
+    import jax
+    rng = np.random.default_rng(3)
+    khi = rng.integers(-2**31, 2**31, 257, dtype=np.int64).astype(np.int32)
+    klo = rng.integers(-2**31, 2**31, 257, dtype=np.int64).astype(np.int32)
+    dev = np.asarray(jax.jit(LC.slot_hash)(khi, klo))
+    np.testing.assert_array_equal(dev, LC.slot_hash_np(khi, klo))
+
+
+def test_expected_hit_ratio_shape():
+    n, th = 100_000, 0.99
+    assert expected_hit_ratio(n, th, 0) == 0.0
+    assert expected_hit_ratio(n, th, n) == pytest.approx(1.0)
+    r = [expected_hit_ratio(n, th, k) for k in (10, 100, 1000, 10_000)]
+    assert all(a < b for a, b in zip(r, r[1:]))  # CDF is monotone
+    # hottest 1% of a theta-0.99 keyspace absorbs the majority of reads
+    assert expected_hit_ratio(n, th, n // 100) > 0.5
+    assert expected_hit_ratio(n, 0.0, n // 4) == pytest.approx(0.25)
+
+
+# -- probe correctness --------------------------------------------------------
+
+def test_probe_hits_are_bit_identical(eight_devices):
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=1024)
+    hot = keys[:300]
+    r = cache.fill(hot)
+    assert r["placed"] == 300 and cache.stats()["cached_keys"] == 300
+    # uncached twin answers first (same engine, cache detached)
+    eng.detach_leaf_cache()
+    v0, f0 = eng.search(keys[:600])
+    eng.leaf_cache = cache
+    v1, f1 = eng.search(keys[:600])
+    np.testing.assert_array_equal(v0, v1)
+    np.testing.assert_array_equal(f0, f1)
+    st = cache.stats()
+    assert st["hits"] == 300 and st["misses"] == 300
+    assert st["hit_ratio"] == pytest.approx(0.5)
+    # absent keys miss cleanly through the cache too
+    v, f = eng.search(keys[:4] + np.uint64(1))
+    assert not f.any() and (v == 0).all()
+
+
+def test_probe_multinode_mesh(eight_devices):
+    _, tree, eng = make(nr=4, B=128)
+    keys, vals = load(tree, eng, n=2000)
+    cache = eng.attach_leaf_cache(slots=1024)
+    assert cache.fill(keys[:256])["placed"] == 256
+    v, f = eng.search(keys[:512])
+    assert f.all()
+    np.testing.assert_array_equal(v, vals[:512])
+    assert cache.stats()["hits"] == 256
+    # combined path on the same mesh: duplicate-heavy client batch
+    dup = np.concatenate([keys[:64]] * 6)
+    v2, f2 = eng.search_combined(dup)
+    assert f2.all()
+    np.testing.assert_array_equal(v2, dup * np.uint64(7))
+
+
+def test_search_combined_merges_hits_per_client(eight_devices):
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:100])
+    # interleave hot (cached), cold (uncached), and absent keys
+    cli = np.concatenate([keys[:100], keys[500:550], keys[:100],
+                          np.array([keys[7] + np.uint64(1)], np.uint64)])
+    rng = np.random.default_rng(5)
+    perm = rng.permutation(cli.size)
+    v, f = eng.search_combined(cli[perm])
+    exp_f = np.concatenate([np.ones(250, bool), np.zeros(1, bool)])[perm]
+    np.testing.assert_array_equal(f, exp_f)
+    np.testing.assert_array_equal(v[f], (cli[perm] * np.uint64(7))[f])
+
+
+def test_stale_after_write_serves_new_value(eight_devices):
+    """Write to a cached key: the invalidation hook drops it, and even
+    a raced probe can never serve the old value (the validation gather
+    sees the bumped entry version)."""
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:100])
+    inv0 = cache.invalidations
+    eng.insert(keys[:10], keys[:10] * np.uint64(99))
+    assert cache.invalidations >= inv0 + 10  # write-path hook fired
+    v, f = eng.search(keys[:20])
+    assert f.all()
+    np.testing.assert_array_equal(v[:10], keys[:10] * np.uint64(99))
+    np.testing.assert_array_equal(v[10:], vals[10:20])
+
+
+def test_validation_catches_unhooked_writes(eight_devices):
+    """Bypass the invalidation hooks entirely (host-mirror left stale on
+    purpose): the pool-validation step alone must keep results right."""
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:100])
+    hooked = cache.invalidate_keys
+    cache.invalidate_keys = lambda ks: 0  # sabotage the hook
+    try:
+        eng.insert(keys[:10], keys[:10] * np.uint64(55))
+        v, f = eng.search(keys[:10])
+        assert f.all()
+        np.testing.assert_array_equal(v, keys[:10] * np.uint64(55))
+        assert cache.invalidations > 0  # stale probes self-invalidated
+    finally:
+        cache.invalidate_keys = hooked
+
+
+def test_delete_then_reinsert_bit_identity(eight_devices):
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:100])
+    assert eng.delete(keys[:50]).all()
+    v, f = eng.search(keys[:100])
+    assert not f[:50].any() and f[50:].all()
+    eng.insert(keys[:50], keys[:50] * np.uint64(3))
+    cache.fill(keys[:100])  # re-admit after churn
+    v, f = eng.search(keys[:100])
+    assert f.all()
+    np.testing.assert_array_equal(v[:50], keys[:50] * np.uint64(3))
+    np.testing.assert_array_equal(v[50:], vals[50:100])
+    assert cache.stats()["hits"] > 0
+
+
+def test_mixed_reads_probe_and_writes_invalidate(eight_devices):
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:100])
+    n_r, n_w = 100, 60
+    mk = np.concatenate([keys[:n_r], keys[200:200 + n_w]])
+    mv = np.concatenate([np.zeros(n_r, np.uint64),
+                         keys[200:200 + n_w] * np.uint64(13)])
+    is_read = np.concatenate([np.ones(n_r, bool), np.zeros(n_w, bool)])
+    h0 = cache.hits
+    out_v, out_f, st = eng.mixed(mk, mv, is_read)
+    assert out_f[:n_r].all()
+    np.testing.assert_array_equal(out_v[:n_r], vals[:n_r])
+    assert (st[n_r:] == batched.ST_APPLIED).sum() == n_w
+    assert cache.hits > h0  # reads served from cache
+    # the written keys must serve their new values afterwards
+    v, f = eng.search(keys[200:200 + n_w])
+    assert f.all()
+    np.testing.assert_array_equal(v, keys[200:200 + n_w] * np.uint64(13))
+
+
+def test_storm_bit_identity_with_splits(eight_devices):
+    """Mixed read/write/delete/split storm (the test_split_storm dense-
+    cluster shape): cached results must match the model through leaf
+    splits, churn and re-admission."""
+    _, tree, eng = make(nr=4, pages=8192, cap=512, B=256)
+    coarse = np.arange(1 << 20, 1 << 21, 1 << 13, dtype=np.uint64)
+    batched.bulk_load(tree, coarse, coarse)
+    eng.attach_router()
+    cache = eng.attach_leaf_cache(slots=1024)
+    model = {int(k): int(k) for k in coarse}
+    rng = np.random.default_rng(9)
+    for wave in range(2):
+        cache.fill(np.array(sorted(model)[:cache.capacity], np.uint64))
+        # dense inserts inside every gap: every leaf in range splits
+        dense = (coarse[:, None]
+                 + rng.integers(1, 1 << 13, (coarse.shape[0], 10),
+                                dtype=np.uint64)).reshape(-1)
+        dense = np.unique(dense)
+        vals = dense + np.uint64(wave + 1)
+        eng.insert(dense, vals)
+        for k, v in zip(dense.tolist(), vals.tolist()):
+            model[int(k)] = int(v)
+        doomed = rng.choice(dense, 40, replace=False)
+        eng.delete(doomed)
+        for k in np.unique(doomed).tolist():
+            model.pop(int(k), None)
+        sample = rng.choice(np.array(sorted(model), np.uint64), 600)
+        v, f = eng.search(sample)
+        assert f.all()
+        np.testing.assert_array_equal(
+            v, np.array([model[int(k)] for k in sample], np.uint64))
+        # mixed round over the same storm state
+        mr = rng.choice(np.array(sorted(model), np.uint64), 200)
+        mw = rng.choice(dense, 100, replace=False)
+        mwv = mw + np.uint64(wave + 7)
+        out_v, out_f, _ = eng.mixed(
+            np.concatenate([mr, mw]),
+            np.concatenate([np.zeros(200, np.uint64), mwv]),
+            np.concatenate([np.ones(200, bool), np.zeros(100, bool)]))
+        assert out_f[:200].all()
+        np.testing.assert_array_equal(
+            out_v[:200],
+            np.array([model[int(k)] for k in mr], np.uint64))
+        # mixed writes are UPSERTS: a wave-deleted key written here is
+        # re-inserted, so the model updates unconditionally
+        for k, v2 in zip(mw.tolist(), mwv.tolist()):
+            model[int(k)] = int(v2)
+    assert cache.stats()["hits"] > 0
+    tree.check_structure()
+
+
+def test_admission_observe_warms_cache(eight_devices):
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    eng.attach_leaf_cache(slots=512, admit_every=2)
+    zipf = ZipfGen(keys.size, 0.99, seed=4)
+    for _ in range(4):
+        batch = keys[zipf.sample(400)]
+        v, f = eng.search(batch)
+        assert f.all()
+        np.testing.assert_array_equal(v, batch * np.uint64(7))
+    st = eng.leaf_cache.stats()
+    assert st["fills"] >= 1 and st["cached_keys"] > 0
+    assert st["hits"] > 0  # the admitted hot set serves repeats
+
+
+# -- chaos: flipped entry versions must miss, never lie ----------------------
+
+def test_chaos_flipped_entry_version_misses_not_lies(eight_devices):
+    cluster, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:100])
+    # pick a CACHED victim and flip its exact slot's fver half
+    i = 7
+    with cache._lock:
+        j = int(np.nonzero(cache._keys == keys[i])[0][0])
+        victim, slot = int(cache._addr[j]), int(cache._slot[j])
+    plan = CH.FaultPlan([CH.Fault(kind="flip_entry_ver", step=0,
+                                  addr=victim, slot=slot)])
+    cluster.dsm.install_chaos(plan)
+    cluster.dsm.read_word(0, 0)
+    cluster.dsm.install_chaos(None)
+    inv0 = cache.invalidations
+    v, f = eng.search(keys[:100])
+    # uncached semantics: a torn slot is not live -> not found; every
+    # other key unaffected.  The cache must agree (miss), never serve
+    # the old value as "found".
+    assert not f[i]
+    exp = np.ones(100, bool)
+    exp[i] = False
+    np.testing.assert_array_equal(f, exp)
+    np.testing.assert_array_equal(v[exp], vals[:100][exp])
+    assert cache.invalidations > inv0  # the stale slot dropped out
+    # repair the fault: the key serves again (descent), and a refill
+    # re-admits it
+    plan.undo(cluster.dsm)
+    v, f = eng.search(keys[i:i + 1])
+    assert f.all() and v[0] == int(vals[i])
+
+
+def test_chaos_fuzz_never_wrong_answers(eight_devices):
+    """Random fault storms against a cache-on engine: every search
+    either agrees with the model or reports not-found (detection is the
+    scrubber's job — the cache must never turn a fault into a WRONG
+    value)."""
+    cluster, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    for round_i in range(3):
+        cache.fill(keys[:200])
+        plan = CH.FaultPlan.random(100 + round_i, n_faults=3)
+        cluster.dsm.install_chaos(plan)
+        cluster.dsm.read_word(0, 0)
+        cluster.dsm.install_chaos(None)
+        v, f = eng.search(keys[:400])
+        ok = v[f] == vals[:400][f]
+        assert ok.all(), "cache served a corrupted/wrong value"
+        plan.undo(cluster.dsm)
+
+
+# -- flush contracts ----------------------------------------------------------
+
+def test_degraded_entry_flushes_cache(eight_devices):
+    _, tree, eng = make()
+    keys, vals = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:100])
+    assert cache.stats()["cached_keys"] == 100
+    eng.enter_degraded("test: synthetic damage")
+    assert cache.stats()["cached_keys"] == 0
+    v, f = eng.search(keys[:50])  # reads still serve, via descent
+    assert f.all()
+    np.testing.assert_array_equal(v, vals[:50])
+    eng.exit_degraded()
+
+
+def test_quarantine_drops_page_keys(eight_devices):
+    """An entry-level scrub violation (contained, not degraded) must
+    still drop the quarantined page's keys from the cache."""
+    cluster, tree, eng = make(nr=4, pages=1024, cap=256, B=128)
+    keys, vals = load(tree, eng, n=1500)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:200])
+    with cache._lock:
+        j = int(np.nonzero(cache._keys == keys[50])[0][0])
+        victim = int(cache._addr[j])
+        on_page = int(((cache._addr == victim)
+                       & (cache._keys != 0)).sum())
+    assert on_page >= 1
+    plan = CH.FaultPlan([CH.Fault(kind="flip_entry_ver", step=0,
+                                  addr=victim, slot=2)])
+    cluster.dsm.install_chaos(plan)
+    cluster.dsm.read_word(0, 0)
+    cluster.dsm.install_chaos(None)
+    scr = Scrubber(eng, interval=1)
+    res = scr.scrub()
+    assert res["new_violations"] >= 1 and not eng.degraded
+    with cache._lock:
+        assert not ((cache._addr == victim) & (cache._keys != 0)).any()
+    plan.undo(cluster.dsm)
+    scr.release_quarantine()
+
+
+def test_targeted_repair_flushes_cache(eight_devices, tmp_path):
+    """The volatility contract across the recovery plane: targeted
+    repair restarts the cache cold (degraded entry already flushed it;
+    the repair flush pins the contract on its own)."""
+    from sherman_tpu.recovery import RecoveryPlane
+    cluster, tree, eng = make(nr=4, pages=1024, cap=256, B=128,
+                              sibling_chase_budget=4, lock_retry_rounds=2)
+    keys, vals = load(tree, eng, n=1200)
+    cache = eng.attach_leaf_cache(slots=512)
+    plane = RecoveryPlane(cluster, tree, eng, str(tmp_path / "r"))
+    plane.checkpoint_base()
+    cache.fill(keys[:100])
+    victim = int(tree._descend(int(keys[600]))[0])
+    scr = Scrubber(eng, interval=1)
+    plan = CH.FaultPlan([CH.Fault(kind="torn_page", step=0,
+                                  addr=victim)])
+    cluster.dsm.install_chaos(plan)
+    cluster.dsm.read_word(0, 0)
+    cluster.dsm.install_chaos(None)
+    assert scr.scrub()["violations"] >= 1 and eng.degraded
+    assert cache.stats()["cached_keys"] == 0  # degraded entry flushed
+    cache.fill(keys[:50])  # a racing refill during degraded serving
+    rep = plane.targeted_repair(scr)
+    assert rep["ok"] and not eng.degraded
+    assert cache.stats()["cached_keys"] == 0  # repair flushed again
+    v, f = eng.search(keys[:200])
+    assert f.all()
+    np.testing.assert_array_equal(v, vals[:200])
+    plane.close()
+
+
+# -- the sealed staged serving loop ------------------------------------------
+
+def _staged_tree(B=2048, n_keys=20_000):
+    cfg = DSMConfig(machine_nr=1, pages_per_node=2048, locks_per_node=512,
+                    step_capacity=B, chunk_pages=32)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B)
+    ranks = np.arange(n_keys, dtype=np.uint64)
+    keys = bits.mix64_np(ranks ^ np.uint64(SALT))
+    order = np.argsort(keys)
+    batched.bulk_load(tree, keys[order],
+                      (keys ^ np.uint64(0xDEADBEEF))[order], fill=0.8)
+    eng.attach_router()
+    return eng, n_keys, B
+
+
+@pytest.mark.parametrize("fusion", ["aligned", "pipelined"])
+def test_staged_cache_receipts_bit_identical(eight_devices, fusion):
+    """Cache-on staged receipts (base fields) == cache-off, hits > 0,
+    measured hit ratio within a few points of the zipf prediction, and
+    the sealed window stays zero-retrace with the probe chained in."""
+    import jax
+    from sherman_tpu.obs import device as DEV
+    from sherman_tpu.workload.device_prep import make_staged_step
+
+    eng, n_keys, B = _staged_tree()
+    S = 4
+    out = {}
+    for label in ("off", "on"):
+        lc = None
+        if label == "on":
+            lc = eng.attach_leaf_cache(slots=2048)
+            hot = bits.mix64_np(np.arange(lc.capacity, dtype=np.uint64)
+                                ^ np.uint64(SALT))
+            placed = lc.fill(hot)["placed"]
+        step, (new_carry, tb, rt, rk) = make_staged_step(
+            eng, n_keys=n_keys, theta=0.99, salt=SALT, batch=B, dev_b=B,
+            log2_bins=16, fusion=fusion, leaf_cache=lc)
+        if lc is not None:
+            assert step.phase_labels["cache_probe"] == "staged.cache_probe"
+            assert step.jserve is eng._get_search_fanout(eng._iters())
+        carry = new_carry()
+        counters = eng.dsm.counters
+        counters, carry = step(eng.dsm.pool, counters, tb, rt, rk, carry)
+        counters, carry = step(eng.dsm.pool, counters, tb, rt, rk, carry)
+        carry = step.drain(carry)
+        jax.block_until_ready(carry)
+        ledger = DEV.get_ledger()
+        with ledger.sealed_scope():
+            r0 = ledger.retraces
+            for _ in range(S):
+                counters, carry = step(eng.dsm.pool, counters, tb, rt,
+                                       rk, carry)
+            carry = step.drain(carry)
+            jax.block_until_ready(carry)
+        assert ledger.retraces == r0, "retrace inside the sealed window"
+        eng.dsm.counters = counters
+        vals = tuple(int(np.asarray(x)) for x in carry)
+        assert vals[1] == 1 and vals[2] == (S + 2) * B
+        out[label] = vals[:5]
+        if lc is not None:
+            hits_c, hits_u = vals[5], vals[6]
+            assert hits_c > 0 and hits_u > 0
+            measured = hits_c / ((S + 2) * B)
+            pred = expected_hit_ratio(n_keys, 0.99, placed)
+            assert abs(measured - pred) < 0.05, (measured, pred)
+        eng.detach_leaf_cache()
+    assert out["off"] == out["on"], out
+
+
+def test_staged_cache_residual_cap_tightens_and_overflow_voids(
+        eight_devices):
+    """dev_b_resid: a cap sized to the measured misses keeps receipts
+    green; an undersized cap VOIDS the phase through the ok receipt
+    (the dev_b overflow contract's twin) — never wrong answers."""
+    import jax
+    from sherman_tpu.workload.device_prep import make_staged_step
+
+    eng, n_keys, B = _staged_tree()
+    lc = eng.attach_leaf_cache(slots=2048)
+    hot = bits.mix64_np(np.arange(lc.capacity, dtype=np.uint64)
+                        ^ np.uint64(SALT))
+    lc.fill(hot)
+
+    def run(resid, steps=3):
+        step, (new_carry, tb, rt, rk) = make_staged_step(
+            eng, n_keys=n_keys, theta=0.99, salt=SALT, batch=B,
+            dev_b=B, log2_bins=16, fusion="aligned", leaf_cache=lc,
+            dev_b_resid=resid)
+        carry = new_carry()
+        counters = eng.dsm.counters
+        for _ in range(steps):
+            counters, carry = step(eng.dsm.pool, counters, tb, rt, rk,
+                                   carry)
+        jax.block_until_ready(carry)
+        eng.dsm.counters = counters
+        return tuple(int(np.asarray(x)) for x in carry)
+
+    full = run(B)  # width = dev_b: overflow impossible
+    assert full[1] == 1 and full[2] == 3 * B
+    resid_per_step = (full[3] - full[6]) // 3
+    ok_cap = min(B, int(resid_per_step * 1.3))
+    tight = run(ok_cap)
+    assert tight[1] == 1 and tight[2] == 3 * B
+    assert tight[:5] == full[:5]  # receipts identical at the tight cap
+    void = run(max(1, resid_per_step // 4))  # starved cap
+    assert void[1] == 0  # phase VOIDED, not silently wrong
+
+
+def test_staged_cache_requires_aligned_or_pipelined(eight_devices):
+    from sherman_tpu.errors import ConfigError
+    from sherman_tpu.workload.device_prep import make_staged_step
+
+    eng, n_keys, B = _staged_tree(B=512, n_keys=4000)
+    lc = eng.attach_leaf_cache(slots=256)
+    with pytest.raises(ConfigError):
+        make_staged_step(eng, n_keys=n_keys, theta=0.99, salt=SALT,
+                         batch=B, dev_b=B, log2_bins=14,
+                         fusion="chained", leaf_cache=lc)
+
+
+def test_device_report_zero_retrace_with_cache(eight_devices,
+                                               monkeypatch, capsys):
+    """tools/device_report.py live mode with SHERMAN_LEAF_CACHE on: the
+    sealed steady-state loop must observe ZERO compiles with the
+    cache_probe program chained in (the zero-retrace pin of the
+    cache-on serving loop), and must have served real hits."""
+    import importlib
+    import os
+    tools_dir = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools")
+    monkeypatch.syspath_prepend(tools_dir)
+    for k, v in (("KEYS", "8000"), ("B", "2048"), ("DEVB", "2048"),
+                 ("K", "1"), ("STEPS", "4"), ("FUSION", "aligned"),
+                 ("SHERMAN_LEAF_CACHE", "1024"),
+                 ("SHERMAN_BENCH_DEVICE_MEMORY", "0")):
+        monkeypatch.setenv(k, v)
+    device_report = importlib.import_module("device_report")
+    out = device_report.main([])
+    assert out["retraces"] == 0
+    assert out["cache"] is not None and out["cache"]["hit_ratio"] > 0
+
+
+def test_cache_collector_in_snapshot(eight_devices):
+    _, tree, eng = make()
+    keys, _ = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=512)
+    cache.fill(keys[:50])
+    eng.search(keys[:100])
+    snap = obs.snapshot()
+    assert snap["cache.hits"] == 50
+    assert snap["cache.misses"] == 50
+    assert snap["cache.hit_ratio"] == pytest.approx(0.5)
+    assert snap["cache.cached_keys"] == 50
+    assert {"cache.invalidations", "cache.evictions"} <= set(snap)
+
+
+def test_fill_eviction_and_window_overflow_accounting(eight_devices):
+    _, tree, eng = make()
+    keys, _ = load(tree, eng)
+    cache = eng.attach_leaf_cache(slots=64)  # capacity 32
+    r1 = cache.fill(keys[:32])
+    assert r1["placed"] + r1["failed"] == 32
+    ev0 = cache.evictions
+    r2 = cache.fill(keys[100:132])  # full turnover
+    assert r2["placed"] > 0
+    assert cache.evictions >= ev0 + r1["placed"]
+    # absent keys resolve to nothing and never occupy slots
+    r3 = cache.fill(keys[:8] + np.uint64(1))
+    assert r3["resolved"] == 0 and cache.stats()["cached_keys"] == 0

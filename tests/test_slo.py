@@ -479,6 +479,31 @@ def test_perfgate_incomparable_receipt_exits_2(tmp_path):
     assert pg.main(["--receipt", p]) == 2
 
 
+def test_perfgate_cache_on_never_gates_against_cache_off(tmp_path,
+                                                         capsys):
+    """Round-10 comparability rule: the hot-key `cache` block is
+    config metadata — a cache-ON receipt's sustained_ops_s (most ops
+    never descend) must SKIP, not gate, against the cache-off
+    trajectory, even when the number would otherwise read as a
+    regression; the symmetric throughput metrics still gate."""
+    pg = _perfgate()
+    cand = pg.load_receipt(os.path.join(_repo_root(), "BENCH_r05.json"))
+    cand.pop("_round", None)
+    cand["cache"] = {"enabled": True, "slots": 65536,
+                     "hit_ratio": 0.79, "hit_ratio_pred": 0.79}
+    cand["sustained_ops_s"] = round(cand["sustained_ops_s"] * 0.5)
+    p = str(tmp_path / "cache_on.json")
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 0  # halved sustained: skipped
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "skipped" in res["metrics"]["sustained_ops_s"]
+    # and the rule is symmetric config-matching, not a blanket skip:
+    # with the cache OFF the same number is a real regression
+    cand.pop("cache")
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 1
+
+
 def test_perfgate_red_on_steady_state_retraces(tmp_path, capsys):
     """Schema-3 device gate: a receipt whose compile ledger counted a
     retrace inside a sealed window fails HARD (no noise margin) even

@@ -23,6 +23,9 @@ Env knobs (live mode): KEYS (20 K), B (8192), DEVB (B), K (delta reps,
 set the roofs on devices the peak table does not know (absolute
 achieved rates print otherwise — fractions are never invented).
 ``SHERMAN_BENCH_DEVICE_MEMORY=0`` skips per-program memory_analysis.
+``SHERMAN_LEAF_CACHE`` runs the sealed loop with the hot-key tier's
+``cache_probe`` program chained in (prefilled with the hottest ranks)
+— the zero-retrace pin then covers the cache-on serving loop.
 
 Output (the profile_gather/profile_staged2 conventions): the ledger
 table (program, compiles, compile ms, retraces), the roofline table
@@ -167,9 +170,21 @@ def _live_report() -> dict:
     eng.attach_router()
     print(f"# bulk_load {time.time() - t0:.1f}s", file=sys.stderr)
 
+    # hot-key tier (SHERMAN_LEAF_CACHE): run the sealed loop with the
+    # cache_probe program chained in — the zero-retrace pin then covers
+    # the cache-on serving loop (fixed table shapes by construction)
+    lc = None
+    if C.leaf_cache_slots():
+        lc = eng.attach_leaf_cache()
+        hot = bits.mix64_np(
+            np.arange(min(lc.capacity, n_keys), dtype=np.uint64)
+            ^ np.uint64(salt))
+        filled = lc.fill(hot)
+        print(f"# leaf cache: {lc.slots} slots, prefilled "
+              f"{filled['placed']} hottest ranks", file=sys.stderr)
     step, (new_carry, tb, rt, rk) = device_prep.make_staged_step(
         eng, n_keys=n_keys, theta=theta, salt=salt, batch=batch,
-        dev_b=dev_b, sampler=sampler, fusion=fusion)
+        dev_b=dev_b, sampler=sampler, fusion=fusion, leaf_cache=lc)
     dsm = eng.dsm
     pool, counters = dsm.pool, dsm.counters
 
@@ -194,6 +209,13 @@ def _live_report() -> dict:
     assert int(np.asarray(carry[2])) == (S + 2) * batch, \
         "staged receipts failed"
     retraces = ledger.retraces
+    cache_hit_ratio = None
+    if lc is not None:
+        hits = int(np.asarray(carry[5]))
+        cache_hit_ratio = hits / ((S + 2) * batch)
+        print(f"# leaf cache: {hits} client hits "
+              f"(ratio {cache_hit_ratio:.4f})", file=sys.stderr)
+        assert hits > 0, "cache-on sealed loop served zero hits"
     print(f"# sealed loop: {S} steps in {wall:.3f}s "
           f"({wall / S * 1e3:.2f} ms/step), {retraces} retraces",
           file=sys.stderr)
@@ -219,7 +241,11 @@ def _live_report() -> dict:
     out = {"metric": "device_report", "fusion": step.fusion,
            "keys": n_keys, "batch": batch, "steps": S,
            "wall_ms_per_step": round(wall / S * 1e3, 3),
-           "retraces": retraces, "device": dev}
+           "retraces": retraces,
+           "cache": ({"slots": lc.slots,
+                      "hit_ratio": round(cache_hit_ratio, 4)}
+                     if lc is not None else None),
+           "device": dev}
     print(json.dumps(out))
     # the pin itself: a live report with a steady-state retrace is a
     # broken serving loop, not a report

@@ -26,6 +26,10 @@ Comparability rules (the trajectory's own lessons):
 - ``sustained_ops_s`` compares only between device-staged runs (both
   sides must carry ``sus_dev_ms_per_step``): r04's host-shipped 3.9 M
   is a different methodology and must never become the baseline;
+- the hot-key leaf cache (the optional schema-3 ``cache`` block) is
+  comparable-config metadata: a cache-ON receipt's ``sustained_ops_s``
+  never gates against a cache-OFF round's and vice versa — most ops of
+  a cache-ON loop never descend, a different workload per step;
 - a metric missing on either side is skipped, not failed — but a
   candidate with NO comparable metric at all exits 2 (the gate cannot
   vouch for it).
@@ -122,6 +126,14 @@ def _device_fracs(r: dict) -> dict:
     return out
 
 
+def _cache_on(r: dict) -> bool:
+    """True when the receipt's device-staged loop ran with the hot-key
+    leaf cache enabled (the optional schema-3 ``cache`` block; absent
+    block = cache off — every pre-cache round)."""
+    c = r.get("cache")
+    return bool(isinstance(c, dict) and c.get("enabled"))
+
+
 def _comparable(cand: dict, r: dict, metric: str) -> bool:
     if r.get("keys") != cand.get("keys") \
             or r.get("batch") != cand.get("batch"):
@@ -133,6 +145,13 @@ def _comparable(cand: dict, r: dict, metric: str) -> bool:
         # sustained number is not this metric's baseline)
         if not r.get("sus_dev_ms_per_step") \
                 or not cand.get("sus_dev_ms_per_step"):
+            return False
+        # hot-key-cache comparability: the ``cache`` block is
+        # comparable-config METADATA, not a gated number — a cache-ON
+        # sustained loop serves most ops without descending, so it
+        # never gates against a cache-OFF round (and vice versa; the
+        # same rule as device-staged-vs-device-staged above)
+        if _cache_on(r) != _cache_on(cand):
             return False
     return True
 

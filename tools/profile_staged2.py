@@ -33,7 +33,13 @@ the attribution tool for that gap:
 
 Env knobs: KEYS (10 M), B (4 M), DEVB, K (delta reps, 8), FUSION,
 SAMPLER (analytic), W (dispatch window, 8), STEPS (pipelined steps, 24),
-MODES (mode-wall table, default "aligned,pipelined"; "" disables).
+MODES (mode-wall table, default "aligned,pipelined"; "" disables; a
+"+cache" suffix — e.g. "aligned+cache" — runs that mode with the
+hot-key leaf cache's probe program chained in and the residual serve
+width sized from a 2-step warmup's measured misses (RESID env
+overrides), attributed with its own cache_probe/residual-serve phase
+walls so the probe cost AND the serve shrink are priced next to the
+uncached modes).
 """
 
 import json
@@ -269,16 +275,75 @@ def main():
     modes = {}
     if modes_env.strip():
         want = [m.strip() for m in modes_env.split(",") if m.strip()]
+        # "+cache" suffix (e.g. "aligned+cache"): the same fusion mode
+        # with the hot-key leaf cache's probe program chained in, so
+        # the probe's cost is attributable per phase next to the
+        # uncached walls.  The cache is built once, prefilled with the
+        # analytically hottest ranks (the zipf sampler's own ranking).
+        lc_box = {"lc": None}
+
+        def _leaf_cache():
+            if lc_box["lc"] is None:
+                lc = eng.attach_leaf_cache()
+                lc.fill(bits.mix64_np(
+                    np.arange(min(lc.capacity, n_keys),
+                              dtype=np.uint64) ^ np.uint64(salt)))
+                lc_box["lc"] = lc
+            return lc_box["lc"]
+
         by_mode = {}
-        for mode in want:
-            if mode == fusion:
-                by_mode[mode] = (step, new_carry)
+        for spec_m in want:
+            base_m, _, suffix = spec_m.partition("+")
+            if suffix not in ("", "cache"):
+                raise SystemExit(f"MODES entry {spec_m!r}: want "
+                                 "<fusion> or <fusion>+cache")
+            cache_on = suffix == "cache"
+            resid = None
+            if cache_on:
+                # size the residual serve width from a 2-step warmup of
+                # a full-width sizing build (bench.py's cap-tightening
+                # dance — the serve must SHRINK for the hits to pay;
+                # RESID env overrides).  Overflow voids via the ok
+                # receipt, which windowed_wall asserts on.
+                resid_env = os.environ.get("RESID")
+                if resid_env:
+                    resid = int(resid_env)
+                else:
+                    sz, (nc_sz, *_r) = device_prep.make_staged_step(
+                        eng, n_keys=n_keys, theta=theta, salt=salt,
+                        batch=batch, dev_b=dev_b, sampler=sampler,
+                        fusion=base_m,
+                        staged=(table_d, rtable_d, rkey_d),
+                        leaf_cache=_leaf_cache())
+                    c_sz = nc_sz()
+                    cbox = {"c": counters}
+                    for _ in range(2):
+                        cbox["c"], c_sz = sz(pool, cbox["c"], table_d,
+                                             rtable_d, rkey_d, c_sz)
+                    c_sz = sz.drain(c_sz)
+                    jax.block_until_ready(c_sz)
+                    counters = cbox["c"]
+                    miss = (int(np.asarray(c_sz[3]))
+                            - int(np.asarray(c_sz[6]))) // 2
+                    # quantum scales down with dev_b so smoke-scale
+                    # runs still show a real shrink (bench.py's 8192
+                    # matters only at its multi-M widths)
+                    q = min(8192, max(256, dev_b // 8))
+                    resid = min(dev_b,
+                                -(-int(max(1, miss) * 1.05) // q) * q)
+                    print(f"# {spec_m}: residual serve width {resid} "
+                          f"of {dev_b} ({miss} measured misses/step)",
+                          file=sys.stderr)
+            if base_m == fusion and not cache_on:
+                by_mode[spec_m] = (step, new_carry)
             else:
                 s2, (nc2, *_r) = device_prep.make_staged_step(
                     eng, n_keys=n_keys, theta=theta, salt=salt,
                     batch=batch, dev_b=dev_b, sampler=sampler,
-                    fusion=mode, staged=(table_d, rtable_d, rkey_d))
-                by_mode[mode] = (s2, nc2)
+                    fusion=base_m, staged=(table_d, rtable_d, rkey_d),
+                    leaf_cache=_leaf_cache() if cache_on else None,
+                    dev_b_resid=resid)
+                by_mode[spec_m] = (s2, nc2)
         if {"prep", "serve_fanout", "verify"} <= set(phase_ms):
             attr = phase_ms
         else:  # anatomy ran chained/fused: attribute the shared
@@ -298,24 +363,39 @@ def main():
               f"{serial:.1f} ms = prep {attr['prep']:.1f} + serve "
               f"{attr['serve_fanout']:.1f} + verify "
               f"{attr['verify']:.1f})", file=sys.stderr)
-        print(f"# {'mode':12s} {'wall_ms':>9s} {'bubble_ms':>10s} "
+        print(f"# {'mode':16s} {'wall_ms':>9s} {'bubble_ms':>10s} "
               f"{'overlap_eff':>12s}", file=sys.stderr)
+        attr_cache = None  # one shared attribution per cache-ness
         for mode in want:
             s2, nc2 = by_mode[mode]
+            cache_on = bool(getattr(s2, "cache", False))
+            if cache_on and attr_cache is None:
+                # cache modes get their OWN attribution: the serve
+                # phase measures the RESIDUAL batch and cache_probe is
+                # a fourth program
+                with obs.span("profile.mode_attribution_cache", reps=K):
+                    attr_cache, counters = s2.phase_profile(
+                        pool, counters, table_d, rtable_d, rkey_d,
+                        reps=K)
+            a = attr_cache if cache_on else attr
             cbox = {"c": counters}
             wall = (full_ms if mode == fusion else windowed_wall(
                 s2, nc2, cbox, f"profile.mode_wall_{mode}"))
             counters = cbox["c"]
             rec = device_prep.overlap_receipt(
-                attr["prep"], attr["serve_fanout"], attr["verify"],
-                wall)
+                a["prep"] + a.get("cache_probe", 0.0),
+                a["serve_fanout"], a["verify"], wall)
             row = {"wall_ms": round(rec["wall_ms"], 2),
                    "bubble_ms": round(rec["bubble_ms"], 2),
                    "overlap_efficiency":
                    round(rec["overlap_efficiency"], 3)}
+            if cache_on:
+                row["cache_probe_ms"] = round(
+                    a.get("cache_probe", 0.0), 2)
+                row["serve_fanout_ms"] = round(a["serve_fanout"], 2)
             modes[mode] = row
             obs.histogram(f"staged.{mode}_wall_ms").record(wall)
-            print(f"# {mode:12s} {row['wall_ms']:9.1f} "
+            print(f"# {mode:16s} {row['wall_ms']:9.1f} "
                   f"{row['bubble_ms']:10.1f} "
                   f"{row['overlap_efficiency']:12.3f}", file=sys.stderr)
     dsm.counters = counters
