@@ -101,7 +101,11 @@ documented in the BENCHMARKS.md appendix "Bench JSON schema".
 recovery) instead of the benchmark; ``bench.py --recovery-drill`` runs
 the recovery-plane drill (tools/recovery_drill.py: traffic -> crash ->
 chain restore + journal replay with measured RPO/RTO -> targeted
-repair) — see README "Robustness".
+repair) — see README "Robustness"; ``bench.py --reshard-drill`` runs
+the capacity drill (tools/reshard_drill.py: live N->M pool grow under
+mixed traffic with a chaos-injected crash mid-migration, resumed
+migration, and the offline-vs-online final-pool bit-identity pin) —
+see README "Elastic scaling".
 
 Read combining: a zipf-0.99 batch of 4 M ops contains ~1-2 M distinct
 keys (~2-4x dedup depending on keyspace size).  The engine already
@@ -1295,6 +1299,10 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
         "host_insert_us": round(host_insert_us, 1),
         "keys": n_keys,
         "batch": batch,
+        # cluster shape: perfgate treats a node-count change as
+        # INCOMPARABLE config (an elastic reshard changes the workload
+        # per node; its receipts never gate against fixed-shape rounds)
+        "nodes": cfg.machine_nr,
         # unified observability plane (sherman_tpu/obs): registry
         # snapshot (incl. dsm.* device op/byte counters), per-phase span
         # stats, and the Perfetto-loadable trace file of this run
@@ -1338,6 +1346,20 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import recovery_drill
         recovery_drill.main(sys.argv[1:])
+        return
+
+    if "--reshard-drill" in sys.argv:
+        # Capacity lane: live N->M elastic reshard under mixed traffic
+        # (background lock-lease page migration -> chaos-injected crash
+        # mid-migration -> recover + resume -> quiesced cutover), with
+        # lost_acks == 0, rpo_ops == 0 and the offline-vs-online
+        # bit-identity pin.  tools/reshard_drill.py owns the sequence;
+        # it prints its own one-line JSON receipt.
+        sys.argv.remove("--reshard-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import reshard_drill
+        reshard_drill.main(sys.argv[1:])
         return
 
     # persistent compilation cache: kernel compiles cost 20-40 s each over

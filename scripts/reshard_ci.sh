@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Reshard CI lane: pin the elastic-scaling story on the CPU mesh.
+#
+# Runs (1) the fast-tier migration tests (grow/shrink under traffic,
+# crash-resume from journaled batch artifacts, corrupt-artifact drop,
+# lock-conflict deferral + typed writer rejection, degraded abort,
+# hot-key-cache coherence, dirty-sink-rides-checkpoint, collector) plus
+# the offline reshard tier, (2) the end-to-end reshard drill (live N->M
+# grow under mixed traffic -> chaos + cold crash mid-migration ->
+# recover + resume -> quiesced cutover, one JSON receipt line), and (3)
+# the offline-vs-online FINAL-POOL IDENTITY PIN: the drill receipt must
+# carry lost_acks == 0, rpo_ops == 0 and bit_identical == true — the
+# online migration is the offline transform of the final logical state,
+# by construction and by this check.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== migration + reshard fast tier =="
+# 'not slow' keeps the 2-process multihost-format test out: it needs a
+# jaxlib with CPU multiprocess collectives (this container's lacks
+# them — the same pre-existing gate as tests/test_multihost.py)
+python -m pytest tests/test_migrate.py tests/test_reshard.py \
+    -q -m 'not slow'
+python -m pytest \
+    tests/test_fuzz.py::test_fuzz_migrate_chaos_detection -q -m ''
+
+echo "== reshard drill (end-to-end, with identity pin) =="
+RECEIPT="$(mktemp /tmp/reshard_receipt.XXXXXX.json)"
+SHERMAN_DRILL_KEYS="${SHERMAN_DRILL_KEYS:-3000}" \
+    SHERMAN_RESHARD_RECEIPT="$RECEIPT" \
+    python bench.py --reshard-drill
+
+echo "== receipt pins (lost_acks / rpo_ops / bit_identical) =="
+python - "$RECEIPT" <<'EOF'
+import json
+import sys
+
+r = json.load(open(sys.argv[1]))
+assert r["ok"] is True, r
+assert r["lost_acks"] == 0, r
+assert r["rpo_ops"] == 0, r
+assert r["bit_identical"] is True, r
+assert r["cutover"]["resume_verified"] > 0, r  # resumed, not restarted
+print("pins green:", {k: r[k] for k in
+                      ("lost_acks", "rpo_ops", "bit_identical")})
+EOF
+rm -f "$RECEIPT"
+echo "RESHARD-CI PASS"

@@ -148,6 +148,12 @@ DEFAULT_REGISTRY = Registry(
         # integer adds only — the cache.* collector allocates at PULL
         # time, which is off the hot path)
         ("sherman_tpu/models/leaf_cache.py", "LeafCache._note_probe"),
+        # online migration (PR 12): the dirty-tracking hooks run inside
+        # every checkpoint save (the sink) and every migration batch
+        # (the poll) — plain set-folding loops; the migrate.* collector
+        # allocates at PULL time like the cache's
+        ("sherman_tpu/migrate.py", "Migrator._on_dirty_clear"),
+        ("sherman_tpu/migrate.py", "Migrator._poll_dirt"),
     ],
     knob_docs=["BENCHMARKS.md"],
 )

@@ -1,0 +1,674 @@
+"""Online elastic reshard — grow/shrink the pool under traffic.
+
+``utils/reshard.py`` already rewrites an N-node pool onto M nodes —
+OFFLINE, on a checkpoint, with the cluster down for the whole
+transform.  This module promotes that transform to an *online*
+operation: a background :class:`Migrator` walks the live pool in
+bounded batches while the engine keeps serving, so the downtime of an
+N→M resize shrinks from "checkpoint + rewrite + restore" to one brief
+quiesced cutover whose work is proportional to the pages written since
+their copy, not the pool.
+
+The protocol, batch by batch (the scrubber's quarantine pattern):
+
+1. **lock**: the batch's page lock words are CAS-acquired under the
+   migrator's OWN live lease (``cluster.register_client``), so no
+   writer can touch a page mid-copy — device inserts that lose the race
+   report ``ST_LOCKED`` and retry through the engine's bounded
+   lock-retry/backoff budget (typed ``ST_LOCK_TIMEOUT`` at exhaustion,
+   never a wrong answer); host writers spin exactly as they do against
+   the scrubber's quarantine.  A word held by a LIVE foreign lease is
+   skipped this batch (``migrate.lock_conflicts``) and retried later; a
+   DEAD holder is revoked through the one revocation policy
+   (``Tree._try_revoke_lease``).
+2. **copy**: the locked pages are read in one batched step and staged
+   host-side, verbatim — address rewriting is deferred to cutover so
+   the staged bytes stay comparable with the live pool.
+3. **journal**: the batch is persisted as a CRC-tagged artifact
+   (``migbatch-<mid>-<seq>.npz``, atomic tmp+fsync+replace) BEFORE the
+   locks release — a crash mid-migration keeps every completed batch,
+   and :meth:`Migrator.resume` reloads them, folds every staged row
+   into the re-verify queue (post-crash journal replay may have
+   rewritten anything), and continues instead of restarting.
+4. **release + invalidate**: the locks are freed in one step and the
+   hot-key tier scatter-invalidates the batch's pages
+   (``models/leaf_cache.py`` — the volatile-across-recovery contract
+   extended to migration batches).
+
+Writes AFTER a page's copy are caught by the DSM's dirty tracking: the
+migrator folds ``dirty_rows()`` into a conservative re-copy set on
+every batch, and a registered **dirty sink** (``DSM.add_dirty_sink``)
+hands it the rows a delta checkpoint is about to consume-and-clear —
+the migration epoch rides the delta-checkpoint chain instead of racing
+it.  :meth:`Migrator.finish` then re-stages the dirtied pages under a
+brief quiesced window, recomputes the live set + old→new address map
+from the CURRENT allocator state, and feeds the staged image through
+``utils.reshard.reshard_arrays`` — the SAME transform the offline CLI
+runs, so the emitted M-node checkpoint is bit-identical to
+``tools/reshard.py`` applied to the final logical state (the drill's
+identity pin; ``tools/reshard_drill.py`` / ``bench.py
+--reshard-drill``).
+
+Observability: the ``migrate.`` pull collector (pages_moved, batches,
+retries, lock_conflicts, resume_count, epoch, …) plus flight-recorder
+events for begin/batch/resume/cutover and a debounced black-box dump
+on abort.  Knob: ``SHERMAN_MIGRATE_BATCH_PAGES`` (pages locked+copied
+per batch — the p99-spike vs migration-throughput dial).
+
+Single-process meshes only, like the recovery plane (multihost
+deployments resize via the offline checkpoint path).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu import obs
+from sherman_tpu.errors import (ConfigError, MultiprocessUnsupportedError,
+                                ShermanError, StateError)
+from sherman_tpu.obs import recorder as FR
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import dsm as D
+from sherman_tpu.utils import checkpoint as CK
+from sherman_tpu.utils import reshard as RS
+
+# CAS attempts per lock word before deferring the word's pages to a
+# later batch (same bound as the scrubber's quarantine: a legitimately
+# held lock drains within a step or two).
+_LOCK_TRIES = 8
+# Quiesced-cutover convergence budget: finish() re-verifies the staged
+# image against the live pool after each delta pass; mismatches still
+# appearing after this many rounds mean a writer (or an unreleasable
+# quarantine) is racing the cutover — abort typed, never emit a pool
+# that silently lost writes.
+_FINISH_VERIFY_ROUNDS = 3
+
+
+def _batch_pages_default() -> int:
+    """``SHERMAN_MIGRATE_BATCH_PAGES``: pages locked + copied per
+    migration batch (default 256).  Smaller batches bound the per-batch
+    lock-hold window (the read-path p99 spike); larger batches finish
+    the copy in fewer lock/journal round trips."""
+    v = os.environ.get("SHERMAN_MIGRATE_BATCH_PAGES", "").strip()
+    if not v:
+        return 256
+    try:
+        n = int(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_MIGRATE_BATCH_PAGES={v!r}: want a positive int")
+    if n <= 0:
+        raise ConfigError(f"SHERMAN_MIGRATE_BATCH_PAGES={n}: want > 0")
+    return n
+
+
+class MigrationAborted(ShermanError, RuntimeError):
+    """Typed migration abort: the engine degraded mid-migration, the
+    cutover could not quiesce, or the migration state was explicitly
+    abandoned.  The SOURCE pool is untouched (the migrator only ever
+    holds lock words and writes artifacts) — serving continues; the
+    staged artifacts remain on disk for a later :meth:`Migrator.resume`
+    or are swept by the next :meth:`Migrator.start`."""
+
+
+class Migrator:
+    """Background page migration of a live N-node pool toward M nodes.
+
+    Lifecycle: construct → :meth:`start` → interleave :meth:`step` with
+    traffic (the scrubber's ``tick`` shape — one bounded batch between
+    engine steps) until :attr:`copied_all` → :meth:`finish` (brief
+    quiesced cutover, emits the M-node checkpoint) → restore the
+    emitted checkpoint on the M-node mesh.  After a crash:
+    ``RecoveryPlane.recover`` the source, then :meth:`resume` and keep
+    going — completed batches are re-verified, not re-done from
+    scratch.
+    """
+
+    def __init__(self, cluster, tree, eng, target_nodes: int,
+                 directory: str, *,
+                 target_pages_per_node: int | None = None,
+                 target_locks_per_node: int | None = None,
+                 batch_pages: int | None = None):
+        if cluster.dsm.multihost:
+            raise MultiprocessUnsupportedError(
+                "online migration is single-process only")
+        if not 1 <= int(target_nodes) <= C.MAX_MACHINE:
+            raise ConfigError(f"target_nodes={target_nodes} out of range")
+        self.cluster = cluster
+        self.tree = tree
+        self.eng = eng
+        self.dsm = cluster.host_dsm
+        self.cfg = cluster.cfg
+        self.target_nodes = int(target_nodes)
+        self.target_pages_per_node = target_pages_per_node
+        self.target_locks_per_node = target_locks_per_node
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.batch_pages = (batch_pages if batch_pages is not None
+                            else _batch_pages_default())
+        # the migrator's locks ride its OWN registered (live) lease, so
+        # lock-lease recovery never revokes a mid-copy hold — the
+        # scrubber's quarantine contract exactly
+        self.ctx = cluster.register_client(replicated=True)
+        self.mid: str | None = None
+        self.seq = 0
+        self.started = False
+        self.finished = False
+        self.aborted: str | None = None
+        # Staging store: ONE flat pool-shaped array + a staged-row mask
+        # (lazily allocated at start/resume).  A per-row dict of small
+        # arrays would roughly double the host footprint in object
+        # overhead and force Python-loop assembly/verification at
+        # cutover — at the 100 M-key config (4.19 M pages) the flat
+        # form IS the cutover image and verifies vectorized.  _dirt =
+        # rows written since migration start (conservative: dirty polls
+        # + the clear sink), re-staged by finish()'s delta passes.
+        self._staged_arr: np.ndarray | None = None
+        self._staged_mask: np.ndarray | None = None
+        self._pending: list[int] = []
+        self._dirt: set[int] = set()
+        self._sink = self._on_dirty_clear
+        # migrate.* accounting (plain int adds on the batch path; the
+        # collector below materializes them at PULL time only)
+        self.pages_moved = 0
+        self.batches = 0
+        self.retries = 0            # re-staged (dirtied-after-copy) pages
+        self.lock_conflicts = 0     # words skipped: held by a live lease
+        self.resume_count = 0
+        self.resume_verified = 0    # staged pages proven clean on resume
+        self.recopies_clean = 0     # non-resume re-copies proven clean
+        #                             (conservative dirt that never
+        #                             changed content) — kept separate
+        #                             so resume_verified > 0 really
+        #                             means a resume happened
+        import weakref
+        ref = weakref.ref(self)
+
+        def _collect():
+            m = ref()
+            if m is None:
+                return {}
+            return {
+                "pages_moved": m.pages_moved,
+                "batches": m.batches,
+                "retries": m.retries,
+                "lock_conflicts": m.lock_conflicts,
+                "resume_count": m.resume_count,
+                "resume_verified": m.resume_verified,
+                "recopies_clean": m.recopies_clean,
+                "epoch": m.seq,
+                "staged_pages": m.staged_pages,
+                "dirt_backlog": len(m._dirt),
+                "in_progress": int(m.started and not m.finished
+                                   and m.aborted is None),
+            }
+
+        obs.register_collector("migrate", _collect)
+
+    # -- artifact naming ------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, "migrate-manifest.npz")
+
+    def _batch_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"migbatch-{self.mid}-{seq:06d}.npz")
+
+    def _sweep_stale(self) -> int:
+        """Remove batch artifacts of a superseded migration id."""
+        n = 0
+        for f in glob.glob(os.path.join(self.dir, "migbatch-*.npz")):
+            if self.mid is not None \
+                    and f"-{self.mid}-" in os.path.basename(f):
+                continue
+            try:
+                os.unlink(f)
+                n += 1
+            except OSError:
+                pass
+        return n
+
+    # -- staging store --------------------------------------------------------
+
+    def _ensure_staging(self) -> None:
+        if self._staged_arr is None:
+            rows = self.cfg.machine_nr * self.cfg.pages_per_node
+            self._staged_arr = np.zeros((rows, C.PAGE_WORDS), np.int32)
+            self._staged_mask = np.zeros(rows, bool)
+
+    @property
+    def staged_pages(self) -> int:
+        """Pages with a staged copy (the ``migrate.staged_pages``
+        gauge)."""
+        return int(self._staged_mask.sum()) \
+            if self._staged_mask is not None else 0
+
+    def is_staged(self, row: int) -> bool:
+        return bool(self._staged_mask is not None
+                    and self._staged_mask[int(row)])
+
+    # -- planning -------------------------------------------------------------
+
+    def _live_rows_now(self) -> np.ndarray:
+        """The CURRENT live-row set, by the same definition the offline
+        transform uses (``utils.reshard.live_rows``) — allocator
+        high-water marks, written pages only, free pool excluded."""
+        cfg = self.cfg
+        nxt = np.ones(cfg.machine_nr, np.int64)
+        free = []
+        for d in self.cluster.directories:
+            nxt[d.node_id] = d.allocator.pages_used
+            free += [bits.make_addr(d.node_id, p) & 0xFFFFFFFF
+                     for p in d.allocator.free_pages_list]
+        # only the W_FRONT_VER column crosses to the host (one narrow
+        # materialization, not the whole pool)
+        fv = np.asarray(self.dsm.pool[:, C.W_FRONT_VER])
+        return RS.live_rows(fv, nxt, np.asarray(sorted(free), np.int64),
+                            cfg.pages_per_node, cfg.machine_nr)
+
+    def _refresh_plan(self) -> int:
+        """Recompute the pending copy plan: live rows not yet staged
+        (ascending — determinism across resumes).  Returns the pending
+        count."""
+        rows = self._live_rows_now()
+        if self._staged_mask is not None and rows.size:
+            rows = rows[~self._staged_mask[rows]]
+        self._pending = rows.tolist()
+        return len(self._pending)
+
+    # -- dirty tracking -------------------------------------------------------
+
+    def _on_dirty_clear(self, rows) -> None:
+        """DSM dirty-sink hook: a checkpoint is about to consume-and-
+        clear these rows — fold every staged one into the re-copy set
+        so the clear cannot hide a post-copy write from the cutover.
+        Runs inside every checkpoint save (registered obs-hot scope:
+        plain loop, no per-call allocation)."""
+        mask = self._staged_mask
+        if mask is None:
+            return
+        dirt = self._dirt
+        for r in rows:
+            r = int(r)
+            if mask[r]:
+                dirt.add(r)
+
+    def _poll_dirt(self) -> None:
+        """Fold the DSM's cumulative dirty rows into the re-copy set
+        (per-batch hot hook — same allocation-free shape as the sink)."""
+        mask = self._staged_mask
+        if mask is None:
+            return
+        dirt = self._dirt
+        for r in self.dsm.dirty_rows():
+            r = int(r)
+            if mask[r]:
+                dirt.add(r)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _require_active(self) -> None:
+        if not self.started:
+            raise StateError("migration not started: call start() first")
+        if self.finished:
+            raise StateError("migration already finished")
+        if self.aborted is not None:
+            raise MigrationAborted(
+                f"migration {self.mid} aborted: {self.aborted}")
+        if self.eng.degraded:
+            self.abort(f"engine degraded: {self.eng.degraded_reason}")
+            raise MigrationAborted(
+                f"migration {self.mid} aborted: engine degraded "
+                f"({self.eng.degraded_reason})")
+
+    def start(self) -> dict:
+        """Begin a new migration: fresh mid, manifest persisted, stale
+        artifacts of superseded migrations swept, copy plan computed."""
+        if self.started:
+            raise StateError("migration already started")
+        if self.eng.degraded:
+            raise MigrationAborted(
+                "refusing to start a migration on a degraded engine")
+        n = self._refresh_plan()
+        # advisory capacity check (the live set can still grow, so the
+        # authoritative check stays in reshard_arrays at cutover): an
+        # OBVIOUSLY undersized target must fail BEFORE hours of
+        # lock/copy/journal work — and before any state is persisted
+        if self.target_pages_per_node is not None:
+            cap = self.target_nodes * (self.target_pages_per_node - 1)
+            if n > cap:
+                raise ConfigError(
+                    f"{n} live pages cannot fit {self.target_nodes} "
+                    f"node(s) x {self.target_pages_per_node} pages "
+                    "(page 0 per node reserved): raise "
+                    "target_pages_per_node before migrating")
+        self.mid = f"{int(np.frombuffer(os.urandom(4), np.uint32)[0]):08x}"
+        self._sweep_stale()
+        man = dict(
+            mid=np.frombuffer(self.mid.encode(), np.uint8).copy(),
+            target=np.asarray(
+                [self.target_nodes, self.target_pages_per_node or 0,
+                 self.target_locks_per_node or 0], np.int64),
+            src_cfg=np.frombuffer(CK.cfg_to_json(self.cfg), np.uint8),
+        )
+        man["integrity"] = CK._integrity(man)
+        CK._savez_atomic(self._manifest_path(), 0, **man)
+        self._ensure_staging()
+        self.started = True
+        # register on the RAW DSM (host_dsm is the same object on the
+        # single-process meshes migration supports)
+        self.cluster.dsm.add_dirty_sink(self._sink)
+        obs.record_event("migrate.begin", mid=self.mid,
+                         src_nodes=self.cfg.machine_nr,
+                         target_nodes=self.target_nodes, live_pages=n)
+        return {"mid": self.mid, "live_pages": n}
+
+    def abort(self, reason: str) -> None:
+        """Abandon the migration (typed; serving is unaffected).  The
+        black box dumps — an abort is exactly the moment a postmortem
+        starts from."""
+        if self.aborted is None:
+            self.aborted = reason
+            obs.counter("migrate.aborts").inc()
+            FR.record_event("migrate.abort", mid=self.mid or "",
+                            reason=reason)
+            FR.auto_dump("migrate_abort")
+            self.cluster.dsm.remove_dirty_sink(self._sink)
+
+    @property
+    def copied_all(self) -> bool:
+        """True when every currently-live page has a staged copy (the
+        signal to call :meth:`finish`; new allocations or post-copy
+        writes after this flip are caught by finish's delta passes)."""
+        return self.started and not self._pending
+
+    # -- the batch protocol ---------------------------------------------------
+
+    def _acquire_locks(self, addrs: list[int]) -> tuple[list[int], set[int]]:
+        """CAS-acquire the lock words covering ``addrs`` under the
+        migrator's lease.  -> (copyable addrs, held words).  Pages whose
+        word stays held by a live foreign lease are deferred (counted in
+        ``lock_conflicts``); dead holders are revoked."""
+        by_word: dict[int, list[int]] = {}
+        for a in addrs:
+            by_word.setdefault(self.tree._lock_word_addr(a), []).append(a)
+        held: set[int] = set()
+        ok_addrs: list[int] = []
+        for la, pages in by_word.items():
+            got = False
+            for _ in range(_LOCK_TRIES):
+                old, won = self.dsm.cas(la, 0, 0, self.ctx.lease,
+                                        space=D.SPACE_LOCK)
+                if won or old == self.ctx.lease:
+                    got = True
+                    break
+                # dead holder (e.g. wedged by the same fault storm the
+                # drill injects): revoke through the one policy
+                self.tree._try_revoke_lease(la, old)
+            if got:
+                held.add(la)
+                ok_addrs.extend(pages)
+            else:
+                self.lock_conflicts += len(pages)
+        return ok_addrs, held
+
+    def _release_locks(self, held: set[int]) -> None:
+        if held:
+            self.dsm.write_rows([
+                {"op": D.OP_WRITE_WORD, "addr": la, "woff": 0, "arg1": 0,
+                 "space": D.SPACE_LOCK} for la in sorted(held)])
+
+    def _stage_batch(self, rows: list[int], *, recopy: bool) -> dict:
+        """One full batch protocol pass over ``rows``: lock → copy →
+        journal → release → cache-invalidate.  Re-copies whose content
+        is unchanged skip the artifact write (``resume_verified`` on
+        resume passes, ``retries`` otherwise count the churn)."""
+        P = self.cfg.pages_per_node
+        addrs = [bits.make_addr(r // P, r % P) for r in rows]
+        addrs, held = self._acquire_locks(addrs)
+        if not addrs:
+            return {"pages": 0, "deferred": len(rows)}
+        try:
+            got_rows = [bits.addr_node(a) * P + bits.addr_page(a)
+                        for a in addrs]
+            pages = self.dsm.read_pages(addrs)
+            changed_rows, changed_pages = [], []
+            arr, mask = self._staged_arr, self._staged_mask
+            for r, pg in zip(got_rows, pages):
+                if mask[r] and np.array_equal(arr[r], pg):
+                    if recopy:
+                        if self.resume_count:
+                            self.resume_verified += 1
+                        else:
+                            self.recopies_clean += 1
+                    continue
+                arr[r] = pg
+                mask[r] = True
+                changed_rows.append(r)
+                changed_pages.append(pg)
+                if recopy:
+                    self.retries += 1
+            if changed_rows:
+                # journal BEFORE the locks release: a crash after this
+                # point keeps the batch; before it, the locks were never
+                # released with an unjournaled copy outstanding
+                self.seq += 1
+                art = dict(
+                    mid=np.frombuffer(self.mid.encode(), np.uint8).copy(),
+                    seq=np.asarray([self.seq], np.int64),
+                    rows=np.asarray(changed_rows, np.int64),
+                    pages=np.asarray(changed_pages, np.int32),
+                )
+                art["integrity"] = CK._integrity(art)
+                CK._savez_atomic(self._batch_path(self.seq), 0, **art)
+        finally:
+            self._release_locks(held)
+        # the batch's rows are now clean as of this copy
+        self._dirt.difference_update(got_rows)
+        self.pages_moved += len(addrs)
+        self.batches += 1
+        # hot-key tier coherence: a migrating page's cached entries must
+        # not outlive its batch (the volatile-across-recovery contract,
+        # extended to migration — scatter-invalidate, not a flush)
+        if self.eng.leaf_cache is not None:
+            self.eng.leaf_cache.invalidate_pages(addrs)
+        obs.record_event("migrate.batch", mid=self.mid, seq=self.seq,
+                         pages=len(addrs), recopy=bool(recopy))
+        return {"pages": len(addrs), "deferred": len(rows) - len(addrs)}
+
+    def step(self, max_pages: int | None = None) -> dict:
+        """One bounded migration batch between engine steps (the
+        scrubber's ``tick`` shape).  Copies fresh pages from the plan;
+        when the plan drains, reports idle (post-copy dirt is the
+        cutover's job — re-staging it under traffic would churn).
+        """
+        self._require_active()
+        n = max_pages or self.batch_pages
+        if not self._pending:
+            self._refresh_plan()  # splits allocate new live pages
+        if not self._pending:
+            return {"idle": True, "pages": 0,
+                    "dirt_backlog": len(self._dirt)}
+        batch, self._pending = self._pending[:n], self._pending[n:]
+        # poll BEFORE the copy: dirt recorded up to here is captured by
+        # the locked read below; dirt after it lands in a later poll.
+        # (The poll is conservative bookkeeping, not load-bearing for
+        # correctness — finish()'s own poll + the clear sink + the
+        # row-by-row verify already close every hole — but keeping the
+        # dirt set current per batch bounds the cutover's re-stage work
+        # and keeps the dirt_backlog gauge honest.)
+        self._poll_dirt()
+        out = self._stage_batch(batch, recopy=False)
+        if out["deferred"]:
+            # deferred pages (live-held lock words) go back on the plan
+            self._pending.extend(r for r in batch
+                                 if not self._staged_mask[r])
+        return out
+
+    def run_to_copied(self, max_batches: int = 1_000_000) -> int:
+        """Drive :meth:`step` until the plan drains (no traffic
+        interleaving — tests and the drill's catch-up phases)."""
+        n = 0
+        while not self.copied_all and n < max_batches:
+            r = self.step()
+            n += 1
+            if r.get("idle"):
+                break
+        return n
+
+    # -- crash restart --------------------------------------------------------
+
+    @classmethod
+    def resume(cls, cluster, tree, eng, directory: str, *,
+               batch_pages: int | None = None) -> "Migrator":
+        """Rebuild a migrator from the on-disk migration state after a
+        crash + source recovery: manifest + every readable batch
+        artifact (CRC-verified; torn/corrupt ones are dropped — their
+        pages just re-copy).  Every staged row is folded into the
+        re-verify set: the crash's journal replay may have rewritten
+        any page, so staged content is re-certified (clean rows count
+        ``resume_verified``, rewritten ones re-stage) instead of
+        trusted."""
+        man = CK._load_arrays(os.path.join(directory,
+                                           "migrate-manifest.npz"))
+        mid = bytes(np.asarray(man["mid"])).decode()
+        tgt = np.asarray(man["target"]).ravel()
+        m = cls(cluster, tree, eng, int(tgt[0]), directory,
+                target_pages_per_node=int(tgt[1]) or None,
+                target_locks_per_node=int(tgt[2]) or None,
+                batch_pages=batch_pages)
+        m.mid = mid
+        m._ensure_staging()
+        m.started = True
+        cluster.dsm.add_dirty_sink(m._sink)
+        arts = sorted(glob.glob(os.path.join(directory,
+                                             f"migbatch-{mid}-*.npz")))
+        dropped = 0
+        max_seq = 0
+        for path in arts:
+            try:
+                z = CK._load_arrays(path)
+            except CK.CheckpointCorruptError:
+                dropped += 1
+                continue
+            max_seq = max(max_seq, int(np.asarray(z["seq"]).ravel()[0]))
+            rows = np.asarray(z["rows"], np.int64)
+            m._staged_arr[rows] = np.asarray(z["pages"], np.int32)
+            m._staged_mask[rows] = True
+        m.seq = max_seq
+        # conservative: every staged page re-verifies against the
+        # recovered pool (journal replay may have rewritten it)
+        m._dirt.update(int(r) for r in np.nonzero(m._staged_mask)[0])
+        m.resume_count += 1
+        m._refresh_plan()
+        obs.counter("migrate.resumes").inc()
+        obs.record_event("migrate.resume", mid=mid,
+                         staged=m.staged_pages, dropped_artifacts=dropped,
+                         reverify=len(m._dirt))
+        return m
+
+    # -- cutover --------------------------------------------------------------
+
+    def _verify_rows(self) -> list[int]:
+        """Certification gather: every live row whose staged copy is
+        absent or differs from the LIVE pool content right now.  One
+        device-side gather of the live rows (O(live pages) — the
+        cutover's one full sweep; the dirty tracking exists to make the
+        RE-STAGE work proportional to writes, this check is what makes
+        "zero lost writes" a measured property rather than a belief)."""
+        import jax.numpy as jnp
+        rows = self._live_rows_now()
+        if not rows.size:
+            return []
+        live = np.asarray(self.dsm.pool[jnp.asarray(rows)])
+        diff = ~self._staged_mask[rows] \
+            | (self._staged_arr[rows] != live).any(axis=1)
+        return [int(r) for r in rows[diff]]
+
+    def finish(self, dst: str, *, hosts: int = 1) -> dict:
+        """Quiesced cutover: flush deferred parents, re-stage the
+        conservative dirt set (post-copy writes, resume re-verifies,
+        late allocations), certify the staged image against the live
+        pool row by row, then run the OFFLINE transform
+        (``reshard_arrays``) over the staged image and emit the M-node
+        checkpoint at ``dst``.
+
+        The caller quiesces traffic for the duration (the single-driver
+        serving shape makes this one call between batches); a writer
+        racing the cutover — or a quarantine whose lock never frees —
+        surfaces as verification mismatches past the convergence budget
+        and aborts typed, never an emitted pool that silently lost
+        writes."""
+        self._require_active()
+        import time
+        t0 = time.perf_counter()
+        self.eng.flush_parents()
+        # conservative delta pass: pre-cutover dirt + late allocations
+        self._refresh_plan()
+        self._poll_dirt()
+        todo = sorted(set(self._pending) | self._dirt)
+        self._pending = []
+        for i in range(0, len(todo), self.batch_pages):
+            self._stage_batch(todo[i:i + self.batch_pages], recopy=True)
+        # certify (and repair) until the image IS the live pool
+        for attempt in range(_FINISH_VERIFY_ROUNDS + 1):
+            bad = self._verify_rows()
+            if not bad:
+                break
+            if attempt == _FINISH_VERIFY_ROUNDS:
+                self.abort("cutover could not quiesce: staged image "
+                           f"kept diverging after {attempt} repair "
+                           "rounds")
+                raise MigrationAborted(
+                    f"migration {self.mid}: cutover could not quiesce "
+                    "(a writer or an unreleasable lock is racing "
+                    "finish())")
+            for i in range(0, len(bad), self.batch_pages):
+                self._stage_batch(bad[i:i + self.batch_pages],
+                                  recopy=True)
+        self._dirt.clear()
+
+        # the staged array IS the cutover image (no second pool-sized
+        # copy): live rows hold their certified copies, everything else
+        # is zero like a checkpoint's unwritten rows; the reserved meta
+        # page (never in the live set) is read live into row 0
+        cfg = self.cfg
+        N = cfg.machine_nr
+        image = self._staged_arr
+        image[0] = self.dsm.read_page(bits.make_addr(0, 0))
+        man = CK._manifest(self.cluster)
+        # counters LAST: nothing below issues another DSM op, so the
+        # emitted totals equal a checkpoint taken right after finish —
+        # the drill's offline-vs-online bit-identity pin needs that
+        counters = np.asarray(self.dsm.counters)
+        locks = np.zeros(N * cfg.locks_per_node, np.int32)
+        arrays, new_cfg, summary = RS.reshard_arrays(
+            man, image, locks, counters, self.target_nodes,
+            pages_per_node=self.target_pages_per_node,
+            locks_per_node=self.target_locks_per_node)
+        RS.write_resharded(dst, arrays, new_cfg, hosts=hosts)
+        self.finished = True
+        self.cluster.dsm.remove_dirty_sink(self._sink)
+        summary["mid"] = self.mid
+        summary["pages_moved"] = self.pages_moved
+        summary["batches"] = self.batches
+        summary["retries"] = self.retries
+        summary["lock_conflicts"] = self.lock_conflicts
+        summary["resume_count"] = self.resume_count
+        summary["resume_verified"] = self.resume_verified
+        summary["recopies_clean"] = self.recopies_clean
+        summary["cutover_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        summary["dst"] = dst
+        obs.record_event("migrate.cutover", mid=self.mid,
+                         live_pages=summary["live_pages"],
+                         target_nodes=self.target_nodes,
+                         cutover_ms=summary["cutover_ms"])
+        return summary
+
+    def close(self) -> None:
+        """Detach from the DSM (idempotent); staged artifacts stay on
+        disk for resume/sweep."""
+        self.cluster.dsm.remove_dirty_sink(self._sink)

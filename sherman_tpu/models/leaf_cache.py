@@ -69,12 +69,19 @@ a missed one; validation stays the authoritative guard):
 - ``enter_degraded`` and scrub quarantine: a quarantined page's keys
   must drop out of the cache (:meth:`invalidate_pages` from the
   scrubber; degraded entry flushes wholesale);
+- online migration (``sherman_tpu/migrate.py``): every migration
+  batch scatter-invalidates its pages (:meth:`invalidate_pages`) when
+  the batch's locks release — entries must not vouch for a page a
+  migrator just held (pinned by the cached-read-during-migration
+  bit-identity test in ``tests/test_migrate.py``);
 - stale probe matches invalidate their own slot on device.
 
 VOLATILITY CONTRACT — the cache is never checkpointed: recovery
 (``RecoveryPlane.recover`` builds a fresh engine) and targeted repair
 (explicit :meth:`flush`) always start cold; the journal replay path
-re-warms nothing.  Metrics ride the ``cache.`` pull collector
+re-warms nothing; the pool emitted by a live reshard restores into a
+fresh engine (cold by construction), extending the contract to
+migration cutover.  Metrics ride the ``cache.`` pull collector
 (hits/misses/invalidations/evictions counters + hit-ratio gauge, the
 ``slo.``-collector shape).
 
@@ -560,7 +567,8 @@ class LeafCache:
 
     def invalidate_pages(self, addrs) -> int:
         """Drop every cached entry resident on the given packed page
-        addresses (split/reclaim rewrites, scrub quarantine)."""
+        addresses (split/reclaim rewrites, scrub quarantine, migration
+        batches)."""
         a = np.asarray(list(addrs), np.int64).astype(np.int32)
         if a.size == 0:
             return 0

@@ -534,6 +534,13 @@ class DSM(_HostOps):
         # is not a legal write and must NOT leak into delta artifacts.
         self.dirty = _zeros((N * P,), jnp.bool_)
         self._dirty_host: set[int] = set()
+        # Dirty SINKS (the online migrator's feed, sherman_tpu/migrate.py):
+        # checkpoint saves consume-and-clear the dirty tracking, which
+        # would silently hide post-copy writes from any second consumer.
+        # A registered sink is handed the rows about to be cleared, so a
+        # concurrent consumer (the migration re-copy queue) never loses
+        # dirt to a checkpoint racing its polls.  Empty list = zero cost.
+        self._dirty_sinks: list = []
 
         spec = jax.sharding.PartitionSpec(AXIS)
         in_specs = (spec, spec, spec,
@@ -698,8 +705,29 @@ class DSM(_HostOps):
                            len(self._dirty_host))
         return np.union1d(dev, host)
 
+    def add_dirty_sink(self, fn) -> None:
+        """Register a callable handed the dirty rows at every
+        :meth:`clear_dirty` (BEFORE the reset) — the second-consumer
+        contract for the dirty tracking (see ``_dirty_sinks``).
+        Single-process only (dirty tracking itself is)."""
+        if self.multihost:
+            raise MultiprocessUnsupportedError(
+                "dirty sinks are single-process only")
+        self._dirty_sinks.append(fn)
+
+    def remove_dirty_sink(self, fn) -> None:
+        if fn in self._dirty_sinks:
+            self._dirty_sinks.remove(fn)
+
     def clear_dirty(self) -> None:
-        """Reset both dirty tiers (a checkpoint artifact captured them)."""
+        """Reset both dirty tiers (a checkpoint artifact captured them).
+        Registered dirty sinks see the rows first — a clear must not
+        hide writes from a concurrent consumer (migration re-copy)."""
+        if self._dirty_sinks and not self.multihost:
+            rows = self.dirty_rows()
+            if rows.size:
+                for fn in list(self._dirty_sinks):
+                    fn(rows)
         N, P = self.cfg.machine_nr, self.cfg.pages_per_node
         if not self.multihost:
             self.dirty = jax.device_put(jnp.zeros(N * P, jnp.bool_),

@@ -61,6 +61,7 @@ import time
 
 import numpy as np
 
+from sherman_tpu import config as C
 from sherman_tpu import obs
 from sherman_tpu.errors import (MultiprocessUnsupportedError, ShermanError,
                                 StateError)
@@ -73,6 +74,8 @@ _OBS_RECOVERS = obs.counter("recovery.recovers")
 _OBS_REPAIRS = obs.counter("recovery.targeted_repairs")
 _OBS_REPAIR_FAILS = obs.counter("recovery.targeted_repair_failures")
 _OBS_PAGES_REPAIRED = obs.counter("recovery.pages_repaired")
+_OBS_STALE_REPAIRS = obs.counter("recovery.stale_page_repairs")
+_OBS_RESURRECTED = obs.counter("recovery.resurrected_keys")
 
 
 class TargetedRepairFailed(ShermanError, RuntimeError):
@@ -331,14 +334,69 @@ class RecoveryPlane:
         P = self.cluster.cfg.pages_per_node
         rows = [bits.addr_node(a) * P + bits.addr_page(a) for a in damaged]
         pages = CK.read_chain_rows(self.base_path, self.delta_paths, rows)
+        # PAGE-VERSION-AWARE repair: the chain's content is only valid
+        # for a page whose live version still matches the chain tip.  A
+        # page legally REWRITTEN since the tip (split, reclaim-reuse —
+        # its front version moved past the chain's; min of the live
+        # pair, because torn-version damage only raises one half) or
+        # ALLOCATED after it (chain front version 0) must not be
+        # blind-restored — resurrecting a pre-split image beside its
+        # live sibling corrupts the chain shape (duplicate coverage,
+        # double in-degree) in a way the local scrub pass cannot even
+        # see.  Such pages are repaired IN PLACE instead: heal the
+        # version pair, clear the violating slots, and re-upsert any
+        # chain-tip key the damage dropped (``_stale_candidates``) —
+        # post-tip ops replay from the journal afterwards, so the
+        # convergence argument is recover()'s own.
+        live = self.tree.dsm.read_pages(damaged)
+        restore_idx, stale_idx = [], []
+        for i in range(len(damaged)):
+            chain_fv = int(pages[i][C.W_FRONT_VER])
+            live_ver = min(int(live[i][C.W_FRONT_VER]),
+                           int(live[i][C.W_REAR_VER]))
+            # the version test alone only defends against RAISING
+            # damage (a zeroed/lowered version half on a since-split
+            # page would read as restorable); require the page's
+            # structural identity — level, fences, sibling — to still
+            # match the chain image too.  Every legal structural
+            # rewrite (split, reclaim absorb) changes these WITH a
+            # version bump, so a mismatch means the chain image is for
+            # a different incarnation of the page.  (Damage to the
+            # header words themselves also lands here: the in-place
+            # patch cannot mend headers, so the scrub re-certify fails
+            # typed into the full-restore fallback — capability given
+            # up for never-wrong.)
+            same_identity = all(
+                int(pages[i][w]) == int(live[i][w])
+                for w in (C.W_LEVEL, C.W_LOW_HI, C.W_LOW_LO,
+                          C.W_HIGH_HI, C.W_HIGH_LO, C.W_SIBLING))
+            if chain_fv != 0 and live_ver <= chain_fv and same_identity:
+                restore_idx.append(i)
+            else:
+                stale_idx.append(i)
+        write_rows = [
+            {"op": D.OP_WRITE, "addr": damaged[i], "woff": 0,
+             "nw": pages.shape[1], "payload": pages[i]}
+            for i in restore_idx]
+        candidates: dict[int, int] = {}
+        for i in stale_idx:
+            patched = self._patch_stale_page(live[i])
+            if patched is not None:
+                write_rows.append(
+                    {"op": D.OP_WRITE, "addr": damaged[i], "woff": 0,
+                     "nw": patched.shape[0], "payload": patched})
+            # chain-tip content of EVERY stale page feeds the
+            # resurrection candidate set: a cleared slot's pre-tip key
+            # may now live under any damaged page's old range
+            candidates.update(self._chain_leaf_entries(pages[i]))
         # raw DSM page writes: unaffected by the scrubber's quarantine
         # locks (those fence TREE writers), marked dirty for the next
         # delta by the host-step boundary union
-        self.tree.dsm.write_rows([
-            {"op": D.OP_WRITE, "addr": a, "woff": 0,
-             "nw": pages.shape[1], "payload": pages[i]}
-            for i, a in enumerate(damaged)])
+        if write_rows:
+            self.tree.dsm.write_rows(write_rows)
         _OBS_PAGES_REPAIRED.inc(len(damaged))
+        if stale_idx:
+            _OBS_STALE_REPAIRS.inc(len(stale_idx))
         # re-certify BEFORE exiting degraded: the whole pool must scrub
         # clean — a repair that only moved the damage fails typed here
         res = scrub_pass(self.tree)
@@ -368,9 +426,48 @@ class RecoveryPlane:
         # not re-journal itself) rebuilds the repaired pages' lost
         # writes; untouched pages just re-apply their own values
         seg, self.eng.journal = self.eng.journal, None
+        resurrected = 0
         try:
             if seg is not None:
                 seg.close()
+            # resurrection pass for stale-chain (version-ahead) pages:
+            # a cleared slot may have dropped a PRE-tip key that no
+            # journal record will replay; re-upsert every chain-tip
+            # candidate that is absent from the live tree NOW.  Runs
+            # with the journal DETACHED and BEFORE the replay: a
+            # journaled resurrection would replay the stale tip value
+            # AFTER the segment's newer records (regression), while in
+            # this order post-tip ops win — recover()'s own convergence
+            # argument.  A key deleted post-tip comes back briefly and
+            # the replayed delete removes it again.
+            if candidates:
+                ck = np.asarray(sorted(candidates), np.uint64)
+                _, found = self.eng.search(ck)
+                miss = ck[~found]
+                if miss.size:
+                    st = self.eng.insert(miss, np.asarray(
+                        [candidates[int(k)] for k in miss], np.uint64))
+                    # a resurrection that could not apply (its leaf's
+                    # lock held by a live lease past the retry budget)
+                    # is a LOST pre-tip key — failing silently here
+                    # while reporting ok=True would be exactly the
+                    # wrong-answer class this module exists to prevent:
+                    # re-enter degraded and fail typed (full recover()
+                    # is the documented fallback)
+                    if st["lock_timeouts"]:
+                        _OBS_REPAIR_FAILS.inc()
+                        self.eng.enter_degraded(
+                            "targeted repair: resurrection upserts "
+                            f"lock-timed-out on {st['lock_timeouts']} "
+                            "key(s)")
+                        raise TargetedRepairFailed(
+                            f"{st['lock_timeouts']} resurrection "
+                            "key(s) could not apply (page lock held by "
+                            "a live lease past the retry budget); "
+                            "falling back to full recover() is the "
+                            "documented exit")
+                    resurrected = int(miss.size)
+                    _OBS_RESURRECTED.inc(resurrected)
             replay_stats = J.replay(self._journal_path(self._segment),
                                     self.eng) \
                 if os.path.exists(self._journal_path(self._segment)) \
@@ -384,6 +481,8 @@ class RecoveryPlane:
                 sync=self.journal_sync,
                 group_commit_ms=self.group_commit_ms))
         out = {"pages": len(damaged), "ok": True,
+               "stale_pages": len(stale_idx),
+               "resurrected": resurrected,
                "replay": replay_stats,
                "repair_ms": round((time.perf_counter() - t0) * 1e3, 1)}
         if verify_structure:
@@ -391,7 +490,55 @@ class RecoveryPlane:
             out["structure"] = check_structure_device(self.tree)
         _OBS_REPAIRS.inc()
         obs.record_event("recovery.targeted_repair", pages=len(damaged),
+                         stale_pages=len(stale_idx),
                          repair_ms=out["repair_ms"],
                          replayed_records=int(
                              out["replay"].get("records", 0)))
         return out
+
+    # -- stale-page (version-ahead) repair helpers ----------------------------
+
+    @staticmethod
+    def _patch_stale_page(live_pg: np.ndarray) -> np.ndarray | None:
+        """In-place repair image for a LEAF page whose live version is
+        ahead of the chain tip: heal a torn front/rear page-version
+        pair (both := the max — a rewrite never lowers the version) and
+        clear every violating slot (torn fver/rver halves, live slots
+        outside the page fence).  Internal pages return ``None`` —
+        entry order cannot be locally reconstructed, so their damage is
+        left for the scrub re-certify to judge (typed fallback when it
+        does not come back clean)."""
+        pg = np.array(live_pg, np.int32)
+        if int(pg[C.W_LEVEL]) != 0:
+            return None
+        ver = max(int(pg[C.W_FRONT_VER]), int(pg[C.W_REAR_VER]))
+        pg[C.W_FRONT_VER] = pg[C.W_REAR_VER] = ver
+        LC = C.LEAF_CAP
+        vw = pg[C.L_VER_W:C.L_VER_W + LC].view(np.uint32)
+        fver = (vw >> np.uint32(16)) & np.uint32(0xFFFF)
+        rver = vw & np.uint32(0xFFFF)
+        torn = fver != rver
+        # live-slot fence containment (uint64 keys from the hi/lo pairs)
+        from sherman_tpu.ops import bits as _b
+        skeys = _b.pairs_to_keys(pg[C.L_KHI_W:C.L_KHI_W + LC],
+                                 pg[C.L_KLO_W:C.L_KLO_W + LC])
+        lo = _b.pair_to_key(int(pg[C.W_LOW_HI]), int(pg[C.W_LOW_LO]))
+        hi = _b.pair_to_key(int(pg[C.W_HIGH_HI]), int(pg[C.W_HIGH_LO]))
+        s_live = (fver == rver) & (fver != 0)
+        oob = s_live & ((skeys < np.uint64(lo)) | (skeys >= np.uint64(hi)))
+        pg[C.L_VER_W:C.L_VER_W + LC][torn | oob] = 0
+        return pg
+
+    @staticmethod
+    def _chain_leaf_entries(chain_pg: np.ndarray) -> dict[int, int]:
+        """{key: value} of every live slot of a chain-tip LEAF image —
+        the resurrection candidate pool for stale-page repair.  Empty
+        for dead/internal chain rows (a page allocated after the tip
+        has no chain content to resurrect)."""
+        if int(chain_pg[C.W_FRONT_VER]) == 0 \
+                or int(chain_pg[C.W_LEVEL]) != 0:
+            return {}
+        from sherman_tpu.ops import layout
+        return {int(k): int(v)
+                for k, v, _ in layout.np_leaf_entries(
+                    np.asarray(chain_pg, np.int32))}

@@ -117,19 +117,48 @@ def _map_ptrs(ptrs: np.ndarray, amap: np.ndarray, P_old: int,
     return np.where(live, mapped, 0).astype(np.int32)
 
 
-def reshard(src: str, dst: str, machine_nr: int, *,
-            pages_per_node: int | None = None,
-            locks_per_node: int | None = None,
-            hosts: int = 1) -> dict:
-    """Rewrite checkpoint ``src`` for a ``machine_nr``-node cluster into
-    ``dst``.  -> summary dict (live_pages, per-node occupancy, geometry).
+def live_rows(front_ver: np.ndarray, next_by_node: np.ndarray,
+              dir_free, P_old: int, N_old: int) -> np.ndarray:
+    """Global pool rows the repack must carry: every allocated page
+    ([1, dir_next) per node — the bump allocators never reuse, so the
+    high-water mark bounds every allocated page) minus leased-but-
+    never-written chunk-tail pages (``front_ver == 0``, the pool's
+    ``W_FRONT_VER`` column — the same liveness test the leaf scan uses:
+    every written page has a nonzero front version) minus the
+    reclaimed-page free pool (nonzero versions but unreachable from the
+    tree; repacking them would resurrect them as permanent dead
+    weight).  Shared by the offline transform below and the online
+    migrator's copy plan (:mod:`sherman_tpu.migrate`) — ONE liveness
+    definition, so the two paths cannot diverge on what "the pool's
+    content" means."""
+    rows = np.concatenate([
+        n * P_old + np.arange(1, int(next_by_node[n]), dtype=np.int64)
+        for n in range(N_old)]) if N_old else np.zeros(0, np.int64)
+    if rows.size:
+        rows = rows[front_ver[rows] != 0]
+    if rows.size and dir_free is not None and np.asarray(dir_free).size:
+        fa = np.asarray(dir_free).astype(np.int64)
+        fnode = (fa >> C.ADDR_PAGE_BITS) & 0xFF
+        fpage = fa & C.ADDR_PAGE_MASK
+        rows = rows[~np.isin(rows, fnode * P_old + fpage)]
+    return rows
 
-    ``pages_per_node`` defaults to preserving the total pool size
-    (``old_total // machine_nr``).  ``hosts > 1`` emits the multi-host
-    checkpoint format (``machine_nr`` must divide evenly; restore with
-    one process per host).  The source may be either format.
+
+def reshard_arrays(man: dict, pool: np.ndarray, locks: np.ndarray,
+                   counters: np.ndarray, machine_nr: int, *,
+                   pages_per_node: int | None = None,
+                   locks_per_node: int | None = None):
+    """The pure array-level address-space rewrite: (manifest, state
+    arrays) of an N-node pool -> (arrays, new_cfg, summary) for an
+    M-node pool.  No file I/O — :func:`reshard` wraps it for the
+    offline checkpoint workflow and ``sherman_tpu/migrate.py`` feeds it
+    the staged image of a LIVE pool at cutover, so the online and
+    offline transforms are the same code by construction (the drill's
+    bit-identity pin leans on exactly this).
+
+    ``arrays`` holds ``pool``/``locks``/``counters`` plus the new
+    manifest fields (:data:`~sherman_tpu.utils.checkpoint._MANIFEST_FIELDS`).
     """
-    man, pool, locks, counters = _load_checkpoint(src)
     old_cfg = cfg_from_json(man["cfg"])  # raises on layout mismatch
     cfg_dict = {f: getattr(old_cfg, f) for f in _CFG_FIELDS}
     N_old, P_old = old_cfg.machine_nr, old_cfg.pages_per_node
@@ -137,33 +166,15 @@ def reshard(src: str, dst: str, machine_nr: int, *,
         raise ReshardError(f"pool shape {pool.shape} does not match the "
                            f"manifest config ({N_old}x{P_old} pages)")
 
-    # 1. live rows per old node: [1, dir_next) — the bump allocators never
-    # reuse, so the high-water mark bounds every allocated page (leased-
-    # but-unused chunk tails ride along as zero pages, same bounded waste
-    # as the reference's no-op free)
+    # 1. live rows per old node (see live_rows: allocated minus unwritten
+    # tails minus the dir_free pool).  dir_next for the new checkpoint
+    # comes from the packed counts below, so dropped rows return to the
+    # allocatable tail.
     next_by_node = np.ones(N_old, np.int64)
     for nid, nxt in zip(man["dir_nodes"], man["dir_next"]):
         next_by_node[int(nid)] = int(nxt)
-    rows = np.concatenate([
-        n * P_old + np.arange(1, next_by_node[n], dtype=np.int64)
-        for n in range(N_old)]) if N_old else np.zeros(0, np.int64)
-    # drop leased-but-never-written chunk-tail pages (W_FRONT_VER == 0,
-    # the same liveness test the leaf scan uses — every written page has a
-    # nonzero front version, layout.py:215): repacking them as occupied
-    # rows would permanently inflate live_pages and the minimum
-    # pages_per_node of every subsequent reshard.  dir_next for the new
-    # checkpoint comes from the packed counts below, so dropped rows
-    # return to the allocatable tail.
-    if rows.size:
-        rows = rows[pool[rows, C.W_FRONT_VER] != 0]
-    # also drop the reclaimed-page free pool (dir_free): those pages have
-    # nonzero versions but are unreachable from the tree; repacking them
-    # would resurrect them as permanent dead weight
-    if rows.size and "dir_free" in man and np.asarray(man["dir_free"]).size:
-        fa = np.asarray(man["dir_free"]).astype(np.int64)
-        fnode = (fa >> C.ADDR_PAGE_BITS) & 0xFF
-        fpage = fa & C.ADDR_PAGE_MASK
-        rows = rows[~np.isin(rows, fnode * P_old + fpage)]
+    rows = live_rows(pool[:, C.W_FRONT_VER], next_by_node,
+                     man.get("dir_free"), P_old, N_old)
     L = rows.size
 
     # 2. new geometry + block assignment (page 0 per new node reserved)
@@ -233,38 +244,70 @@ def reshard(src: str, dst: str, machine_nr: int, *,
         dir_free=np.zeros(0, np.int64),
     )
     assert set(new_man) == set(_MANIFEST_FIELDS)
-
-    if not dst.endswith(".npz"):
-        dst += ".npz"
-    if hosts == 1:
-        _savez_atomic(dst, 0, pool=new_pool, locks=new_locks,
-                      counters=new_counters, **new_man)
-    else:
-        if machine_nr % hosts:
-            raise ConfigError(f"hosts={hosts} must divide machine_nr="
-                             f"{machine_nr} (contiguous node blocks)")
-        nph = machine_nr // hosts
-        epoch = make_epoch(new_man, 0)
-        for h in range(hosts):
-            nodes = np.arange(h * nph, (h + 1) * nph, dtype=np.int64)
-            sl = slice(h * nph * pages_per_node, (h + 1) * nph * pages_per_node)
-            _savez_atomic(
-                f"{dst}.host{h}.npz", h,
-                pool=new_pool[sl],
-                locks=new_locks[h * nph * new_cfg.locks_per_node:
-                                (h + 1) * nph * new_cfg.locks_per_node],
-                counters=new_counters[h * nph * N_COUNTERS:
-                                      (h + 1) * nph * N_COUNTERS],
-                nodes=nodes, epoch=epoch)
-        _savez_atomic(dst, 0, multihost=np.asarray([hosts], np.int64),
-                      epoch=epoch, **new_man)
-
-    return {
+    arrays = dict(pool=new_pool, locks=new_locks, counters=new_counters,
+                  **new_man)
+    summary = {
         "live_pages": int(L),
         "old": {"machine_nr": N_old, "pages_per_node": P_old},
-        "new": {"machine_nr": machine_nr, "pages_per_node": pages_per_node,
-                "hosts": hosts},
+        "new": {"machine_nr": machine_nr, "pages_per_node": pages_per_node},
         "pages_per_new_node": counts.tolist(),
         "root": new_root,
         "root_level": root_level,
     }
+    return arrays, new_cfg, summary
+
+
+def write_resharded(dst: str, arrays: dict, new_cfg, hosts: int = 1) -> str:
+    """Persist a :func:`reshard_arrays` result as a restorable
+    checkpoint (single-process format, or per-host shard files +
+    epoch-tagged manifest when ``hosts > 1``).  Returns the manifest
+    path written."""
+    machine_nr = new_cfg.machine_nr
+    pages_per_node = new_cfg.pages_per_node
+    new_man = {k: arrays[k] for k in _MANIFEST_FIELDS}
+    if not dst.endswith(".npz"):
+        dst += ".npz"
+    if hosts == 1:
+        _savez_atomic(dst, 0, pool=arrays["pool"], locks=arrays["locks"],
+                      counters=arrays["counters"], **new_man)
+        return dst
+    if machine_nr % hosts:
+        raise ConfigError(f"hosts={hosts} must divide machine_nr="
+                          f"{machine_nr} (contiguous node blocks)")
+    nph = machine_nr // hosts
+    epoch = make_epoch(new_man, 0)
+    for h in range(hosts):
+        nodes = np.arange(h * nph, (h + 1) * nph, dtype=np.int64)
+        sl = slice(h * nph * pages_per_node, (h + 1) * nph * pages_per_node)
+        _savez_atomic(
+            f"{dst}.host{h}.npz", h,
+            pool=arrays["pool"][sl],
+            locks=arrays["locks"][h * nph * new_cfg.locks_per_node:
+                                  (h + 1) * nph * new_cfg.locks_per_node],
+            counters=arrays["counters"][h * nph * N_COUNTERS:
+                                        (h + 1) * nph * N_COUNTERS],
+            nodes=nodes, epoch=epoch)
+    _savez_atomic(dst, 0, multihost=np.asarray([hosts], np.int64),
+                  epoch=epoch, **new_man)
+    return dst
+
+
+def reshard(src: str, dst: str, machine_nr: int, *,
+            pages_per_node: int | None = None,
+            locks_per_node: int | None = None,
+            hosts: int = 1) -> dict:
+    """Rewrite checkpoint ``src`` for a ``machine_nr``-node cluster into
+    ``dst``.  -> summary dict (live_pages, per-node occupancy, geometry).
+
+    ``pages_per_node`` defaults to preserving the total pool size
+    (``old_total // machine_nr``).  ``hosts > 1`` emits the multi-host
+    checkpoint format (``machine_nr`` must divide evenly; restore with
+    one process per host).  The source may be either format.
+    """
+    man, pool, locks, counters = _load_checkpoint(src)
+    arrays, new_cfg, summary = reshard_arrays(
+        man, pool, locks, counters, machine_nr,
+        pages_per_node=pages_per_node, locks_per_node=locks_per_node)
+    write_resharded(dst, arrays, new_cfg, hosts=hosts)
+    summary["new"]["hosts"] = hosts
+    return summary

@@ -234,6 +234,117 @@ def test_fuzz_chaos_detection(eight_devices):
     assert dev["keys"] == len(model)
 
 
+def test_fuzz_migrate_chaos_detection(eight_devices, tmp_path):
+    """Chaos storm DURING online migration: every round fires a random
+    FaultPlan between migration batches and asserts detection-or-typed-
+    rejection, never silent data loss — pool corruption shows as scrub
+    violations (a degraded engine then aborts the migration TYPED,
+    ``MigrationAborted``), wedged locks are revoked or deferred
+    (``lock_conflicts``), writes end in typed outcomes.  Each round
+    repairs (plan.undo) and the storm's survivor completes the
+    migration with the final pool bit-identical to the offline
+    transform — corruption never leaks into the emitted checkpoint."""
+    from sherman_tpu import chaos as CH
+    from sherman_tpu.migrate import MigrationAborted, Migrator
+    from sherman_tpu.models.scrub import Scrubber
+    from sherman_tpu.models.validate import check_structure_device
+    from sherman_tpu.utils import checkpoint as CK
+    from sherman_tpu.utils.reshard import reshard
+
+    rng = np.random.default_rng(77)
+    cfg = DSMConfig(machine_nr=4, pages_per_node=2048, locks_per_node=512,
+                    step_capacity=512, chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    from sherman_tpu.config import TreeConfig
+    eng = batched.BatchedEngine(tree, batch_per_node=128,
+                                tcfg=TreeConfig(lock_retry_rounds=2))
+    keyspace = np.unique(rng.integers(1, 1 << 56, 4000, dtype=np.uint64))
+    model: dict[int, int] = {}
+    k0 = keyspace[: keyspace.shape[0] // 2]
+    batched.bulk_load(tree, k0, k0 * np.uint64(3))
+    eng.attach_router()
+    model.update(zip(k0.tolist(), (k0 * np.uint64(3)).tolist()))
+    scr = Scrubber(eng, interval=1, quarantine=False)
+
+    mdir = str(tmp_path / "mig")
+    mig = Migrator(cluster, tree, eng, 6, mdir,
+                   target_pages_per_node=2048, batch_pages=16)
+    mig.start()
+
+    for it in range(6):
+        plan = CH.FaultPlan.random(5000 + it, n_faults=2, step_hi=1)
+        cluster.dsm.install_chaos(plan)
+        cluster.dsm.read_word(0, 0)
+        cluster.dsm.install_chaos(None)
+        corrupting = [f for f in plan.faults
+                      if f.kind in ("torn_page", "flip_entry_ver")]
+        res = scr.scrub()
+        if corrupting:
+            assert res["violations"] >= 1, (it, plan.describe())
+        # migration between faults: a degraded engine must abort TYPED;
+        # otherwise batches keep landing (wedged locks revoke or defer)
+        try:
+            mig.step()
+        except MigrationAborted:
+            assert eng.degraded  # the only legal abort trigger here
+        # writes end typed: applied / superseded / host / lock-timeout
+        # / DegradedError
+        ks = rng.choice(keyspace, size=80, replace=True)
+        vs = ks ^ np.uint64(it * 17 + 5)
+        try:
+            st = eng.insert(ks, vs)
+        except batched.DegradedError:
+            st = None
+        if st is not None:
+            resolved = (st["applied"] + st["superseded"] + st["host_path"]
+                        + st["lock_timeouts"])
+            assert resolved == ks.size, st
+            timed_out = set(st["lock_timeout_keys"])
+            first = np.unique(ks, return_index=True)[1]
+            for i in sorted(first):
+                if int(ks[i]) not in timed_out:
+                    model[int(ks[i])] = int(vs[i])
+        # repair + continue (a fresh migrator after a typed abort —
+        # resume-after-abort is the drill's crash path, not this storm)
+        assert plan.undo(cluster.dsm) >= 0
+        scr.flagged.clear()
+        eng.exit_degraded()
+        if mig.aborted is not None:
+            mig.close()
+            mig = Migrator(cluster, tree, eng, 6, mdir, batch_pages=16,
+                           target_pages_per_node=2048)
+            mig.start()
+        probe = rng.choice(keyspace, size=150, replace=False)
+        v, f = eng.search(probe)
+        exp_f = np.array([int(k) in model for k in probe])
+        np.testing.assert_array_equal(f, exp_f)
+        exp_v = np.array([model.get(int(k), 0) for k in probe], np.uint64)
+        np.testing.assert_array_equal(v[f], exp_v[exp_f])
+
+    assert scr.scrub()["violations"] == 0
+    mig.run_to_copied()
+    online = str(tmp_path / "online.npz")
+    mig.finish(online)
+    src = str(tmp_path / "src.npz")
+    CK.checkpoint(cluster, src)
+    offline = str(tmp_path / "offline.npz")
+    reshard(src, offline, 6, pages_per_node=2048)
+    with np.load(online) as a, np.load(offline) as b:
+        for k in ("pool", "locks", "counters", "dir_next", "dir_free"):
+            assert np.array_equal(a[k], b[k]), k
+    c2 = CK.restore(online)
+    t2 = Tree(c2)
+    e2 = batched.BatchedEngine(t2, batch_per_node=128)
+    e2.attach_router()
+    mk = np.asarray(sorted(model), np.uint64)
+    v, f = e2.search(mk)
+    assert f.all()
+    np.testing.assert_array_equal(
+        v, np.asarray([model[int(k)] for k in mk], np.uint64))
+    check_structure_device(t2)
+
+
 def test_fuzz_journal_torn_and_flipped(tmp_path):
     """Journal robustness storm: random segments, random truncations
     (crash mid-append) and random single-byte flips.  Contract: parsing

@@ -1,0 +1,399 @@
+"""Online elastic reshard tests (sherman_tpu/migrate.py): grow/shrink
+under traffic, crash-resume from journaled batch artifacts, lock-
+conflict deferral + typed writer rejection, degraded-mode interaction,
+hot-key-cache coherence, and the offline-vs-online bit-identity pin.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig, TreeConfig
+from sherman_tpu.migrate import MigrationAborted, Migrator
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.utils import checkpoint as CK
+from sherman_tpu.utils.reshard import reshard
+
+IDENT_KEYS = ("pool", "locks", "counters", "dir_nodes", "dir_next",
+              "dir_root", "dir_free")
+
+
+def _cluster(nodes=4, pages=256, batch=64):
+    cfg = DSMConfig(machine_nr=nodes, pages_per_node=pages,
+                    locks_per_node=128, step_capacity=128, chunk_pages=16)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=batch,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    return cluster, tree, eng
+
+
+def _load(tree, eng, n=1500, seed=3):
+    rng = np.random.default_rng(seed)
+    keys = np.unique(rng.integers(1, 1 << 48, int(n * 1.2),
+                                  dtype=np.uint64))[:n]
+    vals = keys * np.uint64(5)
+    batched.bulk_load(tree, keys, vals)
+    eng.attach_router()
+    return keys, vals
+
+
+def _assert_identity(online: str, offline: str):
+    with np.load(online) as a, np.load(offline) as b:
+        for k in IDENT_KEYS:
+            assert np.array_equal(a[k], b[k]), \
+                f"online vs offline reshard differ on {k!r}"
+
+
+def _finish_and_pin(cluster, mig, tmp_path, target_nodes, ppn):
+    """finish() the migration, then pin bit-identity against the
+    offline transform of the same final logical state."""
+    online = str(tmp_path / "online.npz")
+    summary = mig.finish(online)
+    src = str(tmp_path / "final_src.npz")
+    CK.checkpoint(cluster, src)
+    offline = str(tmp_path / "offline.npz")
+    reshard(src, offline, target_nodes, pages_per_node=ppn)
+    _assert_identity(online, offline)
+    return online, summary
+
+
+def _restore_and_verify(online, target_nodes, keys, val_of):
+    cluster = CK.restore(online)
+    assert cluster.cfg.machine_nr == target_nodes
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=64)
+    eng.attach_router()
+    got, found = eng.search(keys)
+    assert found.all(), f"lost {int((~found).sum())} keys in live reshard"
+    np.testing.assert_array_equal(
+        got, np.asarray([val_of[int(k)] for k in keys], np.uint64))
+    from sherman_tpu.models.validate import check_structure_device
+    check_structure_device(tree)
+    return cluster, tree, eng
+
+
+def test_migrate_grow_under_traffic(eight_devices, tmp_path):
+    """4 -> 6 nodes with inserts/deletes interleaved between migration
+    batches: every batch locks under the migrator's lease, post-copy
+    writes re-stage at cutover, and the emitted pool is bit-identical
+    to the offline transform of the final state."""
+    cluster, tree, eng = _cluster()
+    keys, vals = _load(tree, eng, n=1500)
+    rng = np.random.default_rng(11)
+    extra = np.unique(rng.integers(1 << 50, 1 << 51, 700,
+                                   dtype=np.uint64))[:600]
+    mig = Migrator(cluster, tree, eng, 6, str(tmp_path / "mig"),
+                   target_pages_per_node=256, batch_pages=16)
+    info = mig.start()
+    assert info["live_pages"] > 10
+    val_of = dict(zip(keys.tolist(), vals.tolist()))
+    i = 0
+    while i < extra.size or not mig.copied_all:
+        mig.step()
+        if i < extra.size:
+            b = extra[i:i + 100]
+            eng.insert(b, b ^ np.uint64(0xAB))
+            val_of.update((int(k), int(k ^ np.uint64(0xAB)))
+                          for k in b)
+            i += 100
+    dropped = keys[::9]
+    gone = eng.delete(dropped)
+    assert gone.all()
+    for k in dropped.tolist():
+        val_of.pop(int(k))
+    assert mig.batches > 3 and mig.pages_moved >= info["live_pages"]
+
+    online, summary = _finish_and_pin(cluster, mig, tmp_path, 6, 256)
+    assert summary["retries"] > 0  # traffic really dirtied staged pages
+    live_keys = np.asarray(sorted(val_of), np.uint64)
+    _, _, e2 = _restore_and_verify(online, 6, live_keys, val_of)
+    _, fdel = e2.search(dropped)
+    assert not fdel.any()
+    # the grown cluster keeps working: fresh inserts + splits
+    fresh = np.unique(np.random.default_rng(7).integers(
+        1 << 52, 1 << 53, 300, dtype=np.uint64))[:256]
+    st = e2.insert(fresh, fresh)
+    assert st["applied"] + st["superseded"] == fresh.size
+
+
+def test_migrate_shrink(eight_devices, tmp_path):
+    """4 -> 2 nodes: the same protocol, packing down."""
+    cluster, tree, eng = _cluster()
+    keys, vals = _load(tree, eng, n=1200)
+    mig = Migrator(cluster, tree, eng, 2, str(tmp_path / "mig"),
+                   batch_pages=32)
+    mig.start()
+    mig.run_to_copied()
+    online, _ = _finish_and_pin(cluster, mig, tmp_path, 2, None)
+    _restore_and_verify(online, 2, keys,
+                        dict(zip(keys.tolist(), vals.tolist())))
+
+
+def test_migrate_crash_resume(eight_devices, tmp_path):
+    """Crash mid-migration: recover the source (chain + journal), then
+    resume — completed batches reload from their CRC-tagged artifacts
+    and re-verify instead of re-copying; the final pool still matches
+    the offline transform and loses zero acknowledged ops."""
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.utils import journal as J
+
+    cluster, tree, eng = _cluster()
+    keys, vals = _load(tree, eng, n=1200)
+    rdir = str(tmp_path / "rec")
+    mdir = str(tmp_path / "mig")
+    plane = RecoveryPlane(cluster, tree, eng, rdir)
+    plane.checkpoint_base()
+    acked = dict(zip(keys.tolist(), vals.tolist()))
+    mig = Migrator(cluster, tree, eng, 6, mdir,
+                   target_pages_per_node=256, batch_pages=16)
+    mig.start()
+    rng = np.random.default_rng(5)
+    extra = np.unique(rng.integers(1 << 50, 1 << 51, 500,
+                                   dtype=np.uint64))[:400]
+    for r in range(4):
+        mig.step()
+        b = extra[r * 100:(r + 1) * 100]
+        st = eng.insert(b, b ^ np.uint64(0xCD))
+        assert st["lock_timeouts"] == 0
+        acked.update((int(k), int(k ^ np.uint64(0xCD))) for k in b)
+        if r == 1:
+            plane.checkpoint_delta()  # dirty sink rides the clear
+    staged_before = mig.staged_pages
+    assert staged_before > 0 and mig.seq >= 4
+
+    # crash: torn journal tail, cluster dropped cold
+    jpath = eng.journal.path
+    plane.close()
+    mig.close()
+    with open(jpath, "ab") as f:
+        rec = J.encode_record(J.J_UPSERT, np.asarray([1], np.uint64),
+                              np.asarray([2], np.uint64))
+        f.write(rec[: len(rec) // 2])
+    del cluster, tree, eng
+
+    plane, cluster, tree, eng, _ = RecoveryPlane.recover(
+        rdir, batch_per_node=64, tcfg=TreeConfig(sibling_chase_budget=1))
+    mig = Migrator.resume(cluster, tree, eng, mdir, batch_pages=16)
+    assert mig.resume_count == 1
+    assert mig.staged_pages == staged_before  # artifacts survived
+    mig.run_to_copied()
+    online, summary = _finish_and_pin(cluster, mig, tmp_path, 6, 256)
+    # resumed, not restarted: a good share of the pre-crash copies
+    # re-certified clean instead of re-staging
+    assert summary["resume_verified"] > 0
+    lk = np.asarray(sorted(acked), np.uint64)
+    _restore_and_verify(online, 6, lk, acked)
+    plane.close()
+
+
+def test_migrate_resume_drops_corrupt_artifact(eight_devices, tmp_path):
+    """A bit-flipped batch artifact fails its CRC at resume and is
+    dropped (its pages re-copy) — typed detection, never staged
+    garbage."""
+    cluster, tree, eng = _cluster()
+    keys, vals = _load(tree, eng, n=800)
+    mdir = str(tmp_path / "mig")
+    mig = Migrator(cluster, tree, eng, 6, mdir,
+                   target_pages_per_node=256, batch_pages=16)
+    mig.start()
+    mig.step()
+    mig.step()
+    art = mig._batch_path(1)
+    blob = bytearray(open(art, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    open(art, "wb").write(bytes(blob))
+    mig.close()
+    m2 = Migrator.resume(cluster, tree, eng, mdir, batch_pages=16)
+    # the corrupt artifact's pages dropped out of the staged set and
+    # are back on the plan; completion still converges + pins identity
+    m2.run_to_copied()
+    online, _ = _finish_and_pin(cluster, m2, tmp_path, 6, 256)
+    _restore_and_verify(online, 6, keys,
+                        dict(zip(keys.tolist(), vals.tolist())))
+
+
+def test_migrate_lock_conflict_defers_and_writer_rejects_typed(
+        eight_devices, tmp_path):
+    """Both directions of the lock race: (a) a page held by a LIVE
+    foreign lease defers out of the migration batch (lock_conflicts)
+    and copies after release; (b) a writer hitting a page the migrator
+    holds retries through the bounded budget and rejects TYPED
+    (ST_LOCK_TIMEOUT) — never a wrong answer, never an unbounded
+    spin."""
+    from sherman_tpu.ops import bits
+    from sherman_tpu.parallel import dsm as D
+
+    cluster, tree, eng = _cluster()
+    eng.tcfg = TreeConfig(sibling_chase_budget=1, lock_retry_rounds=2)
+    keys, vals = _load(tree, eng, n=800)
+    mig = Migrator(cluster, tree, eng, 6, str(tmp_path / "mig"),
+                   target_pages_per_node=256, batch_pages=1024)
+
+    # (a) a foreign LIVE client holds one leaf's lock word
+    victim_key = int(keys[400])
+    victim = int(tree._descend(victim_key)[0])
+    holder = cluster.register_client()
+    la = tree._lock_word_addr(victim)
+    _, won = tree.dsm.cas(la, 0, 0, holder.lease, space=D.SPACE_LOCK)
+    assert won
+    mig.start()
+    mig.run_to_copied(max_batches=3)  # deferred page keeps pending
+    P = cluster.cfg.pages_per_node
+    vrow = bits.addr_node(victim) * P + bits.addr_page(victim)
+    assert mig.lock_conflicts >= 1
+    assert not mig.is_staged(vrow)  # deferred, not silently skipped
+    tree.dsm.write_word(la, 0, 0, space=D.SPACE_LOCK)
+    mig.run_to_copied(max_batches=3)
+    assert mig.is_staged(vrow)
+
+    # (b) migrator holds a batch mid-copy; a writer to those pages
+    # exhausts its bounded retry budget with the typed rejection
+    addrs, held = mig._acquire_locks([victim])
+    assert addrs == [victim]
+    st = eng.insert(np.asarray([victim_key], np.uint64),
+                    np.asarray([123], np.uint64), max_rounds=3)
+    assert st["lock_timeouts"] == 1
+    assert st["lock_timeout_keys"] == [victim_key]
+    mig._release_locks(held)
+    st = eng.insert(np.asarray([victim_key], np.uint64),
+                    np.asarray([123], np.uint64))
+    assert st["applied"] == 1
+    got, found = eng.search(np.asarray([victim_key], np.uint64))
+    assert found.all() and int(got[0]) == 123
+
+
+def test_migrate_degraded_aborts_typed(eight_devices, tmp_path):
+    """A degraded engine mid-migration aborts the migration TYPED
+    (MigrationAborted + migrate.abort flight event); the source pool
+    keeps serving reads, and start() refuses on a degraded engine."""
+    from sherman_tpu import obs
+
+    cluster, tree, eng = _cluster()
+    keys, _ = _load(tree, eng, n=600)
+    mig = Migrator(cluster, tree, eng, 6, str(tmp_path / "mig"),
+                   batch_pages=8)
+    mig.start()
+    mig.step()
+    eng.enter_degraded("test damage")
+    with pytest.raises(MigrationAborted):
+        mig.step()
+    assert mig.aborted is not None
+    ev = [e for e in obs.get_recorder().events()
+          if e.get("kind") == "migrate.abort"]
+    assert ev, "migrate.abort flight event missing"
+    with pytest.raises(MigrationAborted):
+        mig.finish(str(tmp_path / "x.npz"))
+    # reads still serve on the source
+    _, found = eng.search(keys[:32])
+    assert found.all()
+    eng.exit_degraded()
+    m2 = Migrator(cluster, tree, eng, 6, str(tmp_path / "mig2"),
+                  batch_pages=8)
+    eng.enter_degraded("still broken")
+    with pytest.raises(MigrationAborted):
+        m2.start()
+
+
+def test_migrate_leaf_cache_coherence(eight_devices, tmp_path):
+    """Hot-key reads DURING migration stay bit-identical to uncached
+    descents: every migration batch scatter-invalidates its pages'
+    cache entries (the volatile-across-recovery contract extended to
+    migration batches)."""
+    from sherman_tpu import obs
+
+    cluster, tree, eng = _cluster()
+    keys, vals = _load(tree, eng, n=1000)
+    cache = eng.attach_leaf_cache(slots=1024)
+    hot = keys[::10][:200]
+    cache.fill(hot)
+    snap0 = obs.snapshot()
+    mig = Migrator(cluster, tree, eng, 6, str(tmp_path / "mig"),
+                   target_pages_per_node=256, batch_pages=16)
+    mig.start()
+    rng = np.random.default_rng(9)
+    while not mig.copied_all:
+        mig.step()
+        # cached reads mid-migration: answers must be bit-identical to
+        # the model regardless of which pages just migrated
+        probe = rng.choice(hot, size=64, replace=True)
+        got, found = eng.search(probe)
+        assert found.all()
+        np.testing.assert_array_equal(got, probe * np.uint64(5))
+        # writes keep invalidating; re-admit some heat
+        b = keys[rng.integers(0, keys.size, 20)]
+        eng.insert(b, b * np.uint64(5))
+    d = obs.delta(snap0, obs.snapshot())
+    assert d.get("cache.invalidations", 0) > 0, \
+        "migration batches never scatter-invalidated the hot-key tier"
+    online, _ = _finish_and_pin(cluster, mig, tmp_path, 6, 256)
+    _restore_and_verify(online, 6, keys,
+                        dict(zip(keys.tolist(), vals.tolist())))
+
+
+def test_migrate_dirty_sink_rides_checkpoint_clear(eight_devices,
+                                                   tmp_path):
+    """A delta checkpoint consume-and-clears the dirty tracking; the
+    registered sink must hand the migrator the cleared rows so a
+    post-copy write hidden behind the clear still re-stages."""
+    from sherman_tpu.recovery import RecoveryPlane
+
+    cluster, tree, eng = _cluster()
+    keys, vals = _load(tree, eng, n=800)
+    plane = RecoveryPlane(cluster, tree, eng, str(tmp_path / "rec"))
+    plane.checkpoint_base()
+    mig = Migrator(cluster, tree, eng, 6, str(tmp_path / "mig"),
+                   target_pages_per_node=256, batch_pages=2048)
+    mig.start()
+    mig.run_to_copied()  # everything staged
+    # dirty a staged page, then let a checkpoint clear the tracking
+    st = eng.insert(keys[:64], keys[:64] ^ np.uint64(0x77))
+    assert st["lock_timeouts"] == 0
+    plane.checkpoint_delta()
+    assert mig._dirt, "clear hid the post-copy writes from the migrator"
+    online, _ = _finish_and_pin(cluster, mig, tmp_path, 6, 256)
+    val_of = dict(zip(keys.tolist(), vals.tolist()))
+    val_of.update((int(k), int(k ^ np.uint64(0x77)))
+                  for k in keys[:64])
+    _restore_and_verify(online, 6, keys, val_of)
+    plane.close()
+
+
+def test_migrate_undersized_target_rejected_at_start(eight_devices,
+                                                     tmp_path):
+    """An obviously undersized target fails typed at start() — before
+    any lock/copy/journal work — not as a cutover surprise after the
+    whole pool was copied."""
+    from sherman_tpu.errors import ConfigError
+
+    cluster, tree, eng = _cluster()
+    _load(tree, eng, n=1200)
+    mig = Migrator(cluster, tree, eng, 2, str(tmp_path / "mig"),
+                   target_pages_per_node=8, batch_pages=16)
+    with pytest.raises(ConfigError, match="cannot fit"):
+        mig.start()
+    assert not mig.started and mig.batches == 0
+
+
+def test_migrate_collector_snapshot(eight_devices, tmp_path):
+    """The ``migrate.`` pull collector publishes the satellite's
+    counters/gauges on every snapshot."""
+    from sherman_tpu import obs
+
+    cluster, tree, eng = _cluster()
+    _load(tree, eng, n=500)
+    mig = Migrator(cluster, tree, eng, 6, str(tmp_path / "mig"),
+                   batch_pages=8)
+    mig.start()
+    mig.step()
+    snap = obs.snapshot()
+    for k in ("migrate.pages_moved", "migrate.batches",
+              "migrate.retries", "migrate.lock_conflicts",
+              "migrate.resume_count", "migrate.epoch",
+              "migrate.in_progress"):
+        assert k in snap, k
+    assert snap["migrate.pages_moved"] > 0
+    assert snap["migrate.in_progress"] == 1

@@ -508,6 +508,127 @@ def test_targeted_repair_exits_degraded(eight_devices, tmp_path):
     plane.close()
 
 
+def test_targeted_repair_page_split_since_tip(eight_devices, tmp_path):
+    """Page-version-aware repair (the migration-hot path): a page that
+    SPLIT after the chain tip is damaged; blind-restoring its chain-tip
+    image would resurrect the pre-split page beside its live sibling
+    (duplicate range coverage, double in-degree — the full-restore
+    fallback of old).  The version-aware path patches the LIVE page in
+    place, re-certifies green, and resurrects any chain-tip key the
+    cleared slots dropped — no full restore, keys all correct."""
+    from sherman_tpu import chaos as CH
+    from sherman_tpu import obs
+    from sherman_tpu.models.scrub import Scrubber
+    from sherman_tpu.ops import layout
+    from sherman_tpu.recovery import RecoveryPlane
+
+    cluster, tree, eng = _small_cluster(pages=1024)
+    eng.tcfg = TreeConfig(sibling_chase_budget=1, lock_retry_rounds=2)
+    keys, vals = _load(tree, eng, n=800, seed=21)
+    rdir = str(tmp_path / "r")
+    plane = RecoveryPlane(cluster, tree, eng, rdir)
+    plane.checkpoint_base()
+
+    # force a POST-TIP split of one specific leaf: insert a dense run
+    # inside its fence until it must split (front version moves past
+    # the chain's)
+    victim = int(tree._descend(int(keys[400]))[0])
+    pg = tree.dsm.read_page(victim)
+    lo, hi = layout.np_lowest(pg), layout.np_highest(pg)
+    fv_tip = int(pg[0])
+    dense = np.arange(lo, min(hi, lo + 80), dtype=np.uint64)[:64]
+    dense = dense[(dense >= max(1, lo)) & (dense < hi)]
+    st = eng.insert(dense, dense ^ np.uint64(0x5050))
+    assert st["lock_timeouts"] == 0
+    pg2 = tree.dsm.read_page(victim)
+    assert int(pg2[0]) > fv_tip, "leaf did not split post-tip"
+
+    # damage the split page: structural (torn version pair) + a torn
+    # entry slot
+    scr = Scrubber(eng, interval=1)
+    assert scr.scrub()["violations"] == 0
+    plan = CH.FaultPlan([
+        CH.Fault(kind="torn_page", step=0, addr=victim),
+        CH.Fault(kind="flip_entry_ver", step=0, addr=victim, slot=3),
+    ])
+    cluster.dsm.install_chaos(plan)
+    cluster.dsm.read_word(0, 0)
+    cluster.dsm.install_chaos(None)
+    res = scr.scrub()
+    assert res["violations"] >= 1 and eng.degraded
+    recovers = int(obs.snapshot().get("recovery.recovers", 0))
+    stale0 = int(obs.snapshot().get("recovery.stale_page_repairs", 0))
+
+    rep = plane.targeted_repair(scr)  # would raise/corrupt before the fix
+    assert rep["pages"] >= 1 and rep["stale_pages"] >= 1
+    assert not eng.degraded
+    assert int(obs.snapshot().get("recovery.recovers", 0)) == recovers
+    assert int(obs.snapshot().get("recovery.stale_page_repairs", 0)) \
+        > stale0
+    # structure is green (the old blind restore broke the chain shape
+    # here) and every key — pre-tip, post-tip dense, torn-slot victims
+    # — reads back correct
+    from sherman_tpu.models.validate import check_structure_device
+    check_structure_device(tree)
+    got, found = eng.search(keys)
+    assert found.all()
+    # the dense run may have overwritten a pre-existing key (the leaf's
+    # lowest fence key IS a key): those carry the dense value
+    over = np.isin(keys, dense)
+    np.testing.assert_array_equal(got[~over], vals[~over])
+    np.testing.assert_array_equal(got[over],
+                                  keys[over] ^ np.uint64(0x5050))
+    got, found = eng.search(dense)
+    assert found.all()
+    np.testing.assert_array_equal(got, dense ^ np.uint64(0x5050))
+    st = eng.insert(keys[:8], keys[:8])  # writable again
+    assert st["applied"] + st["superseded"] == 8
+    plane.close()
+
+
+def test_targeted_repair_split_page_with_lowered_version(eight_devices,
+                                                         tmp_path):
+    """Version-LOWERING damage on a since-split page (a zeroed front
+    version half) must not fool the restorable classification into
+    blind-restoring the pre-split chain image beside the live sibling:
+    the structural-identity check routes it to the in-place patch,
+    which heals the pair from the surviving half."""
+    from sherman_tpu import config as C
+    from sherman_tpu.models.validate import check_structure_device
+    from sherman_tpu.ops import layout
+    from sherman_tpu.recovery import RecoveryPlane
+
+    cluster, tree, eng = _small_cluster(pages=1024)
+    eng.tcfg = TreeConfig(sibling_chase_budget=1, lock_retry_rounds=2)
+    keys, vals = _load(tree, eng, n=800, seed=23)
+    plane = RecoveryPlane(cluster, tree, eng, str(tmp_path / "r"))
+    plane.checkpoint_base()
+    victim = int(tree._descend(int(keys[300]))[0])
+    pg = tree.dsm.read_page(victim)
+    lo, hi = layout.np_lowest(pg), layout.np_highest(pg)
+    dense = np.arange(max(1, lo), min(hi, max(1, lo) + 80),
+                      dtype=np.uint64)[:64]
+    st = eng.insert(dense, dense ^ np.uint64(0x6060))
+    assert st["lock_timeouts"] == 0
+    assert int(tree.dsm.read_page(victim)[0]) > int(pg[0]), "no split"
+    # version-LOWERING damage: zero the front half (the page now looks
+    # unwritten to the scrubber — ground-truth addrs route the repair)
+    tree.dsm.write_words(victim, C.W_FRONT_VER,
+                         np.zeros(1, np.int32))
+    eng.enter_degraded("test: zeroed front version on split page")
+    rep = plane.targeted_repair(addrs=[victim])
+    assert rep["stale_pages"] >= 1 and not eng.degraded
+    check_structure_device(tree)
+    got, found = eng.search(dense)
+    assert found.all()
+    np.testing.assert_array_equal(got, dense ^ np.uint64(0x6060))
+    over = np.isin(keys, dense)
+    got, found = eng.search(keys[~over])
+    assert found.all()
+    np.testing.assert_array_equal(got, vals[~over])
+    plane.close()
+
+
 def test_targeted_repair_failure_is_typed(eight_devices, tmp_path):
     """Damage the repair cannot mend (corruption outside the repaired
     set) fails typed and the engine STAYS degraded."""

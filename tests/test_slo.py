@@ -479,6 +479,32 @@ def test_perfgate_incomparable_receipt_exits_2(tmp_path):
     assert pg.main(["--receipt", p]) == 2
 
 
+def test_perfgate_node_count_change_is_incomparable(tmp_path, capsys):
+    """Elastic-reshard comparability rule: a receipt captured at a
+    different node count never gates against the fixed-shape
+    trajectory — even a halved sustained number SKIPS (the per-node
+    workload changed wholesale).  A missing ``nodes`` field means the
+    pre-field machine_nr=1 bench, so 1-node receipts keep gating."""
+    pg = _perfgate()
+    cand = pg.load_receipt(os.path.join(_repo_root(), "BENCH_r05.json"))
+    cand.pop("_round", None)
+    cand["nodes"] = 6  # a post-reshard capture at the grown shape
+    for k in ("value", "sustained_ops_s", "sus_mixed_ops_s"):
+        cand[k] = round(cand[k] * 0.5)
+    p = str(tmp_path / "resharded.json")
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 2  # nothing comparable at all
+    # same numbers at the trajectory's own shape: a real regression
+    cand["nodes"] = 1
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 1
+    # and a reshard-drill receipt is not a bench receipt: exits 2
+    drill = {"metric": "reshard_drill", "ok": True, "lost_acks": 0,
+             "rpo_ops": 0, "nodes": 4, "target_nodes": 6}
+    json.dump(drill, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 2
+
+
 def test_perfgate_cache_on_never_gates_against_cache_off(tmp_path,
                                                          capsys):
     """Round-10 comparability rule: the hot-key `cache` block is

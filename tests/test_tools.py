@@ -299,6 +299,23 @@ def test_recovery_drill_driver(eight_devices, capsys):
     assert "RECOVERY-DRILL PASS" in capsys.readouterr().err
 
 
+def test_reshard_drill_driver(eight_devices, capsys):
+    # the full capacity drill: live 4->6 grow under mixed acked traffic
+    # -> wedged-lock chaos + cold crash (torn journal tail)
+    # mid-migration -> recover + resume (batches re-verified, not
+    # re-done) -> quiesced cutover -> offline-vs-online bit-identity +
+    # zero lost acks on the restored 6-node cluster
+    import reshard_drill
+    r = reshard_drill.main(["--keys", "2500", "--nodes", "4",
+                            "--target-nodes", "6", "--batch-pages", "24"])
+    assert r["ok"] and r["lost_acks"] == 0 and r["rpo_ops"] == 0
+    assert r["bit_identical"] is True
+    assert r["resume"]["resume_count"] == 1
+    assert r["cutover"]["resume_verified"] > 0
+    assert r["cutover"]["pages_moved"] > 0
+    assert "RESHARD-DRILL PASS" in capsys.readouterr().err
+
+
 def test_device_report_driver(eight_devices, capsys, monkeypatch,
                               tmp_path):
     """White-box device report (CPU smoke of tools/device_report): the

@@ -23,6 +23,16 @@ Comparability rules (the trajectory's own lessons):
 - only rounds with the same ``keys`` and ``batch`` as the candidate
   compare (r01's retracted 107 M predates the accounting and carries
   no config — it filters itself out);
+- a NODE-COUNT change is incomparable config: an elastic reshard
+  (``bench.py --reshard-drill``, ``sherman_tpu/migrate.py``) changes
+  the per-node workload and the exchange topology wholesale, so a
+  receipt captured at M nodes never gates against a round captured at
+  N != M — reshard-drill receipts themselves carry their own metric
+  (``reshard_drill``) and are not bench receipts at all (feeding one
+  here exits 2: no comparable metric).  Rounds predating the ``nodes``
+  field compare as 1-node runs — ``bench.py`` hardcoded
+  ``machine_nr=1`` for the whole committed trajectory, so the default
+  is a fact, not a guess;
 - ``sustained_ops_s`` compares only between device-staged runs (both
   sides must carry ``sus_dev_ms_per_step``): r04's host-shipped 3.9 M
   is a different methodology and must never become the baseline;
@@ -137,6 +147,12 @@ def _cache_on(r: dict) -> bool:
 def _comparable(cand: dict, r: dict, metric: str) -> bool:
     if r.get("keys") != cand.get("keys") \
             or r.get("batch") != cand.get("batch"):
+        return False
+    # node-count rule (see the docstring): a reshard changes the
+    # per-node workload — different node counts never compare.  A
+    # receipt without the field ran machine_nr=1 (the pre-field
+    # bench.py hardcoded it).
+    if (r.get("nodes") or 1) != (cand.get("nodes") or 1):
         return False
     if r.get(metric) is None or cand.get(metric) is None:
         return False

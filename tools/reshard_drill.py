@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Reshard drill: live N→M grow under mixed traffic, crash-restartable.
+
+The capacity drill — third end-to-end rehearsal beside the chaos drill
+(detection) and the recovery drill (durability):
+
+  phase 1  build + bulk-load an N-node CPU mesh, start the recovery
+           plane (base checkpoint + journal) and the online migrator
+           (``sherman_tpu/migrate.py``) toward M nodes.
+  phase 2  MIXED acknowledged traffic (inserts, deletes, reads)
+           interleaved with bounded migration batches — the migrator
+           lock-copies live pages under its own lease while the engine
+           serves; a delta checkpoint lands mid-stream (the migration's
+           dirty re-copy set rides the clear through the DSM dirty
+           sink).  Per-op-class p99 is sampled from the PR 7 SLO plane
+           before and during migration — the published "bounded p99
+           spike" receipt.
+  chaos +  a seeded FaultPlan wedges a lock as held-by-a-dead-client
+  crash    mid-migration (the migrator must revoke it to keep copying),
+           then the cluster is dropped cold with a torn journal tail.
+  recover  ``RecoveryPlane.recover`` (RPO 0 against the acked-op
+           ledger), then ``Migrator.resume``: completed batches are
+           re-verified from their CRC-tagged artifacts, not re-done.
+  finish   more acked traffic, migration completes, quiesced cutover
+           emits the M-node checkpoint.
+  validate the emitted pool must be BIT-IDENTICAL to the offline
+           ``tools/reshard.py`` transform of the same final logical
+           state (same transform by construction — the pin proves the
+           staged image lost zero writes), and the restored M-node
+           cluster must serve every acknowledged op: ``lost_acks == 0``.
+
+Runs on the CPU mesh anywhere (``bench.py --reshard-drill`` forwards
+here; ``scripts/reshard_ci.sh`` pins it in CI).  Prints ONE JSON line
+``{"metric": "reshard_drill", "ok": true, "lost_acks": 0, "rpo_ops": 0,
+"bit_identical": true, ...}`` and mirrors it to
+``SHERMAN_RESHARD_RECEIPT`` when set.  Env knobs: SHERMAN_DRILL_KEYS
+(default 4000), SHERMAN_DRILL_NODES (source N, default 4),
+SHERMAN_DRILL_TARGET_NODES (target M, default 6), SHERMAN_CHAOS_SEED,
+SHERMAN_MIGRATE_BATCH_PAGES (migration batch size).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from common import build_cluster, pages_for_keys, setup_platform
+
+
+def _p99(window: dict, op_class: str) -> float:
+    rec = (window or {}).get(op_class) or {}
+    return float(rec.get("p99_ms") or 0.0)
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--keys", type=int,
+                   default=int(os.environ.get("SHERMAN_DRILL_KEYS", 4000)))
+    p.add_argument("--nodes", type=int,
+                   default=int(os.environ.get("SHERMAN_DRILL_NODES", 4)))
+    p.add_argument("--target-nodes", type=int,
+                   default=int(os.environ.get("SHERMAN_DRILL_TARGET_NODES",
+                                              6)))
+    p.add_argument("--seed", type=int,
+                   default=int(os.environ.get("SHERMAN_CHAOS_SEED", 7)))
+    p.add_argument("--batch-pages", type=int,
+                   default=int(os.environ.get("SHERMAN_MIGRATE_BATCH_PAGES",
+                                              32)),
+                   help="migration batch size (small, so the copy "
+                        "genuinely interleaves with the drill traffic)")
+    p.add_argument("--dir", default=None,
+                   help="drill directory (default: a tempdir)")
+    a = p.parse_args(argv)
+    setup_platform(max(a.nodes, a.target_nodes))
+
+    from sherman_tpu import chaos as CH
+    from sherman_tpu import obs
+    from sherman_tpu.config import TreeConfig
+    from sherman_tpu.migrate import Migrator
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+    from sherman_tpu.models.validate import check_structure_device
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.utils import checkpoint as CK
+    from sherman_tpu.utils import journal as J
+    from sherman_tpu.utils.reshard import reshard
+
+    t_start = time.time()
+    out: dict = {"metric": "reshard_drill", "seed": a.seed, "ok": False,
+                 "nodes": a.nodes, "target_nodes": a.target_nodes}
+    root = a.dir or tempfile.mkdtemp(prefix="sherman_reshard_")
+    rdir = os.path.join(root, "recovery")
+    mdir = os.path.join(root, "migration")
+    out["dir"] = root
+
+    # -- phase 1: build + arm recovery plane + migrator -----------------------
+    ppn = pages_for_keys(a.keys)
+    cluster, tree, eng = build_cluster(
+        a.nodes, ppn, batch_per_node=512,
+        locks_per_node=1024, chunk_pages=64)
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 56, int(a.keys * 1.05),
+                                  dtype=np.uint64))[:a.keys]
+    vals = keys ^ np.uint64(0xE1A57C)
+    batched.bulk_load(tree, keys, vals)
+    eng.attach_router()
+    check_structure_device(tree)
+    plane = RecoveryPlane(cluster, tree, eng, rdir)
+    plane.checkpoint_base()
+    mig = Migrator(cluster, tree, eng, a.target_nodes, mdir,
+                   target_pages_per_node=ppn, batch_pages=a.batch_pages)
+    out["migration"] = mig.start()
+    snap0 = obs.snapshot()
+
+    # acked-op ledger: every (key -> value | None=deleted) whose engine
+    # op RETURNED before the crash — the lost-ack audit set
+    acked: dict = {}
+
+    def ack_insert(ks, vs):
+        st = eng.insert(ks, vs)
+        assert st["lock_timeouts"] == 0, st
+        for k, v in zip(ks.tolist(), vs.tolist()):
+            acked[k] = v
+
+    def ack_delete(ks):
+        gone = eng.delete(ks)
+        assert gone.all()
+        for k in ks.tolist():
+            acked[k] = None
+
+    # baseline read p99 (traffic only, no migration interleaved).  The
+    # first searches compile the read programs; reset the SLO window
+    # after the warmup so neither sample is a compile wall in disguise.
+    from sherman_tpu.obs import slo as SLO
+    for i in range(4):
+        eng.search(keys[i::97])
+    SLO.get_slo().reset()
+    for i in range(6):
+        eng.search(keys[i::61])
+    p99_before = _p99(obs.slo_window(), "read")
+    SLO.get_slo().reset()
+
+    # -- phase 2: mixed traffic x migration batches ---------------------------
+    nb = max(64, a.keys // 10)
+    i = 0
+    rounds = 0
+    while i < 3 * nb:
+        mig.step()
+        rounds += 1
+        b = keys[i: i + nb // 2]
+        ack_insert(b, b ^ np.uint64(0x1111))
+        eng.search(keys[(i + rounds) % nb:: 61])
+        i += nb // 2
+    ack_delete(keys[3 * nb: 3 * nb + nb // 4])
+    d1 = plane.checkpoint_delta()  # the dirty sink rides this clear
+    out["delta1"] = {"pages": d1["pages"]}
+    while not mig.copied_all and rounds < 10_000:
+        mig.step()
+        rounds += 1
+        eng.search(keys[rounds % nb:: 53])
+    p99_during = _p99(obs.slo_window(), "read")
+    # the "bounded p99 spike" receipt: reads keep flowing while the
+    # migrator holds batch locks — the spike is the lock-hold +
+    # interleave tax, published for the trajectory (the hard pins are
+    # lost_acks/rpo/bit-identity; CPU-mesh walls are too noisy to gate)
+    out["slo"] = {"read_p99_before_ms": round(p99_before, 3),
+                  "read_p99_during_ms": round(p99_during, 3),
+                  "read_p99_spike": round(p99_during / p99_before, 2)
+                  if p99_before > 0 else None}
+    pre_crash_moved = mig.pages_moved
+    assert pre_crash_moved > 0 and mig.batches > 1
+
+    # -- chaos mid-migration: wedged lock the migrator must revoke ------------
+    plan = CH.FaultPlan([CH.Fault(kind="wedge_lock", step=0)], seed=a.seed)
+    cluster.dsm.install_chaos(plan)
+    cluster.dsm.read_word(0, 0)
+    cluster.dsm.install_chaos(None)
+    b = keys[3 * nb + nb // 4: 4 * nb]
+    ack_insert(b, b ^ np.uint64(0x2222))
+    mig.step()  # copies through the wedged word via lease revocation
+
+    # -- crash: drop the cluster cold, tear the journal tail ------------------
+    jpath = eng.journal.path
+    plane.close()
+    mig.close()
+    with open(jpath, "ab") as f:  # crash mid-append: torn half-record
+        rec = J.encode_record(J.J_UPSERT, np.asarray([1 << 40], np.uint64),
+                              np.asarray([7], np.uint64))
+        f.write(rec[: len(rec) // 2])
+    del cluster, tree, eng
+
+    # -- recover + resume -----------------------------------------------------
+    t0 = time.perf_counter()
+    plane, cluster, tree, eng, rec = RecoveryPlane.recover(
+        rdir, batch_per_node=512,
+        tcfg=TreeConfig(sibling_chase_budget=1))
+    out["recover"] = {"total_ms": rec["total_ms"],
+                      "replayed": rec["replay"]["records"]}
+    mig = Migrator.resume(cluster, tree, eng, mdir,
+                          batch_pages=a.batch_pages)
+    out["resume"] = {"staged": mig.staged_pages,
+                     "resume_count": mig.resume_count}
+    assert mig.resume_count == 1
+
+    # RPO audit on the recovered source
+    live = {k: v for k, v in acked.items() if v is not None}
+    lk = np.asarray(sorted(live), np.uint64)
+    got, found = eng.search(lk)
+    rpo = int((~found).sum()) + int(
+        (got[found] != np.asarray([live[int(k)] for k in lk],
+                                  np.uint64)[found]).sum())
+    dk = np.asarray([k for k, v in acked.items() if v is None], np.uint64)
+    if dk.size:
+        _, dfound = eng.search(dk)
+        rpo += int(dfound.sum())
+    out["rpo_ops"] = rpo
+    obs.gauge("recovery.rpo_ops").set(rpo)
+    assert rpo == 0, f"RPO violated: {rpo} acknowledged ops lost"
+    out["rto_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+
+    # -- finish: more acked traffic, complete, quiesced cutover ---------------
+    b = keys[4 * nb: 5 * nb]
+    ack_insert(b, b ^ np.uint64(0x3333))
+    mig.run_to_copied()
+    dst = os.path.join(mdir, "online.npz")
+    summary = mig.finish(dst)
+    assert mig.resume_verified > 0, \
+        "resume re-verified nothing: batches were re-done, not resumed"
+    out["cutover"] = {k: summary[k] for k in (
+        "live_pages", "pages_moved", "batches", "retries",
+        "lock_conflicts", "resume_verified", "cutover_ms")}
+
+    # -- validate 1: bit-identity with the OFFLINE transform ------------------
+    src_final = os.path.join(root, "final_src.npz")
+    CK.checkpoint(cluster, src_final)
+    offline = os.path.join(root, "offline.npz")
+    reshard(src_final, offline, a.target_nodes, pages_per_node=ppn)
+    ident = True
+    with np.load(dst) as on, np.load(offline) as off:
+        for k in ("pool", "locks", "counters", "dir_nodes", "dir_next",
+                  "dir_root", "dir_free"):
+            if not np.array_equal(on[k], off[k]):
+                ident = False
+                out.setdefault("identity_mismatch", []).append(k)
+    out["bit_identical"] = ident
+    assert ident, f"online pool != offline reshard: {out.get('identity_mismatch')}"
+
+    # -- validate 2: the M-node cluster serves every acknowledged op ----------
+    c2 = CK.restore(dst)
+    t2 = Tree(c2)
+    e2 = batched.BatchedEngine(t2, batch_per_node=512,
+                               tcfg=TreeConfig(sibling_chase_budget=1))
+    e2.attach_router()
+    info = check_structure_device(t2)
+    got, found = e2.search(lk)
+    lost = int((~found).sum()) + int(
+        (got[found] != np.asarray([live[int(k)] for k in lk],
+                                  np.uint64)[found]).sum())
+    if dk.size:
+        _, dfound = e2.search(dk)
+        lost += int(dfound.sum())
+    # untouched bulk keys ride along too
+    probe = keys[5 * nb:: max(1, a.keys // 512)]
+    probe = probe[~np.isin(probe, np.asarray(list(acked), np.uint64))]
+    got, found = e2.search(probe)
+    lost += int((~found).sum()) + int(
+        (got[found] != (probe ^ np.uint64(0xE1A57C))[found]).sum())
+    out["lost_acks"] = lost
+    assert lost == 0, f"{lost} acknowledged ops lost across the reshard"
+    assert info["keys"] > 0
+    # the new shape accepts writes (capacity actually grew)
+    st = e2.insert(keys[:8], keys[:8])
+    assert st["applied"] + st["superseded"] == 8
+
+    d = obs.delta(snap0, obs.snapshot())
+    out["obs"] = {k: int(d[k]) for k in sorted(d)
+                  if k in ("migrate.pages_moved", "migrate.batches",
+                           "migrate.retries", "migrate.lock_conflicts",
+                           "migrate.resume_count",
+                           "migrate.resume_verified", "migrate.epoch",
+                           "lease.revoked")}
+    out["elapsed_s"] = round(time.time() - t_start, 1)
+    out["ok"] = True
+    plane.close()
+    line = json.dumps(out)
+    print(line)
+    receipt = os.environ.get("SHERMAN_RESHARD_RECEIPT")
+    if receipt:
+        with open(receipt, "w") as f:
+            f.write(line + "\n")
+    print("RESHARD-DRILL PASS", file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    main()
