@@ -105,7 +105,12 @@ repair) — see README "Robustness"; ``bench.py --reshard-drill`` runs
 the capacity drill (tools/reshard_drill.py: live N->M pool grow under
 mixed traffic with a chaos-injected crash mid-migration, resumed
 migration, and the offline-vs-online final-pool bit-identity pin) —
-see README "Elastic scaling".
+see README "Elastic scaling"; ``bench.py --serve`` runs the serving
+front door's OPEN-loop bench (tools/serve_bench.py: multi-tenant paced
+clients through sherman_tpu/serve.py — SLO-adaptive step width,
+fair-share admission + typed backpressure, journaled write acks, and
+the sealed zero-retrace serving loop; ``--crash-drill`` for the
+journaled-ack RPO-0 drill) — see README "Serving front door".
 
 Read combining: a zipf-0.99 batch of 4 M ops contains ~1-2 M distinct
 keys (~2-4x dedup depending on keyspace size).  The engine already
@@ -1346,6 +1351,21 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import recovery_drill
         recovery_drill.main(sys.argv[1:])
+        return
+
+    if "--serve" in sys.argv:
+        # Serving lane: the open-loop front-door bench (multi-tenant
+        # paced clients through sherman_tpu/serve.py — SLO-adaptive
+        # step width, fair-share admission, journaled acks, sealed
+        # zero-retrace serving loop) instead of the closed-loop
+        # benchmark.  tools/serve_bench.py owns the sequence; it
+        # prints its own one-line JSON receipt (metric "serve_bench";
+        # with --crash-drill, the journaled-ack RPO-0 drill).
+        sys.argv.remove("--serve")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import serve_bench
+        serve_bench.main(sys.argv[1:])
         return
 
     if "--reshard-drill" in sys.argv:

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Serving-front-door CI lane: pin the continuous-batching ingress
+# (sherman_tpu/serve.py) on the CPU mesh.
+#
+# Runs (1) the serve fast tier (width controller frontier/breach
+# units, the shared admission pacer, ingress-step request combining +
+# cache bit-identity, fair-share admission under a greedy tenant,
+# typed overload/degraded rejects, write-shed brownout with reads
+# still serving, the journaled-ack crash drill pinning RPO 0 and
+# acks/fsync > 1, the sealed zero-retrace serving-loop pins for
+# aligned + pipelined x cache on/off, and the perfgate serve-mode
+# comparability rules), and (2) a serve_bench smoke: the open-loop
+# driver end to end with the p99-target-met, zero-retrace and
+# fairness pins, plus the crash drill's RPO-0 pin.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== serve fast tier (controller, pacer, admission, brownout, crash drill, zero-retrace) =="
+python -m pytest tests/test_serve.py -q
+
+echo "== serve_bench open-loop smoke (p99 met, zero retraces, fair shares) =="
+python tools/serve_bench.py --keys 50000 --secs 5 \
+    --widths 512,2048,8192 --req-ops 2048 --tenants 2 --spin-ms 0.3 \
+    > /tmp/_serve_ci.json
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/_serve_ci.json"))
+s = d["serve"]
+assert s["retraces"] == 0, f"sealed serving loop retraced: {s['retraces']}"
+assert s["bad_values"] == 0, "front door served wrong values"
+assert s["p99_target_met"], (
+    f"read p99 {d['serve_read_p99_ms']} ms missed the "
+    f"{s['p99_targets_ms']['read']} ms target")
+assert s["within_1_3x"], (
+    f"open-loop capacity ratio {s['ratio_vs_closed']} vs closed > 1.3")
+assert s["fairness"]["greedy_rejects"] > 0, \
+    "greedy flooder was never typed-rejected"
+assert s["fairness"]["polite_rejects"] == 0, \
+    "polite tenant rejected under fair share"
+print("serve smoke:", d["serve_ops_s"], "ops/s open-loop;",
+      "p99", d["serve_read_p99_ms"], "ms vs target",
+      s["p99_targets_ms"]["read"], "ms; settled W",
+      s["slo_settled_width"], "; ratio", s["ratio_vs_closed"])
+EOF
+
+echo "== serve crash drill (journaled acks: RPO 0, acks/fsync > 1) =="
+python tools/serve_bench.py --crash-drill --keys 30000 --secs 3 \
+    --widths 512,2048 > /tmp/_serve_crash_ci.json
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/_serve_crash_ci.json"))
+assert d["rpo_ops"] == 0, f"acked writes lost: {d['rpo_ops']}"
+assert d["acked_rows"] > 0, "drill acked nothing"
+assert (d["acks_per_fsync"] or 0) > 1, (
+    f"no ack coalescing under concurrent writers: {d['acks_per_fsync']}")
+print("crash drill:", d["acked_write_requests"], "acked reqs,",
+      d["acks_per_fsync"], "acks/fsync, RPO", d["rpo_ops"])
+EOF
+echo "SERVE-CI PASS"

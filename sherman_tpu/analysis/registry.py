@@ -110,6 +110,14 @@ DEFAULT_REGISTRY = Registry(
         ("sherman_tpu/models/leaf_cache.py", "LeafCache._get_fill.kernel"),
         ("sherman_tpu/workload/device_prep.py",
          "make_staged_step.cache_probe"),
+        # serving front door (PR 13): the per-step ingress dispatch
+        # closures — the front door's continuous-batching loop runs one
+        # of these per device step, so a stray host sync here serializes
+        # every serving step on the access-tunnel RTT (completion
+        # belongs in the complete() half, which materializes by design)
+        ("sherman_tpu/workload/device_prep.py",
+         "make_ingress_step.dispatch"),
+        ("sherman_tpu/serve.py", "ShermanServer._dispatch_reads"),
     ],
     static_roots={"cfg", "config", "self", "C", "D", "CFG", "bits",
                   "layout"},
@@ -154,6 +162,11 @@ DEFAULT_REGISTRY = Registry(
         # allocates at PULL time like the cache's
         ("sherman_tpu/migrate.py", "Migrator._on_dirty_clear"),
         ("sherman_tpu/migrate.py", "Migrator._poll_dirt"),
+        # serving front door (PR 13): the admission/serve accounting
+        # runs on every submit and every completed batch inside the
+        # open loop — plain integer adds only; the serve.* collector
+        # allocates at PULL time like the cache's and migrate's
+        ("sherman_tpu/serve.py", "ShermanServer._note_*"),
     ],
     knob_docs=["BENCHMARKS.md"],
 )

@@ -22,9 +22,12 @@ Design rules:
   (``JournalCorruptError``, ``CheckpointCorruptError``,
   ``DegradedError``, ``TargetedRepairFailed``,
   ``PallasUnavailableError``, ``ExchangeLaneError``, ``PrepOverflow``)
-  stay defined next to the code that raises them — they now also
-  inherit ``ShermanError`` so the root catch covers them.  This module
-  is import-leaf (stdlib only) precisely so they can.
+  — and newer ones following the same pattern
+  (``ServeOverloadError``, the serving front door's typed admission
+  backpressure in :mod:`sherman_tpu.serve`) — stay defined next to
+  the code that raises them; they all inherit ``ShermanError`` so the
+  root catch covers them.  This module is import-leaf (stdlib only)
+  precisely so they can.
 """
 
 __all__ = [

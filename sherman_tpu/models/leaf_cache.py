@@ -553,6 +553,19 @@ class LeafCache:
         if due and cand.size:
             self.fill(cand)
 
+    def sketch_stats(self) -> dict:
+        """Admission-sketch receipt for drivers (the serving front
+        door's ``cache`` block): how many batches the decayed top-K
+        sketch has observed, how many keys it currently tracks, and the
+        auto-admission cadence.  Zero-observation stats mean the cache
+        runs in manual-``fill`` mode."""
+        with self._lock:
+            return {
+                "admit_every": self.admit_every,
+                "observed_batches": self._observed_batches,
+                "tracked_keys": len(self._freq),
+            }
+
     # -- invalidation ---------------------------------------------------------
 
     def invalidate_keys(self, keys) -> int:

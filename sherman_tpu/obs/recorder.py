@@ -24,6 +24,10 @@ Event sources (each site calls :func:`record_event`):
   ``recovery.recover`` / ``recovery.targeted_repair`` /
   ``recovery.targeted_repair_failed``
 - ``watchdog.fired``
+- ``serve.start`` / ``serve.sealed`` / ``serve.width_change`` /
+  ``serve.brownout_enter`` / ``serve.brownout_exit`` /
+  ``serve.dispatch_error`` / ``serve.stop``    (the serving front
+  door's control-plane moments — sherman_tpu/serve.py)
 
 Auto-dump: :func:`auto_dump` fires on degraded entry, typed-error
 raise, and watchdog expiry — but only when ``SHERMAN_BLACKBOX_DIR``

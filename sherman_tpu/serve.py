@@ -1,0 +1,1181 @@
+"""Serving front door: continuous batching with SLO-adaptive step width.
+
+Every published number so far came from a closed-loop bench driver that
+owns the whole machine; this module is the missing REQUEST PATH — the
+piece that turns the engine into something millions of clients could
+sit behind (the ROADMAP's "refactor that unlocks every millions-of-
+users scenario").  An Orca/vLLM-style continuous-batching ingress:
+independent client requests (read / insert / delete / scan) coalesce
+into device steps, and the step WIDTH — the repo's one latency-vs-
+throughput dial, measured as a frontier since round 4 — is chosen
+ADAPTIVELY against a per-class p99 target instead of the bench's fixed
+4 M-op batch.
+
+Architecture (one dispatcher thread drives the device; clients only
+enqueue):
+
+- **Admission** (:meth:`ShermanServer.submit`, any thread): typed,
+  synchronous backpressure.  A full queue — or a tenant exceeding its
+  max-min fair share of it — raises :class:`ServeOverloadError`
+  (beside the engine's existing ``ST_LOCK_TIMEOUT`` /
+  :class:`~sherman_tpu.models.batched.DegradedError` typed rejects);
+  writes are additionally shed FIRST under pressure (brownout, below).
+  Admission does no device work and no allocation beyond the request
+  record itself.
+- **Continuous batching** (the dispatcher): pending read requests are
+  coalesced — round-robin across tenants, FIFO within a tenant — into
+  one device step of width ``W`` picked by the
+  :class:`WidthController`, and dispatched through
+  :func:`~sherman_tpu.workload.device_prep.make_ingress_step`: the
+  host-fed twin of the ``fusion="pipelined"`` staged substrate, whose
+  serve is the SAME compiled program object the staged loops and the
+  host-staged throughput phase run.  With ``fusion="pipelined"``
+  (default) ONE batch stays in flight: batch k's host prep + dispatch
+  overlaps batch k-1's device serve, the two-deep discipline applied
+  to external traffic; ``"aligned"`` completes each batch before the
+  next dispatch (the sequential comparator).
+- **Adaptive width**: the controller is seeded by a calibration sweep
+  over the width ladder (closed-loop wall per rung — every rung is
+  compiled and warmed HERE, which is what lets the loop seal) and
+  refined online from each completed step's wall plus the
+  ``obs.slo_window()`` / serve-tracker per-class p50/p99.  It picks
+  the largest rung whose modeled p99 meets the target (throughput
+  within the SLO), never a rung wider than the backlog needs, and
+  steps down multiplicatively when the MEASURED window p99 breaches
+  the target (the model is a guide; the tracker is the truth).
+- **SEALED serving loop** (the PR 8 contract): after warmup the
+  compile ledger is sealed — any retrace in steady state is a counted
+  ``compile.retrace`` flight event, an auto-dumped black box, and a
+  perfgate red.  The width ladder makes this possible: every compiled
+  shape the loop can dispatch exists before ``seal()``.
+- **Journaled by construction**: the write path acks a request ONLY
+  after the engine op returns, and a journaled engine appends the
+  op's record — fsync'd, group-committed under
+  ``Journal(group_commit_ms=...)`` — before returning.  No code path
+  exists that resolves a write future before a covering fsync; the
+  crash drill (``tools/serve_bench.py --crash-drill``) pins
+  ``rpo_ops == 0`` against the acked-op ledger.  Continuous batching
+  is also what finally gives group commit its production shape: one
+  batch record covers every client write it coalesced, so acks per
+  fsync scale with the batch instead of 1.
+- **Brownout — shed writes first**: degraded mode already proves the
+  read path can serve alone, so pressure follows the same gradient.
+  Above ``brownout_hi`` queue occupancy, write admissions get
+  :class:`ServeOverloadError` while reads keep admitting to the full
+  cap (hysteresis at ``brownout_lo``); on engine DEGRADED entry,
+  write admissions AND already-queued writes fail with the typed
+  :class:`~sherman_tpu.models.batched.DegradedError` while reads keep
+  serving.  Both transitions are flight-recorded.
+- **Telemetry**: per-REQUEST end-to-end latency (submit -> ack) lands
+  in a dedicated :class:`~sherman_tpu.obs.slo.SloTracker` published as
+  the ``serve.`` pull collector (``serve.read.p99_ms`` in every
+  snapshot / scrape), beside admission/reject/tenant-share counters
+  and the current width; the engine-side service walls still feed the
+  default ``slo.`` tracker via ``obs.slo.observe`` — the controller
+  consumes both.
+
+Knobs (documented in the README knob table): ``SHERMAN_SERVE_WIDTHS``
+(the ladder), ``SHERMAN_SERVE_P99_MS`` (per-class targets, e.g. ``50``
+or ``read:20,insert:200``), ``SHERMAN_SERVE_QUEUE_OPS`` (admission
+capacity), ``SHERMAN_SERVE_GROUP_COMMIT_MS`` (journal group commit for
+the attached write-ahead journal).
+
+Not promised: cross-request ordering.  Requests are independent — a
+read admitted after a write may be served from the pre-write snapshot
+(the engine's step-boundary linearization); per-key read-your-write
+holds only once the write's future resolved before the read was
+submitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import weakref
+from collections import deque
+
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu import obs
+from sherman_tpu.errors import ConfigError, KeyRangeError, ShermanError, \
+    StateError
+from sherman_tpu.models.batched import DegradedError
+from sherman_tpu.obs import device as DEV
+from sherman_tpu.obs import recorder as FR
+from sherman_tpu.obs import slo as SLO
+from sherman_tpu.workload.device_prep import make_ingress_step
+
+__all__ = [
+    "ServeOverloadError", "ServeConfig", "ServeFuture", "WidthController",
+    "ShermanServer", "READ_CLASSES", "WRITE_CLASSES", "OP_CLASSES",
+]
+
+READ_CLASSES = ("read", "scan")
+WRITE_CLASSES = ("insert", "delete")
+OP_CLASSES = READ_CLASSES + WRITE_CLASSES
+
+
+class ServeOverloadError(ShermanError, RuntimeError):
+    """Typed admission backpressure: the front door refused this request
+    at submit time — queue full, tenant over its fair share, or write
+    shed under brownout.  Sits beside the engine's ``ST_LOCK_TIMEOUT``
+    and :class:`~sherman_tpu.models.batched.DegradedError` typed
+    rejects; clients back off and retry, they never see a silent
+    drop."""
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+def _env_widths() -> tuple[int, ...]:
+    """``SHERMAN_SERVE_WIDTHS``: comma-separated step-width ladder of
+    the front door's read path (ascending; every rung is compiled and
+    warmed before the loop seals).  Default suits the CPU mesh; chip
+    deployments ladder toward the bench's 4 M-op width."""
+    v = os.environ.get("SHERMAN_SERVE_WIDTHS", "1024,4096,16384,65536")
+    try:
+        widths = tuple(sorted({int(w) for w in v.split(",") if w.strip()}))
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_SERVE_WIDTHS={v!r}: want comma-separated ints")
+    if not widths or widths[0] <= 0:
+        raise ConfigError(
+            f"SHERMAN_SERVE_WIDTHS={v!r}: want positive widths")
+    return widths
+
+
+def _env_p99_targets() -> dict[str, float]:
+    """``SHERMAN_SERVE_P99_MS``: per-class end-to-end p99 targets in
+    ms — a bare number applies to every class, or
+    ``read:20,insert:200`` per class."""
+    v = os.environ.get("SHERMAN_SERVE_P99_MS", "50")
+    out: dict[str, float] = {}
+    try:
+        if ":" in v:
+            for part in v.split(","):
+                cls, ms = part.split(":")
+                out[cls.strip()] = float(ms)
+        else:
+            out = {cls: float(v) for cls in OP_CLASSES}
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_SERVE_P99_MS={v!r}: want a float or "
+            "class:float pairs")
+    for cls in out:
+        if cls not in OP_CLASSES:
+            raise ConfigError(
+                f"SHERMAN_SERVE_P99_MS class {cls!r}: want one of "
+                f"{OP_CLASSES}")
+    for cls in OP_CLASSES:
+        out.setdefault(cls, 50.0)
+    return out
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Front-door knobs.  ``from_env`` reads the ``SHERMAN_SERVE_*``
+    family; tests construct directly."""
+
+    #: read-path step-width ladder (ascending; each rung one compiled
+    #: shape, warmed before seal)
+    widths: tuple = dataclasses.field(default_factory=_env_widths)
+    #: per-class end-to-end p99 targets (ms)
+    p99_targets_ms: dict = dataclasses.field(
+        default_factory=_env_p99_targets)
+    #: admission capacity in queued OPS (not requests); 0 = derive
+    #: 4x the widest rung
+    max_queue_ops: int = 0
+    #: write-shed brownout thresholds as queue-occupancy fractions
+    brownout_hi: float = 0.75
+    brownout_lo: float = 0.50
+    #: write coalescing: dispatch a write batch at this many ops ...
+    write_width: int = 16384
+    #: ... or when the oldest pending write has lingered this long
+    write_linger_ms: float = 2.0
+    #: journal group-commit window for the attached write-ahead journal
+    #: (``Journal(group_commit_ms=...)``); RPO stays 0 by construction
+    group_commit_ms: float = 2.0
+    #: serve-tracker sliding window (the published p99's horizon)
+    window_s: float = 10.0
+    #: "pipelined" keeps one read batch in flight (two-deep, default);
+    #: "aligned" completes each batch before the next dispatch
+    fusion: str = "pipelined"
+    #: p99 model: est_p99(W) = model_mult x measured wall(W) (formation
+    #: wait + service; the open-loop 1.5x-span model plus slack)
+    model_mult: float = 2.0
+    #: closed-loop steps per ladder rung during calibration
+    calib_steps: int = 3
+    #: seal the compile ledger after warmup (the zero-retrace contract)
+    seal: bool = True
+
+    def __post_init__(self):
+        self.widths = tuple(sorted(int(w) for w in self.widths))
+        if not self.widths or self.widths[0] <= 0:
+            raise ConfigError("ServeConfig.widths: want positive rungs")
+        if self.max_queue_ops <= 0:
+            self.max_queue_ops = 4 * self.widths[-1]
+        if not (0.0 < self.brownout_lo <= self.brownout_hi <= 1.0):
+            raise ConfigError(
+                "ServeConfig brownout: want 0 < lo <= hi <= 1")
+        if self.fusion not in ("aligned", "pipelined"):
+            raise ConfigError(
+                f"ServeConfig.fusion={self.fusion!r}: want "
+                "aligned|pipelined")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        gc = os.environ.get("SHERMAN_SERVE_GROUP_COMMIT_MS")
+        q = os.environ.get("SHERMAN_SERVE_QUEUE_OPS")
+        kw: dict = {}
+        if gc is not None:
+            kw["group_commit_ms"] = float(gc)
+        if q is not None:
+            kw["max_queue_ops"] = int(q)
+        kw.update(overrides)
+        return cls(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Futures + requests
+# ---------------------------------------------------------------------------
+
+class ServeFuture:
+    """Completion handle for one submitted request.  ``result()``
+    blocks until the ack and re-raises the typed error when the
+    request failed in flight (degraded write shed, dispatcher
+    failure)."""
+
+    __slots__ = ("op", "tenant", "n_ops", "t_submit", "_ev", "_result",
+                 "_error")
+
+    def __init__(self, op: str, tenant: str, n_ops: int):
+        self.op = op
+        self.tenant = tenant
+        self.n_ops = n_ops
+        self.t_submit = time.perf_counter()
+        self._ev = threading.Event()
+        self._result = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._ev.wait(timeout):
+            raise StateError("serve request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _set(self, result) -> None:
+        self._result = result
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._ev.set()
+
+
+class _Request:
+    __slots__ = ("fut", "keys", "values", "ranges")
+
+    def __init__(self, fut, keys=None, values=None, ranges=None):
+        self.fut = fut
+        self.keys = keys
+        self.values = values
+        self.ranges = ranges
+
+
+# ---------------------------------------------------------------------------
+# Width controller
+# ---------------------------------------------------------------------------
+
+class WidthController:
+    """SLO-adaptive step-width selection over a fixed ladder.
+
+    State per rung: an EWMA of measured step walls (seeded by the
+    calibration sweep, refined by every completed step).  The model
+    ``est_p99(W) = model_mult * wall(W)`` is the open-loop
+    formation-wait + service shape (latency_bench's 1.5x-span p50
+    model, with slack for the tail); the pick is
+
+    - the LARGEST rung whose modeled p99 meets the target (throughput
+      inside the SLO), clamped by ``cap`` (below),
+    - but never a rung wider than the backlog needs — serving 500
+      queued ops through a 65 K-wide program pays the wide program's
+      wall for nothing (descent cost is per ROW of the compiled
+      shape), so the smallest feasible rung covering the backlog wins
+      when the queue is shallow.
+
+    ``note_window_p99`` is the measured-truth override: when the
+    tracker's observed window p99 breaches the target, the cap steps
+    DOWN one rung (multiplicative decrease) and holds for
+    ``hold_steps`` completions before probing back up — the model
+    proposes, the measurement disposes.
+    """
+
+    def __init__(self, widths, target_p99_ms: float, *,
+                 model_mult: float = 2.0, ewma: float = 0.3,
+                 hold_steps: int = 50):
+        self.widths = tuple(sorted(int(w) for w in widths))
+        if not self.widths:
+            raise ConfigError("WidthController: empty width ladder")
+        self.target_p99_ms = float(target_p99_ms)
+        self.model_mult = float(model_mult)
+        self.ewma = float(ewma)
+        self.hold_steps = int(hold_steps)
+        self.wall_ms: dict[int, float | None] = {w: None
+                                                 for w in self.widths}
+        self.cap_idx = len(self.widths) - 1
+        self._hold = 0
+        self._last = self.widths[0]
+        self.picks: dict[int, int] = {w: 0 for w in self.widths}
+        self.downshifts = 0
+
+    def seed(self, width: int, wall_ms: float) -> None:
+        self.wall_ms[width] = float(wall_ms)
+
+    def update(self, width: int, wall_ms: float) -> None:
+        prev = self.wall_ms.get(width)
+        self.wall_ms[width] = (float(wall_ms) if prev is None else
+                               (1 - self.ewma) * prev
+                               + self.ewma * float(wall_ms))
+        if self._hold > 0:
+            self._hold -= 1
+            if self._hold == 0 and self.cap_idx < len(self.widths) - 1:
+                self.cap_idx += 1  # probe back up, one rung at a time
+
+    def est_p99_ms(self, width: int) -> float | None:
+        w = self.wall_ms.get(width)
+        return None if w is None else self.model_mult * w
+
+    def note_window_p99(self, p99_ms: float, *,
+                        queue_dominated: bool = False) -> None:
+        """Feed the MEASURED window p99 (serve tracker / slo_window);
+        a SERVICE-dominated breach steps the cap down one rung and
+        holds.  ``queue_dominated`` breaches (batch-formation wait
+        exceeds the service wall — the offered load outruns capacity)
+        must NOT downshift: a narrower step lowers throughput and
+        deepens the very queue that caused the breach; overload relief
+        is admission control's job (typed rejects), the width's job is
+        to keep the SERVICE share of the latency inside the target."""
+        if p99_ms > self.target_p99_ms and not queue_dominated \
+                and self.cap_idx > 0 and self._hold == 0:
+            self.cap_idx -= 1
+            self._hold = self.hold_steps
+            self.downshifts += 1
+
+    def feasible(self) -> list[int]:
+        out = []
+        for w in self.widths[: self.cap_idx + 1]:
+            est = self.est_p99_ms(w)
+            if est is not None and est <= self.target_p99_ms:
+                out.append(w)
+        return out
+
+    def pick(self, backlog_ops: int, min_ops: int = 0) -> int:
+        """Choose a rung for a step serving ``backlog_ops`` of queued
+        work whose largest indivisible request is ``min_ops`` wide.
+        Requests never split across steps, so rungs below ``min_ops``
+        are structurally unusable — when no rung inside the target can
+        carry the head request, the narrowest rung that CAN wins over
+        never serving it (its latency is then the queue's honest
+        cost, visible in the tracker)."""
+        usable = [w for w in self.widths if w >= min_ops] \
+            or [self.widths[-1]]
+        feas = [w for w in self.feasible() if w >= min_ops]
+        if not feas:
+            if backlog_ops > usable[0] and self._last in usable:
+                # OVERLOAD STABILITY: a deep queue with no rung inside
+                # the target means the tail is lost either way — hold
+                # the current width instead of collapsing to the
+                # narrowest rung, whose lower drain rate would deepen
+                # the queue further (the cap-512 death spiral)
+                w = self._last
+            else:
+                # idle/unmeasured: the narrowest structurally-usable
+                # rung — lowest latency, and the measured path keeps
+                # it honest
+                w = usable[0]
+        else:
+            w = feas[-1]
+            for cand in feas:
+                if cand >= backlog_ops:
+                    w = cand
+                    break
+        self.picks[w] += 1
+        self._last = w
+        return w
+
+    def settled_width(self) -> int:
+        """The rung this controller has used most — the receipt's
+        'settled on' width."""
+        return max(self.picks.items(), key=lambda kv: kv[1])[0]
+
+    def snapshot(self) -> dict:
+        return {
+            "target_p99_ms": self.target_p99_ms,
+            "wall_ms": {w: (round(v, 3) if v is not None else None)
+                        for w, v in self.wall_ms.items()},
+            "cap_width": self.widths[self.cap_idx],
+            "picks": dict(self.picks),
+            "downshifts": self.downshifts,
+            "settled_width": self.settled_width(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# The server
+# ---------------------------------------------------------------------------
+
+class _TenantState:
+    __slots__ = ("queues", "queued_ops", "admitted_ops", "served_ops",
+                 "rejected_overload", "rejected_degraded")
+
+    def __init__(self):
+        self.queues = {cls: deque() for cls in OP_CLASSES}
+        self.queued_ops = 0
+        self.admitted_ops = 0
+        self.served_ops = 0
+        self.rejected_overload = 0
+        self.rejected_degraded = 0
+
+
+class ShermanServer:
+    """The continuous-batching front door over a
+    :class:`~sherman_tpu.models.batched.BatchedEngine` (see the module
+    docstring for the architecture).
+
+    Lifecycle::
+
+        srv = ShermanServer(eng, config, journal=Journal(...))
+        srv.start(calib_keys=some_real_keys)   # warmup + SEAL
+        fut = srv.submit("read", keys, tenant="t0")
+        vals, found = fut.result()
+        srv.stop()                             # drain + unseal
+
+    Single-dispatcher contract: one thread drives every engine step
+    (the journaled engine's record-order == apply-order contract);
+    ``submit`` is safe from any number of client threads.
+    """
+
+    def __init__(self, eng, config: ServeConfig | None = None, *,
+                 journal=None):
+        self.eng = eng
+        self.cfg = config or ServeConfig.from_env()
+        if eng.router is None:
+            raise ConfigError("ShermanServer: attach_router() first")
+        self.journal = journal
+        if journal is not None:
+            eng.attach_journal(journal)
+        self.leaf_cache = eng.leaf_cache
+        # one ingress step per ladder rung — every compiled shape the
+        # sealed loop can dispatch exists up front
+        self._steps = {w: make_ingress_step(eng, width=w,
+                                            leaf_cache=self.leaf_cache)
+                       for w in self.cfg.widths}
+        self.controller = WidthController(
+            self.cfg.widths, self.cfg.p99_targets_ms["read"],
+            model_mult=self.cfg.model_mult)
+        self.tracker = SLO.SloTracker(window_s=self.cfg.window_s)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._tenants: dict[str, _TenantState] = {}
+        self._rr: deque[str] = deque()  # round-robin tenant order
+        self._queued_ops = 0
+        self._queued_write_ops = 0
+        self._queued_read_ops = 0
+        # queue-vs-service latency attribution of the last completed
+        # steps (EWMA of formation-wait / service-wall ratio): the
+        # controller's breach handler needs to know WHO owns the tail
+        self._qwait_ratio = 0.0
+        self._running = False
+        self._draining = False
+        self._thread: threading.Thread | None = None
+        self._sealed = False
+        self._retrace0 = 0
+        self._brownout = False
+        self._was_degraded = False
+        self._depth = 2 if self.cfg.fusion == "pipelined" else 1
+        self._cur_width = self.cfg.widths[0]
+        self._completions = 0
+        self._last_complete_t = 0.0
+        # receipt counters (plain adds on the hot paths — SL006)
+        self.admitted_ops = 0
+        self.served_ops = 0
+        self.acked_writes = 0  # write REQUESTS acked (after the fsync)
+        self.rejected_overload = 0
+        self.rejected_degraded = 0
+        self.dispatch_errors = 0
+        self.calibration: dict[int, dict] = {}
+        ref = weakref.ref(self)
+
+        def _collect():
+            s = ref()
+            return s._collect() if s is not None else {}
+
+        obs.register_collector("serve", _collect)
+
+    # -- hot accounting (registered SL006 scopes: plain adds only) -----------
+
+    def _note_admit(self, st: _TenantState, n: int) -> None:
+        st.queued_ops += n
+        st.admitted_ops += n
+        self._queued_ops += n
+        self.admitted_ops += n
+
+    def _note_reject_overload(self, st: _TenantState) -> None:
+        st.rejected_overload += 1
+        self.rejected_overload += 1
+
+    def _note_reject_degraded(self, st: _TenantState) -> None:
+        st.rejected_degraded += 1
+        self.rejected_degraded += 1
+
+    def _note_served(self, st: _TenantState, n: int) -> None:
+        st.served_ops += n
+        self.served_ops += n
+
+    # -- admission -----------------------------------------------------------
+
+    def _tenant(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = _TenantState()
+            self._tenants[tenant] = st
+            self._rr.append(tenant)
+        return st
+
+    def submit(self, op: str, keys=None, values=None, *,
+               tenant: str = "default", ranges=None) -> ServeFuture:
+        """Admit one request (typed backpressure; see the module
+        docstring).  ``keys`` uint64 for read/insert/delete (+
+        ``values`` for insert); ``ranges`` [(lo, hi), ...] for scan.
+        Returns a :class:`ServeFuture` whose ``result()`` is
+        ``(values, found)`` for reads, an ok-per-key bool array for
+        inserts, a found-per-key bool array for deletes, and
+        ``range_query_many``'s list for scans."""
+        if op not in OP_CLASSES:
+            raise ConfigError(f"submit op {op!r}: want one of "
+                              f"{OP_CLASSES}")
+        if not self._running:
+            raise StateError("server not running (call start())")
+        if op == "scan":
+            if not ranges:
+                raise ConfigError("scan submit needs ranges")
+            n = len(ranges)
+            if n > self.cfg.widths[-1]:
+                raise ConfigError(
+                    f"scan of {n} ranges exceeds the flush budget "
+                    f"{self.cfg.widths[-1]}; chunk client-side")
+        else:
+            keys = np.ascontiguousarray(keys, np.uint64)
+            n = int(keys.size)
+            if n == 0:
+                raise ConfigError("empty request")
+            # per-class admit cap = the LARGEST batch the class's
+            # flush path can actually take (admitting a request no
+            # dispatcher budget can pop would hang its future forever
+            # at the head of the tenant's FIFO)
+            cap = self.cfg.write_width if op in WRITE_CLASSES \
+                else self.cfg.widths[-1]
+            if n > cap:
+                raise ConfigError(
+                    f"{op} request of {n} ops exceeds the "
+                    f"{cap}-op dispatch budget; chunk client-side")
+            if int(keys.min()) < C.KEY_MIN or int(keys.max()) > C.KEY_MAX:
+                raise KeyRangeError("keys outside [KEY_MIN, KEY_MAX]")
+            if op == "insert":
+                values = np.ascontiguousarray(values, np.uint64)
+                if values.shape != keys.shape:
+                    raise ConfigError("insert needs one value per key")
+        fut = ServeFuture(op, tenant, n)
+        with self._lock:
+            if not self._running:
+                # re-check under the lock: a stop() racing the
+                # unlocked fast-path check above may already have run
+                # its final _fail_queued — a request appended now
+                # would never be served OR failed
+                raise StateError("server not running (call start())")
+            st = self._tenant(tenant)
+            if op in WRITE_CLASSES:
+                reason = self.eng.degraded_reason
+                if reason is not None:
+                    # degraded brownout: writes reject typed at the
+                    # DOOR (fail fast — queueing a write the engine
+                    # will refuse only adds latency to the refusal)
+                    self._note_reject_degraded(st)
+                    raise DegradedError(reason)
+                if self._brownout:
+                    self._note_reject_overload(st)
+                    raise ServeOverloadError(
+                        "write shed (brownout): queue at "
+                        f"{self._queued_ops}/{self.cfg.max_queue_ops} "
+                        "ops; retry with backoff")
+            # max-min fair share: a tenant may hold at most
+            # capacity / active_tenants queued ops, so a greedy tenant
+            # saturates its own share and gets typed rejects while
+            # polite tenants keep admitting into theirs.  The divisor
+            # floors at 2 — a lone flooder must never hold the WHOLE
+            # queue, or a newcomer's first request bounces off the
+            # total cap before fair sharing can even engage
+            active = sum(1 for t in self._tenants.values()
+                         if t.queued_ops > 0)
+            if st.queued_ops == 0:
+                active += 1
+            share = max(1, self.cfg.max_queue_ops // max(2, active))
+            if self._queued_ops + n > self.cfg.max_queue_ops \
+                    or st.queued_ops + n > share:
+                self._note_reject_overload(st)
+                raise ServeOverloadError(
+                    f"queue full (tenant {tenant!r}: "
+                    f"{st.queued_ops}+{n} of fair share {share}; "
+                    f"total {self._queued_ops}/"
+                    f"{self.cfg.max_queue_ops} ops)")
+            st.queues[op].append(
+                _Request(fut, keys=keys, values=values, ranges=ranges))
+            self._note_admit(st, n)
+            if op in WRITE_CLASSES:
+                self._queued_write_ops += n
+            elif op == "read":
+                self._queued_read_ops += n
+            # write-shed brownout entry (checked on the admission path
+            # so pressure reacts at wire speed; exit is checked on the
+            # dispatch path as the queue drains)
+            if not self._brownout and self._queued_ops \
+                    > self.cfg.brownout_hi * self.cfg.max_queue_ops:
+                self._brownout = True
+                FR.record_event("serve.brownout_enter",
+                                queued_ops=self._queued_ops,
+                                cap=self.cfg.max_queue_ops)
+            self._cv.notify()
+        return fut
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, calib_keys=None, *, calib_writes=None,
+              calib_delete_keys=None) -> dict:
+        """Warm + calibrate every ladder rung, SEAL the compile ledger,
+        and start the dispatcher.
+
+        ``calib_keys`` (uint64, real/loaded keys) drives the read-path
+        calibration sweep — closed-loop walls per rung seed the width
+        controller and are returned (and kept as ``self.calibration``)
+        as the ``{width: {wall_ms, ops_s}}`` frontier receipt.
+        ``calib_writes`` (keys, values — value-preserving pairs, e.g.
+        the loaded values) warms the insert path; ``calib_delete_keys``
+        (keys known ABSENT) warms the delete descent without mutating.
+        Skipping calibration (all None) skips the seal too: an unwarmed
+        loop would count its own first-dispatch compiles as
+        retraces."""
+        if self._running:
+            raise StateError("server already running")
+        ledger = DEV.get_ledger()
+        FR.record_event("serve.start", widths=list(self.cfg.widths),
+                        fusion=self.cfg.fusion)
+        if calib_keys is not None:
+            self._calibrate(np.ascontiguousarray(calib_keys, np.uint64),
+                            calib_writes, calib_delete_keys)
+        self._retrace0 = ledger.retraces
+        if calib_keys is not None and self.cfg.seal:
+            ledger.seal()
+            self._sealed = True
+            FR.record_event(
+                "serve.sealed",
+                walls={str(w): round(c["wall_ms"], 3)
+                       for w, c in self.calibration.items()})
+        self._running = True
+        self._draining = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="sherman-serve",
+                                        daemon=True)
+        self._thread.start()
+        return dict(self.calibration)
+
+    def _calibrate(self, keys_pool, calib_writes, calib_delete_keys):
+        """Closed-loop sweep over the ladder: compile + warm every
+        rung's programs (ingress serve, cache probe, straggler rescue,
+        write paths) and measure each rung's pipelined wall — the
+        width x latency frontier seed."""
+        rng = np.random.default_rng(17)
+        K = max(1, self.cfg.calib_steps)
+        for w, step in self._steps.items():
+            kidx = rng.integers(0, keys_pool.size, (K + 1, w))
+            # warm (compile) outside the timing, then a short two-deep
+            # closed loop — the pipelined wall the serving loop pays
+            step(keys_pool[kidx[0]])
+            t0 = time.perf_counter()
+            h = step.dispatch(keys_pool[kidx[1]])
+            for i in range(1, K):
+                h2 = step.dispatch(keys_pool[kidx[i + 1]])
+                step.complete(h)
+                h = h2
+            step.complete(h)
+            wall_ms = (time.perf_counter() - t0) / K * 1e3
+            self.controller.seed(w, wall_ms)
+            self.calibration[w] = {
+                "wall_ms": wall_ms,
+                "ops_s": w / (wall_ms / 1e3),
+            }
+        # straggler rescue path (root descent at the engine width)
+        self.eng.search(keys_pool[rng.integers(0, keys_pool.size, 64)])
+        # scan path (range_query_many compiles its leaf-walk lazily;
+        # twice for the threaded-carry variant, like the writes below)
+        lo = int(keys_pool.min())
+        self.eng.range_query_many([(lo, lo + 64)])
+        self.eng.range_query_many([(lo, lo + 64)])
+        # sketch-admission fill program (a fill mid-window must not be
+        # the first compile of engine.cache_fill)
+        if self.leaf_cache is not None and self.leaf_cache.admit_every:
+            seed_keys = self.leaf_cache.cached_keys()
+            if seed_keys.size == 0:
+                seed_keys = np.unique(keys_pool[rng.integers(
+                    0, keys_pool.size, 256)])
+            self.leaf_cache.fill(seed_keys)
+        # write paths warm TWICE: the first call's program outputs
+        # (pool/counters/dirty) become the second call's inputs, and
+        # host-staged vs threaded avals are DISTINCT jit cache entries
+        # (bench.py's second-warmup-step lesson) — a single warmup
+        # would leave the threaded variant to compile inside the
+        # sealed window as a false retrace
+        if calib_writes is not None:
+            wk, wv = calib_writes
+            wk = np.ascontiguousarray(wk, np.uint64)
+            wv = np.ascontiguousarray(wv, np.uint64)
+            self.eng.insert(wk, wv)
+            self.eng.insert(wk, wv)
+        if calib_delete_keys is not None:
+            dk = np.ascontiguousarray(calib_delete_keys, np.uint64)
+            self.eng.delete(dk)
+            self.eng.delete(dk)
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop serving.  ``drain=True`` serves everything already
+        admitted first; ``drain=False`` fails queued requests with the
+        typed :class:`~sherman_tpu.errors.StateError` (the crash-drill
+        shape keeps the journal UNCLOSED — durable records need no
+        goodbye)."""
+        with self._lock:
+            if not self._running:
+                return
+            self._running = False
+            self._draining = bool(drain)
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._sealed:
+            DEV.get_ledger().unseal()
+            self._sealed = False
+        FR.record_event("serve.stop", served_ops=self.served_ops,
+                        acked_writes=self.acked_writes)
+
+    def kill(self) -> None:
+        """Crash-drill stop: abandon the dispatcher without draining
+        and WITHOUT closing the journal — exactly what a process crash
+        leaves behind.  Every acked write is already covered by an
+        fsync (the ack gate), so recovery replays to RPO 0."""
+        self.stop(drain=False, timeout=5.0)
+
+    @property
+    def retraces(self) -> int:
+        """Steady-state retraces observed since this server sealed."""
+        return DEV.get_ledger().retraces - self._retrace0
+
+    def retarget(self, op_class: str, p99_ms: float) -> None:
+        """Re-aim one class's end-to-end p99 target at runtime (SLOs
+        are operator policy, not a rebuild) — the adaptive controller
+        follows on its next pick."""
+        if op_class not in OP_CLASSES:
+            raise ConfigError(f"retarget class {op_class!r}: want one "
+                              f"of {OP_CLASSES}")
+        self.cfg.p99_targets_ms[op_class] = float(p99_ms)
+        if op_class == "read":
+            self.controller.target_p99_ms = float(p99_ms)
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        pend: deque = deque()  # in-flight read slots (two-deep pipeline)
+        while True:
+            with self._lock:
+                if not self._running and (not self._draining
+                                          or self._queued_ops == 0):
+                    break
+                has_work = self._queued_ops > 0
+                if not has_work and not pend:
+                    self._cv.wait(0.002)
+                    has_work = self._queued_ops > 0
+                    if not has_work and not pend:
+                        continue
+            try:
+                self._check_degraded_transition()
+                did = self._maybe_flush_writes()
+                did = self._maybe_flush_scans() or did
+                slot = self._dispatch_reads()
+                if slot is not None:
+                    pend.append(slot)
+                    did = True
+                while len(pend) >= (self._depth if slot is not None
+                                    else 1):
+                    self._complete_read(pend.popleft())
+                    did = True
+                    if not pend:
+                        break
+                if not did:
+                    # admitted work exists but none of it is due yet
+                    # (write linger): sleep a beat instead of spinning
+                    # the GIL out from under the client threads
+                    with self._lock:
+                        self._cv.wait(0.0005)
+            except BaseException as e:  # noqa: BLE001 — serving loop
+                # must survive a bad batch: the batch's futures carry
+                # the error, the loop keeps serving everyone else
+                self.dispatch_errors += 1
+                FR.record_event("serve.dispatch_error", error=repr(e))
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
+        # shutdown: drain the pipeline, then fail anything left
+        for slot in pend:
+            try:
+                self._complete_read(slot)
+            except BaseException:  # noqa: BLE001
+                pass
+        self._fail_queued(StateError("server stopped"))
+
+    def _fail_queued(self, err: BaseException) -> None:
+        with self._lock:
+            for st in self._tenants.values():
+                for q in st.queues.values():
+                    while q:
+                        req = q.popleft()
+                        n = req.fut.n_ops
+                        st.queued_ops -= n
+                        self._queued_ops -= n
+                        if req.fut.op in WRITE_CLASSES:
+                            self._queued_write_ops -= n
+                        elif req.fut.op == "read":
+                            self._queued_read_ops -= n
+                        req.fut._fail(err)
+
+    def _check_degraded_transition(self) -> None:
+        deg = self.eng.degraded
+        if deg and not self._was_degraded:
+            # shed queued writes typed; reads keep serving (the
+            # degraded read path is the brownout's whole premise)
+            reason = self.eng.degraded_reason or "degraded"
+            with self._lock:
+                for st in self._tenants.values():
+                    for cls in WRITE_CLASSES:
+                        q = st.queues[cls]
+                        while q:
+                            req = q.popleft()
+                            n = req.fut.n_ops
+                            st.queued_ops -= n
+                            self._queued_ops -= n
+                            self._queued_write_ops -= n
+                            st.rejected_degraded += 1
+                            self.rejected_degraded += 1
+                            req.fut._fail(DegradedError(reason))
+            FR.record_event("serve.brownout_enter", degraded=True,
+                            reason=reason)
+        elif not deg and self._was_degraded:
+            FR.record_event("serve.brownout_exit", degraded=True)
+        self._was_degraded = deg
+
+    def _take(self, classes, budget_ops: int) -> list[_Request]:
+        """Pop up to ``budget_ops`` ops of the given classes —
+        round-robin across tenants (max-min fair service), FIFO within
+        a tenant, whole requests only (no mid-request splits)."""
+        out: list[_Request] = []
+        with self._lock:
+            if not self._rr:
+                return out
+            took = budget_ops
+            idle_rounds = 0
+            while took > 0 and idle_rounds < len(self._rr):
+                tenant = self._rr[0]
+                self._rr.rotate(-1)
+                st = self._tenants[tenant]
+                got = False
+                for cls in classes:
+                    q = st.queues[cls]
+                    if q and q[0].fut.n_ops <= took:
+                        req = q.popleft()
+                        n = req.fut.n_ops
+                        st.queued_ops -= n
+                        self._queued_ops -= n
+                        if cls in WRITE_CLASSES:
+                            self._queued_write_ops -= n
+                        elif cls == "read":
+                            self._queued_read_ops -= n
+                        took -= n
+                        out.append(req)
+                        got = True
+                        break
+                idle_rounds = 0 if got else idle_rounds + 1
+            if self._brownout and self._queued_ops \
+                    < self.cfg.brownout_lo * self.cfg.max_queue_ops:
+                self._brownout = False
+                FR.record_event("serve.brownout_exit",
+                                queued_ops=self._queued_ops)
+        return out
+
+    def _read_backlog(self) -> tuple[int, int]:
+        """(queued read ops, widest head-of-queue request) — the
+        controller's pick inputs; head size matters because requests
+        never split across steps."""
+        with self._lock:
+            head = 0
+            for st in self._tenants.values():
+                q = st.queues["read"]
+                if q and q[0].fut.n_ops > head:
+                    head = q[0].fut.n_ops
+            return self._queued_read_ops, head
+
+    def _dispatch_reads(self):
+        """Form one read step at the controller's width and launch it
+        (async).  Returns the in-flight slot or None."""
+        backlog, head = self._read_backlog()
+        if backlog == 0:
+            return None
+        width = self.controller.pick(backlog, head)
+        if width != self._cur_width:
+            FR.record_event("serve.width_change", frm=self._cur_width,
+                            to=width)
+            self._cur_width = width
+        reqs = self._take(("read",), width)
+        if not reqs:
+            return None
+        keys = np.concatenate([r.keys for r in reqs]) \
+            if len(reqs) > 1 else reqs[0].keys
+        t0 = time.perf_counter()
+        try:
+            handle = self._steps[width].dispatch(keys)
+        except BaseException as e:  # noqa: BLE001 — the batch's futures
+            # must carry the failure; the loop keeps serving
+            self._fail_batch(reqs, e)
+            return None
+        return (width, reqs, handle, t0)
+
+    def _fail_batch(self, reqs, e: BaseException) -> None:
+        self.dispatch_errors += 1
+        err = e if isinstance(e, ShermanError) \
+            else StateError(f"serve dispatch failed: {e!r}")
+        FR.record_event("serve.dispatch_error", error=repr(e))
+        for r in reqs:
+            r.fut._fail(err)
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise e
+
+    def _complete_read(self, slot) -> None:
+        width, reqs, handle, t0 = slot
+        try:
+            vals, found = self._steps[width].complete(handle)
+        except BaseException as e:  # noqa: BLE001
+            self._fail_batch(reqs, e)
+            return
+        t1 = time.perf_counter()
+        wall = t1 - t0
+        n = vals.shape[0]
+        # service-side refinement: the MARGINAL completion interval
+        # feeds the controller — under the two-deep pipeline a step's
+        # dispatch-to-complete wall includes its predecessor's device
+        # time, so attributing the raw wall would double-count the
+        # pipeline and talk the controller out of perfectly feasible
+        # rungs (est = model x 2 x true service).  The marginal
+        # interval is exactly what the closed-loop calibration
+        # measured (elapsed / K over an overlapped chain).
+        svc = t1 - max(t0, self._last_complete_t)
+        self._last_complete_t = t1
+        self.controller.update(width, svc * 1e3)
+        SLO.observe("read", n, wall)
+        off = 0
+        oldest = t1
+        for req in reqs:
+            m = req.fut.n_ops
+            req.fut._set((vals[off:off + m], found[off:off + m]))
+            # end-to-end (submit -> ack) latency — the SLO the target
+            # governs, attributed per REQUEST (the client's unit of
+            # experience) weighted by its ops
+            self.tracker.observe("read", m, t1 - req.fut.t_submit)
+            if req.fut.t_submit < oldest:
+                oldest = req.fut.t_submit
+            st = self._tenants[req.fut.tenant]
+            self._note_served(st, m)
+            off += m
+        # queue-vs-service attribution: formation wait of the batch's
+        # OLDEST request vs the service wall — when waiting dominates,
+        # the tail belongs to the offered load, not the width
+        qwait = max(0.0, t0 - oldest)
+        ratio = qwait / wall if wall > 0 else 0.0
+        self._qwait_ratio = 0.7 * self._qwait_ratio + 0.3 * ratio
+        self._completions += 1
+        if self._completions % 16 == 0:
+            # measured-truth override: the window p99 disposes what the
+            # wall model proposed (queue-dominated breaches excluded —
+            # see WidthController.note_window_p99)
+            w = self.tracker.window().get("read")
+            if w and w["window_ops"]:
+                self.controller.note_window_p99(
+                    w["p99_ms"],
+                    queue_dominated=self._qwait_ratio > 1.0)
+
+    def _write_due(self) -> bool:
+        with self._lock:
+            if self._queued_write_ops >= self.cfg.write_width:
+                return True
+            if self._queued_write_ops == 0:
+                return False
+            if not self._running:  # draining
+                return True
+            oldest = None
+            for st in self._tenants.values():
+                for cls in WRITE_CLASSES:
+                    q = st.queues[cls]
+                    if q:
+                        t = q[0].fut.t_submit
+                        oldest = t if oldest is None else min(oldest, t)
+            return oldest is not None and \
+                (time.perf_counter() - oldest) * 1e3 \
+                >= self.cfg.write_linger_ms
+
+    def _maybe_flush_writes(self) -> bool:
+        if not self._write_due():
+            return False
+        reqs = self._take(WRITE_CLASSES, self.cfg.write_width)
+        if not reqs:
+            return False
+        ins = [r for r in reqs if r.fut.op == "insert"]
+        dels = [r for r in reqs if r.fut.op == "delete"]
+        if ins:
+            keys = np.concatenate([r.keys for r in ins]) \
+                if len(ins) > 1 else ins[0].keys
+            values = np.concatenate([r.values for r in ins]) \
+                if len(ins) > 1 else ins[0].values
+            try:
+                # the ack gate: insert() returns only after the journal
+                # record covering these rows is DURABLE (fsync'd /
+                # group-committed) — resolving the futures after this
+                # call is what "journaled by construction" means
+                stats = self.eng.insert(keys, values)
+                t1 = time.perf_counter()
+                to = np.asarray(stats["lock_timeout_keys"], np.uint64) \
+                    if stats["lock_timeouts"] else None
+                for r in ins:
+                    ok = np.ones(r.fut.n_ops, bool) if to is None \
+                        else ~np.isin(r.keys, to)
+                    r.fut._set(ok)
+                    self.tracker.observe("insert", r.fut.n_ops,
+                                         t1 - r.fut.t_submit)
+                    self._note_served(self._tenants[r.fut.tenant],
+                                      r.fut.n_ops)
+                    self.acked_writes += 1
+            except BaseException as e:  # noqa: BLE001 — a popped
+                # request's future must resolve even on non-Sherman
+                # failures (XLA runtime errors, OOM): _fail_batch
+                # wraps, records, and re-raises KeyboardInterrupt
+                self._fail_batch(ins, e)
+        if dels:
+            keys = np.concatenate([r.keys for r in dels]) \
+                if len(dels) > 1 else dels[0].keys
+            try:
+                found = self.eng.delete(keys)
+                t1 = time.perf_counter()
+                off = 0
+                for r in dels:
+                    m = r.fut.n_ops
+                    r.fut._set(found[off:off + m])
+                    self.tracker.observe("delete", m,
+                                         t1 - r.fut.t_submit)
+                    self._note_served(self._tenants[r.fut.tenant], m)
+                    self.acked_writes += 1
+                    off += m
+            except BaseException as e:  # noqa: BLE001
+                self._fail_batch(dels, e)
+        return True
+
+    def _maybe_flush_scans(self) -> bool:
+        reqs = self._take(("scan",), self.cfg.widths[-1])
+        for r in reqs:
+            try:
+                res = self.eng.range_query_many(r.ranges)
+                r.fut._set(res)
+                self.tracker.observe(
+                    "scan", r.fut.n_ops,
+                    time.perf_counter() - r.fut.t_submit)
+                self._note_served(self._tenants[r.fut.tenant],
+                                  r.fut.n_ops)
+            except BaseException as e:  # noqa: BLE001
+                self._fail_batch([r], e)
+        return bool(reqs)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _collect(self) -> dict:
+        """The ``serve.`` pull collector (flat numbers, the ``slo.``
+        shape): per-class end-to-end window stats + admission state."""
+        flat = dict(self.tracker.collect())
+        flat.update({
+            "width": float(self._cur_width),
+            "queued_ops": float(self._queued_ops),
+            "admitted_ops": float(self.admitted_ops),
+            "served_ops": float(self.served_ops),
+            "acked_writes": float(self.acked_writes),
+            "rejected_overload": float(self.rejected_overload),
+            "rejected_degraded": float(self.rejected_degraded),
+            "brownout": 1.0 if self._brownout else 0.0,
+            "retraces": float(self.retraces),
+        })
+        return flat
+
+    def stats(self) -> dict:
+        """Receipt-grade nested stats (serve_bench's ``serve`` block):
+        controller state, per-tenant shares, rejects, journal
+        coalescing, cache sketch."""
+        with self._lock:
+            tenants = {
+                name: {
+                    "admitted_ops": st.admitted_ops,
+                    "served_ops": st.served_ops,
+                    "queued_ops": st.queued_ops,
+                    "rejected_overload": st.rejected_overload,
+                    "rejected_degraded": st.rejected_degraded,
+                }
+                for name, st in self._tenants.items()
+            }
+        total_served = max(1, self.served_ops)
+        for t in tenants.values():
+            t["share"] = round(t["served_ops"] / total_served, 4)
+        out = {
+            "fusion": self.cfg.fusion,
+            "widths": list(self.cfg.widths),
+            "p99_targets_ms": dict(self.cfg.p99_targets_ms),
+            "max_queue_ops": self.cfg.max_queue_ops,
+            "controller": self.controller.snapshot(),
+            "calibration": {str(w): {k: round(v, 3)
+                                     for k, v in c.items()}
+                            for w, c in self.calibration.items()},
+            "window": self.tracker.window(),
+            "tenants": tenants,
+            "admitted_ops": self.admitted_ops,
+            "served_ops": self.served_ops,
+            "acked_writes": self.acked_writes,
+            "rejects": {"overload": self.rejected_overload,
+                        "degraded": self.rejected_degraded},
+            "dispatch_errors": self.dispatch_errors,
+            "sealed": self._sealed,
+            "retraces": self.retraces,
+        }
+        if self.journal is not None:
+            js = self.journal.stats()
+            js["acks_per_fsync"] = (self.acked_writes / js["fsyncs"]
+                                    if js["fsyncs"] else None)
+            out["journal"] = js
+        if self.leaf_cache is not None:
+            out["cache"] = {**self.leaf_cache.stats(),
+                            "sketch": self.leaf_cache.sketch_stats()}
+        return out

@@ -174,6 +174,13 @@ class Journal:
         self.sync = bool(sync)
         self.group_commit_ms = float(group_commit_ms)
         self._lock = threading.Lock()
+        # per-INSTANCE accounting (the obs counters above are process-
+        # wide totals): the serving front door's receipt needs THIS
+        # segment's appends/fsyncs to publish its acks-per-fsync
+        # coalescing ratio (tools/serve_bench.py)
+        self.appends = 0
+        self.rows = 0
+        self.fsyncs = 0
         # group-commit state (guarded by _lock via the condition):
         # records are sequenced as they hit the OS file; an ack may
         # only release once _synced_seq covers its sequence number
@@ -242,6 +249,11 @@ class Journal:
                 self._f.flush()
                 self._written_seq += 1
                 seq = self._written_seq
+                # per-instance receipt counters under the SAME lock as
+                # the sequence they describe (concurrent group-commit
+                # appenders would otherwise lose increments)
+                self.appends += 1
+                self.rows += int(keys.size)
                 if self.sync and self.group_commit_ms <= 0:
                     try:
                         _fsync(self._f.fileno())
@@ -252,6 +264,7 @@ class Journal:
                         raise
                     self._synced_seq = seq
                     _OBS_FSYNCS.inc()
+                    self.fsyncs += 1
             if self.sync and self.group_commit_ms > 0:
                 self._commit(seq)
         finally:
@@ -308,10 +321,25 @@ class Journal:
                                              group_commit=True)
                             raise
                         _OBS_FSYNCS.inc()
+                        self.fsyncs += 1
                     self._synced_seq = max(self._synced_seq, cover)
                 finally:
                     self._leader = False
                     self._commit_cv.notify_all()
+
+    def stats(self) -> dict:
+        """Per-instance accounting snapshot: {appends, rows, fsyncs,
+        appends_per_fsync} — the front door's durability receipt
+        (each append covers one engine batch record, so client write
+        acks per fsync = acked requests / fsyncs on the caller's
+        side)."""
+        return {
+            "appends": self.appends,
+            "rows": self.rows,
+            "fsyncs": self.fsyncs,
+            "appends_per_fsync": (self.appends / self.fsyncs
+                                  if self.fsyncs else None),
+        }
 
     def close(self) -> None:
         with self._lock:

@@ -1458,3 +1458,136 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     table_d, rtable_d, rkey_d = staged or _stage_inputs(
         dsm, router, n_keys, theta, LB, seed, sampler)
     return step, (new_carry, table_d, rtable_d, rkey_d)
+
+
+def make_ingress_step(eng, *, width: int, leaf_cache=None):
+    """External-driver hook on the staged serving substrate — the
+    serving front door's read path (:mod:`sherman_tpu.serve`).
+
+    The staged factories above generate their client batches ON DEVICE
+    (the bench's synthetic zipf open loop); a front door serves batches
+    that arrive from OUTSIDE.  This factory is the host-fed twin: client
+    key batches of ONE fixed compiled ``width`` are combined, probed and
+    dispatched through the SAME serve program OBJECT the staged loops
+    and the host-staged throughput phase run
+    (``BatchedEngine._get_search_fanout`` — so the CI program-identity
+    pin and the compile-ledger label extend to the front door), with the
+    per-request answer fan-out on device via the unique-inverse map,
+    exactly like ``search_combined`` but at the CALLER's width instead
+    of the engine's fixed ``machine_nr * B``.  Fixed width is the whole
+    point: the adaptive batcher picks a step width from a pre-warmed
+    ladder, and every ladder rung is one compiled shape — the sealed
+    serving loop stays zero-retrace by construction.
+
+    Split dispatch/complete protocol (the two-deep pipeline's raw
+    material — the front door keeps ONE batch in flight and overlaps
+    batch k's host prep + dispatch with batch k-1's device serve, the
+    ``fusion="pipelined"`` discipline applied to external traffic)::
+
+        handle = step.dispatch(keys)        # launch only, keys u64 [n]
+        vals, found = step.complete(handle) # blocks, materializes
+
+    ``dispatch`` contract (it is a registered SL001 hot function — no
+    host syncs of device data inside): ``keys`` MUST already be a
+    uint64 ndarray with ``0 < n <= width`` and every key in
+    ``[KEY_MIN, KEY_MAX]`` (the front door validates at admission);
+    duplicate keys share one descent row (request combining — the
+    unique set is key-sorted, the round-1 locality win).  With
+    ``leaf_cache`` attached the unique batch is probed first
+    (pool-validated hits leave the active set and merge back per client
+    row in ``complete`` — bit-identical to the uncached path, the
+    engine read paths' own contract) and the raw client stream feeds
+    the admission sketch (``observe``), so sketch-driven admission
+    learns from REAL request streams.
+
+    Straggler contract: rows whose descent overran the budget (stale
+    router seeds after splits/growth) are rescued in ``complete`` via
+    the engine's root-descent ``search`` — warm it before sealing.
+
+    NOTE this factory and ``BatchedEngine.search_combined`` implement
+    the same combine/probe/fan-out/rescue/merge protocol at different
+    width regimes (the engine's fixed ``machine_nr * B`` + client
+    quantum vs the caller's ladder rung); the bit-identity pin in
+    ``tests/test_serve.py`` (ingress vs ``search_combined`` on the
+    same batch) is the guard that keeps the two copies from
+    diverging.
+    """
+    router = eng.router
+    if router is None:
+        raise ConfigError("make_ingress_step: attach_router() first — "
+                          "the front door serves router-seeded descents")
+    if width <= 0 or width % eng.cfg.machine_nr != 0:
+        raise ConfigError(
+            f"ingress width {width} must be a positive multiple of "
+            f"machine_nr={eng.cfg.machine_nr} (the batch shards over "
+            "the node mesh)")
+    iters = eng._iters()
+    fn = eng._get_search_fanout(iters)
+    root = np.int32(eng.tree._root_addr)
+
+    def dispatch(keys):
+        n = keys.shape[0]
+        uk, inv = np.unique(keys, return_inverse=True)
+        U = uk.shape[0]
+        kh, kl = bits.keys_to_pairs(uk)
+        khi = np.zeros(width, kh.dtype)
+        klo = np.zeros(width, kl.dtype)
+        khi[:U] = kh
+        klo[:U] = kl
+        active = np.zeros(width, bool)
+        active[:U] = True
+        chit = cvhi = cvlo = None
+        if leaf_cache is not None:
+            # admission sketch sees the RAW (duplicated) client stream —
+            # frequency ranking needs the multiplicities — then the
+            # probe drops pool-validated hits out of the device batch
+            leaf_cache.observe(keys)
+            chit, cvhi, cvlo = leaf_cache.probe(khi, klo, active)
+            active = active & ~chit
+        start = router.host_start(khi, klo)
+        inv_p = np.zeros(width, np.int32)
+        inv_p[:n] = inv.astype(np.int32)
+        args = (eng._shard(khi), eng._shard(klo), root,
+                eng._shard(active), eng._shard(start),
+                eng._shard(inv_p))
+        with eng._step_mutex:  # launch-only, the engine step contract
+            eng.dsm.counters, done, found, vhi, vlo = fn(
+                eng.dsm.pool, eng.dsm.counters, *args)
+        return (n, U, uk, inv, done, found, vhi, vlo, chit, cvhi, cvlo)
+
+    def complete(handle):
+        n, U, uk, inv, done, found, vhi, vlo, chit, cvhi, cvlo = handle
+        done, found, vhi, vlo = eng._unshard(done, found, vhi, vlo)
+        done_u = np.asarray(done[:U])
+        if chit is not None:
+            done_u = done_u | chit[:U]
+        if not bool(done_u.all()):
+            # straggler rescue (stale seeds / height growth): the
+            # engine's root-descent path answers the whole unique set,
+            # host fan-out (search() owns retries + SLO attribution)
+            vals_u, found_u = eng.search(uk)
+            return vals_u[inv][:n], found_u[inv][:n]
+        vals = np.array(bits.pairs_to_keys(vhi[:n], vlo[:n]))
+        fnd = np.array(found[:n])
+        if chit is not None and chit[:U].any():
+            # cache hits' device rows were inactive — overwrite their
+            # client rows through the same inverse map the fan-out used
+            ch = chit[:U][inv][:n]
+            fnd[ch] = True
+            vals[ch] = np.asarray(bits.pairs_to_keys(
+                cvhi[:U], cvlo[:U]))[inv][:n][ch]
+        return vals, fnd
+
+    def step(keys):
+        """Synchronous convenience: dispatch + complete in one call
+        (closed-loop drivers and tests; the front door pipelines the
+        two halves itself)."""
+        return complete(dispatch(keys))
+
+    step.dispatch = dispatch
+    step.complete = complete
+    step.width = width
+    step.cache = leaf_cache is not None
+    step.programs = {"serve_fanout": fn}
+    step.phase_labels = {"serve_fanout": fn.label}
+    return step

@@ -37,8 +37,10 @@ sub-dict with the tracker's own window view).
                    RTT; co-located hosts read raw directly), the
                    calibrated-sync-subtracted values a lower bound.
 
-Admissions are paced by a ``perf_counter_ns`` SLEEP+SPIN hybrid (round
-6): coarse sleep until ``--spin-ms`` before each deadline, then a spin
+Admissions are paced by the shared ``perf_counter_ns`` SLEEP+SPIN
+hybrid (round 6; one copy in ``tools/common.py`` —
+:class:`common.AdmissionPacer` — shared with ``tools/serve_bench.py``):
+coarse sleep until ``--spin-ms`` before each deadline, then a spin
 bounded at half the batch period — ms-granularity ``time.sleep`` could
 not pace sub-ms periods, which is what kept the 16 K row below the
 round-5 admission floor.  Every row publishes its per-admission pacing
@@ -65,7 +67,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from common import setup_platform  # noqa: E402
+from common import AdmissionPacer, setup_platform  # noqa: E402
 
 
 def main() -> None:
@@ -262,39 +264,25 @@ def main() -> None:
         # estimator the SLO plane publishes
         ol_raw_t = SLO.LatencyTracker()
         ol_adj_t = SLO.LatencyTracker()
-        # Admission pacing: perf_counter_ns SPIN-WAIT, not time.sleep.
-        # ms-granularity sleep cannot pace sub-ms batch periods — the
-        # round-5 16 K row was below this host's ADMISSION floor purely
-        # because sleep() quantizes at ~1-16 ms.  The hybrid sleeps
-        # until spin_ns before the deadline (duty-cycle-bounded: the
-        # spin budget is capped at half the batch period, so the pacer
-        # can never consume a whole core busy-waiting), then spins on
-        # the ns clock.  Per-admission error (dispatch time - due time)
-        # is recorded and PUBLISHED (adm_jitter_p50/p99_ms): each row
-        # carries its own admission-feasibility receipt — a row whose
-        # p99 jitter rivals its batch period was not actually paced at
-        # the offered rate, and says so in the JSON instead of needing
-        # a prose rejection note.
-        spin_ns = int(min(args.spin_ms * 1e6, 0.5 * T * 1e9))
-        T_ns = int(T * 1e9)
+        # Admission pacing: the SHARED perf_counter_ns sleep+spin pacer
+        # (common.AdmissionPacer — one copy for this driver and
+        # serve_bench; the rationale and the jitter-receipt contract
+        # live on the class).  Deadline i = t_base + i*T; per-admission
+        # error is recorded and PUBLISHED (adm_jitter_p50/p99_ms) as
+        # the row's admission-feasibility receipt.
+        pacer = AdmissionPacer(T, spin_ms=args.spin_ms)
+        T_ns = pacer.period_ns
         sync_ns = int(sync_ms * 1e6)
-        adm_err_ns = []
-        t_b = time.perf_counter_ns() + 2 * T_ns
+        pacer.start()
         for i in range(n_ol):
-            due = t_b + i * T_ns
-            now = time.perf_counter_ns()
-            if now < due - spin_ns:
-                time.sleep((due - spin_ns - now) / 1e9)
-            while True:
-                now = time.perf_counter_ns()
-                if now >= due:
-                    break
-            adm_err_ns.append(now - due)
+            pacer.wait_turn(i)
             counters, done, found, vhi, vlo = step(i, counters)
             if i % stride == stride - 1:
                 jax.block_until_ready(found)
                 t_c = time.perf_counter_ns()
-                mean_arrival = t_b + int((i - 0.5) * T_ns)
+                # arrivals are uniform over batch i's admission window,
+                # so the sample's reference point is the MEAN arrival
+                mean_arrival = pacer.due_ns(i) - T_ns // 2
                 raw_ms = (t_c - mean_arrival) / 1e6
                 ol_raw_t.record(raw_ms / 1e3)
                 ol_adj_t.record(max(0.0, raw_ms - sync_ms) / 1e3)
@@ -307,15 +295,16 @@ def main() -> None:
                 # falling behind the offered rate — still accumulates
                 # across strides exactly as in a true open loop
                 # (uncapped re-anchoring would reintroduce coordinated
-                # omission).
-                lag = time.perf_counter_ns() - (t_b + (i + 1) * T_ns)
-                if lag > 0:
-                    t_b += min(lag, sync_ns)
-        adm_p50 = float(np.percentile(adm_err_ns, 50)) / 1e6
-        adm_p99 = float(np.percentile(adm_err_ns, 99)) / 1e6
+                # omission).  AdmissionPacer.absorb_stall is this exact
+                # rule.
+                pacer.absorb_stall(i + 1, sync_ns)
+        adm = pacer.jitter_receipt()
+        adm_p50 = adm["adm_jitter_p50_ms"]
+        adm_p99 = adm["adm_jitter_p99_ms"]
         # feasibility: admissions held the offered schedule if the p99
         # pacing error is small against the batch period
-        adm_ok = adm_p99 < 0.25 * T * 1e3
+        adm_ok = adm["adm_feasible"]
+        spin_ns = pacer.spin_ns
         # each sample is a batch-MEAN op latency; op arrivals are
         # uniform over a T-wide window, so op-level tails spread
         # +-T/2 around the batch mean.  p50 is unaffected (symmetric);

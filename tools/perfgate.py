@@ -40,6 +40,20 @@ Comparability rules (the trajectory's own lessons):
   comparable-config metadata: a cache-ON receipt's ``sustained_ops_s``
   never gates against a cache-OFF round's and vice versa — most ops of
   a cache-ON loop never descend, a different workload per step;
+- SERVE-MODE receipts (``tools/serve_bench.py`` / ``bench.py --serve``
+  — the open-loop, admission-paced front door; identified by the
+  ``serve`` block or ``metric == "serve_bench"``) are a different
+  methodology wholesale: a front-door receipt NEVER gates against a
+  closed-loop round's ``sustained_ops_s`` (or any other closed-loop
+  metric) and vice versa — an open loop pays admission pacing,
+  queueing and per-request acks the closed loop does not, so the
+  comparison would manufacture regressions both ways.  WITHIN
+  serve-mode rounds, per-class p99 (``serve_read_p99_ms`` /
+  ``serve_write_p99_ms``, lower-is-better) and open-loop throughput
+  (``serve_ops_s``) gate with the same noise-margin rule — but only
+  between rounds whose ``serve.p99_targets_ms`` match: a target change
+  re-aims the adaptive controller, which is a config change, not a
+  regression;
 - a metric missing on either side is skipped, not failed — but a
   candidate with NO comparable metric at all exits 2 (the gate cannot
   vouch for it).
@@ -84,6 +98,11 @@ METRICS = (
     ("sustained_ops_s", True),   # device-staged open loop (r05+)
     ("sus_mixed_ops_s", True),   # YCSB-A mixed loop
     ("p99_ms", False),           # step-span tail latency
+    # serve-mode metrics (r12+, gate only within serve-mode rounds at
+    # matching p99 targets — see the comparability rules)
+    ("serve_ops_s", True),       # open-loop front-door throughput
+    ("serve_read_p99_ms", False),   # end-to-end per-request read p99
+    ("serve_write_p99_ms", False),  # end-to-end per-request write p99
 )
 
 
@@ -144,10 +163,30 @@ def _cache_on(r: dict) -> bool:
     return bool(isinstance(c, dict) and c.get("enabled"))
 
 
+def _serve_mode(r: dict) -> bool:
+    """True for a serving-front-door receipt (open-loop, admission-
+    paced — ``tools/serve_bench.py``): the ``serve`` block or the
+    ``serve_bench`` metric name.  Serve-mode and closed-loop receipts
+    never gate against each other (different methodology wholesale —
+    see the module docstring's comparability rules)."""
+    return bool(isinstance(r.get("serve"), dict)
+                or r.get("metric") == "serve_bench")
+
+
 def _comparable(cand: dict, r: dict, metric: str) -> bool:
     if r.get("keys") != cand.get("keys") \
             or r.get("batch") != cand.get("batch"):
         return False
+    # serve-mode wall: front-door receipts gate only within serve-mode
+    # rounds, closed-loop receipts only within closed-loop rounds
+    if _serve_mode(cand) != _serve_mode(r):
+        return False
+    if metric.startswith("serve_"):
+        # per-class p99 gates only between rounds aiming at the SAME
+        # targets — a re-aimed controller is a config change
+        if (cand.get("serve") or {}).get("p99_targets_ms") \
+                != (r.get("serve") or {}).get("p99_targets_ms"):
+            return False
     # node-count rule (see the docstring): a reshard changes the
     # per-node workload — different node counts never compare.  A
     # receipt without the field ran machine_nr=1 (the pre-field
