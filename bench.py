@@ -1231,6 +1231,15 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             # convention-violating tree deserves an asterisk.  Optional
             # field: schema stays 3.
             "lint_clean": _lint_clean(),
+            # value configuration (PR 14): the closed-loop bench always
+            # runs fixed-width 8-byte inline values — heap-bearing
+            # workloads go through bench.py --ycsb, whose rows carry
+            # their own value config.  perfgate treats a differing
+            # value config as INCOMPARABLE (the nodes rule's pattern):
+            # out-of-line payload resolution is a different read per op.
+            "value_bytes": 8,
+            "value_dist": "fixed",
+            "value_heap": False,
         },
         # hot-key tier receipt (models/leaf_cache.py; None = cache off,
         # the shipped default — optional block, schema stays 3).
@@ -1366,6 +1375,22 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import serve_bench
         serve_bench.main(sys.argv[1:])
+        return
+
+    if "--ycsb" in sys.argv:
+        # Workload lane: the YCSB A-F core matrix as first-class bench
+        # rows (A/B/C/D/F over the fused mixed/read paths, E over
+        # range_query_many; with SHERMAN_VALUE_HEAP set, reads resolve
+        # variable-length payloads through the value heap's fused
+        # fan-out gather, with the gather phase attributed and the
+        # YCSB-C loop sealed zero-retrace).  tools/ycsb_bench.py owns
+        # the sequence; it prints its own one-line JSON receipt
+        # (metric "ycsb_matrix").
+        sys.argv.remove("--ycsb")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import ycsb_bench
+        ycsb_bench.main(sys.argv[1:])
         return
 
     if "--reshard-drill" in sys.argv:

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Value-heap + YCSB-matrix CI lane: pin the out-of-line value heap
+# (sherman_tpu/models/value_heap.py) and the YCSB A-F driver
+# (sherman_tpu/workload/ycsb.py, tools/ycsb_bench.py) on the CPU mesh.
+#
+# Runs (1) the value-heap fast tier (handle protocol, fused-fan-out
+# payload reads pinned bit-identical to the host reference resolver,
+# stale-handle revalidation, double-free/torn-slab typed rejection,
+# checkpoint/restore + delta + journal-replay + reshard + migration
+# round trips, serve payload classes), (2) the heap fault-storm fuzz
+# round, (3) a mini YCSB A-F sweep smoke heap-on (sealed zero-retrace
+# C, device-vs-host audit green), and (4) the fixed-width bit-identity
+# pin: with SHERMAN_VALUE_HEAP unset the DSM carries NO heap region
+# and checkpoints are byte-compatible with pre-heap artifacts.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== value-heap fast tier =="
+python -m pytest tests/test_value_heap.py -q
+
+echo "== heap fault-storm fuzz round =="
+python -m pytest "tests/test_fuzz.py::test_fuzz_value_heap_faults" -q -m ''
+
+echo "== mini YCSB A-F sweep (heap on, sealed C, audit) =="
+SHERMAN_VALUE_HEAP=8192 python tools/ycsb_bench.py \
+    --keys 20000 --ops 2048 --steps 3 --value-bytes 64 \
+    > /tmp/_ycsb_ci.json
+python - <<'EOF'
+import json
+j = json.loads(open("/tmp/_ycsb_ci.json").read().strip().splitlines()[-1])
+assert set(j["workloads"]) == set("ABCDEF"), sorted(j["workloads"])
+assert j["workloads"]["C"]["sealed"] and j["workloads"]["C"]["retraces"] == 0
+assert j["audit_ok"] is True, "device payloads diverged from host resolver"
+assert j["config"]["value_heap"] is True
+e = j["workloads"]["E"]
+assert e["counts"]["scan_rows"] > 0
+print("YCSB heap-on sweep:",
+      {w: r["ops_s"] for w, r in j["workloads"].items()})
+EOF
+
+echo "== fixed-width (heap-off) bit-identity pin =="
+python - <<'EOF'
+import numpy as np
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig
+from sherman_tpu.errors import ConfigError
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+
+# SHERMAN_VALUE_HEAP unset: the default DSMConfig carries no heap —
+# no second region is allocated, attach refuses typed, and the
+# compiled program set is exactly the pre-heap one (nothing heap-
+# related is reachable from the engine's entry points)
+cfg = DSMConfig(machine_nr=1, pages_per_node=1024, locks_per_node=256,
+                step_capacity=256, chunk_pages=32)
+assert cfg.heap_pages_per_node == 0
+cluster = Cluster(cfg)
+assert cluster.dsm.heap is None
+tree = Tree(cluster)
+eng = batched.BatchedEngine(tree, batch_per_node=256)
+keys = np.arange(1, 2001, dtype=np.uint64) * 13
+batched.bulk_load(tree, keys, keys * np.uint64(7))
+eng.attach_router()
+vals, found = eng.search_combined(keys)
+assert found.all() and (vals == keys * np.uint64(7)).all()
+try:
+    eng.attach_value_heap()
+    raise SystemExit("heap attach must refuse without a region")
+except ConfigError:
+    pass
+print("heap-off: no region, typed refusal, inline reads intact")
+EOF
+
+echo "== ycsb/serve driver smoke (slow tier) =="
+python -m pytest "tests/test_tools.py::test_ycsb_bench_driver" -q -m ''
+
+echo "YCSB-CI PASS"

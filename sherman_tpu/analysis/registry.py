@@ -118,6 +118,16 @@ DEFAULT_REGISTRY = Registry(
         ("sherman_tpu/workload/device_prep.py",
          "make_ingress_step.dispatch"),
         ("sherman_tpu/serve.py", "ShermanServer._dispatch_reads"),
+        # value heap (PR 14): the handle-resolve kernels are traced
+        # (the gather phase of the fused read fan-out), and the fused
+        # program closure composes the descent + gather on device — a
+        # host sync in either breaks tracing or serializes every
+        # payload read
+        ("sherman_tpu/models/value_heap.py", "resolve_rows"),
+        ("sherman_tpu/models/value_heap.py",
+         "ValueHeap._get_resolve.kernel"),
+        ("sherman_tpu/models/value_heap.py",
+         "ValueHeap._get_fused.kernel"),
     ],
     static_roots={"cfg", "config", "self", "C", "D", "CFG", "bits",
                   "layout"},
@@ -167,6 +177,10 @@ DEFAULT_REGISTRY = Registry(
         # open loop — plain integer adds only; the serve.* collector
         # allocates at PULL time like the cache's and migrate's
         ("sherman_tpu/serve.py", "ShermanServer._note_*"),
+        # value heap (PR 14): per-batch put/get/free accounting —
+        # plain integer adds; the heap.* collector allocates at PULL
+        # time like every other collector
+        ("sherman_tpu/models/value_heap.py", "ValueHeap._note_*"),
     ],
     knob_docs=["BENCHMARKS.md"],
 )

@@ -97,6 +97,34 @@ def staged_fusion() -> str:
     return v
 
 
+def value_heap_pages() -> int:
+    """Out-of-line value heap knob (``SHERMAN_VALUE_HEAP``): heap pages
+    per node of the second DSM region storing variable-length payloads
+    (:mod:`sherman_tpu.models.value_heap`), 0 = disabled.
+
+    Off is the SHIPPED DEFAULT: with the knob unset every leaf value is
+    the inline 64-bit word pair it always was and every compiled
+    program, pool image and bench receipt is bit-identical to a build
+    without the subsystem (the heap-off identity pin in CI).
+    ``SHERMAN_VALUE_HEAP=1`` enables the heap at the default region
+    size; any larger integer is the heap pages-per-node count."""
+    import os
+    v = os.environ.get("SHERMAN_VALUE_HEAP", "0").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return 0
+    if v in ("1", "true", "on", "yes"):
+        return 4096
+    try:
+        n = int(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_VALUE_HEAP={v!r}: want 0/1 or a pages-per-node "
+            "count")
+    if n < 0:
+        raise ConfigError(f"SHERMAN_VALUE_HEAP={n}: want >= 0")
+    return n
+
+
 def leaf_cache_slots() -> int:
     """Hot-key tier knob (``SHERMAN_LEAF_CACHE``): physical slot count
     of the compute-side versioned leaf/value cache
@@ -166,6 +194,16 @@ class DSMConfig:
     # (CI-pinned); flip per deployment from tools/profile_gather.py
     # measurements, not belief.
     gather_impl: str = "xla"
+    # Out-of-line VALUE HEAP (models/value_heap.py): a second DSM
+    # region of this many 1 KB pages per node, carved into size-class
+    # slabs holding variable-length payloads; leaf slots then store
+    # versioned HANDLES instead of inline values, resolved in the same
+    # fused device step as the descent fan-out (gathered through
+    # ``gather_impl`` like the pool).  0 (default) = no heap: every
+    # program and artifact is bit-identical to a build without the
+    # subsystem.  SHERMAN_VALUE_HEAP drives it in the bench/serve
+    # drivers (config.value_heap_pages()).
+    heap_pages_per_node: int = 0
 
     def __post_init__(self):
         assert 1 <= self.machine_nr <= MAX_MACHINE
@@ -180,6 +218,8 @@ class DSMConfig:
             "per-node pool limit (int32 word indexing); add nodes instead")
         assert self.exchange_impl in ("xla", "pallas")
         assert self.gather_impl in ("xla", "pallas")
+        assert self.heap_pages_per_node >= 0
+        assert self.heap_pages_per_node <= (1 << ADDR_PAGE_BITS)
 
 
 # ---------------------------------------------------------------------------

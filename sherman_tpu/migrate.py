@@ -606,6 +606,12 @@ class Migrator:
         import time
         t0 = time.perf_counter()
         self.eng.flush_parents()
+        # value heap: stage the region FIRST, so the certification
+        # against a fresh read at emit time below actually brackets
+        # the whole cutover window (two adjacent reads would compare a
+        # buffer to itself and could never catch a racing writer)
+        heap_image = (self.dsm.heap_snapshot()
+                      if self.dsm.heap is not None else None)
         # conservative delta pass: pre-cutover dirt + late allocations
         self._refresh_plan()
         self._poll_dirt()
@@ -640,6 +646,20 @@ class Migrator:
         image = self._staged_arr
         image[0] = self.dsm.read_page(bits.make_addr(0, 0))
         man = CK._manifest(self.cluster)
+        # value heap certification: the image staged at cutover entry
+        # must still BE the live region now that the pool has quiesced
+        # (handles address the heap by global row, so the region copies
+        # verbatim and the transform pads the node split — no handle
+        # rewrite).  A heap writer racing the cutover lands between
+        # the two reads and aborts typed, the pool verify's contract.
+        if heap_image is not None:
+            heap_live = self.dsm.heap_snapshot()
+            if not np.array_equal(heap_image, heap_live):
+                self.abort("cutover could not quiesce the value heap "
+                           "(a heap writer is racing finish())")
+                raise MigrationAborted(
+                    f"migration {self.mid}: heap image diverged during "
+                    "cutover (a writer is racing finish())")
         # counters LAST: nothing below issues another DSM op, so the
         # emitted totals equal a checkpoint taken right after finish —
         # the drill's offline-vs-online bit-identity pin needs that
@@ -648,11 +668,14 @@ class Migrator:
         arrays, new_cfg, summary = RS.reshard_arrays(
             man, image, locks, counters, self.target_nodes,
             pages_per_node=self.target_pages_per_node,
-            locks_per_node=self.target_locks_per_node)
+            locks_per_node=self.target_locks_per_node,
+            heap=heap_image)
         RS.write_resharded(dst, arrays, new_cfg, hosts=hosts)
         self.finished = True
         self.cluster.dsm.remove_dirty_sink(self._sink)
         summary["mid"] = self.mid
+        summary["heap_pages"] = (int(heap_image.shape[0])
+                                 if heap_image is not None else 0)
         summary["pages_moved"] = self.pages_moved
         summary["batches"] = self.batches
         summary["retries"] = self.retries

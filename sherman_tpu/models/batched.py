@@ -1115,6 +1115,12 @@ class BatchedEngine:
         # it, degraded entry flushes it.  None (default) costs one
         # `is None` test per read batch.
         self.leaf_cache = None
+        # Optional out-of-line value heap (models/value_heap.py,
+        # attached by attach_value_heap): leaf values become versioned
+        # slab handles resolved in the fused fan-out; journal replay
+        # discovers it here.  None (default) = inline 64-bit values,
+        # bit-identical to pre-heap builds.
+        self.value_heap = None
         # Optional write-ahead op journal (utils/journal.py, attached by
         # the recovery plane): every engine write op appends ONE batch
         # record of its APPLIED rows before returning — the record is
@@ -1264,6 +1270,17 @@ class BatchedEngine:
         self.leaf_cache = LeafCache(self, slots=slots,
                                     admit_every=admit_every)
         return self.leaf_cache
+
+    def attach_value_heap(self, **kw):
+        """Create + attach the out-of-line value heap
+        (models/value_heap.py) over this engine's DSM heap region
+        (``DSMConfig.heap_pages_per_node`` / ``SHERMAN_VALUE_HEAP``):
+        leaf values become versioned slab handles and
+        ``put``/``get``/``remove``/``scan`` on the returned
+        :class:`~sherman_tpu.models.value_heap.ValueHeap` serve
+        variable-length payloads."""
+        from sherman_tpu.models.value_heap import ValueHeap
+        return ValueHeap(self, **kw)
 
     def detach_leaf_cache(self) -> None:
         """Drop the hot-key tier (reads go back to full descents).

@@ -1,0 +1,1005 @@
+"""Out-of-line value heap — variable-length payloads behind leaf handles.
+
+Sherman (and this reproduction until now) stores fixed-width 64-bit
+values inline in leaf slots — the ROADMAP's "single biggest gap between
+'index benchmark' and 'storage system people can put real records in'".
+This module lifts it with a SECOND DSM region (``DSMConfig.
+heap_pages_per_node``; ``dsm.heap``): 1 KB heap pages carved into
+size-class slabs holding variable-length payloads, while the leaf value
+lanes hold versioned **handles**.  The B+-tree machinery is untouched —
+a handle is just a 64-bit value to every compiled tree program, which
+is what keeps the heap-off build bit-identical to pre-heap builds.
+
+Layout and protocol:
+
+- **Heap page**: words ``[0, 255)`` are the slab region; word 255 is a
+  page tag ``TAG_MAGIC | size_class`` written at carve time (the
+  rebuild/scrub anchor — allocator state is reconstructible from the
+  region alone, like the pool's allocator marks).
+- **Slab** (size class ``c``): ``HEAP_CLASSES[c]`` words; word 0 is the
+  header ``(version << 16) | nbytes`` and the rest is payload (so class
+  capacities are 28/60/124/252 bytes by default).  ``version`` is a
+  16-bit counter that skips 0; ``nbytes == 0`` marks a free slab.
+- **Handle** (the leaf value, 64 bits as the usual hi/lo int32 pair):
+  ``hi`` = global heap row, ``lo`` = ``slab_idx<<24 | class<<20 |
+  version``.  The version is the COHERENCE TOKEN: a read resolves the
+  handle by gathering the slab **in the same fused device step as the
+  descent fan-out** (one extra gather phase over ``dsm.heap``, routed
+  through ``DSMConfig.gather_impl`` — ``"pallas"`` uses the
+  ``gather_pages`` DMA ring) and compares the slab header's version to
+  the handle's.  A mismatch is a STALE handle (the slab was freed or
+  rewritten after the descent snapshotted the leaf): the reader
+  revalidates-and-retries through a fresh descent; persistent mismatch
+  (torn/corrupt slab) fails typed (:class:`HeapCorruptError`) — never
+  a silent wrong payload.
+- **Writes** allocate from per-client size-class freelists (carving
+  fresh pages node-round-robin when a list runs dry) under the
+  FREE-AFTER-INSTALL protocol: every record gets a fresh slab, and
+  the superseded slab is freed only after the new handle's install
+  succeeded — a per-key install failure (``ST_LOCK_TIMEOUT``) leaves
+  the old record intact and readable, and a concurrent reader always
+  finds a valid slab behind whichever handle its descent saw.  Frees
+  ride the version bump: freeing a slab whose header version no
+  longer matches the handle raises the typed
+  :class:`~sherman_tpu.errors.DoubleFreeError`.
+- **Durability**: slab writes land through ``dsm.heap_write_cells``
+  (one device step — header+payload are step-atomic like pool writes)
+  and are journaled pre-ack (``J_HEAP_PUT``/``J_HEAP_FREE`` records
+  BEFORE the engine's own ``J_UPSERT``/``J_DELETE``, matching apply
+  order), dirty-tracked for delta checkpoints, carried by full
+  checkpoints/restore and the reshard transform (handles address the
+  heap by GLOBAL row, so an N->M reshard redistributes heap pages
+  without rewriting a single handle), and staged into the online
+  migrator's cutover image.
+- **Scrub** (:meth:`ValueHeap.scrub`): orphan handles (live leaf
+  handle whose slab version mismatches) are counted and surfaced;
+  leaked slabs (allocated but unreferenced) are reclaimed back onto
+  the freelists.
+
+The engine stays value-agnostic; :class:`ValueHeap` wraps it with the
+payload API (``put``/``get``/``remove``/``scan``) the YCSB driver and
+the serving front door consume.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu import obs
+from sherman_tpu.config import PAGE_WORDS
+from sherman_tpu.errors import (ConfigError, DoubleFreeError, ShermanError,
+                                StateError)
+from sherman_tpu.obs import device as DEV
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import transport
+from sherman_tpu.parallel.dsm import read_pages_spmd
+from sherman_tpu.parallel.mesh import AXIS
+
+__all__ = [
+    "HEAP_CLASSES", "HeapFullError", "HeapCorruptError", "ValueHeap",
+    "class_for_bytes", "pack_handles", "unpack_handles",
+]
+
+# Slab words per size class (word 0 of each slab is the header).
+HEAP_CLASSES = (8, 16, 32, 64)
+#: heap-page word reserved for the carve-time class tag
+TAG_W = PAGE_WORDS - 1
+TAG_MAGIC = 0x48450000  # "HE" << 16
+#: slab region words per heap page (word TAG_W excluded)
+SLAB_REGION_WORDS = TAG_W
+#: widest payload any class carries, in words (the resolve programs'
+#: static output width)
+MAX_PAYLOAD_WORDS = HEAP_CLASSES[-1] - 1
+
+_SLABS_PER_PAGE = tuple(SLAB_REGION_WORDS // w for w in HEAP_CLASSES)
+_CLASS_CAP_BYTES = tuple((w - 1) * 4 for w in HEAP_CLASSES)
+_VER_MASK = 0xFFFF
+
+_OBS_PUTS = obs.counter("heap.puts")
+_OBS_GETS = obs.counter("heap.gets")
+_OBS_FREES = obs.counter("heap.frees")
+_OBS_CARVES = obs.counter("heap.pages_carved")
+_OBS_STALE = obs.counter("heap.stale_retries")
+_OBS_ORPHANS = obs.counter("heap.orphan_handles")
+_OBS_LEAKS = obs.counter("heap.leaks_reclaimed")
+_OBS_DOUBLE = obs.counter("heap.double_frees")
+
+
+class HeapFullError(ShermanError, RuntimeError):
+    """Every node's heap region is carved and the requested size
+    class's freelists are empty — grow ``heap_pages_per_node`` (or
+    reshard onto more nodes)."""
+
+
+class HeapCorruptError(ShermanError, RuntimeError):
+    """A handle's slab failed version validation on every retry (torn
+    or corrupted slab content): the payload cannot be served.  Typed
+    rejection — never a silent wrong payload."""
+
+
+def class_for_bytes(n: int) -> int:
+    """Smallest size class whose payload capacity fits ``n`` bytes."""
+    for c, cap in enumerate(_CLASS_CAP_BYTES):
+        if n <= cap:
+            return c
+    raise ConfigError(
+        f"payload of {n} bytes exceeds the largest value-heap class "
+        f"({_CLASS_CAP_BYTES[-1]} bytes); chunk the record client-side")
+
+
+def pack_handles(rows, slabs, clss, vers) -> np.ndarray:
+    """(row, slab, class, version) arrays -> uint64 handle values."""
+    hi = np.asarray(rows, np.uint64) & np.uint64(0xFFFFFFFF)
+    lo = ((np.asarray(slabs, np.uint64) << np.uint64(24))
+          | (np.asarray(clss, np.uint64) << np.uint64(20))
+          | (np.asarray(vers, np.uint64) & np.uint64(_VER_MASK)))
+    return (hi << np.uint64(32)) | lo
+
+
+def unpack_handles(vals) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray]:
+    """uint64 handles -> (rows, slabs, classes, versions) int64."""
+    v = np.asarray(vals, np.uint64)
+    rows = (v >> np.uint64(32)).astype(np.int64)
+    lo = (v & np.uint64(0xFFFFFFFF)).astype(np.int64)
+    return (rows, (lo >> 24) & 0xFF, (lo >> 20) & 0xF, lo & _VER_MASK)
+
+
+def _header_word(ver: int, nbytes: int) -> int:
+    return int(np.uint32(((ver & _VER_MASK) << 16)
+                         | (nbytes & 0xFFFF)).view(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# Device-side handle resolution (the fused gather phase).
+# ---------------------------------------------------------------------------
+
+def resolve_rows(heap, vhi, vlo, active, *, hcfg, axis_name: str = AXIS):
+    """Resolve handle pairs to payload rows on device; call inside
+    shard_map.  -> (payload [B, MAX_PAYLOAD_WORDS] int32, nbytes [B]
+    int32, ver_ok [B] bool).
+
+    One heap-page gather per row (``read_pages_spmd`` over the heap
+    region — the same engine, same ``gather_impl`` routing, as the
+    descent's page fetches), then a static-width slab slice + header
+    version check.  Payload words beyond the record's length are
+    zeroed so results are bit-deterministic.
+    """
+    Hpp = hcfg.pages_per_node
+    row = vhi  # global heap row (int32 bit pattern, non-negative)
+    slab = jnp.right_shift(vlo, 24) & 0xFF
+    cls = jnp.right_shift(vlo, 20) & 0xF
+    hver = vlo & _VER_MASK
+    addr = bits.make_addr(row // Hpp, row % Hpp)
+    pages, ok = read_pages_spmd(heap, addr, cfg=hcfg,
+                                axis_name=axis_name, active=active)
+    sw = jnp.take(jnp.asarray(HEAP_CLASSES, jnp.int32),
+                  jnp.clip(cls, 0, len(HEAP_CLASSES) - 1))
+    off = slab * sw
+    hdr = jnp.take_along_axis(
+        pages, jnp.clip(off, 0, PAGE_WORDS - 1)[:, None], axis=1)[:, 0]
+    sver = jnp.right_shift(hdr, 16) & _VER_MASK
+    nbytes = hdr & 0xFFFF
+    ver_ok = ok & (sver == hver) & (hver != 0)
+    colw = jnp.arange(MAX_PAYLOAD_WORDS, dtype=jnp.int32)
+    idx = jnp.clip(off[:, None] + 1 + colw[None, :], 0, PAGE_WORDS - 1)
+    payload = jnp.take_along_axis(pages, idx, axis=1)
+    nwords = (nbytes + 3) >> 2
+    keep = ver_ok[:, None] & (colw[None, :] < nwords[:, None]) \
+        & (colw[None, :] < (sw - 1)[:, None])
+    payload = jnp.where(keep, payload, 0)
+    nbytes = jnp.where(ver_ok, nbytes, 0)
+    return payload, nbytes, ver_ok
+
+
+# ---------------------------------------------------------------------------
+# The heap itself.
+# ---------------------------------------------------------------------------
+
+class ValueHeap:
+    """Slab allocator + payload API over the DSM's heap region (see
+    the module docstring).  Single driver per heap (the engine's
+    journaled-writer shape); ``client_id`` partitions the freelists so
+    a future multi-client front door never contends on them."""
+
+    def __init__(self, eng, *, default_client: int = 0):
+        self.eng = eng
+        self.dsm = eng.dsm
+        self.cfg = eng.cfg
+        if self.dsm.heap is None:
+            raise ConfigError(
+                "ValueHeap needs a DSM with heap_pages_per_node > 0 "
+                "(SHERMAN_VALUE_HEAP)")
+        self.Hpp = self.cfg.heap_pages_per_node
+        self.N = self.cfg.machine_nr
+        self.rows_total = self.N * self.Hpp
+        self.default_client = int(default_client)
+        # allocator state (host; reconstructible from the region —
+        # rebuild()): per-page class (-1 = uncarved), per-slab version
+        # mirror, per-(client, class) free slab sets, per-node bump.
+        self._page_cls = np.full(self.rows_total, -1, np.int8)
+        self._ver = np.zeros((self.rows_total, max(_SLABS_PER_PAGE)),
+                             np.uint16)
+        self._free: dict[tuple[int, int], set] = {}
+        self._next_page = np.zeros(self.N, np.int64)
+        # uncarved pages BELOW a node's bump mark (a rebuild after an
+        # N->M reshard interleaves the old nodes' carved segments into
+        # the new node split, leaving carvable holes the bump pointer
+        # alone would strand forever)
+        self._spare_pages: list[int] = []
+        self._rr_node = 0
+        self._lock = threading.Lock()
+        self._resolve_cache: dict = {}
+        self._fused_cache: dict = {}
+        # receipt counters (plain adds on the hot paths — SL006)
+        self.puts = 0
+        self.gets = 0
+        self.frees = 0
+        self.stale_retries = 0
+        self.pages_carved = 0
+        eng.value_heap = self
+        import weakref
+        ref = weakref.ref(self)
+        obs.register_collector(
+            "heap", lambda: (lambda h: h._collect() if h is not None
+                             else {})(ref()))
+
+    # -- hot accounting (registered SL006 scope: plain adds only) ------------
+
+    def _note_put(self, n: int) -> None:
+        self.puts += n
+
+    def _note_get(self, n: int) -> None:
+        self.gets += n
+
+    def _note_free(self, n: int) -> None:
+        self.frees += n
+
+    def _collect(self) -> dict:
+        # pull-time only — take the allocator lock so a concurrent
+        # put()'s freelist-key insertion can't race the iteration
+        with self._lock:
+            free = sum(len(s) for s in self._free.values())
+        return {
+            "puts": float(self.puts),
+            "gets": float(self.gets),
+            "frees": float(self.frees),
+            "stale_retries": float(self.stale_retries),
+            "pages_carved": float(self.pages_carved),
+            "free_slabs": float(free),
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            carved = int((self._page_cls >= 0).sum())
+            free = int(sum(len(s) for s in self._free.values()))
+        return {
+            "pages_total": self.rows_total,
+            "pages_carved": carved,
+            "free_slabs": free,
+            "puts": self.puts,
+            "gets": self.gets,
+            "frees": self.frees,
+            "stale_retries": self.stale_retries,
+        }
+
+    # -- allocation ----------------------------------------------------------
+
+    def _carve(self, client: int, cls: int) -> None:
+        """Carve one fresh heap page into class-``cls`` slabs for
+        ``client`` (spare holes first, then node-round-robin bump;
+        typed HeapFullError when every node's region is exhausted)."""
+        row = None
+        while self._spare_pages:
+            cand = self._spare_pages.pop()
+            if self._page_cls[cand] < 0:  # replay may have carved it
+                row = cand
+                break
+        if row is None:
+            for _ in range(self.N):
+                node = self._rr_node
+                self._rr_node = (self._rr_node + 1) % self.N
+                if self._next_page[node] < self.Hpp:
+                    page = int(self._next_page[node])
+                    self._next_page[node] += 1
+                    row = node * self.Hpp + page
+                    break
+        if row is None:
+            raise HeapFullError(
+                f"value heap exhausted ({self.rows_total} pages "
+                f"carved; class {cls} freelist empty): grow "
+                "heap_pages_per_node")
+        self._page_cls[row] = cls
+        self.dsm.heap_write_cells(
+            [row], [TAG_W], [np.int32(TAG_MAGIC | cls)])
+        self._free.setdefault((client, cls), set()).update(
+            (row, s) for s in range(_SLABS_PER_PAGE[cls]))
+        self.pages_carved += 1
+        _OBS_CARVES.inc()
+
+    def _alloc(self, client: int, cls: int, count: int) -> list:
+        """Pop ``count`` free (row, slab) pairs of class ``cls``."""
+        free = self._free.setdefault((client, cls), set())
+        out = []
+        while len(out) < count:
+            if not free:
+                self._carve(client, cls)
+            out.append(free.pop())
+        return out
+
+    # -- payload <-> words ---------------------------------------------------
+
+    @staticmethod
+    def _payload_words(b: bytes) -> np.ndarray:
+        pad = (-len(b)) % 4
+        return np.frombuffer(bytes(b) + b"\x00" * pad, "<i4").copy()
+
+    @staticmethod
+    def _words_to_bytes(words: np.ndarray, nbytes: int) -> bytes:
+        return np.asarray(words, np.int32).tobytes()[:nbytes]
+
+    # -- writes --------------------------------------------------------------
+
+    def put(self, keys, payloads, *, client: int | None = None) -> dict:
+        """Upsert variable-length ``payloads`` (list of bytes) under
+        uint64 ``keys``.  Duplicate keys in one batch: last writer
+        wins (the engine's own upsert linearization).  Returns
+        {applied, allocated, freed, lock_timeouts, lock_timeout_keys}.
+
+        Protocol — NEVER destroy before install: every record gets a
+        FRESH slab (write payload -> journal J_HEAP_PUT -> install the
+        handles through the engine's upsert path); superseded old
+        slabs are freed only AFTER their key's install succeeded, so a
+        per-key install failure (typed ``ST_LOCK_TIMEOUT``) leaves the
+        old record fully intact and readable.  Timed-out keys are
+        COMPENSATED: their never-referenced fresh slabs are freed, and
+        a journal record re-asserting the pre-op state (old handle, or
+        a delete for a fresh key) is appended so replay converges to
+        the live outcome instead of resurrecting the failed put."""
+        client = self.default_client if client is None else int(client)
+        self.eng._require_writable()
+        keys = np.asarray(keys, np.uint64)
+        if keys.size != len(payloads):
+            raise ConfigError("put needs one payload per key")
+        if keys.size == 0:
+            return {"applied": 0, "allocated": 0, "freed": 0,
+                    "lock_timeouts": 0, "lock_timeout_keys": []}
+        # dedup keeping the LAST occurrence (upsert semantics)
+        _, last_idx = np.unique(keys[::-1], return_index=True)
+        order = np.sort(keys.size - 1 - last_idx)
+        ukeys = keys[order]
+        upay = [bytes(payloads[i]) for i in order]
+        old_vals, old_found = self.eng.search(ukeys)
+        with self._lock:
+            handles, rows_w, woffs_w, vals_w, old_live = \
+                self._plan_puts(client, ukeys, upay, old_vals)
+        self.dsm.heap_write_cells(rows_w, woffs_w, vals_w)
+        self._journal_heap_put(ukeys, handles, upay)
+        stats = self.eng.insert(ukeys, handles)
+        to_keys = np.asarray(stats["lock_timeout_keys"], np.uint64) \
+            if stats["lock_timeouts"] else np.zeros(0, np.uint64)
+        failed = np.isin(ukeys, to_keys)
+        ok = ~failed
+        # free AFTER install: superseded old slabs of the keys that
+        # actually applied...
+        old_freeable = old_live & ok & old_found
+        if old_freeable.any():
+            self.free_handles(ukeys[old_freeable],
+                              old_vals[old_freeable], client=client)
+        # ...and the never-referenced fresh slabs of keys that did not,
+        # plus the compensating journal records (see docstring)
+        if failed.any():
+            self.free_handles(ukeys[failed], handles[failed],
+                              client=client)
+            j = self.eng.journal
+            if j is not None:
+                from sherman_tpu.utils import journal as JJ
+                f_old = failed & old_found
+                if f_old.any():
+                    j.append(JJ.J_UPSERT, ukeys[f_old], old_vals[f_old])
+                f_fresh = failed & ~old_found
+                if f_fresh.any():
+                    j.append(JJ.J_DELETE, ukeys[f_fresh])
+        self._note_put(int(ukeys.size))
+        _OBS_PUTS.inc(int(ukeys.size))
+        return {"applied": int(stats["applied"]),
+                "allocated": int(ukeys.size),
+                "freed": int(old_freeable.sum()),
+                "lock_timeouts": int(failed.sum()),
+                "lock_timeout_keys": ukeys[failed].tolist()}
+
+    def _handle_live(self, row: int, slab: int, cls: int,
+                     ver: int) -> bool:
+        """True iff (row, slab, cls, ver) decodes to a live slab this
+        allocator owns — guards against treating INLINE legacy values
+        (a tree bulk-loaded before the heap attached) as handles."""
+        return (0 <= row < self.rows_total
+                and 0 <= cls < len(HEAP_CLASSES)
+                and 0 <= slab < _SLABS_PER_PAGE[cls]
+                and int(self._page_cls[row]) == cls
+                and ver != 0 and int(self._ver[row, slab]) == ver)
+
+    def _plan_puts(self, client, ukeys, upay, old_vals):
+        """Under the allocator lock: allocate ONE fresh slab per
+        record, bump its version, and build the cell-scatter arrays
+        (vectorized per record — one numpy concatenate, not a Python
+        append per payload word).  Old slabs are untouched here (the
+        free-after-install protocol; see :meth:`put`).
+        -> (handles u64 [n], rows, woffs, vals, old_live bool [n])."""
+        n = ukeys.size
+        o_rows, o_slabs, o_cls, o_vers = unpack_handles(old_vals)
+        old_live = np.asarray([
+            self._handle_live(int(o_rows[i]), int(o_slabs[i]),
+                              int(o_cls[i]), int(o_vers[i]))
+            for i in range(n)], bool)
+        clss = [class_for_bytes(len(b)) for b in upay]
+        by_cls: dict[int, list[int]] = {}
+        for i, cls in enumerate(clss):
+            by_cls.setdefault(cls, []).append(i)
+        fresh: dict[int, list] = {
+            cls: self._alloc(client, cls, len(idxs))
+            for cls, idxs in by_cls.items()}
+        slab_at = {i: fresh[cls][k]
+                   for cls, idxs in by_cls.items()
+                   for k, i in enumerate(idxs)}
+        import struct
+        handles = np.zeros(n, np.uint64)
+        rec_rows = np.zeros(n, np.int64)
+        rec_offs = np.zeros(n, np.int64)
+        m_arr = np.zeros(n, np.int64)
+        chunks: list[bytes] = []
+        for i, b in enumerate(upay):
+            cls = clss[i]
+            row, slab = slab_at[i]
+            ver = (int(self._ver[row, slab]) + 1) & _VER_MASK
+            if ver == 0:
+                ver = 1
+            self._ver[row, slab] = ver
+            handles[i] = ((row << 32) | (slab << 24) | (cls << 20)
+                          | ver)
+            # header + padded payload as raw little-endian bytes: ONE
+            # join + frombuffer below builds the whole value lane
+            # (no per-record numpy allocation on the write hot path)
+            chunks.append(struct.pack(
+                "<I", ((ver & _VER_MASK) << 16) | len(b))
+                + b + b"\x00" * ((-len(b)) % 4))
+            rec_rows[i] = row
+            rec_offs[i] = slab * HEAP_CLASSES[cls]
+            m_arr[i] = 1 + (len(b) + 3) // 4
+        total = int(m_arr.sum())
+        rows_arr = np.repeat(rec_rows, m_arr)
+        starts = np.repeat(np.cumsum(m_arr) - m_arr, m_arr)
+        woffs = (np.repeat(rec_offs, m_arr)
+                 + np.arange(total, dtype=np.int64)
+                 - starts).astype(np.int32)
+        vals = np.frombuffer(b"".join(chunks), "<i4")
+        return handles, rows_arr, woffs, vals, old_live
+
+    def remove(self, keys, *, client: int | None = None) -> np.ndarray:
+        """Delete ``keys`` and free their slabs.  Returns found [n]
+        (aligned to the input order; duplicates share one delete)."""
+        client = self.default_client if client is None else int(client)
+        self.eng._require_writable()
+        keys = np.asarray(keys, np.uint64)
+        if keys.size == 0:
+            return np.zeros(0, bool)
+        uk = np.unique(keys)
+        vals, found = self.eng.search(uk)
+        out_u = self.eng.delete(uk)
+        if found.any():
+            live = np.zeros(found.shape, bool)
+            rows, slabs, clss, vers = unpack_handles(vals)
+            for i in np.nonzero(found)[0]:
+                live[i] = self._handle_live(int(rows[i]), int(slabs[i]),
+                                            int(clss[i]), int(vers[i]))
+            if live.any():
+                self.free_handles(uk[live], vals[live], client=client)
+        return out_u[np.searchsorted(uk, keys)]
+
+    def free_handles(self, keys, handles, *,
+                     client: int | None = None) -> int:
+        """Return slabs to the freelist, version-bumping their headers
+        so stale handles miss.  A handle whose slab version no longer
+        matches was already freed (or rewritten): typed
+        :class:`~sherman_tpu.errors.DoubleFreeError`."""
+        client = self.default_client if client is None else int(client)
+        keys = np.asarray(keys, np.uint64)
+        handles = np.asarray(handles, np.uint64)
+        rows, slabs, clss, vers = unpack_handles(handles)
+        with self._lock:
+            for i in range(handles.size):
+                # the FULL liveness guard (bounds + page class + ver):
+                # a wrong-class or version-0 handle would compute a
+                # word offset inside some OTHER live slab — freeing it
+                # must reject typed, never corrupt a neighbor
+                if not self._handle_live(int(rows[i]), int(slabs[i]),
+                                         int(clss[i]), int(vers[i])):
+                    _OBS_DOUBLE.inc()
+                    raise DoubleFreeError(
+                        f"free of handle {int(handles[i]):#x}: slab "
+                        "not live under this handle (already freed, "
+                        "rewritten, or malformed)")
+            nv = ((vers.astype(np.int64) + 1) & _VER_MASK)
+            nv = np.where(nv == 0, 1, nv)
+            self._ver[rows, slabs] = nv.astype(np.uint16)
+            for i in range(handles.size):
+                self._free.setdefault(
+                    (client, int(clss[i])), set()).add(
+                    (int(rows[i]), int(slabs[i])))
+            woffs_w = (slabs * np.take(
+                np.asarray(HEAP_CLASSES, np.int64), clss)).astype(np.int32)
+            vals_w = (((nv & _VER_MASK) << 16).astype(np.uint32)
+                      ).view(np.int32)
+        if handles.size:
+            self.dsm.heap_write_cells(rows, woffs_w, vals_w)
+            self._journal_heap_free(keys, handles)
+        self._note_free(int(handles.size))
+        _OBS_FREES.inc(int(handles.size))
+        return int(handles.size)
+
+    # -- journaling ----------------------------------------------------------
+
+    def _journal_heap_put(self, keys, handles, payloads) -> None:
+        j = self.eng.journal
+        if j is not None and keys.size:
+            from sherman_tpu.utils import journal as J
+            j.append_heap(J.J_HEAP_PUT, keys, handles, payloads)
+
+    def _journal_heap_free(self, keys, handles) -> None:
+        j = self.eng.journal
+        if j is not None and np.asarray(handles).size:
+            from sherman_tpu.utils import journal as J
+            j.append(J.J_HEAP_FREE, keys, handles)
+
+    def replay_put(self, keys, handles, payloads) -> None:
+        """Journal replay: rewrite each record's slab AT ITS RECORDED
+        ADDRESS with its recorded version (idempotent, convergent
+        in-order — a later record reusing the slab overwrites), then
+        install the record's handles through the engine.  The install
+        must NOT be left to the op's own ``J_UPSERT`` record: a crash
+        between the two appends would otherwise replay a same-class
+        in-place slab rewrite (new bytes, bumped version) with the
+        leaf still holding the OLD handle version — the previously
+        ACKED record becomes permanently unreadable.  Re-installing
+        here closes the window ("ack may lag apply", at-least-once);
+        the following ``J_UPSERT`` replay, when present, re-applies
+        the same handles idempotently."""
+        handles = np.asarray(handles, np.uint64)
+        rows, slabs, clss, vers = unpack_handles(handles)
+        rows_w, woffs_w, vals_w = [], [], []
+        with self._lock:
+            for i in range(handles.size):
+                row, slab, cls = int(rows[i]), int(slabs[i]), int(clss[i])
+                ver = int(vers[i])
+                if self._page_cls[row] < 0:
+                    self._page_cls[row] = cls
+                    node = row // self.Hpp
+                    new_hw = row % self.Hpp + 1
+                    if new_hw > self._next_page[node]:
+                        # skipped pages become carvable spares (the
+                        # _carve pop re-checks they stayed uncarved)
+                        base = node * self.Hpp
+                        self._spare_pages.extend(
+                            base + p
+                            for p in range(int(self._next_page[node]),
+                                           new_hw - 1)
+                            if self._page_cls[base + p] < 0)
+                        self._next_page[node] = new_hw
+                    rows_w.append(np.asarray([row], np.int64))
+                    woffs_w.append(np.asarray([TAG_W], np.int32))
+                    vals_w.append(np.asarray([TAG_MAGIC | cls],
+                                             np.int32))
+                self._ver[row, slab] = ver
+                self._free.get((self.default_client, cls),
+                               set()).discard((row, slab))
+                b = payloads[i]
+                off = slab * HEAP_CLASSES[cls]
+                words = self._payload_words(b)
+                m = words.size + 1
+                rows_w.append(np.full(m, row, np.int64))
+                woffs_w.append(off + np.arange(m, dtype=np.int32))
+                vals_w.append(np.concatenate(
+                    [np.asarray([_header_word(ver, len(b))], np.int32),
+                     words]))
+        if rows_w:
+            self.dsm.heap_write_cells(np.concatenate(rows_w),
+                                      np.concatenate(woffs_w),
+                                      np.concatenate(vals_w))
+        if handles.size:
+            # replay runs with the journal detached (RecoveryPlane's
+            # contract), so this install never re-journals itself
+            self.eng.insert(np.asarray(keys, np.uint64), handles)
+
+    def replay_free(self, keys, handles) -> None:
+        """Journal replay of frees: version-conditional (idempotent) —
+        a slab already past the recorded version stays put."""
+        handles = np.asarray(handles, np.uint64)
+        rows, slabs, clss, vers = unpack_handles(handles)
+        rows_w, woffs_w, vals_w = [], [], []
+        with self._lock:
+            for i in range(handles.size):
+                row, slab, cls = int(rows[i]), int(slabs[i]), int(clss[i])
+                if int(self._ver[row, slab]) != int(vers[i]):
+                    continue
+                nv = (int(vers[i]) + 1) if ((int(vers[i]) + 1)
+                                            & _VER_MASK) else 1
+                self._ver[row, slab] = nv
+                self._free.setdefault((self.default_client, cls),
+                                      set()).add((row, slab))
+                rows_w.append(row)
+                woffs_w.append(slab * HEAP_CLASSES[cls])
+                vals_w.append(_header_word(nv, 0))
+        if rows_w:
+            self.dsm.heap_write_cells(rows_w, woffs_w, vals_w)
+
+    # -- reads ---------------------------------------------------------------
+
+    def _hcfg(self, capacity: int):
+        """DSMConfig view of the heap region for read_pages_spmd: the
+        heap IS a second DSM region, so the page-gather primitive (and
+        its pallas DMA-ring routing) applies verbatim; step capacity
+        covers the worst case (every row owned by one node)."""
+        import dataclasses
+        return dataclasses.replace(
+            self.cfg, pages_per_node=self.Hpp, heap_pages_per_node=0,
+            step_capacity=max(self.cfg.step_capacity, capacity))
+
+    def _get_resolve(self, width: int):
+        """Sealed resolve program over [width] handle pairs (the
+        standalone gather phase — the staged/serving loops' extra
+        program; the closed-loop read path fuses it into the fan-out
+        via :meth:`_get_fused`)."""
+        fn = self._resolve_cache.get(width)
+        if fn is None:
+            spec = jax.sharding.PartitionSpec(AXIS)
+            hcfg = self._hcfg(width)
+
+            def kernel(heap, vhi, vlo, active):
+                return resolve_rows(heap, vhi, vlo, active, hcfg=hcfg)
+
+            sm = jax.shard_map(
+                kernel, mesh=self.dsm.mesh,
+                in_specs=(spec, spec, spec, spec),
+                out_specs=(spec, spec, spec), check_vma=False)
+            fn = DEV.wrap_program("heap.resolve", jax.jit(sm))
+            self._resolve_cache[width] = fn
+        return fn
+
+    def _get_fused(self, iters: int, n_pad: int):
+        """Descent fan-out + heap gather in ONE compiled program: the
+        engine's ``_get_search_fanout`` shape (search over the unique
+        set, packed in-step answer fan-out to client rows) with the
+        handle-resolve phase chained on the fanned-out handles — the
+        payload read costs one program dispatch total."""
+        fn = self._fused_cache.get((iters, n_pad))
+        if fn is None:
+            from sherman_tpu.models.batched import search_routed_spmd
+            spec = jax.sharding.PartitionSpec(AXIS)
+            rep = jax.sharding.PartitionSpec()
+            N = self.N
+            cfg = self.cfg
+            hcfg = self._hcfg(n_pad // N)
+
+            def kernel(pool, counters, heap, khi, klo, root, active,
+                       start, inv):
+                counters, done, found, vhi, vlo = search_routed_spmd(
+                    pool, counters, khi, klo, root, active, start,
+                    cfg=cfg, iters=iters)
+                ans = jnp.stack([found.astype(jnp.int32), vhi, vlo,
+                                 jnp.zeros_like(vhi)], axis=-1)
+                if N > 1:
+                    ans = transport.gather_rows(ans, AXIS)
+                safe = jnp.clip(inv, 0, ans.shape[0] - 1)
+                out = jnp.take_along_axis(ans, safe[:, None], axis=0)
+                found_c = out[:, 0].astype(bool)
+                vhi_c, vlo_c = out[:, 1], out[:, 2]
+                payload, nbytes, ver_ok = resolve_rows(
+                    heap, vhi_c, vlo_c, found_c, hcfg=hcfg)
+                return (counters, done, found_c, vhi_c, vlo_c,
+                        payload, nbytes, ver_ok)
+
+            sm = jax.shard_map(
+                kernel, mesh=self.dsm.mesh,
+                in_specs=(spec, spec, spec, spec, spec, rep, spec, spec,
+                          spec),
+                out_specs=(spec,) * 8, check_vma=False)
+            fn = DEV.wrap_program(
+                "heap.fanout_resolve",
+                jax.jit(sm, donate_argnums=C.donate_argnums(1)))
+            self._fused_cache[(iters, n_pad)] = fn
+        return fn
+
+    def resolve_u64(self, values, found):
+        """Device-resolve uint64 handle values -> (payload_words
+        [n, MAX_PAYLOAD_WORDS], nbytes [n], ver_ok [n]).  Width is
+        bucketed to a power-of-two node multiple so the serving loop's
+        compiled-shape set stays bounded (and sealable)."""
+        values = np.asarray(values, np.uint64)
+        found = np.asarray(found, bool)
+        n = values.size
+        if n == 0:
+            return (np.zeros((0, MAX_PAYLOAD_WORDS), np.int32),
+                    np.zeros(0, np.int32), np.zeros(0, bool))
+        q = 256 * self.N
+        width = q
+        while width < n:
+            width *= 2
+        vhi, vlo = bits.keys_to_pairs(values)
+        pv = np.zeros(width, np.int32)
+        pl = np.zeros(width, np.int32)
+        pa = np.zeros(width, bool)
+        pv[:n], pl[:n], pa[:n] = vhi, vlo, found
+        fn = self._get_resolve(width)
+        sh = self.eng._shard
+        with self.eng._step_mutex:
+            payload, nbytes, ver_ok = fn(self.dsm.heap, sh(pv), sh(pl),
+                                         sh(pa))
+        payload, nbytes, ver_ok = self.eng._unshard(payload, nbytes,
+                                                    ver_ok)
+        return (np.asarray(payload[:n]), np.asarray(nbytes[:n]),
+                np.asarray(ver_ok[:n]))
+
+    def resolve_host(self, values, found) -> tuple[list, np.ndarray]:
+        """HOST reference resolver (numpy over a materialized heap) —
+        the bit-identity oracle the device path is pinned against, and
+        the no-router fallback.  -> (payloads list[bytes|None],
+        ver_ok [n])."""
+        values = np.asarray(values, np.uint64)
+        found = np.asarray(found, bool)
+        heap = self.dsm.heap_snapshot()
+        rows, slabs, clss, vers = unpack_handles(values)
+        out: list = []
+        ver_ok = np.zeros(values.size, bool)
+        for i in range(values.size):
+            if not found[i]:
+                out.append(None)
+                continue
+            row, slab, cls = int(rows[i]), int(slabs[i]), int(clss[i])
+            if not (0 <= row < heap.shape[0]
+                    and cls < len(HEAP_CLASSES)
+                    and slab < _SLABS_PER_PAGE[cls]):
+                out.append(None)
+                continue
+            off = slab * HEAP_CLASSES[cls]
+            hdr = int(np.uint32(np.int64(heap[row, off]) & 0xFFFFFFFF))
+            if (hdr >> 16) != int(vers[i]) or int(vers[i]) == 0:
+                out.append(None)
+                continue
+            nbytes = hdr & 0xFFFF
+            nwords = (nbytes + 3) // 4
+            out.append(self._words_to_bytes(
+                heap[row, off + 1: off + 1 + nwords], nbytes))
+            ver_ok[i] = True
+        return out, ver_ok
+
+    def get(self, keys, *, _max_retries: int = 3):
+        """Read payloads for uint64 ``keys`` — descent + handle gather
+        in one fused device step (router attached), stale handles
+        revalidated through fresh descents.  -> (payloads
+        list[bytes|None], found [n])."""
+        keys = np.asarray(keys, np.uint64)
+        n = keys.size
+        self._note_get(n)
+        _OBS_GETS.inc(int(n))
+        if n == 0:
+            return [], np.zeros(0, bool)
+        vals, found, payload, nbytes, ver_ok = self._read_once(keys)
+        out: list = [None] * n
+        for i in np.nonzero(found & ver_ok)[0]:
+            out[i] = self._words_to_bytes(payload[i],
+                                          int(nbytes[i]))
+        bad = found & ~ver_ok
+        tries = 0
+        while bad.any():
+            if tries >= _max_retries:
+                raise HeapCorruptError(
+                    f"{int(bad.sum())} handle(s) failed slab version "
+                    f"validation after {tries} revalidation retries "
+                    "(torn or corrupt slab): refusing to serve a "
+                    "payload the version token cannot certify")
+            if tries:
+                # back off between retries: a legal read-during-
+                # overwrite race resolves as soon as the writer's
+                # install lands — burning all retries back-to-back
+                # inside its window would fail a healthy read
+                import time
+                time.sleep(0.0005 * tries)
+            tries += 1
+            self.stale_retries += int(bad.sum())
+            _OBS_STALE.inc(int(bad.sum()))
+            # revalidate-and-retry: a fresh descent re-reads the leaf
+            # (the handle may have moved under an overwrite/free)
+            vals2, found2 = self.eng.search(keys[bad])
+            pay2, nb2, ok2 = self.resolve_u64(vals2, found2)
+            idx = np.nonzero(bad)[0]
+            for k, i in enumerate(idx):
+                if not found2[k]:
+                    out[i] = None
+                    found[i] = False
+                    bad[i] = False
+                elif ok2[k]:
+                    out[i] = self._words_to_bytes(pay2[k], int(nb2[k]))
+                    bad[i] = False
+        return out, found
+
+    def _read_once(self, keys):
+        """One combined read: fused fan-out + gather when the router
+        is attached (cache-aware reads go through search_combined +
+        the standalone resolve program so cache hits still resolve
+        device-side)."""
+        eng = self.eng
+        uk, inv = np.unique(keys, return_inverse=True)
+        use_fused = (eng.router is not None and eng.leaf_cache is None
+                     and 0 < uk.size <= eng.B * self.N)
+        if not use_fused:
+            vals, found = eng.search_combined(keys)
+            payload, nbytes, ver_ok = self.resolve_u64(vals, found)
+            return vals, found, payload, nbytes, ver_ok
+        khi, klo = bits.keys_to_pairs(uk)
+        (khi, _), (klo, _) = eng._pad(khi), eng._pad(klo)
+        active, _ = eng._pad(np.ones(uk.size, bool))
+        n = keys.size
+        quantum = 8192 * self.N
+        n_pad = -(-n // quantum) * quantum
+        inv_p = np.zeros(n_pad, np.int32)
+        inv_p[:n] = inv.astype(np.int32)
+        fn = self._get_fused(eng._iters(), n_pad)
+        sh = eng._shard
+        with eng._step_mutex:
+            (eng.dsm.counters, done, found, vhi, vlo, payload, nbytes,
+             ver_ok) = fn(
+                eng.dsm.pool, eng.dsm.counters, self.dsm.heap,
+                sh(khi), sh(klo), np.int32(eng.tree._root_addr),
+                sh(active), sh(eng.router.host_start(khi, klo)),
+                sh(inv_p))
+        done, found, vhi, vlo, payload, nbytes, ver_ok = eng._unshard(
+            done, found, vhi, vlo, payload, nbytes, ver_ok)
+        if not bool(np.asarray(done[:uk.size]).all()):
+            # straggler rescue (stale router seeds / growth): the
+            # host-fanout path re-reads and re-resolves everything
+            vals, found = eng.search_combined(keys)
+            payload, nbytes, ver_ok = self.resolve_u64(vals, found)
+            return vals, found, payload, nbytes, ver_ok
+        vals = bits.pairs_to_keys(vhi[:n], vlo[:n])
+        return (vals, np.asarray(found[:n]), np.asarray(payload[:n]),
+                np.asarray(nbytes[:n]), np.asarray(ver_ok[:n]))
+
+    def scan(self, ranges):
+        """Range scans with payload resolution (the YCSB-E path): one
+        ``range_query_many`` leaf walk for every range, then ONE
+        device gather resolving every hit's handle.  -> list of
+        (keys uint64 [m], payloads list[bytes]) per range."""
+        res = self.eng.range_query_many(ranges)
+        all_vals = np.concatenate([v for _, v in res]) if res \
+            else np.zeros(0, np.uint64)
+        if all_vals.size == 0:
+            return [(k, []) for k, _ in res]
+        payload, nbytes, ver_ok = self.resolve_u64(
+            all_vals, np.ones(all_vals.size, bool))
+        out = []
+        off = 0
+        for keys, vals in res:
+            m = vals.size
+            pay = []
+            for i in range(m):
+                if ver_ok[off + i]:
+                    pay.append(self._words_to_bytes(payload[off + i],
+                                                    int(nbytes[off + i])))
+                else:
+                    # stale mid-scan handle: per-key revalidation
+                    p, f = self.get(keys[i:i + 1])
+                    pay.append(p[0] if f[0] else b"")
+            out.append((keys, pay))
+            off += m
+        return out
+
+    # -- scrub / rebuild -----------------------------------------------------
+
+    def live_handles(self) -> tuple[np.ndarray, np.ndarray]:
+        """(keys, handle values) of every live leaf entry — the
+        scrub's reference set (one full-range batched scan)."""
+        res = self.eng.range_query_many([(C.KEY_MIN, C.KEY_POS_INF)])
+        return res[0]
+
+    def scrub(self, repair: bool = True) -> dict:
+        """Audit the heap region against the live tree: ORPHAN handles
+        (live leaf handle whose slab header disagrees — damage, never
+        legal) are counted and returned; LEAKED slabs (allocated
+        content no handle references) are reclaimed onto the freelist
+        when ``repair``.  -> {orphans, leaked, checked}."""
+        keys, vals = self.live_handles()
+        rows, slabs, clss, vers = unpack_handles(vals)
+        heap = self.dsm.heap_snapshot()
+        referenced = set()
+        orphans = []
+        for i in range(vals.size):
+            row, slab, cls = int(rows[i]), int(slabs[i]), int(clss[i])
+            referenced.add((row, slab))
+            off = slab * HEAP_CLASSES[cls]
+            hdr = int(np.uint32(np.int64(heap[row, off]) & 0xFFFFFFFF))
+            if (hdr >> 16) != int(vers[i]):
+                orphans.append(int(keys[i]))
+        leaked = []
+        for row in np.nonzero(self._page_cls >= 0)[0]:
+            cls = int(self._page_cls[row])
+            for slab in range(_SLABS_PER_PAGE[cls]):
+                off = slab * HEAP_CLASSES[cls]
+                hdr = int(np.uint32(np.int64(heap[row, off])
+                                    & 0xFFFFFFFF))
+                if (hdr & 0xFFFF) and (int(row), slab) not in referenced:
+                    leaked.append((int(row), slab, cls, hdr >> 16))
+        if repair and leaked:
+            rows_w, woffs_w, vals_w = [], [], []
+            with self._lock:
+                for row, slab, cls, ver in leaked:
+                    nv = (ver + 1) if ((ver + 1) & _VER_MASK) else 1
+                    self._ver[row, slab] = nv
+                    self._free.setdefault((self.default_client, cls),
+                                          set()).add((row, slab))
+                    rows_w.append(row)
+                    woffs_w.append(slab * HEAP_CLASSES[cls])
+                    vals_w.append(_header_word(nv, 0))
+            self.dsm.heap_write_cells(rows_w, woffs_w, vals_w)
+            _OBS_LEAKS.inc(len(leaked))
+        if orphans:
+            _OBS_ORPHANS.inc(len(orphans))
+        return {"orphans": len(orphans), "orphan_keys": orphans[:16],
+                "leaked": len(leaked),
+                "checked": int(vals.size)}
+
+    def rebuild(self) -> dict:
+        """Reconstruct the allocator state from the heap region alone
+        (restore/recover path): page class tags -> carve map, slab
+        headers -> version mirror + freelists (``nbytes == 0`` slabs
+        are free), bump marks from the carved high-water per node —
+        with uncarved holes BELOW the high-water collected as spare
+        pages (an N->M reshard interleaves the old nodes' carved
+        segments, so the bump pointer alone would strand them)."""
+        heap = self.dsm.heap_snapshot()
+        with self._lock:
+            self._page_cls[:] = -1
+            self._ver[:] = 0
+            self._free.clear()
+            self._next_page[:] = 0
+            self._spare_pages = []
+            tags = heap[:, TAG_W].view(np.uint32)
+            carved = (tags & np.uint32(0xFFFF0000)) == np.uint32(TAG_MAGIC)
+            for row in np.nonzero(carved)[0]:
+                cls = int(tags[row] & 0xF)
+                if cls >= len(HEAP_CLASSES):
+                    continue
+                self._page_cls[row] = cls
+                node, page = row // self.Hpp, row % self.Hpp
+                self._next_page[node] = max(self._next_page[node],
+                                            page + 1)
+                for slab in range(_SLABS_PER_PAGE[cls]):
+                    off = slab * HEAP_CLASSES[cls]
+                    hdr = int(np.uint32(np.int64(heap[row, off])
+                                        & 0xFFFFFFFF))
+                    self._ver[row, slab] = (hdr >> 16) & _VER_MASK
+                    if (hdr & 0xFFFF) == 0:
+                        self._free.setdefault(
+                            (self.default_client, cls), set()).add(
+                            (int(row), slab))
+            # carvable holes below each node's bump mark
+            for node in range(self.N):
+                hw = int(self._next_page[node])
+                base = node * self.Hpp
+                seg = self._page_cls[base: base + hw]
+                self._spare_pages.extend(
+                    int(base + p) for p in np.nonzero(seg < 0)[0])
+            carved_n = int((self._page_cls >= 0).sum())
+            self.pages_carved = carved_n
+        return {"pages_carved": carved_n,
+                "free_slabs": int(sum(len(s)
+                                      for s in self._free.values()))}
+
+    def detach(self) -> None:
+        obs.get_registry().unregister_collector("heap")
+        self.eng.value_heap = None

@@ -520,6 +520,25 @@ class DSM(_HostOps):
         self.pool = _zeros((N * P, PAGE_WORDS), jnp.int32)
         self.locks = _zeros((N * L,), jnp.int32)
         self.counters = _zeros((N * N_COUNTERS,), jnp.uint32)
+        # Out-of-line VALUE HEAP — the second DSM region (see
+        # DSMConfig.heap_pages_per_node; models/value_heap.py owns the
+        # slab/handle protocol on top).  Sharded over nodes like the
+        # pool; None when disabled, so a heap-off build carries no
+        # extra device state and stays bit-identical to pre-heap
+        # builds.  Single-process only for now (like delta checkpoints
+        # and the recovery plane — the heap's allocator/journal
+        # integration assumes one driver).
+        self.heap = None
+        self._heap_dirty_host: set[int] = set()
+        self._heap_write = None
+        if cfg.heap_pages_per_node > 0:
+            if self.multihost:
+                raise MultiprocessUnsupportedError(
+                    "the value heap is single-process only (like delta "
+                    "checkpoints); unset heap_pages_per_node on "
+                    "multihost meshes")
+            self.heap = _zeros((N * cfg.heap_pages_per_node, PAGE_WORDS),
+                               jnp.int32)
         # Dirty-page tracking (the recovery plane's delta-checkpoint
         # feed, utils/checkpoint.checkpoint_delta): pages written since
         # the last checkpoint artifact.  Two tiers, united at save time:
@@ -599,6 +618,10 @@ class DSM(_HostOps):
         for _src in ("pool", "locks", "counters", "dirty"):
             acct.register(_src, (lambda r=ref, n=_src: (
                 getattr(r(), n).nbytes if r() is not None else 0)))
+        if self.heap is not None:
+            acct.register("heap", (lambda r=ref: (
+                r().heap.nbytes
+                if r() is not None and r().heap is not None else 0)))
 
     # -- raw step ------------------------------------------------------------
 
@@ -705,6 +728,84 @@ class DSM(_HostOps):
                            len(self._dirty_host))
         return np.union1d(dev, host)
 
+    # -- value-heap region (the second DSM region) ---------------------------
+    # Word-cell writes + page reads over ``self.heap``.  The slab/handle
+    # protocol (size classes, versions, freelists) lives in
+    # models/value_heap.py; these are the raw region ops, kept on the
+    # DSM so dirty tracking and checkpoints see ONE owner for both
+    # regions.  Single-process only (enforced at construction).
+
+    def _require_heap(self) -> None:
+        if self.heap is None:
+            raise ConfigError(
+                "no value heap configured: set "
+                "DSMConfig.heap_pages_per_node > 0 (SHERMAN_VALUE_HEAP)")
+
+    def heap_write_cells(self, rows, woffs, vals) -> None:
+        """Scatter int32 words into heap pages in ONE device step:
+        ``heap[rows[i], woffs[i]] = vals[i]``.  Row/word arrays are
+        padded to a power-of-two quantum so the compiled scatter count
+        stays bounded (pad cells target row H with ``mode="drop"``).
+        Marks the touched heap rows dirty (delta-checkpoint feed)."""
+        self._require_heap()
+        rows = np.asarray(rows, np.int64)
+        woffs = np.asarray(woffs, np.int32)
+        vals = np.asarray(vals, np.int32)
+        if rows.size == 0:
+            return
+        H = self.heap.shape[0]
+        n = max(256, 1 << int(np.ceil(np.log2(rows.size))))
+        pr = np.full(n, H, np.int32)   # out-of-range: dropped
+        pw = np.zeros(n, np.int32)
+        pv = np.zeros(n, np.int32)
+        pr[: rows.size] = rows.astype(np.int32)
+        pw[: rows.size] = woffs
+        pv[: rows.size] = vals
+        with self._step_mutex:
+            self.heap = self._heap_write_jit()(
+                self.heap, jnp.asarray(pr), jnp.asarray(pw),
+                jnp.asarray(pv))
+        self._heap_dirty_host.update(int(r) for r in np.unique(rows))
+
+    def _heap_write_jit(self):
+        if self._heap_write is None:
+            self._heap_write = jax.jit(
+                lambda h, r, w, v: h.at[r, w].set(v, mode="drop"),
+                donate_argnums=CFG.donate_argnums(0))
+        return self._heap_write
+
+    def heap_read_rows(self, rows) -> np.ndarray:
+        """Gather heap pages by global heap row (host convenience — the
+        reference resolver / scrub path; the hot read path gathers on
+        device inside the fused fan-out).  Takes the step mutex: the
+        heap handle is DONATED by heap_write_cells, so an unguarded
+        read racing a writer thread can hit a deleted buffer."""
+        self._require_heap()
+        rows = np.asarray(rows, np.int64)
+        if rows.size == 0:
+            return np.zeros((0, PAGE_WORDS), np.int32)
+        with self._step_mutex:
+            return np.asarray(self.heap[jnp.asarray(rows)])
+
+    def heap_snapshot(self) -> np.ndarray:
+        """Materialize the whole heap region (mutex-guarded handle
+        read — see :meth:`heap_read_rows`)."""
+        self._require_heap()
+        with self._step_mutex:
+            return np.asarray(self.heap)
+
+    def mark_heap_dirty_rows(self, rows) -> None:
+        """Explicitly mark global heap rows dirty (restore/replay paths
+        whose writes bypass heap_write_cells)."""
+        self._heap_dirty_host.update(int(r) for r in np.asarray(rows).ravel())
+
+    def heap_dirty_rows(self) -> np.ndarray:
+        """Sorted global heap rows written since the last clear."""
+        if not self._heap_dirty_host:
+            return np.zeros(0, np.int64)
+        return np.sort(np.fromiter(self._heap_dirty_host, np.int64,
+                                   len(self._heap_dirty_host)))
+
     def add_dirty_sink(self, fn) -> None:
         """Register a callable handed the dirty rows at every
         :meth:`clear_dirty` (BEFORE the reset) — the second-consumer
@@ -738,6 +839,7 @@ class DSM(_HostOps):
                 lambda idx: np.zeros(self.shard.shard_shape((N * P,)),
                                      bool))
         self._dirty_host.clear()
+        self._heap_dirty_host.clear()
 
     # -- host convenience ops (control plane / slow paths / tests) -----------
     # Each builds a small batch and steps once; requests are spread over

@@ -273,6 +273,12 @@ class RecoveryPlane:
         eng = BatchedEngine(tree, batch_per_node=batch_per_node, tcfg=tcfg)
         if attach_router:
             eng.attach_router()
+        # value heap: re-attach + rebuild the allocator from the
+        # restored region BEFORE replay (heap journal records rewrite
+        # slabs at their recorded addresses through the attached heap)
+        if cluster.cfg.heap_pages_per_node > 0:
+            from sherman_tpu.models.value_heap import ValueHeap
+            ValueHeap(eng).rebuild()
         replay_stats = {"records": 0, "rows": 0, "upserts": 0,
                         "deletes": 0, "segments": 0}
         # replay ALL live-chain segments ascending: in-order replay is
@@ -281,7 +287,7 @@ class RecoveryPlane:
         for seg in journals:
             st = J.replay(seg, eng)
             for k2, v in st.items():
-                replay_stats[k2] += v
+                replay_stats[k2] = replay_stats.get(k2, 0) + v
             replay_stats["segments"] += 1
         t_replay = time.perf_counter()
         plane = cls(cluster, tree, eng, directory,

@@ -204,6 +204,18 @@ class ServeConfig:
     #: "pipelined" keeps one read batch in flight (two-deep, default);
     #: "aligned" completes each batch before the next dispatch
     fusion: str = "pipelined"
+    #: second journaled lane (the write-path SLO story): run the write
+    #: flush — engine op + journal append + fsync/group-commit window —
+    #: on a DEDICATED thread so the read dispatcher never parks behind
+    #: a commit.  The journal's single-writer contract holds (all
+    #: writes still issue from ONE thread); device steps stay
+    #: serialized by the engine's step mutex.  OFF is the shipped
+    #: default (standing guardrail: measurement-driven flips) — the
+    #: Round-13 CPU A/B measured parity-to-worse on the shared-core
+    #: CPU mesh, where the engine-op wall (not the fsync) dominates
+    #: and a second Python thread pays the GIL tax; the chip capture
+    #: (real fsync stalls, free cores) is queued in BENCHMARKS.md.
+    write_lane: bool = False
     #: p99 model: est_p99(W) = model_mult x measured wall(W) (formation
     #: wait + service; the open-loop 1.5x-span model plus slack)
     model_mult: float = 2.0
@@ -230,11 +242,15 @@ class ServeConfig:
     def from_env(cls, **overrides) -> "ServeConfig":
         gc = os.environ.get("SHERMAN_SERVE_GROUP_COMMIT_MS")
         q = os.environ.get("SHERMAN_SERVE_QUEUE_OPS")
+        wl = os.environ.get("SHERMAN_SERVE_WRITE_LANE")
         kw: dict = {}
         if gc is not None:
             kw["group_commit_ms"] = float(gc)
         if q is not None:
             kw["max_queue_ops"] = int(q)
+        if wl is not None:
+            kw["write_lane"] = wl.strip().lower() not in (
+                "", "0", "false", "off", "no")
         kw.update(overrides)
         return cls(**kw)
 
@@ -281,13 +297,17 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("fut", "keys", "values", "ranges")
+    __slots__ = ("fut", "keys", "values", "ranges", "payloads",
+                 "resolve_payloads")
 
-    def __init__(self, fut, keys=None, values=None, ranges=None):
+    def __init__(self, fut, keys=None, values=None, ranges=None,
+                 payloads=None, resolve_payloads=False):
         self.fut = fut
         self.keys = keys
         self.values = values
         self.ranges = ranges
+        self.payloads = payloads
+        self.resolve_payloads = resolve_payloads
 
 
 # ---------------------------------------------------------------------------
@@ -464,7 +484,7 @@ class ShermanServer:
     """
 
     def __init__(self, eng, config: ServeConfig | None = None, *,
-                 journal=None):
+                 journal=None, value_heap=None):
         self.eng = eng
         self.cfg = config or ServeConfig.from_env()
         if eng.router is None:
@@ -473,6 +493,11 @@ class ShermanServer:
         if journal is not None:
             eng.attach_journal(journal)
         self.leaf_cache = eng.leaf_cache
+        # variable-size records (models/value_heap.py): payload-bearing
+        # inserts allocate slabs + install handles; reads submitted with
+        # resolve_payloads gather them behind the same ingress step
+        self.value_heap = value_heap if value_heap is not None \
+            else getattr(eng, "value_heap", None)
         # one ingress step per ladder rung — every compiled shape the
         # sealed loop can dispatch exists up front
         self._steps = {w: make_ingress_step(eng, width=w,
@@ -496,6 +521,7 @@ class ShermanServer:
         self._running = False
         self._draining = False
         self._thread: threading.Thread | None = None
+        self._wthread: threading.Thread | None = None
         self._sealed = False
         self._retrace0 = 0
         self._brownout = False
@@ -551,17 +577,34 @@ class ShermanServer:
         return st
 
     def submit(self, op: str, keys=None, values=None, *,
-               tenant: str = "default", ranges=None) -> ServeFuture:
+               tenant: str = "default", ranges=None, payloads=None,
+               resolve_payloads: bool = False) -> ServeFuture:
         """Admit one request (typed backpressure; see the module
         docstring).  ``keys`` uint64 for read/insert/delete (+
         ``values`` for insert); ``ranges`` [(lo, hi), ...] for scan.
         Returns a :class:`ServeFuture` whose ``result()`` is
         ``(values, found)`` for reads, an ok-per-key bool array for
         inserts, a found-per-key bool array for deletes, and
-        ``range_query_many``'s list for scans."""
+        ``range_query_many``'s list for scans.
+
+        Variable-size records (value heap attached): an insert with
+        ``payloads`` (list of bytes, one per key) allocates heap slabs
+        and installs handles; a read with ``resolve_payloads=True``
+        resolves its answers' handles behind the same ingress step and
+        its ``result()`` is ``(payloads list[bytes|None], found)``; a
+        scan with ``resolve_payloads=True`` returns
+        ``[(keys, payloads)]`` per range."""
         if op not in OP_CLASSES:
             raise ConfigError(f"submit op {op!r}: want one of "
                               f"{OP_CLASSES}")
+        if (payloads is not None or resolve_payloads) \
+                and self.value_heap is None:
+            raise ConfigError(
+                "variable-size records need a value heap "
+                "(ShermanServer(..., value_heap=) / "
+                "eng.attach_value_heap(); SHERMAN_VALUE_HEAP)")
+        if payloads is not None and op != "insert":
+            raise ConfigError("payloads only ride insert requests")
         if not self._running:
             raise StateError("server not running (call start())")
         if op == "scan":
@@ -590,9 +633,23 @@ class ShermanServer:
             if int(keys.min()) < C.KEY_MIN or int(keys.max()) > C.KEY_MAX:
                 raise KeyRangeError("keys outside [KEY_MIN, KEY_MAX]")
             if op == "insert":
-                values = np.ascontiguousarray(values, np.uint64)
-                if values.shape != keys.shape:
-                    raise ConfigError("insert needs one value per key")
+                if payloads is not None:
+                    if len(payloads) != n:
+                        raise ConfigError(
+                            "insert needs one payload per key")
+                    payloads = [bytes(b) for b in payloads]
+                    # size-class validation at the DOOR: an oversized
+                    # record must reject THIS request typed, not fail
+                    # every co-batched tenant's insert at flush time
+                    from sherman_tpu.models.value_heap import \
+                        class_for_bytes
+                    for b in payloads:
+                        class_for_bytes(len(b))  # raises ConfigError
+                else:
+                    values = np.ascontiguousarray(values, np.uint64)
+                    if values.shape != keys.shape:
+                        raise ConfigError(
+                            "insert needs one value per key")
         fut = ServeFuture(op, tenant, n)
         with self._lock:
             if not self._running:
@@ -637,7 +694,9 @@ class ShermanServer:
                     f"total {self._queued_ops}/"
                     f"{self.cfg.max_queue_ops} ops)")
             st.queues[op].append(
-                _Request(fut, keys=keys, values=values, ranges=ranges))
+                _Request(fut, keys=keys, values=values, ranges=ranges,
+                         payloads=payloads,
+                         resolve_payloads=resolve_payloads))
             self._note_admit(st, n)
             if op in WRITE_CLASSES:
                 self._queued_write_ops += n
@@ -694,6 +753,14 @@ class ShermanServer:
                                         name="sherman-serve",
                                         daemon=True)
         self._thread.start()
+        if self.cfg.write_lane:
+            # the second journaled lane: write flushes (engine op +
+            # journal fsync) run here so the read dispatcher never
+            # stalls behind a commit window (the YCSB-A read-p99 story)
+            self._wthread = threading.Thread(target=self._write_loop,
+                                             name="sherman-serve-write",
+                                             daemon=True)
+            self._wthread.start()
         return dict(self.calibration)
 
     def _calibrate(self, keys_pool, calib_writes, calib_delete_keys):
@@ -723,6 +790,41 @@ class ShermanServer:
             }
         # straggler rescue path (root descent at the engine width)
         self.eng.search(keys_pool[rng.integers(0, keys_pool.size, 64)])
+        # value-heap resolve programs: warm the width-bucket ladder the
+        # payload reads can dispatch (pow2 node multiples up to the
+        # widest rung) plus the put/free write paths, twice each for
+        # the threaded-carry variants — a payload read mid-window must
+        # not be the resolve program's first compile
+        if self.value_heap is not None:
+            vh = self.value_heap
+            wmax = self.cfg.widths[-1]
+            w = 256 * vh.N
+            probe = keys_pool[rng.integers(0, keys_pool.size, 8)]
+            pv, pf = self.eng.search(probe)
+            while True:
+                pad = np.zeros(w, np.uint64)
+                pad[: probe.size] = pv
+                fnd = np.zeros(w, bool)
+                fnd[: probe.size] = pf
+                vh.resolve_u64(pad[:w], fnd[:w])
+                vh.resolve_u64(pad[:w], fnd[:w])
+                if w >= wmax:
+                    break
+                w *= 2
+            wk = np.unique(keys_pool[rng.integers(0, keys_pool.size, 32)])
+            try:
+                # value-preserving warm: read the payloads back and
+                # re-put them (compiles the slab-scatter + insert
+                # shapes without changing a record)
+                pays, pfound = vh.get(wk)
+                keep = [p if p is not None else b"\x00" for p in pays]
+                vh.put(wk, keep)
+                vh.put(wk, keep)
+            except ShermanError as e:
+                # a tree whose values were never migrated to handles
+                # cannot warm the payload write path — serve it, but
+                # payload classes stay cold (first dispatch compiles)
+                FR.record_event("serve.heap_warm_skipped", error=repr(e))
         # scan path (range_query_many compiles its leaf-walk lazily;
         # twice for the threaded-carry variant, like the writes below)
         lo = int(keys_pool.min())
@@ -767,6 +869,8 @@ class ShermanServer:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self._wthread is not None:
+            self._wthread.join(timeout)
         if self._sealed:
             DEV.get_ledger().unseal()
             self._sealed = False
@@ -813,7 +917,11 @@ class ShermanServer:
                         continue
             try:
                 self._check_degraded_transition()
-                did = self._maybe_flush_writes()
+                # write flushes ride the dedicated lane when enabled —
+                # the dispatcher's read loop must never stall behind a
+                # journal fsync (the PR-13 REMAINING write-path story)
+                did = False if self.cfg.write_lane \
+                    else self._maybe_flush_writes()
                 did = self._maybe_flush_scans() or did
                 slot = self._dispatch_reads()
                 if slot is not None:
@@ -838,13 +946,42 @@ class ShermanServer:
                 FR.record_event("serve.dispatch_error", error=repr(e))
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     raise
-        # shutdown: drain the pipeline, then fail anything left
+        # shutdown: drain the pipeline, wait out the write lane (its
+        # own drain loop exits on the same flags), then fail the rest
         for slot in pend:
             try:
                 self._complete_read(slot)
             except BaseException:  # noqa: BLE001
                 pass
+        if self._wthread is not None and self._wthread.is_alive() \
+                and self._wthread is not threading.current_thread():
+            self._wthread.join(10.0)
         self._fail_queued(StateError("server stopped"))
+
+    def _write_loop(self) -> None:
+        """The second journaled lane: pops write requests and runs the
+        engine op + journal append/fsync off the read dispatcher's hot
+        loop.  Single-writer journal contract preserved — every write
+        still issues from THIS one thread."""
+        while True:
+            with self._lock:
+                if not self._running and (not self._draining
+                                          or self._queued_write_ops == 0):
+                    break
+                if self._queued_write_ops == 0:
+                    self._cv.wait(0.002)
+                    continue
+            try:
+                if not self._maybe_flush_writes():
+                    with self._lock:
+                        self._cv.wait(0.0005)
+            except BaseException as e:  # noqa: BLE001 — the lane must
+                # survive a bad batch like the dispatcher does
+                self.dispatch_errors += 1
+                FR.record_event("serve.dispatch_error", error=repr(e),
+                                lane="write")
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
 
     def _fail_queued(self, err: BaseException) -> None:
         with self._lock:
@@ -993,11 +1130,41 @@ class ShermanServer:
         self._last_complete_t = t1
         self.controller.update(width, svc * 1e3)
         SLO.observe("read", n, wall)
+        # variable-size records: one batched handle-resolve gather for
+        # every payload-requesting request in this step (stale handles
+        # fall back to the heap's revalidate-and-retry read per slice)
+        pay = nb = vok = None
+        if self.value_heap is not None \
+                and any(r.resolve_payloads for r in reqs):
+            try:
+                pay, nb, vok = self.value_heap.resolve_u64(vals, found)
+            except BaseException as e:  # noqa: BLE001 — every future in
+                # the slot must resolve; a hung client is worse than a
+                # failed batch
+                self._fail_batch(reqs, e)
+                return
         off = 0
         oldest = t1
         for req in reqs:
             m = req.fut.n_ops
-            req.fut._set((vals[off:off + m], found[off:off + m]))
+            try:
+                if req.resolve_payloads:
+                    req.fut._set(self._payload_result(
+                        req, vals, found, pay, nb, vok, off, m))
+                else:
+                    req.fut._set((vals[off:off + m],
+                                  found[off:off + m]))
+            except BaseException as e:  # noqa: BLE001 — a raising
+                # per-request payload resolve (HeapCorruptError on a
+                # torn slab) must fail THAT future typed, not leave it
+                # (and every later request in the batch) unset forever
+                self.dispatch_errors += 1
+                FR.record_event("serve.dispatch_error", error=repr(e))
+                req.fut._fail(e if isinstance(e, ShermanError)
+                              else StateError(
+                                  f"payload resolve failed: {e!r}"))
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
             # end-to-end (submit -> ack) latency — the SLO the target
             # governs, attributed per REQUEST (the client's unit of
             # experience) weighted by its ops
@@ -1024,6 +1191,30 @@ class ShermanServer:
                     w["p99_ms"],
                     queue_dominated=self._qwait_ratio > 1.0)
 
+    def _payload_result(self, req, vals, found, pay, nb, vok,
+                        off: int, m: int):
+        """Assemble one payload-read request's result slice from the
+        batch's resolve gather; stale handles revalidate through the
+        heap's bounded-retry read."""
+        vh = self.value_heap
+        sl_found = np.array(found[off:off + m])
+        out: list = [None] * m
+        stale = []
+        for j in range(m):
+            if not sl_found[j]:
+                continue
+            if vok[off + j]:
+                out[j] = vh._words_to_bytes(pay[off + j],
+                                            int(nb[off + j]))
+            else:
+                stale.append(j)
+        if stale:
+            p2, f2 = vh.get(req.keys[np.asarray(stale)])
+            for k, j in enumerate(stale):
+                out[j] = p2[k]
+                sl_found[j] = bool(f2[k])
+        return out, sl_found
+
     def _write_due(self) -> bool:
         with self._lock:
             if self._queued_write_ops >= self.cfg.write_width:
@@ -1049,8 +1240,32 @@ class ShermanServer:
         reqs = self._take(WRITE_CLASSES, self.cfg.write_width)
         if not reqs:
             return False
-        ins = [r for r in reqs if r.fut.op == "insert"]
+        hins = [r for r in reqs
+                if r.fut.op == "insert" and r.payloads is not None]
+        ins = [r for r in reqs
+               if r.fut.op == "insert" and r.payloads is None]
         dels = [r for r in reqs if r.fut.op == "delete"]
+        if hins:
+            # variable-size records: heap slab writes + handle installs
+            # (journaled pre-ack inside put(), same gate as insert)
+            keys = np.concatenate([r.keys for r in hins]) \
+                if len(hins) > 1 else hins[0].keys
+            payloads = [b for r in hins for b in r.payloads]
+            try:
+                hst = self.value_heap.put(keys, payloads)
+                t1 = time.perf_counter()
+                hto = np.asarray(hst["lock_timeout_keys"], np.uint64) \
+                    if hst["lock_timeouts"] else None
+                for r in hins:
+                    r.fut._set(np.ones(r.fut.n_ops, bool) if hto is None
+                               else ~np.isin(r.keys, hto))
+                    self.tracker.observe("insert", r.fut.n_ops,
+                                         t1 - r.fut.t_submit)
+                    self._note_served(self._tenants[r.fut.tenant],
+                                      r.fut.n_ops)
+                    self.acked_writes += 1
+            except BaseException as e:  # noqa: BLE001
+                self._fail_batch(hins, e)
         if ins:
             keys = np.concatenate([r.keys for r in ins]) \
                 if len(ins) > 1 else ins[0].keys
@@ -1083,7 +1298,11 @@ class ShermanServer:
             keys = np.concatenate([r.keys for r in dels]) \
                 if len(dels) > 1 else dels[0].keys
             try:
-                found = self.eng.delete(keys)
+                # a heap-backed tree frees slabs with the delete (the
+                # reclaim path), else the plain engine delete
+                found = self.value_heap.remove(keys) \
+                    if self.value_heap is not None \
+                    else self.eng.delete(keys)
                 t1 = time.perf_counter()
                 off = 0
                 for r in dels:
@@ -1102,7 +1321,10 @@ class ShermanServer:
         reqs = self._take(("scan",), self.cfg.widths[-1])
         for r in reqs:
             try:
-                res = self.eng.range_query_many(r.ranges)
+                res = self.value_heap.scan(r.ranges) \
+                    if (r.resolve_payloads
+                        and self.value_heap is not None) \
+                    else self.eng.range_query_many(r.ranges)
                 r.fut._set(res)
                 self.tracker.observe(
                     "scan", r.fut.n_ops,
@@ -1175,7 +1397,10 @@ class ShermanServer:
             js["acks_per_fsync"] = (self.acked_writes / js["fsyncs"]
                                     if js["fsyncs"] else None)
             out["journal"] = js
+        out["write_lane"] = self.cfg.write_lane
         if self.leaf_cache is not None:
             out["cache"] = {**self.leaf_cache.stats(),
                             "sketch": self.leaf_cache.sketch_stats()}
+        if self.value_heap is not None:
+            out["value_heap"] = self.value_heap.stats()
         return out

@@ -39,7 +39,7 @@ from sherman_tpu.errors import (CheckpointFormatError, ConfigError,
 
 _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
                "step_capacity", "host_step_capacity", "chunk_pages",
-               "exchange_impl", "gather_impl")
+               "exchange_impl", "gather_impl", "heap_pages_per_node")
 
 # fsync indirection for tests (patching os.fsync itself would also
 # intercept interpreter/numpy internals)
@@ -145,6 +145,9 @@ def checkpoint(cluster, path: str):
         epoch=epoch,
         **man,
     )
+    # value-heap region (optional — heap-off checkpoints are unchanged)
+    if dsm.heap is not None:
+        arrays["heap"] = dsm.heap_snapshot()
     arrays["integrity"] = _integrity(arrays)
     _savez_atomic(path, 0, **arrays)
     _OBS_FULL_SAVES.inc()
@@ -378,6 +381,13 @@ def restore(path: str, mesh=None, keeper=None, clear_locks: bool = True):
         locks = np.zeros_like(locks)
     dsm.locks = jax.device_put(locks, dsm.shard)
     dsm.counters = jax.device_put(z["counters"], dsm.shard)
+    if dsm.heap is not None:
+        if "heap" not in z:
+            raise CheckpointFormatError(
+                f"{path}: cfg configures a value heap "
+                f"({cfg.heap_pages_per_node} pages/node) but the "
+                "artifact carries no heap array")
+        dsm.heap = jax.device_put(z["heap"], dsm.shard)
     _restore_directories(cluster, z)
     # flight event: a restore is the recovery step every drill's black
     # box must show after the degraded transition
@@ -546,6 +556,14 @@ def checkpoint_delta(cluster, path: str, parent_epoch) -> dict:
         counters=np.asarray(dsm.counters),
         **man,
     )
+    # value-heap dirty rows ride the same link (optional arrays —
+    # heap-off deltas are byte-compatible with pre-heap builds)
+    if dsm.heap is not None:
+        hrows = dsm.heap_dirty_rows()
+        arrays["heap_rows"] = hrows.astype(np.int64)
+        arrays["heap_pages"] = (
+            np.asarray(dsm.heap[jnp.asarray(hrows)]) if hrows.size
+            else np.zeros((0, _C.PAGE_WORDS), np.int32))
     arrays["integrity"] = _integrity(arrays)
     _savez_atomic(path, 0, **arrays)
     dsm.clear_dirty()
@@ -619,6 +637,19 @@ def restore_chain(base_path: str, delta_paths, mesh=None,
             dsm.pool = jax.device_put(
                 dsm.pool.at[jnp.asarray(rows)].set(
                     jnp.asarray(z["delta_pages"])), dsm.shard)
+        if dsm.heap is not None and "heap_rows" in z:
+            hrows = np.asarray(z["heap_rows"], np.int64)
+            if hrows.size:
+                hpages = np.asarray(z["heap_pages"])
+                if hpages.shape != (hrows.size, _C.PAGE_WORDS) \
+                        or hrows.min() < 0 \
+                        or hrows.max() >= dsm.heap.shape[0]:
+                    raise CheckpointCorruptError(
+                        f"{path}: heap delta rows/pages shape mismatch "
+                        "or rows outside the heap region")
+                dsm.heap = jax.device_put(
+                    dsm.heap.at[jnp.asarray(hrows)].set(
+                        jnp.asarray(hpages)), dsm.shard)
         locks = np.asarray(z["locks"])
         if clear_locks:
             locks = np.zeros_like(locks)

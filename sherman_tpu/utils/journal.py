@@ -61,9 +61,13 @@ MAGIC = b"SHJRNL01"
 _HDR = struct.Struct("<II")          # length, crc32(payload)
 _PAY = struct.Struct("<BxxxI")       # kind, nrows
 
-J_UPSERT = 1   # keys + values (engine insert / mixed write rows)
-J_DELETE = 2   # keys only
-KINDS = (J_UPSERT, J_DELETE)
+J_UPSERT = 1     # keys + values (engine insert / mixed write rows)
+J_DELETE = 2     # keys only
+J_HEAP_PUT = 3   # value-heap slab writes: keys + handles + payload blob
+J_HEAP_FREE = 4  # value-heap slab frees: keys + handles
+KINDS = (J_UPSERT, J_DELETE, J_HEAP_PUT, J_HEAP_FREE)
+# kinds whose payload is keys + one u64 value lane (shared layout)
+_TWO_LANE = (J_UPSERT, J_HEAP_FREE)
 
 # One frame is one engine-op batch; anything claiming more than this is
 # a corrupt length word, not a real record (the engine chunks batches
@@ -101,11 +105,14 @@ class JournalSyncError(ShermanError, RuntimeError):
 
 def encode_record(kind: int, keys, values=None) -> bytes:
     """One framed record (header + payload) for ``append``/tests."""
-    if kind not in KINDS:
-        raise ConfigError(f"unknown journal record kind {kind}")
+    if kind not in KINDS or kind == J_HEAP_PUT:
+        raise ConfigError(f"unknown journal record kind {kind}"
+                          if kind != J_HEAP_PUT else
+                          "J_HEAP_PUT records carry payload bytes: "
+                          "use encode_heap_record")
     keys = np.ascontiguousarray(keys, np.uint64)
     payload = _PAY.pack(kind, keys.size) + keys.tobytes()
-    if kind == J_UPSERT:
+    if kind in _TWO_LANE:
         values = np.ascontiguousarray(values, np.uint64)
         if values.shape != keys.shape:
             raise ConfigError("journal upsert needs one value per key")
@@ -113,18 +120,60 @@ def encode_record(kind: int, keys, values=None) -> bytes:
     return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
 
 
+def encode_heap_record(kind: int, keys, handles, payloads) -> bytes:
+    """Value-heap put record: keys + handles + per-key byte lengths +
+    concatenated payload blob (``payloads``: list of bytes).  The
+    handle encodes the slab address, so replay rewrites every payload
+    AT its recorded slab — bit-identical heap content after
+    restore+replay."""
+    if kind != J_HEAP_PUT:
+        raise ConfigError(f"encode_heap_record wants J_HEAP_PUT, "
+                          f"got {kind}")
+    keys = np.ascontiguousarray(keys, np.uint64)
+    handles = np.ascontiguousarray(handles, np.uint64)
+    if handles.shape != keys.shape or len(payloads) != keys.size:
+        raise ConfigError("heap record needs one handle+payload per key")
+    lens = np.asarray([len(b) for b in payloads], np.uint32)
+    blob = b"".join(bytes(b) for b in payloads)
+    payload = (_PAY.pack(kind, keys.size) + keys.tobytes()
+               + handles.tobytes() + lens.tobytes() + blob)
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
 def _decode_payload(payload: bytes, off: int):
-    """payload bytes -> (kind, keys, values|None); raises on bad shape."""
+    """payload bytes -> (kind, keys, aux); raises on bad shape.  ``aux``
+    is the value lane (u64, or None for J_DELETE), except J_HEAP_PUT
+    where it is ``(handles u64[n], payloads list[bytes])``."""
     kind, n = _PAY.unpack_from(payload)
     body = payload[_PAY.size:]
-    want = n * 8 * (2 if kind == J_UPSERT else 1)
+    if kind == J_HEAP_PUT:
+        fixed = n * 8 * 2 + n * 4
+        if len(body) < fixed:
+            raise JournalCorruptError(
+                f"journal record at byte {off}: heap-put nrows={n} "
+                f"does not fit its {len(body)}-byte body")
+        keys = np.frombuffer(body[: n * 8], np.uint64).copy()
+        handles = np.frombuffer(body[n * 8: n * 16], np.uint64).copy()
+        lens = np.frombuffer(body[n * 16: fixed], np.uint32)
+        blob = body[fixed:]
+        if int(lens.sum()) != len(blob):
+            raise JournalCorruptError(
+                f"journal record at byte {off}: heap-put blob length "
+                f"{len(blob)} does not match its length table")
+        payloads = []
+        pos = 0
+        for ln in lens.tolist():
+            payloads.append(blob[pos: pos + ln])
+            pos += ln
+        return kind, keys, (handles, payloads)
+    want = n * 8 * (2 if kind in _TWO_LANE else 1)
     if kind not in KINDS or len(body) != want:
         raise JournalCorruptError(
             f"journal record at byte {off}: kind={kind} nrows={n} does "
             f"not match its {len(body)}-byte body")
     keys = np.frombuffer(body[: n * 8], np.uint64).copy()
     vals = (np.frombuffer(body[n * 8:], np.uint64).copy()
-            if kind == J_UPSERT else None)
+            if kind in _TWO_LANE else None)
     return kind, keys, vals
 
 
@@ -234,6 +283,19 @@ class Journal:
         if keys.size == 0:
             return 0  # nothing applied: no record
         rec = encode_record(kind, keys, values)
+        return self._append_rec(rec, int(keys.size))
+
+    def append_heap(self, kind: int, keys, handles, payloads) -> int:
+        """Append one value-heap batch record (keys + handles + payload
+        bytes; see :func:`encode_heap_record`) under the same
+        durability/group-commit contract as :meth:`append`."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        if keys.size == 0:
+            return 0
+        rec = encode_heap_record(kind, keys, handles, payloads)
+        return self._append_rec(rec, int(keys.size))
+
+    def _append_rec(self, rec: bytes, nrows: int) -> int:
         with self._entrants_lock:
             self._entrants += 1
         try:
@@ -253,7 +315,7 @@ class Journal:
                 # the sequence they describe (concurrent group-commit
                 # appenders would otherwise lose increments)
                 self.appends += 1
-                self.rows += int(keys.size)
+                self.rows += nrows
                 if self.sync and self.group_commit_ms <= 0:
                     try:
                         _fsync(self._f.fileno())
@@ -271,7 +333,7 @@ class Journal:
             with self._entrants_lock:
                 self._entrants -= 1
         _OBS_APPENDS.inc()
-        _OBS_ROWS.inc(int(keys.size))
+        _OBS_ROWS.inc(nrows)
         _OBS_BYTES.inc(len(rec))
         return len(rec)
 
@@ -438,9 +500,27 @@ def replay(path: str, eng) -> dict:
     record order.  The engine's own journaling must be detached by the
     caller (RecoveryPlane does) so replay does not re-journal itself.
     Returns {"records", "rows", "upserts", "deletes"}."""
-    stats = {"records": 0, "rows": 0, "upserts": 0, "deletes": 0}
+    stats = {"records": 0, "rows": 0, "upserts": 0, "deletes": 0,
+             "heap_puts": 0, "heap_frees": 0}
     for kind, keys, vals in read_records(path, truncate_torn=True):
-        if kind == J_UPSERT:
+        if kind in (J_HEAP_PUT, J_HEAP_FREE):
+            # value-heap records (models/value_heap.py): slab rewrites
+            # at their RECORDED addresses — the engine must carry an
+            # attached heap, or replay cannot honor the record
+            heap = getattr(eng, "value_heap", None)
+            if heap is None:
+                raise StateError(
+                    "journal carries value-heap records but the engine "
+                    "has no attached ValueHeap (attach_value_heap "
+                    "before replay)")
+            if kind == J_HEAP_PUT:
+                handles, payloads = vals
+                heap.replay_put(keys, handles, payloads)
+                stats["heap_puts"] += 1
+            else:
+                heap.replay_free(keys, vals)
+                stats["heap_frees"] += 1
+        elif kind == J_UPSERT:
             eng.insert(keys, vals)
             stats["upserts"] += 1
         else:

@@ -147,7 +147,8 @@ def live_rows(front_ver: np.ndarray, next_by_node: np.ndarray,
 def reshard_arrays(man: dict, pool: np.ndarray, locks: np.ndarray,
                    counters: np.ndarray, machine_nr: int, *,
                    pages_per_node: int | None = None,
-                   locks_per_node: int | None = None):
+                   locks_per_node: int | None = None,
+                   heap: np.ndarray | None = None):
     """The pure array-level address-space rewrite: (manifest, state
     arrays) of an N-node pool -> (arrays, new_cfg, summary) for an
     M-node pool.  No file I/O — :func:`reshard` wraps it for the
@@ -181,9 +182,21 @@ def reshard_arrays(man: dict, pool: np.ndarray, locks: np.ndarray,
     per_new = -(-L // machine_nr) if L else 0
     if pages_per_node is None:
         pages_per_node = max((N_old * P_old) // machine_nr, per_new + 1)
+    # value-heap geometry: handles address the heap by GLOBAL row, so
+    # the transform never rewrites them — the flat region just re-splits
+    # over the new node count (padded up so every old row keeps its
+    # index; the tail pages are uncarved spare capacity)
+    H_old = old_cfg.heap_pages_per_node
+    if (heap is None) != (H_old == 0):
+        raise ReshardError(
+            "heap array and manifest heap_pages_per_node disagree "
+            f"(heap {'present' if heap is not None else 'absent'}, "
+            f"cfg says {H_old} pages/node)")
+    heap_per_new = -(-(N_old * H_old) // machine_nr) if H_old else 0
     new_cfg = DSMConfig(**{**cfg_dict,
                            "machine_nr": machine_nr,
                            "pages_per_node": pages_per_node,
+                           "heap_pages_per_node": heap_per_new,
                            **({"locks_per_node": locks_per_node}
                               if locks_per_node else {})})
     if per_new + 1 > pages_per_node:
@@ -246,6 +259,15 @@ def reshard_arrays(man: dict, pool: np.ndarray, locks: np.ndarray,
     assert set(new_man) == set(_MANIFEST_FIELDS)
     arrays = dict(pool=new_pool, locks=new_locks, counters=new_counters,
                   **new_man)
+    if heap is not None:
+        if heap.shape != (N_old * H_old, C.PAGE_WORDS):
+            raise ReshardError(
+                f"heap shape {heap.shape} does not match the manifest "
+                f"config ({N_old}x{H_old} heap pages)")
+        new_heap = np.zeros((machine_nr * heap_per_new, C.PAGE_WORDS),
+                            np.int32)
+        new_heap[: heap.shape[0]] = heap  # global rows preserved
+        arrays["heap"] = new_heap
     summary = {
         "live_pages": int(L),
         "old": {"machine_nr": N_old, "pages_per_node": P_old},
@@ -268,9 +290,14 @@ def write_resharded(dst: str, arrays: dict, new_cfg, hosts: int = 1) -> str:
     if not dst.endswith(".npz"):
         dst += ".npz"
     if hosts == 1:
+        extra = ({"heap": arrays["heap"]} if "heap" in arrays else {})
         _savez_atomic(dst, 0, pool=arrays["pool"], locks=arrays["locks"],
-                      counters=arrays["counters"], **new_man)
+                      counters=arrays["counters"], **extra, **new_man)
         return dst
+    if "heap" in arrays:
+        raise ConfigError(
+            "the value heap is single-process only: emit hosts=1 "
+            "checkpoints for heap-bearing clusters")
     if machine_nr % hosts:
         raise ConfigError(f"hosts={hosts} must divide machine_nr="
                           f"{machine_nr} (contiguous node blocks)")
@@ -305,9 +332,11 @@ def reshard(src: str, dst: str, machine_nr: int, *,
     one process per host).  The source may be either format.
     """
     man, pool, locks, counters = _load_checkpoint(src)
+    heap = man.pop("heap", None)
     arrays, new_cfg, summary = reshard_arrays(
         man, pool, locks, counters, machine_nr,
-        pages_per_node=pages_per_node, locks_per_node=locks_per_node)
+        pages_per_node=pages_per_node, locks_per_node=locks_per_node,
+        heap=heap)
     write_resharded(dst, arrays, new_cfg, hosts=hosts)
     summary["new"]["hosts"] = hosts
     return summary

@@ -480,3 +480,80 @@ def test_fuzz_delta_artifact_corruption(eight_devices, tmp_path):
                                       want_pool)
     open(d1, "wb").write(clean)
     assert rejected >= 1, "no flip was ever detected — CRCs inert?"
+
+
+def test_fuzz_value_heap_faults(eight_devices):
+    """Value-heap fault storm (models/value_heap.py): random rounds of
+    stale handles (overwrites racing cached handle copies), torn slab
+    headers (version/length flips), and double frees.  Contract: every
+    read returns either the CORRECT current payload or a typed
+    rejection (HeapCorruptError), frees of superseded handles raise the
+    typed DoubleFreeError — never a silent wrong payload."""
+    from sherman_tpu.errors import DoubleFreeError
+    from sherman_tpu.models import value_heap as VH
+    from sherman_tpu.workload.ycsb import payload_for_key
+
+    rng = np.random.default_rng(91)
+    cfg = DSMConfig(machine_nr=2, pages_per_node=1024,
+                    locks_per_node=512, step_capacity=512,
+                    chunk_pages=32, heap_pages_per_node=256)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=256)
+    keys = np.unique(rng.integers(1, 1 << 56, 500,
+                                  dtype=np.uint64))[:400]
+    batched.bulk_load(tree, keys, keys)
+    eng.attach_router()
+    vh = eng.attach_value_heap()
+    model = {int(k): payload_for_key(int(k), 120, "uniform")
+             for k in keys}
+    vh.put(keys, [model[int(k)] for k in keys])
+
+    tornado = []  # (row, off, clean_word) to repair between rounds
+    for rnd in range(10):
+        # 1) stale handles: overwrite a random slice, keeping the model
+        idx = rng.integers(0, keys.size, 24)
+        nk = keys[np.unique(idx)]
+        np_pay = [payload_for_key(int(k) ^ rnd ^ 1, 120, "uniform")
+                  for k in nk]
+        vh.put(nk, np_pay)
+        for k, p in zip(nk, np_pay):
+            model[int(k)] = p
+        # 2) torn slab header on a random live key (off-model damage)
+        vic = keys[int(rng.integers(0, keys.size))]
+        hv, hf = eng.search(np.asarray([vic], np.uint64))
+        row, slab, cls, ver = (int(x[0]) for x in
+                               VH.unpack_handles(hv))
+        off = slab * VH.HEAP_CLASSES[cls]
+        clean = int(vh.dsm.heap_read_rows([row])[0, off])
+        torn = int(np.uint32((((ver + 9) & 0xFFFF) << 16) | 2
+                             ).view(np.int32))
+        vh.dsm.heap_write_cells([row], [off], [torn])
+        tornado.append((int(vic), row, off, clean))
+        # 3) reads: every answer correct or typed — never silently wrong
+        probe = keys[rng.integers(0, keys.size, 64)]
+        try:
+            got, found = vh.get(probe)
+            assert found.all()
+            for i, k in enumerate(probe):
+                if int(k) == int(vic):
+                    continue  # damaged key may legally have raised
+                assert got[i] == model[int(k)], hex(int(k))
+        except VH.HeapCorruptError:
+            pass  # typed rejection of the torn slab: the legal outcome
+        # the damaged key alone: MUST fail typed (its slab is torn)
+        with pytest.raises(VH.HeapCorruptError):
+            vh.get(np.asarray([vic], np.uint64))
+        # 4) double free: a re-freed handle fails typed
+        dk = keys[int(rng.integers(0, keys.size))]
+        dv, df = eng.search(np.asarray([dk], np.uint64))
+        if df[0] and int(dk) != int(vic):
+            vh.free_handles(np.asarray([dk], np.uint64), dv)
+            with pytest.raises(DoubleFreeError):
+                vh.free_handles(np.asarray([dk], np.uint64), dv)
+            # restore the record so the model stays authoritative
+            vh.put(np.asarray([dk], np.uint64), [model[int(dk)]])
+        # repair the torn header so later rounds start clean
+        vh.dsm.heap_write_cells([row], [off], [clean])
+        got2, f2 = vh.get(np.asarray([vic], np.uint64))
+        assert f2[0] and got2[0] == model[int(vic)]

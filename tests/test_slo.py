@@ -479,6 +479,41 @@ def test_perfgate_incomparable_receipt_exits_2(tmp_path):
     assert pg.main(["--receipt", p]) == 2
 
 
+def test_perfgate_value_config_change_is_incomparable(tmp_path,
+                                                      capsys):
+    """Value-config comparability rule (PR 14): a receipt whose
+    config.value_bytes/value_dist/value_heap differ from a round's
+    never gates against it in EITHER direction — a heap-on capture
+    with halved throughput SKIPS, and an inline capture keeps gating
+    against the inline trajectory (missing fields = the pre-heap
+    8-byte fixed inline fact)."""
+    pg = _perfgate()
+    cand = pg.load_receipt(os.path.join(_repo_root(), "BENCH_r05.json"))
+    cand.pop("_round", None)
+    cand.setdefault("config", {})
+    cand["config"].update({"value_bytes": 252, "value_dist": "fixed",
+                           "value_heap": True})
+    for k in ("value", "sustained_ops_s", "sus_mixed_ops_s"):
+        cand[k] = round(cand[k] * 0.5)
+    p = str(tmp_path / "heapcfg.json")
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 2  # nothing comparable at all
+    # direction 2: the same halved numbers back at the inline config
+    # gate red against the committed inline trajectory
+    cand["config"].update({"value_bytes": 8, "value_heap": False})
+    json.dump(cand, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 1
+    # explicit inline fields match the field-less history exactly
+    cand2 = pg.load_receipt(os.path.join(_repo_root(),
+                                         "BENCH_r05.json"))
+    cand2.pop("_round", None)
+    cand2.setdefault("config", {})
+    cand2["config"].update({"value_bytes": 8, "value_dist": "fixed",
+                            "value_heap": False})
+    json.dump(cand2, open(p, "w"))
+    assert pg.main(["--receipt", p]) == 0
+
+
 def test_perfgate_node_count_change_is_incomparable(tmp_path, capsys):
     """Elastic-reshard comparability rule: a receipt captured at a
     different node count never gates against the fixed-shape

@@ -364,3 +364,37 @@ def test_device_report_driver(eight_devices, capsys, monkeypatch,
     r3 = device_report.main(["--receipt", str(p2)])
     capsys.readouterr()
     assert "no device section" in r3["error"]
+
+
+def test_ycsb_bench_driver(eight_devices, capsys):
+    """bench.py --ycsb smoke: the A-F matrix runs inline AND heap-on
+    (value heap via SHERMAN_VALUE_HEAP), with the YCSB-C loop sealed
+    zero-retrace and the heap audit green."""
+    import json
+
+    import ycsb_bench
+    r = ycsb_bench.main(["--keys", "6000", "--ops", "1024",
+                         "--steps", "2", "--workloads", "A,C,E"])
+    capsys.readouterr()
+    assert set(r["workloads"]) == {"A", "C", "E"}
+    assert all(row["ops_s"] > 0 for row in r["workloads"].values())
+    assert r["workloads"]["C"]["sealed"] is True
+    assert r["workloads"]["C"]["retraces"] == 0
+    assert r["config"]["value_heap"] is False
+    assert r["workloads"]["E"]["counts"]["scan_rows"] > 0
+
+    os.environ["SHERMAN_VALUE_HEAP"] = "4096"
+    try:
+        r2 = ycsb_bench.main(["--keys", "6000", "--ops", "1024",
+                              "--steps", "2", "--workloads", "C,E",
+                              "--value-bytes", "100"])
+    finally:
+        del os.environ["SHERMAN_VALUE_HEAP"]
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    j = json.loads(out)
+    assert j["config"]["value_heap"] is True
+    assert j["config"]["value_bytes"] == 100
+    assert r2["audit_ok"] is True
+    assert r2["workloads"]["C"]["retraces"] == 0
+    assert r2["heap_phase_ms"]["heap_gather_ms"] >= 0
+    assert r2["heap"]["puts"] >= 6000

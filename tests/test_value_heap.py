@@ -1,0 +1,609 @@
+"""Value heap (models/value_heap.py) fast tier: handle protocol,
+fused-fan-out payload reads pinned bit-identical to the host reference
+resolver, allocator reuse/free/double-free semantics, stale-handle
+revalidation, torn-slab typed rejection, and the heap's citizenship in
+every plane — checkpoint/restore + delta chains, journal replay (RPO
+0), reshard round trips, online migration cutover, scrub, the leaf
+cache, and the serving front door's variable-size record classes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sherman_tpu import obs
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig, TreeConfig
+from sherman_tpu.errors import ConfigError, DoubleFreeError
+from sherman_tpu.models import batched
+from sherman_tpu.models import value_heap as VH
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.ops import bits
+from sherman_tpu.utils import checkpoint as CK
+from sherman_tpu.utils import journal as J
+from sherman_tpu.utils import reshard as RS
+
+SALT = 0x5E17_AB1E_5A17
+N_KEYS = 800
+
+
+def make(nr=1, pages=1024, heap_pages=256, cap=512, B=256):
+    cfg = DSMConfig(machine_nr=nr, pages_per_node=pages,
+                    locks_per_node=512, step_capacity=cap,
+                    chunk_pages=32, heap_pages_per_node=heap_pages)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B)
+    return cluster, tree, eng
+
+
+def keyspace(n=N_KEYS):
+    keys = np.unique(bits.mix64_np(
+        np.arange(n, dtype=np.uint64) ^ np.uint64(SALT)))
+    return keys
+
+
+def payloads_for(keys, rng=None, lo=1, hi=250):
+    rng = rng or np.random.default_rng(int(keys[0]) & 0xFFFF)
+    lens = rng.integers(lo, hi, keys.size)
+    return [bytes(rng.integers(0, 256, int(ln), dtype=np.uint8))
+            for ln in lens]
+
+
+def loaded(nr=1, heap_pages=256, n=N_KEYS, router=True):
+    cluster, tree, eng = make(nr=nr, heap_pages=heap_pages)
+    keys = keyspace(n)
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0xD00D))
+    if router:
+        eng.attach_router()
+    vh = eng.attach_value_heap()
+    pay = payloads_for(keys)
+    vh.put(keys, pay)
+    return cluster, tree, eng, vh, keys, pay
+
+
+@pytest.fixture(scope="module")
+def heap_rig():
+    """Shared loaded single-node rig (tests that MUTATE topology or
+    corrupt state build their own)."""
+    return loaded()
+
+
+# -- handle protocol ---------------------------------------------------------
+
+def test_handle_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 1 << 30, 64)
+    slabs = rng.integers(0, 31, 64)
+    clss = rng.integers(0, 4, 64)
+    vers = rng.integers(1, 0xFFFF, 64)
+    h = VH.pack_handles(rows, slabs, clss, vers)
+    r2, s2, c2, v2 = VH.unpack_handles(h)
+    assert (r2 == rows).all() and (s2 == slabs).all()
+    assert (c2 == clss).all() and (v2 == vers).all()
+
+
+def test_class_for_bytes_caps():
+    assert VH.class_for_bytes(1) == 0
+    assert VH.class_for_bytes(28) == 0
+    assert VH.class_for_bytes(29) == 1
+    assert VH.class_for_bytes(252) == len(VH.HEAP_CLASSES) - 1
+    with pytest.raises(ConfigError):
+        VH.class_for_bytes(253)
+
+
+def test_heap_off_is_absent():
+    cluster, tree, eng = make(heap_pages=0)
+    assert cluster.dsm.heap is None
+    with pytest.raises(ConfigError):
+        eng.attach_value_heap()
+    # heap-off checkpoints carry no heap array
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        CK.checkpoint(cluster, os.path.join(d, "c.npz"))
+        with np.load(os.path.join(d, "c.npz")) as z:
+            assert "heap" not in z.files
+
+
+# -- reads: fused gather pinned against the host reference resolver ----------
+
+def test_get_bit_identical_to_host_resolver(heap_rig):
+    _, _, eng, vh, keys, pay = heap_rig
+    got, found = vh.get(keys)
+    assert found.all()
+    vals, f2 = eng.search(keys)
+    ref, ok = vh.resolve_host(vals, f2)
+    assert ok.all()
+    for i in range(keys.size):
+        assert got[i] == ref[i] == pay[i]
+
+
+def test_get_multinode_fused():
+    _, _, eng, vh, keys, pay = loaded(nr=4, heap_pages=96)
+    got, found = vh.get(keys)
+    assert found.all()
+    assert all(got[i] == pay[i] for i in range(keys.size))
+    # duplicate client keys share one descent (combined fan-out)
+    dup = np.repeat(keys[:40], 7)
+    got2, f2 = vh.get(dup)
+    assert f2.all()
+    assert all(got2[i] == pay[int(np.searchsorted(keys, dup[i]))]
+               for i in range(dup.size))
+
+
+def test_get_with_leaf_cache_identical(heap_rig):
+    _, _, eng, vh, keys, pay = heap_rig
+    eng.attach_leaf_cache(slots=1024)
+    try:
+        eng.leaf_cache.fill(keys[:200])
+        got, found = vh.get(keys[:300])
+        assert found.all()
+        assert all(got[i] == pay[i] for i in range(300))
+    finally:
+        eng.detach_leaf_cache()
+
+
+def test_missing_keys_not_found(heap_rig):
+    _, _, _, vh, keys, _ = heap_rig
+    absent = np.asarray([5, 7, 11], np.uint64)
+    got, found = vh.get(absent)
+    assert not found.any() and got == [None] * 3
+
+
+def test_scan_resolves_payloads(heap_rig):
+    _, _, _, vh, keys, pay = heap_rig
+    lo, hi = int(keys[100]), int(keys[160])
+    (ks, ps), = vh.scan([(lo, hi)])
+    assert ks.size > 0
+    for k, p in zip(ks, ps):
+        assert p == pay[int(np.searchsorted(keys, k))]
+
+
+def test_sealed_zero_retrace_reads(heap_rig):
+    from sherman_tpu.obs import device as DEV
+    _, _, _, vh, keys, _ = heap_rig
+    vh.get(keys[:256])  # warm every shape
+    ledger = DEV.get_ledger()
+    r0 = ledger.retraces
+    ledger.seal()
+    try:
+        got, found = vh.get(keys[:256])
+    finally:
+        ledger.unseal()
+    assert found.all() and ledger.retraces == r0
+
+
+# -- writes: reuse, class change, free, double free --------------------------
+
+def test_overwrite_frees_old_slab_after_install():
+    """The FREE-AFTER-INSTALL protocol: an overwrite allocates a fresh
+    slab, installs the new handle, and only then frees the old slab —
+    so the old record would have stayed readable had the install
+    failed, and the freed slab returns to the freelist."""
+    _, _, eng, vh, keys, pay = loaded(n=200)
+    v0, _ = eng.search(keys[:50])
+    st = vh.put(keys[:50], [b"Z" * len(pay[i]) for i in range(50)])
+    assert st["allocated"] == 50 and st["freed"] == 50
+    assert st["lock_timeouts"] == 0
+    v1, _ = eng.search(keys[:50])
+    r0, s0, c0, ver0 = VH.unpack_handles(v0)
+    r1, s1, c1, ver1 = VH.unpack_handles(v1)
+    assert not ((r0 == r1) & (s0 == s1)).any()  # fresh slab per record
+    # the superseded handles are stale (their slabs freed post-install)
+    _, ok = vh.resolve_host(v0, np.ones(50, bool))
+    assert not ok.any()
+    got, _ = vh.get(keys[:50])
+    assert all(g == b"Z" * len(pay[i]) for i, g in enumerate(got))
+
+
+def test_class_change_frees_old_slab():
+    _, _, eng, vh, keys, pay = loaded(n=200)
+    small = [b"s" * 4 for _ in range(30)]   # class 0
+    vh.put(keys[:30], small)
+    v_old, _ = eng.search(keys[:30])
+    free0 = sum(len(s) for s in vh._free.values())
+    st = vh.put(keys[:30], [b"B" * 200 for _ in range(30)])  # class 3
+    assert st["freed"] == 30
+    assert sum(len(s) for s in vh._free.values()) > free0
+    # the superseded handles are STALE now: host resolver refuses them
+    _, ok = vh.resolve_host(v_old, np.ones(30, bool))
+    assert not ok.any()
+    got, _ = vh.get(keys[:30])
+    assert all(g == b"B" * 200 for g in got)
+
+
+def test_remove_frees_and_double_free_typed():
+    _, _, eng, vh, keys, _ = loaded(n=200)
+    hv, hf = eng.search(keys[:10])
+    assert hf.all()
+    found = vh.remove(keys[:10])
+    assert found.all()
+    _, fd = vh.get(keys[:10])
+    assert not fd.any()
+    with pytest.raises(DoubleFreeError):
+        vh.free_handles(keys[:10], hv)
+    with pytest.raises(DoubleFreeError):
+        vh.free_handles(keys[:1], np.asarray([0xFFFF_FFFF_FFFF_FFFF],
+                                             np.uint64))
+
+
+def test_stale_handle_revalidates_through_retry():
+    _, _, eng, vh, keys, pay = loaded(n=200)
+    stale, _ = eng.search(keys[:20])
+    vh.put(keys[:20], [b"NEW" for _ in range(20)])
+    # the pre-overwrite handles fail device validation...
+    _, _, ver_ok = vh.resolve_u64(stale, np.ones(20, bool))
+    assert not ver_ok.any()
+    # ...but a get() revalidates through a fresh descent
+    got, found = vh.get(keys[:20])
+    assert found.all() and all(g == b"NEW" for g in got)
+
+
+def test_torn_slab_typed_never_wrong():
+    _, _, eng, vh, keys, pay = loaded(n=200)
+    # corrupt one live slab's header version directly (a torn write)
+    vals, _ = eng.search(keys[:1])
+    row, slab, cls, ver = (int(x[0]) for x in VH.unpack_handles(vals))
+    off = slab * VH.HEAP_CLASSES[cls]
+    bad = int(np.uint32((((ver + 7) & 0xFFFF) << 16) | 1).view(np.int32))
+    vh.dsm.heap_write_cells([row], [off], [bad])
+    with pytest.raises(VH.HeapCorruptError):
+        vh.get(keys[:1])
+    # untouched keys still serve correct payloads
+    got, found = vh.get(keys[1:50])
+    assert found.all()
+    assert all(got[i] == pay[1 + i] for i in range(49))
+
+
+def test_heap_full_typed():
+    cluster, tree, eng = make(heap_pages=2)
+    keys = keyspace(100)
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0xD00D))
+    eng.attach_router()
+    vh = eng.attach_value_heap()
+    with pytest.raises(VH.HeapFullError):
+        vh.put(keys, [b"x" * 200 for _ in range(keys.size)])
+
+
+# -- scrub -------------------------------------------------------------------
+
+def test_scrub_reclaims_leaks_counts_orphans():
+    _, _, eng, vh, keys, _ = loaded(n=200)
+    # leak: allocate a slab nobody references, with live content
+    row, slab = vh._alloc(0, 0, 1)[0]
+    hdr = int(np.uint32((3 << 16) | 8).view(np.int32))
+    vh.dsm.heap_write_cells([row], [slab * VH.HEAP_CLASSES[0]], [hdr])
+    vh._ver[row, slab] = 3
+    # orphan: free a referenced slab behind the tree's back
+    hv, _ = eng.search(keys[:3])
+    vh.free_handles(keys[:3], hv)
+    res = vh.scrub(repair=True)
+    assert res["leaked"] >= 1
+    assert res["orphans"] == 3
+    # the reclaimed leak is allocatable again
+    assert (row, slab) in vh._free[(0, 0)]
+
+
+# -- durability planes -------------------------------------------------------
+
+def test_checkpoint_restore_bit_identity(tmp_path):
+    cluster, tree, eng, vh, keys, pay = loaded(n=300)
+    eng.flush_parents()
+    path = str(tmp_path / "c.npz")
+    CK.checkpoint(cluster, path)
+    before = np.asarray(cluster.dsm.heap)
+    cl2 = CK.restore(path)
+    assert np.array_equal(np.asarray(cl2.dsm.heap), before)
+    tr2 = Tree(cl2)
+    eng2 = batched.BatchedEngine(tr2, batch_per_node=256)
+    eng2.attach_router()
+    vh2 = eng2.attach_value_heap()
+    rb = vh2.rebuild()
+    assert rb["pages_carved"] == vh.stats()["pages_carved"]
+    got, found = vh2.get(keys)
+    assert found.all()
+    assert all(got[i] == pay[i] for i in range(keys.size))
+
+
+def test_delta_chain_carries_heap_rows(tmp_path):
+    cluster, tree, eng, vh, keys, pay = loaded(n=300)
+    base = str(tmp_path / "base.npz")
+    eng.flush_parents()
+    epoch = CK.checkpoint(cluster, base)
+    new_pay = [b"delta!" for _ in range(40)]
+    vh.put(keys[:40], new_pay)
+    d1 = str(tmp_path / "d1.npz")
+    info = CK.checkpoint_delta(cluster, d1, parent_epoch=epoch)
+    with np.load(d1) as z:
+        assert z["heap_rows"].size > 0  # heap dirt rode the link
+    cl2 = CK.restore_chain(base, [d1])
+    tr2 = Tree(cl2)
+    eng2 = batched.BatchedEngine(tr2, batch_per_node=256)
+    eng2.attach_router()
+    vh2 = eng2.attach_value_heap()
+    vh2.rebuild()
+    got, found = vh2.get(keys[:60])
+    assert found.all()
+    for i in range(60):
+        assert got[i] == (new_pay[i] if i < 40 else pay[i])
+
+
+def test_recovery_replay_rpo_zero(tmp_path):
+    from sherman_tpu.recovery import RecoveryPlane
+    cluster, tree, eng, vh, keys, pay = loaded(n=300)
+    plane = RecoveryPlane(cluster, tree, eng, str(tmp_path / "rec"))
+    plane.checkpoint_base()
+    post = [b"post-base" for _ in range(50)]
+    vh.put(keys[:50], post)
+    vh.remove(keys[50:60])
+    # crash: rebuild purely from disk
+    plane2, cl2, tr2, eng2, receipt = RecoveryPlane.recover(
+        str(tmp_path / "rec"), batch_per_node=256)
+    assert receipt["replay"]["heap_puts"] >= 1
+    assert receipt["replay"]["heap_frees"] >= 1
+    vh2 = eng2.value_heap
+    got, found = vh2.get(keys[:70])
+    assert not found[50:60].any()
+    for i in range(50):
+        assert found[i] and got[i] == post[i]
+    for i in range(60, 70):
+        assert found[i] and got[i] == pay[i]
+
+
+def test_journal_heap_record_roundtrip(tmp_path):
+    path = str(tmp_path / "j.wal")
+    keys = np.asarray([3, 5], np.uint64)
+    handles = np.asarray([0x10000 | 7, 0x20000 | 9], np.uint64)
+    pays = [b"abc", b"defgh"]
+    with J.Journal(path) as j:
+        j.append_heap(J.J_HEAP_PUT, keys, handles, pays)
+        j.append(J.J_HEAP_FREE, keys, handles)
+    recs = J.read_records(path)
+    assert recs[0][0] == J.J_HEAP_PUT
+    assert (recs[0][1] == keys).all()
+    h2, p2 = recs[0][2]
+    assert (h2 == handles).all() and p2 == pays
+    assert recs[1][0] == J.J_HEAP_FREE
+    assert (recs[1][2] == handles).all()
+
+
+def test_reshard_round_trip_preserves_heap(tmp_path):
+    cluster, tree, eng, vh, keys, pay = loaded(nr=2, heap_pages=48,
+                                               n=300)
+    eng.flush_parents()
+    src = str(tmp_path / "src.npz")
+    CK.checkpoint(cluster, src)
+    m3 = str(tmp_path / "m3.npz")
+    RS.reshard(src, m3, 3)
+    cl3 = CK.restore(m3)
+    assert cl3.cfg.machine_nr == 3
+    tr3 = Tree(cl3)
+    eng3 = batched.BatchedEngine(tr3, batch_per_node=256)
+    eng3.attach_router()
+    vh3 = eng3.attach_value_heap()
+    vh3.rebuild()
+    got, found = vh3.get(keys)
+    assert found.all()
+    assert all(got[i] == pay[i] for i in range(keys.size))
+    # round trip back: the original heap rows are bit-identical
+    back = str(tmp_path / "back.npz")
+    RS.reshard(m3, back, 2)
+    with np.load(src) as z1, np.load(back) as z2:
+        h1, h2 = z1["heap"], z2["heap"]
+        n = min(h1.shape[0], h2.shape[0])
+        assert np.array_equal(h1[:n], h2[:n])
+        assert not h2[n:].any()
+
+
+def test_migrate_cutover_carries_heap(tmp_path):
+    from sherman_tpu.migrate import Migrator
+    cluster, tree, eng, vh, keys, pay = loaded(n=300)
+    mig = Migrator(cluster, tree, eng, 2, str(tmp_path / "mig"))
+    mig.start()
+    mig.run_to_copied()
+    # mid-migration payload reads stay correct
+    got, found = vh.get(keys[:80])
+    assert found.all() and all(got[i] == pay[i] for i in range(80))
+    dst = str(tmp_path / "m2.npz")
+    summary = mig.finish(dst)
+    assert summary["heap_pages"] > 0
+    cl2 = CK.restore(dst)
+    tr2 = Tree(cl2)
+    eng2 = batched.BatchedEngine(tr2, batch_per_node=256)
+    eng2.attach_router()
+    vh2 = eng2.attach_value_heap()
+    vh2.rebuild()
+    got2, f2 = vh2.get(keys)
+    assert f2.all()
+    assert all(got2[i] == pay[i] for i in range(keys.size))
+
+
+# -- serving front door ------------------------------------------------------
+
+def test_serve_variable_size_records():
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cluster, tree, eng, vh, keys, pay = loaded(n=300)
+    cfg = ServeConfig(widths=(256, 1024), p99_targets_ms={
+        c: 200.0 for c in ("read", "scan", "insert", "delete")},
+        calib_steps=1, seal=False, write_linger_ms=0.5,
+        write_lane=True)
+    srv = ShermanServer(eng, cfg)
+    srv.start(calib_keys=keys)
+    try:
+        # payload read behind the shared ingress step
+        f1 = srv.submit("read", keys[:64], resolve_payloads=True)
+        got, found = f1.result(timeout=30)
+        assert found.all()
+        assert all(got[i] == pay[i] for i in range(64))
+        # payload insert through the write lane
+        f2 = srv.submit("insert", keys[:8],
+                        payloads=[b"served!" for _ in range(8)])
+        assert f2.result(timeout=30).all()
+        f3 = srv.submit("read", keys[:8], resolve_payloads=True)
+        got3, _ = f3.result(timeout=30)
+        assert all(g == b"served!" for g in got3)
+        # scan with payloads
+        f4 = srv.submit("scan", ranges=[(int(keys[100]), int(keys[120]))],
+                        resolve_payloads=True)
+        (ks, ps), = f4.result(timeout=30)
+        assert len(ps) == ks.size > 0
+        # delete frees slabs through the reclaim path
+        f5 = srv.submit("delete", keys[8:12])
+        assert f5.result(timeout=30).all()
+        st = srv.stats()
+        assert st["value_heap"]["frees"] >= 4
+        assert st["write_lane"] is True
+    finally:
+        srv.stop()
+
+
+def test_serve_write_lane_off_still_serves():
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cluster, tree, eng, vh, keys, pay = loaded(n=200)
+    cfg = ServeConfig(widths=(256,), p99_targets_ms={
+        c: 200.0 for c in ("read", "scan", "insert", "delete")},
+        calib_steps=1, seal=False, write_lane=False,
+        write_linger_ms=0.5)
+    srv = ShermanServer(eng, cfg)
+    srv.start(calib_keys=keys)
+    try:
+        f = srv.submit("insert", keys[:4],
+                       payloads=[b"one-lane" for _ in range(4)])
+        assert f.result(timeout=30).all()
+        g = srv.submit("read", keys[:4], resolve_payloads=True)
+        got, _ = g.result(timeout=30)
+        assert all(p == b"one-lane" for p in got)
+    finally:
+        srv.stop()
+
+
+# -- heap collector ----------------------------------------------------------
+
+def test_heap_collector_registered():
+    _, _, eng, vh, keys, _ = loaded(n=100)
+    vh.get(keys[:10])
+    snap = obs.snapshot()
+    assert snap.get("heap.puts", 0) >= 100
+    assert snap.get("heap.gets", 0) >= 10
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_replay_heals_partial_put_window(tmp_path):
+    """Crash BETWEEN a put's J_HEAP_PUT append and the engine's
+    J_UPSERT append: a same-class in-place overwrite's slab bytes are
+    already journaled with a bumped version, but no handle-install
+    record exists.  replay_put must install the record's own handles
+    (at-least-once) — otherwise the leaf's old-version handle points
+    at the rewritten slab forever and the ACKED record is lost."""
+    from sherman_tpu.recovery import RecoveryPlane
+    cluster, tree, eng, vh, keys, pay = loaded(n=100)
+    plane = RecoveryPlane(cluster, tree, eng, str(tmp_path / "rec"))
+    plane.checkpoint_base()
+    k = keys[:1]
+    vh.put(k, [b"acked-v1"])
+    # simulate the torn window: journal the NEXT overwrite's heap
+    # record (same slab, bumped version) WITHOUT running the insert
+    vals, _ = eng.search(k)
+    rows, slabs, clss, vers = VH.unpack_handles(vals)
+    h2 = VH.pack_handles(rows, slabs, clss, (vers % 0xFFFF) + 1)
+    eng.journal.append_heap(J.J_HEAP_PUT, k, h2, [b"torn-v2"])
+    # crash + recover: the replayed heap record must be READABLE
+    _, _, _, eng2, receipt = RecoveryPlane.recover(
+        str(tmp_path / "rec"), batch_per_node=256)
+    got, found = eng2.value_heap.get(k)
+    assert found[0] and got[0] == b"torn-v2"
+
+
+def test_serve_payload_read_of_inline_value_fails_typed():
+    """A payload read whose handle never validates (a key inserted
+    INLINE on a heap-attached server) must FAIL its future typed —
+    never leave it (or its batch-mates) unset forever."""
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cluster, tree, eng, vh, keys, pay = loaded(n=200)
+    cfg = ServeConfig(widths=(256,), p99_targets_ms={
+        c: 200.0 for c in ("read", "scan", "insert", "delete")},
+        calib_steps=1, seal=False, write_linger_ms=0.5)
+    srv = ShermanServer(eng, cfg)
+    srv.start(calib_keys=keys)
+    try:
+        bad_key = np.asarray([0xBAD_C0DE_1], np.uint64)
+        f0 = srv.submit("insert", bad_key,
+                        values=np.asarray([7], np.uint64))
+        f0.result(timeout=30)
+        f1 = srv.submit("read", bad_key, resolve_payloads=True)
+        with pytest.raises(VH.HeapCorruptError):
+            f1.result(timeout=30)
+        # the loop survived: a later request still serves
+        f2 = srv.submit("read", keys[:4], resolve_payloads=True)
+        got, found = f2.result(timeout=30)
+        assert found.all() and all(got[i] == pay[i] for i in range(4))
+    finally:
+        srv.stop()
+
+
+def test_serve_oversized_payload_rejected_at_submit():
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cluster, tree, eng, vh, keys, _ = loaded(n=100)
+    cfg = ServeConfig(widths=(256,), p99_targets_ms={
+        c: 200.0 for c in ("read", "scan", "insert", "delete")},
+        calib_steps=1, seal=False)
+    srv = ShermanServer(eng, cfg)
+    srv.start(calib_keys=keys)
+    try:
+        with pytest.raises(ConfigError):
+            srv.submit("insert", keys[:1], payloads=[b"x" * 300])
+    finally:
+        srv.stop()
+
+
+def test_rebuild_reclaims_reshard_holes(tmp_path):
+    """After an N->M reshard the carved segments of the old nodes
+    interleave with uncarved holes in the new node split; rebuild()
+    must hand those holes back to the allocator (spare pages), not
+    strand them below the bump mark forever."""
+    cluster, tree, eng, vh, keys, pay = loaded(nr=2, heap_pages=64,
+                                               n=300)
+    eng.flush_parents()
+    src = str(tmp_path / "src.npz")
+    CK.checkpoint(cluster, src)
+    dst = str(tmp_path / "m1.npz")
+    RS.reshard(src, dst, 1)
+    cl1 = CK.restore(dst)
+    tr1 = Tree(cl1)
+    eng1 = batched.BatchedEngine(tr1, batch_per_node=256)
+    eng1.attach_router()
+    vh1 = eng1.attach_value_heap()
+    vh1.rebuild()
+    holes = len(vh1._spare_pages)
+    total_free_pages = holes + int(
+        (vh1.Hpp * vh1.N) - vh1._next_page.sum())
+    # fill every remaining page: must NOT HeapFullError while spare
+    # pages exist (each 200-byte record = class 3, 3 slabs/page)
+    budget = total_free_pages * 3 + sum(
+        len(s) for (c, cls), s in vh1._free.items() if cls == 3)
+    nk = np.unique(bits.mix64_np(np.arange(10_000, 10_000 + budget,
+                                           dtype=np.uint64)))
+    vh1.put(nk[:budget], [b"x" * 200 for _ in range(budget)])
+    got, found = vh1.get(keys[:50])
+    assert found.all() and all(got[i] == pay[i] for i in range(50))
+
+
+def test_free_wrong_class_handle_typed():
+    """A free whose handle decodes to a different class than the page
+    was carved with would compute a word offset inside ANOTHER live
+    slab — it must reject typed, never corrupt the neighbor."""
+    _, _, eng, vh, keys, pay = loaded(n=100)
+    vh.put(keys[:1], [b"tiny"])  # class 0 slab
+    vals, _ = eng.search(keys[:1])
+    row, slab, cls, ver = (int(x[0]) for x in VH.unpack_handles(vals))
+    assert cls == 0
+    # forge a class-1 handle onto the same class-0 page
+    forged = VH.pack_handles([row], [3], [1],
+                             [int(vh._ver[row, 3]) or 1])
+    with pytest.raises(DoubleFreeError):
+        vh.free_handles(keys[:1], forged)
+    # the real record is untouched
+    got, found = vh.get(keys[:1])
+    assert found[0] and got[0] == b"tiny"

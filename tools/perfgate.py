@@ -40,6 +40,13 @@ Comparability rules (the trajectory's own lessons):
   comparable-config metadata: a cache-ON receipt's ``sustained_ops_s``
   never gates against a cache-OFF round's and vice versa — most ops of
   a cache-ON loop never descend, a different workload per step;
+- a VALUE-CONFIG change is incomparable config (PR 14): rows whose
+  ``config.value_bytes`` / ``config.value_dist`` / ``config.value_heap``
+  differ never gate against each other — out-of-line heap reads gather
+  payload pages inline reads never touch, and payload size rescales
+  every byte-bound phase.  Receipts predating the fields compare as
+  fixed-width 8-byte inline (the hardcoded pre-heap fact), so the
+  committed trajectory keeps gating;
 - SERVE-MODE receipts (``tools/serve_bench.py`` / ``bench.py --serve``
   — the open-loop, admission-paced front door; identified by the
   ``serve`` block or ``metric == "serve_bench"``) are a different
@@ -163,6 +170,18 @@ def _cache_on(r: dict) -> bool:
     return bool(isinstance(c, dict) and c.get("enabled"))
 
 
+def _value_cfg(r: dict) -> tuple:
+    """The receipt's value configuration (config.value_bytes /
+    value_dist / value_heap, PR 14).  Absent fields = the pre-heap
+    fact: every committed round ran fixed-width 8-byte inline values
+    (bench.py hardcoded them until the fields existed), so older
+    artifacts compare as (8, "fixed", False) rather than skipping."""
+    c = r.get("config") or {}
+    return (c.get("value_bytes") or 8,
+            c.get("value_dist") or "fixed",
+            bool(c.get("value_heap")))
+
+
 def _serve_mode(r: dict) -> bool:
     """True for a serving-front-door receipt (open-loop, admission-
     paced — ``tools/serve_bench.py``): the ``serve`` block or the
@@ -192,6 +211,14 @@ def _comparable(cand: dict, r: dict, metric: str) -> bool:
     # receipt without the field ran machine_nr=1 (the pre-field
     # bench.py hardcoded it).
     if (r.get("nodes") or 1) != (cand.get("nodes") or 1):
+        return False
+    # value-config rule (PR 14): rows with differing value_bytes /
+    # value_dist / value_heap never gate against each other — an
+    # out-of-line heap read gathers payload pages the inline read never
+    # touches, and a payload-size change rescales every byte-bound
+    # phase.  Missing fields = the pre-heap inline fact (see
+    # _value_cfg), so the whole committed trajectory keeps comparing.
+    if _value_cfg(r) != _value_cfg(cand):
         return False
     if r.get(metric) is None or cand.get(metric) is None:
         return False

@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""YCSB A-F matrix driver (``bench.py --ycsb``).
+
+Runs the full core-workload matrix (``sherman_tpu/workload/ycsb.py``)
+as first-class bench rows over one bulk-loaded tree:
+
+- **A/B** (zipf read/update) ride the fused ``mixed`` step inline, or
+  the value heap's get/put paths with variable-length payloads;
+- **C** (zipf read-only) is the headline row: with the heap ON, every
+  read resolves its payload in the fused descent fan-out + heap gather
+  program, with the gather phase attributed separately (``phase_ms``:
+  ``read_fanout`` vs ``heap_gather``, chained-delta) and the loop runs
+  SEALED (compile ledger; ``retraces`` published, pinned 0 in CI);
+- **D** (read-latest + inserts) advances the insert frontier;
+- **E** (scans + inserts) drives ``range_query_many`` — with the heap
+  ON every scan hit's payload is gathered in one resolve step;
+- **F** (read-modify-write) re-reads then re-stamps.
+
+Every row publishes its ANALYTIC twin (op-class mix by construction,
+expected rows per scan in the hashed keyspace) next to the measured
+number, plus a sampled AUDIT against the host reference resolver when
+the heap is on (device payloads must be bit-identical).
+
+``--ab`` additionally runs the YCSB-C heap-on vs inline A/B at two
+value size classes — the "what does out-of-line cost on reads" receipt.
+
+The receipt's ``config`` block carries ``value_bytes``/``value_dist``/
+``value_heap`` — perfgate treats rows with differing value config as
+incomparable (the ``nodes`` rule's pattern).
+
+Run::
+
+    python tools/ycsb_bench.py [--keys 200000] [--ops 8192] [--steps 8]
+        [--theta 0.99] [--workloads A,B,C,D,E,F] [--value-bytes 64]
+        [--value-dist fixed] [--nodes 1] [--ab]
+
+Env twins (the README knob table): ``SHERMAN_YCSB_OPS``,
+``SHERMAN_YCSB_WORKLOADS``, ``SHERMAN_VALUE_BYTES``,
+``SHERMAN_VALUE_DIST``; ``SHERMAN_VALUE_HEAP`` sizes the heap region
+(0 = inline values).  Prints ONE JSON line (``metric: ycsb_matrix``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import pages_for_keys, setup_platform  # noqa: E402
+
+SALT = 0x5E17_AB1E_5A17
+
+
+def build(n_keys: int, ops: int, nodes: int, heap_pages: int,
+          value_bytes: int, value_dist: str):
+    """Cluster + bulk-loaded tree + engine (+ heap migration)."""
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig, TreeConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+    from sherman_tpu.ops import bits
+    from sherman_tpu.workload.ycsb import payload_for_key
+
+    ranks = np.arange(n_keys, dtype=np.uint64)
+    keys = np.unique(bits.mix64_np(ranks ^ np.uint64(SALT)))
+    vals = keys ^ np.uint64(0xD00D)
+    # D/E grow the frontier ~5% of the op budget: size the pool for it
+    grow = max(1024, ops * 64 // 8)
+    cfg = DSMConfig(
+        machine_nr=nodes,
+        pages_per_node=pages_for_keys((n_keys + grow) // nodes + 1),
+        locks_per_node=16384,
+        step_capacity=max(512, min(ops, 8192)),
+        chunk_pages=256,
+        heap_pages_per_node=heap_pages)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    batched.bulk_load(tree, keys, vals)
+    eng = batched.BatchedEngine(
+        tree, batch_per_node=max(256, -(-ops // nodes)),
+        tcfg=TreeConfig(sibling_chase_budget=2))
+    eng.attach_router()
+    vh = None
+    if heap_pages:
+        vh = eng.attach_value_heap()
+        # migrate the loaded records out of line (chunked puts)
+        step = max(1024, ops)
+        for i in range(0, keys.size, step):
+            ck = keys[i: i + step]
+            vh.put(ck, [payload_for_key(int(k), value_bytes, value_dist)
+                        for k in ck])
+    return cluster, tree, eng, vh, keys
+
+
+def _percentiles(walls_ms):
+    w = np.sort(np.asarray(walls_ms))
+    if w.size == 0:
+        return 0.0, 0.0
+    return (float(w[int(0.5 * (w.size - 1))]),
+            float(w[int(np.ceil(0.99 * (w.size - 1)))]))
+
+
+def run_workload(eng, vh, gen, *, ops: int, steps: int,
+                 seal: bool = False) -> dict:
+    """Closed-loop ``steps`` batches of ``ops`` ops.  One warmup batch
+    compiles every shape, then (optionally) the compile ledger seals
+    around the timed loop — a retrace in steady state is a counted
+    hazard, not a mystery."""
+    from sherman_tpu.obs import device as DEV
+    from sherman_tpu.workload.ycsb import payload_for_key
+
+    counts = {"read": 0, "update": 0, "insert": 0, "rmw": 0,
+              "scan": 0, "scan_rows": 0, "scan_rows_expected": 0}
+
+    def play(b) -> None:
+        heap = vh is not None
+        rk = b.get("read")
+        uk = b.get("update")
+        if not heap and rk is not None and uk is not None \
+                and "scan" not in b and "rmw" not in b:
+            # the fused mixed step serves the whole read+update batch
+            keys = np.concatenate([rk, uk])
+            isr = np.zeros(keys.size, bool)
+            isr[: rk.size] = True
+            eng.mixed(keys, keys ^ np.uint64(0xBEEF), isr)
+            counts["read"] += rk.size
+            counts["update"] += uk.size
+            rk = uk = None
+        if rk is not None:
+            (vh.get(rk) if heap else eng.search_combined(rk))
+            counts["read"] += rk.size
+        if uk is not None:
+            if heap:
+                vh.put(uk, [payload_for_key(int(k) ^ 1, gen.value_bytes,
+                                            gen.value_dist)
+                            for k in uk])
+            else:
+                eng.insert(uk, uk ^ np.uint64(0xBEEF))
+            counts["update"] += uk.size
+        ik = b.get("insert")
+        if ik is not None:
+            if heap:
+                vh.put(ik, gen.payloads_for_keys(ik))
+            else:
+                eng.insert(ik, ik ^ np.uint64(0xD00D))
+            counts["insert"] += ik.size
+        fk = b.get("rmw")
+        if fk is not None:
+            if heap:
+                got, fnd = vh.get(fk)
+                vh.put(fk, [(g or b"\x00") + b"!"
+                            if len(g or b"") < gen.value_bytes
+                            else (g or b"\x00")
+                            for g in got])
+            else:
+                v, fnd = eng.search_combined(fk)
+                eng.insert(fk, v ^ np.uint64(1))
+            counts["rmw"] += fk.size
+        sc = b.get("scan")
+        if sc is not None:
+            res = vh.scan(sc) if heap else eng.range_query_many(sc)
+            counts["scan"] += len(sc)
+            counts["scan_rows"] += int(sum(len(r[0]) for r in res))
+            counts["scan_rows_expected"] += int(
+                b.get("scan_expected_rows", 0))
+
+    play(gen.batch(ops))  # warmup: compile every class's shapes
+    ledger = DEV.get_ledger()
+    r0 = ledger.retraces
+    if seal:
+        ledger.seal()
+    walls = []
+    t0 = time.perf_counter()
+    try:
+        for _ in range(steps):
+            ts = time.perf_counter()
+            play(gen.batch(ops))
+            walls.append((time.perf_counter() - ts) * 1e3)
+    finally:
+        if seal:
+            ledger.unseal()
+    total_s = time.perf_counter() - t0
+    p50, p99 = _percentiles(walls)
+    out = {
+        "ops": ops * steps,
+        "ops_s": round(ops * steps / total_s),
+        "step_p50_ms": round(p50, 2),
+        "step_p99_ms": round(p99, 2),
+        "counts": {k: int(v) for k, v in counts.items() if v},
+        "analytic": gen.expectations(),
+        "sealed": bool(seal),
+        "retraces": int(ledger.retraces - r0),
+    }
+    if counts["scan"]:
+        out["scan_rows_per_scan"] = round(
+            counts["scan_rows"] / counts["scan"], 2)
+        out["scan_rows_expected_per_scan"] = round(
+            counts["scan_rows_expected"] / counts["scan"], 2)
+    return out
+
+
+def heap_phase_attribution(eng, vh, keys, ops: int, reps: int = 4) -> dict:
+    """Chained-delta attribution of the heap READ path: the descent
+    fan-out alone vs fan-out + heap gather (the extra phase's cost),
+    plus the standalone resolve program — the receipt's proof that the
+    payload gather rides the fused step instead of a second descent."""
+    import jax
+    rng = np.random.default_rng(3)
+    kb = keys[rng.integers(0, keys.size, ops)]
+
+    def t(fn):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        jax.block_until_ready(eng.dsm.pool)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    fanout_ms = t(lambda: eng.search_combined(kb))
+    fused_ms = t(lambda: vh.get(kb))
+    vals, found = eng.search_combined(kb)
+    resolve_ms = t(lambda: vh.resolve_u64(vals, found))
+    return {
+        "read_fanout_ms": round(fanout_ms, 2),
+        "fused_read_ms": round(fused_ms, 2),
+        "heap_gather_ms": round(resolve_ms, 2),
+        "fused_overhead_ms": round(fused_ms - fanout_ms, 2),
+    }
+
+
+def audit_heap(eng, vh, keys, n: int = 256) -> bool:
+    """Sampled device-vs-host-reference bit-identity audit."""
+    rng = np.random.default_rng(11)
+    ks = keys[rng.integers(0, keys.size, n)]
+    dev, found = vh.get(ks)
+    vals, f2 = eng.search(ks)
+    ref, ok = vh.resolve_host(vals, f2)
+    for i in range(ks.size):
+        if bool(found[i]) != bool(f2[i] and ok[i]):
+            return False
+        if found[i] and dev[i] != ref[i]:
+            return False
+    return True
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description="YCSB A-F matrix bench")
+    ap.add_argument("--keys", type=int, default=int(os.environ.get(
+        "SHERMAN_BENCH_KEYS", 200_000)))
+    ap.add_argument("--ops", type=int, default=int(os.environ.get(
+        "SHERMAN_YCSB_OPS", 8192)), help="ops per closed-loop step")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--theta", type=float, default=0.99)
+    ap.add_argument("--workloads", default=os.environ.get(
+        "SHERMAN_YCSB_WORKLOADS", "A,B,C,D,E,F"))
+    ap.add_argument("--value-bytes", type=int, default=int(os.environ.get(
+        "SHERMAN_VALUE_BYTES", 64)))
+    ap.add_argument("--value-dist", default=os.environ.get(
+        "SHERMAN_VALUE_DIST", "fixed"), choices=("fixed", "uniform"))
+    ap.add_argument("--nodes", type=int, default=1)
+    ap.add_argument("--ab", action="store_true",
+                    help="YCSB-C heap-on vs inline A/B at 2 size classes")
+    a = ap.parse_args(argv)
+
+    setup_platform(a.nodes)
+    from sherman_tpu.config import value_heap_pages
+    from sherman_tpu.workload.ycsb import YcsbGen
+
+    heap_pages = value_heap_pages()
+    cluster, tree, eng, vh, keys = build(
+        a.keys, a.ops, a.nodes, heap_pages, a.value_bytes, a.value_dist)
+
+    rows = {}
+    for w in [w.strip().upper() for w in a.workloads.split(",")
+              if w.strip()]:
+        gen = YcsbGen(w, a.keys, theta=a.theta, seed=17, salt=SALT,
+                      value_bytes=a.value_bytes,
+                      value_dist=a.value_dist)
+        rows[w] = run_workload(eng, vh, gen, ops=a.ops, steps=a.steps,
+                               seal=(w == "C"))
+        print(f"# YCSB-{w}: {rows[w]['ops_s']:,} ops/s "
+              f"(p99 {rows[w]['step_p99_ms']} ms/step)",
+              file=sys.stderr)
+
+    out = {
+        "metric": "ycsb_matrix",
+        "schema_version": 3,
+        "keys": a.keys,
+        "batch": a.ops,
+        "nodes": a.nodes,
+        "theta": a.theta,
+        "workloads": rows,
+        "config": {
+            "gather_impl": cluster.cfg.gather_impl,
+            "exchange_impl": cluster.cfg.exchange_impl,
+            "value_bytes": a.value_bytes if heap_pages else 8,
+            "value_dist": a.value_dist if heap_pages else "fixed",
+            "value_heap": bool(heap_pages),
+        },
+    }
+    if vh is not None:
+        out["heap"] = vh.stats()
+        out["heap_phase_ms"] = heap_phase_attribution(eng, vh, keys,
+                                                      a.ops)
+        out["audit_ok"] = audit_heap(eng, vh, keys)
+    if a.ab and heap_pages:
+        out["ycsb_c_ab"] = run_c_ab(a)
+    print(json.dumps(out))
+    return out
+
+
+def run_c_ab(a) -> dict:
+    """YCSB-C heap-on vs inline at two value size classes: fresh
+    engines per arm (arms must not share compiled-shape warmth or
+    pool state)."""
+    from sherman_tpu.models import value_heap as VH
+    from sherman_tpu.workload.ycsb import YcsbGen
+    arms = {}
+    for label, vb in (("inline", 8), ("heap_28B", 28),
+                      ("heap_252B", 252)):
+        heap_pages = 0
+        if label != "inline":
+            cls = VH.class_for_bytes(vb)
+            slabs = VH.SLAB_REGION_WORDS // VH.HEAP_CLASSES[cls]
+            heap_pages = (a.keys // slabs // max(1, a.nodes)
+                          + a.keys // slabs // 8 + 64)
+        _, _, eng2, vh2, _ = build(a.keys, a.ops, a.nodes, heap_pages,
+                                   vb, "fixed")
+        gen = YcsbGen("C", a.keys, theta=a.theta, seed=17, salt=SALT,
+                      value_bytes=vb, value_dist="fixed")
+        arms[label] = run_workload(eng2, vh2, gen, ops=a.ops,
+                                   steps=a.steps, seal=True)
+        arms[label]["value_bytes"] = vb
+        print(f"# YCSB-C A/B {label}: {arms[label]['ops_s']:,} ops/s",
+              file=sys.stderr)
+    return arms
+
+
+if __name__ == "__main__":
+    main()
