@@ -105,7 +105,12 @@ repair) — see README "Robustness"; ``bench.py --reshard-drill`` runs
 the capacity drill (tools/reshard_drill.py: live N->M pool grow under
 mixed traffic with a chaos-injected crash mid-migration, resumed
 migration, and the offline-vs-online final-pool bit-identity pin) —
-see README "Elastic scaling"; ``bench.py --serve`` runs the serving
+see README "Elastic scaling"; ``bench.py --contract-drill`` runs the
+client-contract drill (tools/contract_drill.py: exactly-once acks +
+deadlines + the linearizability auditor across chaos, a cold crash,
+recovery and a migration — duplicate_acks == 0, lost_acks == 0,
+linearizable == true) — see README "Client contract"; ``bench.py
+--serve`` runs the serving
 front door's OPEN-loop bench (tools/serve_bench.py: multi-tenant paced
 clients through sherman_tpu/serve.py — SLO-adaptive step width,
 fair-share admission + typed backpressure, journaled write acks, and
@@ -1391,6 +1396,23 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import ycsb_bench
         ycsb_bench.main(sys.argv[1:])
+        return
+
+    if "--contract-drill" in sys.argv:
+        # Client-contract lane: exactly-once acks + deadlines + the
+        # per-key linearizability auditor rehearsed end to end (open-
+        # loop retrying clients -> chaos storm -> cold crash with torn
+        # journal tail -> recovery reconstructing the dedup window ->
+        # retry-across-crash re-acked not re-applied -> live migration
+        # -> offline history check), pinning duplicate_acks == 0,
+        # lost_acks == 0, rpo_ops == 0 and linearizable == true.
+        # tools/contract_drill.py owns the sequence; it prints its own
+        # one-line JSON receipt.
+        sys.argv.remove("--contract-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import contract_drill
+        contract_drill.main(sys.argv[1:])
         return
 
     if "--reshard-drill" in sys.argv:

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Client-contract CI lane: pin the exactly-once / deadline /
+# linearizability plane (sherman_tpu/serve.py + audit.py +
+# utils/journal.py v2 + recovery.py window reconstruction).
+#
+# Runs (1) the contract fast tier — the per-key linearizability
+# checker units incl. the seeded duplicate-apply and stale-read
+# violations (non-vacuity), the fixpoint window cut + batch intents
+# (no-false-alarms polarity), the exactly-once dedup window
+# (retry-re-acks-never-re-applies, bounded eviction, in-flight join,
+# seed+rejournal), typed deadline shedding, weighted 2:1 fair shares,
+# the retrying/hedging client, journal v2 rid/ack round trips + v1
+# back-compat, the zero-retrace sealed loop with the contract plane
+# armed, the < 2% inline-auditor cost pin, and the perfgate contract
+# hard-red rules; (2) the client-contract fuzz round (retry storms +
+# torn tails + chaos); and (3) the contract drill end to end with its
+# receipt pins asserted.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== contract fast tier (auditor, dedup, deadlines, weights, journal v2) =="
+python -m pytest tests/test_audit.py tests/test_serve.py -q
+
+echo "== client-contract fuzz round (retry storms + torn tails + chaos) =="
+python -m pytest tests/test_fuzz.py::test_fuzz_client_contract -q
+
+echo "== contract drill (chaos storm -> cold crash -> recovery -> migration) =="
+SHERMAN_CONTRACT_RECEIPT=/tmp/_contract_ci.json \
+    python bench.py --contract-drill --keys 3000 --secs 2.5
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/_contract_ci.json"))
+assert d["ok"], "drill not ok"
+assert d["duplicate_acks"] == 0, f"duplicate acks: {d['duplicate_acks']}"
+assert d["lost_acks"] == 0, f"lost acks: {d['lost_acks']}"
+assert d["rpo_ops"] == 0, f"rpo: {d['rpo_ops']}"
+assert d["linearizable"] is True, "history not linearizable"
+assert d["deadline"]["shed_typed"] > 0, "deadline burst never shed typed"
+assert d["phase_a"]["retraces_clean_window"] == 0, "sealed loop retraced"
+assert d["phase_a"]["audit_cost_frac"] < 0.02, \
+    f"inline auditor cost {d['phase_a']['audit_cost_frac']}"
+assert d["recover"]["replayed_acks"] > 0, "no ack records replayed"
+assert d["retry_across_crash"]["retried"] > 0
+print("contract drill:", d["retry_across_crash"]["retried"],
+      "rids retried across the crash,",
+      d["recover"]["window"], "window entries recovered,",
+      d["audit"]["reads_checked"], "reads checked linearizable;",
+      "auditor cost", d["phase_a"]["audit_cost_frac"])
+EOF
+
+echo "== perfgate: committed contract receipt passes on its pins =="
+python tools/perfgate.py --receipt /tmp/_contract_ci.json --json
+echo "CONTRACT-CI PASS"

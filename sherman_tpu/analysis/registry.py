@@ -118,6 +118,12 @@ DEFAULT_REGISTRY = Registry(
         ("sherman_tpu/workload/device_prep.py",
          "make_ingress_step.dispatch"),
         ("sherman_tpu/serve.py", "ShermanServer._dispatch_reads"),
+        # client-contract plane (PR 15): the dispatch-path queue pops
+        # run per formed step under the admission lock — deadline
+        # shedding and the fair-share take are plain pops/adds, and a
+        # stray host sync here stalls every client behind the lock
+        ("sherman_tpu/serve.py", "ShermanServer._take"),
+        ("sherman_tpu/serve.py", "ShermanServer._shed_expired"),
         # value heap (PR 14): the handle-resolve kernels are traced
         # (the gather phase of the fused read fan-out), and the fused
         # program closure composes the descent + gather on device — a
@@ -153,6 +159,10 @@ DEFAULT_REGISTRY = Registry(
     jit_factory_patterns=["_get_*", "*_jit", "wrap_program"],
     append_paths=[
         ("sherman_tpu/utils/journal.py", "Journal.append"),
+        # the client-contract ack records ride the same gate: an ack
+        # cached in the dedup window must be durable before any future
+        # resolves (PR 15)
+        ("sherman_tpu/utils/journal.py", "Journal.append_acks"),
     ],
     obs_hot_functions=[
         ("sherman_tpu/obs/registry.py", "Counter.inc"),
@@ -181,6 +191,10 @@ DEFAULT_REGISTRY = Registry(
         # plain integer adds; the heap.* collector allocates at PULL
         # time like every other collector
         ("sherman_tpu/models/value_heap.py", "ValueHeap._note_*"),
+        # client-contract auditor (PR 15): the inline observe cost
+        # accounting runs on every completed batch inside the serve
+        # wall (the < 2% pin's own numerator must not allocate)
+        ("sherman_tpu/audit.py", "Auditor._note_cost"),
     ],
     knob_docs=["BENCHMARKS.md"],
 )

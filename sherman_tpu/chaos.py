@@ -145,6 +145,18 @@ class FaultPlan:
                   for _ in range(n_faults)]
         return cls(faults, seed=seed)
 
+    @classmethod
+    def storm(cls, seed: int, n_faults: int = 6, step_hi: int = 32
+              ) -> "FaultPlan":
+        """Seeded serving-storm plan: the persistent-corruption set
+        PLUS the transient reply faults (``drop_cas``/``stale_read``)
+        spread over a wider step range — the shape the front-door
+        drills fire UNDER live client traffic (contract drill, client-
+        contract fuzz), where retry paths must absorb lost CAS rounds
+        and the scrubber/lease machinery must catch the rest."""
+        return cls.random(seed, n_faults=n_faults, step_hi=step_hi,
+                          kinds=KINDS)
+
     # -- the DSM hook (called under the DSM step mutex) -----------------------
 
     def on_step(self, dsm, reqs):

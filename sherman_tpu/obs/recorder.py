@@ -26,8 +26,12 @@ Event sources (each site calls :func:`record_event`):
 - ``watchdog.fired``
 - ``serve.start`` / ``serve.sealed`` / ``serve.width_change`` /
   ``serve.brownout_enter`` / ``serve.brownout_exit`` /
-  ``serve.dispatch_error`` / ``serve.stop``    (the serving front
-  door's control-plane moments — sherman_tpu/serve.py)
+  ``serve.dispatch_error`` / ``serve.stop`` / ``serve.drain``
+  (the serving front door's control-plane moments —
+  sherman_tpu/serve.py)
+- ``audit.violation`` / ``audit.checker_error``   (the client-contract
+  linearizability auditor — sherman_tpu/audit.py; a violation also
+  auto-dumps the black box, the degraded-entry contract)
 
 Auto-dump: :func:`auto_dump` fires on degraded entry, typed-error
 raise, and watchdog expiry — but only when ``SHERMAN_BLACKBOX_DIR``

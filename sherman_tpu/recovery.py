@@ -101,9 +101,13 @@ class RecoveryPlane:
 
     def __init__(self, cluster, tree, eng, directory: str,
                  journal_sync: bool = True,
-                 group_commit_ms: float = 0.0):
+                 group_commit_ms: float = 0.0,
+                 ack_carry: int = 65536):
         if cluster.dsm.multihost:
             raise MultiprocessUnsupportedError("RecoveryPlane is single-process only")
+        #: exactly-once ack entries carried across journal rotations
+        #: (most-recent wins; bounds the re-forwarded window)
+        self.ack_carry = int(ack_carry)
         self.cluster = cluster
         self.tree = tree
         self.eng = eng
@@ -119,6 +123,11 @@ class RecoveryPlane:
         self.delta_paths: list[str] = []
         self._tip_epoch = None
         self._segment = 0
+        #: exactly-once window reconstructed by :meth:`recover` from
+        #: the journal's J_ACK records: {(tenant, rid): (op_kind, ok)}
+        #: in ack order — ``ShermanServer.seed_dedup`` adopts it so a
+        #: write retried across the crash re-acks its ORIGINAL result
+        self.dedup_window: dict = {}
         # host-memory accountant source (obs/device.py): total on-disk
         # bytes of the chain's artifacts (base + deltas + journals) as
         # ``device.host_checkpoints_bytes``; weakref-bound so a closed
@@ -189,19 +198,52 @@ class RecoveryPlane:
     # -- saving ---------------------------------------------------------------
 
     def _rotate_journal(self, k: int) -> None:
-        """Start journal segment ``k`` (ops after chain link ``k``) and
-        retire the previous segment — its ops are captured by the
-        artifact that was just made durable."""
+        """Start journal segment ``k`` (ops after chain link ``k``).
+        The previous segment's J_ACK records are NOT state (no
+        checkpoint captures them), so they are carried FORWARD into
+        the fresh segment — the exactly-once window stays
+        reconstructible across any number of rotations, bounded by
+        ``ack_carry`` most-recent entries.
+
+        Rotation does NOT delete retired segments — that is
+        :meth:`_sweep_retired_segments`, called only AFTER the chain
+        artifact covering their ops is durable.  Unlinking here would
+        open a crash window (rotate, crash before the save lands: the
+        retired ops exist nowhere on disk), while leaving an
+        overlapping segment merely replays redundantly — convergent
+        by the module contract."""
         old = self.eng.journal
-        self.eng.attach_journal(J.Journal(
+        fresh = J.Journal(
             self._journal_path(k), sync=self.journal_sync,
-            group_commit_ms=self.group_commit_ms))
+            group_commit_ms=self.group_commit_ms)
+        # attach BEFORE closing the old segment: a live dispatcher's
+        # appends race this rotation, and an append must always find
+        # an OPEN journal (old until the swap, fresh after)
+        self.eng.attach_journal(fresh)
         self._segment = k
         if old is not None:
             old.close()
+            try:
+                carry: dict = {}
+                for kind, _keys, aux in J.read_records(old.path):
+                    if kind == J.J_ACK:
+                        for rid, tenant, op, ok in aux:
+                            carry[(tenant, rid)] = (rid, tenant, op, ok)
+                acks = list(carry.values())[-self.ack_carry:] \
+                    if self.ack_carry > 0 else []
+                if acks:
+                    fresh.append_acks(acks)
+            except (OSError, J.JournalCorruptError):
+                pass  # an unreadable retiring segment loses only dedup
+                # coverage (retries re-apply idempotently), never state
+
+    def _sweep_retired_segments(self) -> None:
+        """Delete every journal segment other than the live one —
+        only once the chain artifact capturing their ops is DURABLE
+        (after a base/delta save, never at rotation time)."""
         for f in glob.glob(os.path.join(self.dir,
                                         f"journal-{self.cid}-*.wal")):
-            if f != self._journal_path(k):
+            if f != self._journal_path(self._segment):
                 try:
                     os.unlink(f)
                 except OSError:
@@ -217,25 +259,46 @@ class RecoveryPlane:
         self.delta_paths = []
         self._sweep_stale()
         self._rotate_journal(1)
+        # the base save above is already durable: retired segments of
+        # this chain (none on a fresh chain) can go now
+        self._sweep_retired_segments()
         obs.record_event("recovery.checkpoint_base", cid=self.cid,
                          bytes=os.path.getsize(self.base_path))
         return {"path": self.base_path, "cid": self.cid,
                 "bytes": os.path.getsize(self.base_path)}
 
     def checkpoint_delta(self) -> dict:
-        """Delta link: only the pages written since the previous link,
-        then journal rotation.  Falls back to :meth:`checkpoint_base`
-        when no chain exists yet."""
+        """Delta link: journal rotation, THEN only the pages written
+        since the previous link.  Falls back to :meth:`checkpoint_base`
+        when no chain exists yet.
+
+        Rotation runs FIRST — the live-dispatcher ordering (PR 15): an
+        op racing this checkpoint then lands in the NEW segment and
+        replays convergently over the link (redundant, never wrong —
+        the module docstring's overlap rule).  Rotating after the
+        snapshot instead would let a racing op apply after the
+        snapshot yet journal into the RETIRING segment — silent
+        RPO > 0 under a concurrent writer (the serving front door's
+        whole shape) once that segment is swept.  The retired segment
+        is deleted only AFTER the delta artifact is durable: a crash
+        in between leaves BOTH segments on disk, and recover() replays
+        the overlap convergently — never a window where the retired
+        ops exist nowhere.  ``checkpoint_base`` still requires a
+        quiesced writer stream (its rotation needs the new chain id,
+        which only exists after the save)."""
         if self.cid is None:
             return self.checkpoint_base()
-        self.eng.flush_parents()
         k = len(self.delta_paths) + 1
+        self._rotate_journal(k + 1)
+        self.eng.flush_parents()
         path = self._delta_path(k)
         info = CK.checkpoint_delta(self.cluster, path,
                                    parent_epoch=self._tip_epoch)
         self.delta_paths.append(path)
         self._tip_epoch = info["epoch"]
-        self._rotate_journal(k + 1)
+        # the delta (capturing every op in the retired segment) is
+        # durable: NOW the retired segment can go
+        self._sweep_retired_segments()
         info["path"] = path
         obs.record_event("recovery.checkpoint_delta", cid=self.cid,
                          link=k, pages=int(info.get("pages", -1)))
@@ -283,9 +346,13 @@ class RecoveryPlane:
                         "deletes": 0, "segments": 0}
         # replay ALL live-chain segments ascending: in-order replay is
         # convergent, so a segment overlapping its checkpoint (crash
-        # between save and rotation) is redundant, never wrong
+        # between save and rotation) is redundant, never wrong.  J_ACK
+        # records ride along into the ack sink — the exactly-once
+        # window reconstruction (later acks override earlier, matching
+        # the front door's own last-writer window semantics).
+        acks: list = []
         for seg in journals:
-            st = J.replay(seg, eng)
+            st = J.replay(seg, eng, ack_sink=acks)
             for k2, v in st.items():
                 replay_stats[k2] = replay_stats.get(k2, 0) + v
             replay_stats["segments"] += 1
@@ -293,6 +360,8 @@ class RecoveryPlane:
         plane = cls(cluster, tree, eng, directory,
                     journal_sync=journal_sync,
                     group_commit_ms=group_commit_ms)
+        for rid, tenant, op, ok in acks:
+            plane.dedup_window[(tenant, rid)] = (op, ok)
         plane.checkpoint_base()  # re-base: fresh chain, stale cid swept
         t_end = time.perf_counter()
         _OBS_RECOVERS.inc()
@@ -474,10 +543,15 @@ class RecoveryPlane:
                             "documented exit")
                     resurrected = int(miss.size)
                     _OBS_RESURRECTED.inc(resurrected)
-            replay_stats = J.replay(self._journal_path(self._segment),
-                                    self.eng) \
-                if os.path.exists(self._journal_path(self._segment)) \
-                else {"records": 0, "rows": 0}
+            if os.path.exists(self._journal_path(self._segment)):
+                acks: list = []
+                replay_stats = J.replay(
+                    self._journal_path(self._segment), self.eng,
+                    ack_sink=acks)
+                for rid, tenant, op, ok in acks:
+                    self.dedup_window[(tenant, rid)] = (op, ok)
+            else:
+                replay_stats = {"records": 0, "rows": 0}
         finally:
             # reopen the segment for appends (replay only truncated torn
             # tails; the records themselves stay — recovery replays them

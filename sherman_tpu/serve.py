@@ -74,27 +74,62 @@ enqueue):
   default ``slo.`` tracker via ``obs.slo.observe`` — the controller
   consumes both.
 
+- **Client contract** (PR 15 — the exactly-once / deadline / audit
+  plane):
+
+  - *exactly-once writes*: a write submitted with a client-assigned
+    request id (``rid``) is applied AT MOST once no matter how often
+    it is retried — a bounded per-tenant dedup window caches each
+    acked rid's result (retry -> the ORIGINAL result re-acked, never a
+    re-apply that could stomp a newer write), an in-flight rid returns
+    the SAME future, and the window itself is journaled
+    (``J_ACK`` batch records, appended post-apply pre-ack under the
+    same fsync gate) so ``RecoveryPlane.recover`` reconstructs it
+    across a cold crash (:meth:`ShermanServer.seed_dedup`);
+  - *deadlines*: ``submit(..., deadline_ms=...)`` attaches a budget;
+    requests still queued past it are shed BEFORE dispatch with the
+    typed :class:`DeadlineExceededError` — never silently served
+    late.  (A request dispatched before expiry completes normally:
+    in-flight work is not cancelled.)
+  - *retries*: :class:`RetryPolicy` / :class:`RetryingClient` — capped
+    exponential backoff with jitter on typed backpressure, read-only
+    hedging after the tracker's p99, and writes retried ONLY under a
+    request id (a retry without one could double-apply, so the client
+    refuses to guess);
+  - *graceful drain*: :meth:`ShermanServer.drain` — stop admitting,
+    serve everything admitted, push a final covering fsync, stop:
+    acked-but-unflushed is impossible by construction;
+  - *the auditor*: an attached :class:`~sherman_tpu.audit.Auditor`
+    records sampled per-key invocation/response events on the
+    completion path and checks the acked history linearizable-per-key
+    in the background (violations flight-record + black-box dump; the
+    inline cost is self-timed and pinned < 2%).
+
 Knobs (documented in the README knob table): ``SHERMAN_SERVE_WIDTHS``
 (the ladder), ``SHERMAN_SERVE_P99_MS`` (per-class targets, e.g. ``50``
 or ``read:20,insert:200``), ``SHERMAN_SERVE_QUEUE_OPS`` (admission
 capacity), ``SHERMAN_SERVE_GROUP_COMMIT_MS`` (journal group commit for
-the attached write-ahead journal).
+the attached write-ahead journal), ``SHERMAN_SERVE_WEIGHTS`` (weighted
+per-tenant shares, e.g. ``gold:2,free:1``), ``SHERMAN_SERVE_DEDUP``
+(per-tenant exactly-once window, requests).
 
 Not promised: cross-request ordering.  Requests are independent — a
 read admitted after a write may be served from the pre-write snapshot
 (the engine's step-boundary linearization); per-key read-your-write
 holds only once the write's future resolved before the read was
-submitted.
+submitted.  The auditor checks exactly this model (single-key, no
+cross-key claims) — see the :mod:`sherman_tpu.audit` docstring.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import threading
 import time
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -106,11 +141,13 @@ from sherman_tpu.models.batched import DegradedError
 from sherman_tpu.obs import device as DEV
 from sherman_tpu.obs import recorder as FR
 from sherman_tpu.obs import slo as SLO
+from sherman_tpu.utils import journal as J
 from sherman_tpu.workload.device_prep import make_ingress_step
 
 __all__ = [
-    "ServeOverloadError", "ServeConfig", "ServeFuture", "WidthController",
-    "ShermanServer", "READ_CLASSES", "WRITE_CLASSES", "OP_CLASSES",
+    "ServeOverloadError", "DeadlineExceededError", "ServeConfig",
+    "ServeFuture", "WidthController", "ShermanServer", "RetryPolicy",
+    "RetryingClient", "READ_CLASSES", "WRITE_CLASSES", "OP_CLASSES",
 ]
 
 READ_CLASSES = ("read", "scan")
@@ -127,9 +164,41 @@ class ServeOverloadError(ShermanError, RuntimeError):
     drop."""
 
 
+class DeadlineExceededError(ShermanError, RuntimeError):
+    """Typed deadline shed: the request's budget expired while it was
+    still QUEUED, so it was removed before dispatch — a deadline the
+    front door cannot meet is reported, never silently served late.
+    (Requests already dispatched when the budget expires complete
+    normally; in-flight device work is not cancelled.)"""
+
+
 # ---------------------------------------------------------------------------
 # Config
 # ---------------------------------------------------------------------------
+
+
+def _env_weights() -> dict:
+    """``SHERMAN_SERVE_WEIGHTS``: weighted per-tenant admission shares,
+    ``tenant:weight`` pairs (``gold:2,free:1``).  Unlisted tenants
+    weigh 1.0 — the max-min fair share generalizes to weighted max-min
+    (a 2:1 split holds 2/3 vs 1/3 of the queue under contention)."""
+    v = os.environ.get("SHERMAN_SERVE_WEIGHTS", "")
+    out: dict[str, float] = {}
+    if not v.strip():
+        return out
+    try:
+        for part in v.split(","):
+            name, w = part.split(":")
+            out[name.strip()] = float(w)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_SERVE_WEIGHTS={v!r}: want tenant:weight pairs")
+    for name, w in out.items():
+        if w <= 0:
+            raise ConfigError(
+                f"SHERMAN_SERVE_WEIGHTS tenant {name!r}: want a "
+                "positive weight")
+    return out
 
 def _env_widths() -> tuple[int, ...]:
     """``SHERMAN_SERVE_WIDTHS``: comma-separated step-width ladder of
@@ -216,6 +285,12 @@ class ServeConfig:
     #: and a second Python thread pays the GIL tax; the chip capture
     #: (real fsync stalls, free cores) is queued in BENCHMARKS.md.
     write_lane: bool = False
+    #: weighted per-tenant admission shares (tenant -> weight; unlisted
+    #: tenants weigh 1.0) — weighted max-min fair share
+    tenant_weights: dict = dataclasses.field(default_factory=_env_weights)
+    #: exactly-once dedup window per tenant, in write REQUESTS (rids);
+    #: 0 disables the contract plane entirely
+    dedup_window: int = 4096
     #: p99 model: est_p99(W) = model_mult x measured wall(W) (formation
     #: wait + service; the open-loop 1.5x-span model plus slack)
     model_mult: float = 2.0
@@ -243,6 +318,7 @@ class ServeConfig:
         gc = os.environ.get("SHERMAN_SERVE_GROUP_COMMIT_MS")
         q = os.environ.get("SHERMAN_SERVE_QUEUE_OPS")
         wl = os.environ.get("SHERMAN_SERVE_WRITE_LANE")
+        dd = os.environ.get("SHERMAN_SERVE_DEDUP")
         kw: dict = {}
         if gc is not None:
             kw["group_commit_ms"] = float(gc)
@@ -251,6 +327,8 @@ class ServeConfig:
         if wl is not None:
             kw["write_lane"] = wl.strip().lower() not in (
                 "", "0", "false", "off", "no")
+        if dd is not None:
+            kw["dedup_window"] = int(dd)
         kw.update(overrides)
         return cls(**kw)
 
@@ -262,17 +340,22 @@ class ServeConfig:
 class ServeFuture:
     """Completion handle for one submitted request.  ``result()``
     blocks until the ack and re-raises the typed error when the
-    request failed in flight (degraded write shed, dispatcher
-    failure)."""
+    request failed in flight (degraded write shed, deadline shed,
+    dispatcher failure).  ``deduped`` marks a result re-acked from the
+    exactly-once window (the original ack, not a re-apply)."""
 
-    __slots__ = ("op", "tenant", "n_ops", "t_submit", "_ev", "_result",
-                 "_error")
+    __slots__ = ("op", "tenant", "n_ops", "t_submit", "rid", "deadline",
+                 "deduped", "_ev", "_result", "_error")
 
-    def __init__(self, op: str, tenant: str, n_ops: int):
+    def __init__(self, op: str, tenant: str, n_ops: int,
+                 rid=None, deadline: float | None = None):
         self.op = op
         self.tenant = tenant
         self.n_ops = n_ops
         self.t_submit = time.perf_counter()
+        self.rid = rid
+        self.deadline = deadline
+        self.deduped = False
         self._ev = threading.Event()
         self._result = None
         self._error: BaseException | None = None
@@ -454,15 +537,29 @@ class WidthController:
 
 class _TenantState:
     __slots__ = ("queues", "queued_ops", "admitted_ops", "served_ops",
-                 "rejected_overload", "rejected_degraded")
+                 "rejected_overload", "rejected_degraded", "weight",
+                 "reserve", "dedup", "pending", "dedup_hits",
+                 "deadline_shed")
 
-    def __init__(self):
+    def __init__(self, weight: float = 1.0, reserve: float = 2.0):
         self.queues = {cls: deque() for cls in OP_CLASSES}
         self.queued_ops = 0
         self.admitted_ops = 0
         self.served_ops = 0
         self.rejected_overload = 0
         self.rejected_degraded = 0
+        #: weighted max-min share inputs: this tenant's weight, and the
+        #: floor denominator (own weight + the heaviest OTHER tenant's
+        #: weight — a lone flooder must always leave a newcomer's share
+        #: free, the un-weighted rule's `max(2, active)` generalized)
+        self.weight = weight
+        self.reserve = reserve
+        #: exactly-once plane: acked results keyed by rid (bounded
+        #: ring) + in-flight rids (a retry joins the SAME future)
+        self.dedup: OrderedDict = OrderedDict()
+        self.pending: dict = {}
+        self.dedup_hits = 0
+        self.deadline_shed = 0
 
 
 class ShermanServer:
@@ -484,9 +581,12 @@ class ShermanServer:
     """
 
     def __init__(self, eng, config: ServeConfig | None = None, *,
-                 journal=None, value_heap=None):
+                 journal=None, value_heap=None, auditor=None):
         self.eng = eng
         self.cfg = config or ServeConfig.from_env()
+        #: optional sampling history auditor (sherman_tpu/audit.py):
+        #: fed on the completion paths, checked in the background
+        self.auditor = auditor
         if eng.router is None:
             raise ConfigError("ShermanServer: attach_router() first")
         self.journal = journal
@@ -537,6 +637,12 @@ class ShermanServer:
         self.rejected_overload = 0
         self.rejected_degraded = 0
         self.dispatch_errors = 0
+        # client-contract counters
+        self.dedup_hits = 0        # retries re-acked from the window
+        self.deadline_shed = 0     # queued requests shed typed at expiry
+        self.duplicate_applies = 0  # window misses that re-applied an
+        # already-acked rid (the exactly-once invariant: must stay 0 —
+        # both guards would have to fail for it to move)
         self.calibration: dict[int, dict] = {}
         ref = weakref.ref(self)
 
@@ -566,19 +672,31 @@ class ShermanServer:
         st.served_ops += n
         self.served_ops += n
 
+    def _note_dedup_hit(self, st: _TenantState) -> None:
+        st.dedup_hits += 1
+        self.dedup_hits += 1
+
+    def _note_deadline_shed(self, st: _TenantState) -> None:
+        st.deadline_shed += 1
+        self.deadline_shed += 1
+
     # -- admission -----------------------------------------------------------
 
     def _tenant(self, tenant: str) -> _TenantState:
         st = self._tenants.get(tenant)
         if st is None:
-            st = _TenantState()
+            w = float(self.cfg.tenant_weights.get(tenant, 1.0))
+            others = [float(v) for k, v in self.cfg.tenant_weights.items()
+                      if k != tenant]
+            st = _TenantState(weight=w, reserve=w + max(others + [1.0]))
             self._tenants[tenant] = st
             self._rr.append(tenant)
         return st
 
     def submit(self, op: str, keys=None, values=None, *,
                tenant: str = "default", ranges=None, payloads=None,
-               resolve_payloads: bool = False) -> ServeFuture:
+               resolve_payloads: bool = False, rid=None,
+               deadline_ms: float | None = None) -> ServeFuture:
         """Admit one request (typed backpressure; see the module
         docstring).  ``keys`` uint64 for read/insert/delete (+
         ``values`` for insert); ``ranges`` [(lo, hi), ...] for scan.
@@ -593,7 +711,17 @@ class ShermanServer:
         resolves its answers' handles behind the same ingress step and
         its ``result()`` is ``(payloads list[bytes|None], found)``; a
         scan with ``resolve_payloads=True`` returns
-        ``[(keys, payloads)]`` per range."""
+        ``[(keys, payloads)]`` per range.
+
+        Client contract: ``rid`` (a client-assigned u64 request id on a
+        WRITE) arms exactly-once — an already-acked rid returns a
+        resolved future carrying the ORIGINAL result (``fut.deduped``),
+        an in-flight rid returns the same future, and the dedup check
+        runs BEFORE every backpressure gate (a retrying client must be
+        able to learn its write landed even under brownout/degraded).
+        ``deadline_ms`` attaches a budget; a request still queued past
+        it fails typed with :class:`DeadlineExceededError` instead of
+        being served late."""
         if op not in OP_CLASSES:
             raise ConfigError(f"submit op {op!r}: want one of "
                               f"{OP_CLASSES}")
@@ -650,7 +778,14 @@ class ShermanServer:
                     if values.shape != keys.shape:
                         raise ConfigError(
                             "insert needs one value per key")
-        fut = ServeFuture(op, tenant, n)
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ConfigError(
+                f"deadline_ms={deadline_ms}: want a positive budget")
+        if rid is not None:
+            rid = int(rid)
+        deadline = (time.perf_counter() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        fut = ServeFuture(op, tenant, n, rid=rid, deadline=deadline)
         with self._lock:
             if not self._running:
                 # re-check under the lock: a stop() racing the
@@ -659,6 +794,23 @@ class ShermanServer:
                 # would never be served OR failed
                 raise StateError("server not running (call start())")
             st = self._tenant(tenant)
+            # exactly-once: the dedup check runs BEFORE every
+            # backpressure gate — a retry of an acked write must learn
+            # its result even when a fresh write would be shed
+            if rid is not None and op in WRITE_CLASSES \
+                    and self.cfg.dedup_window > 0:
+                cached = st.dedup.get(rid)
+                if cached is not None:
+                    self._note_dedup_hit(st)
+                    fut.deduped = True
+                    fut._set(np.array(cached[1]))
+                    return fut
+                pend = st.pending.get(rid)
+                if pend is not None:
+                    # the original is still in flight: the retry joins
+                    # it (one apply, one ack, shared by both callers)
+                    self._note_dedup_hit(st)
+                    return pend
             if op in WRITE_CLASSES:
                 reason = self.eng.degraded_reason
                 if reason is not None:
@@ -673,30 +825,39 @@ class ShermanServer:
                         "write shed (brownout): queue at "
                         f"{self._queued_ops}/{self.cfg.max_queue_ops} "
                         "ops; retry with backoff")
-            # max-min fair share: a tenant may hold at most
-            # capacity / active_tenants queued ops, so a greedy tenant
-            # saturates its own share and gets typed rejects while
-            # polite tenants keep admitting into theirs.  The divisor
-            # floors at 2 — a lone flooder must never hold the WHOLE
-            # queue, or a newcomer's first request bounces off the
-            # total cap before fair sharing can even engage
-            active = sum(1 for t in self._tenants.values()
-                         if t.queued_ops > 0)
+            # WEIGHTED max-min fair share: a tenant may hold at most
+            # capacity * w / W queued ops, W = the total weight of
+            # active tenants (so a greedy tenant saturates its own
+            # share and gets typed rejects while polite tenants keep
+            # admitting into theirs, proportionally to their weights).
+            # The denominator floors at this tenant's weight + the
+            # heaviest other's (st.reserve) — a lone flooder must never
+            # hold the WHOLE queue, or a newcomer's first request
+            # bounces off the total cap before fair sharing can even
+            # engage (the un-weighted rule's `max(2, active)`,
+            # generalized; identical shares when every weight is 1)
+            active_w = sum(t.weight for t in self._tenants.values()
+                           if t.queued_ops > 0)
             if st.queued_ops == 0:
-                active += 1
-            share = max(1, self.cfg.max_queue_ops // max(2, active))
+                active_w += st.weight
+            share = max(1, int(self.cfg.max_queue_ops * st.weight
+                               / max(st.reserve, active_w)))
             if self._queued_ops + n > self.cfg.max_queue_ops \
                     or st.queued_ops + n > share:
                 self._note_reject_overload(st)
                 raise ServeOverloadError(
                     f"queue full (tenant {tenant!r}: "
-                    f"{st.queued_ops}+{n} of fair share {share}; "
+                    f"{st.queued_ops}+{n} of fair share {share} "
+                    f"at weight {st.weight}; "
                     f"total {self._queued_ops}/"
                     f"{self.cfg.max_queue_ops} ops)")
             st.queues[op].append(
                 _Request(fut, keys=keys, values=values, ranges=ranges,
                          payloads=payloads,
                          resolve_payloads=resolve_payloads))
+            if rid is not None and op in WRITE_CLASSES \
+                    and self.cfg.dedup_window > 0:
+                st.pending[rid] = fut
             self._note_admit(st, n)
             if op in WRITE_CLASSES:
                 self._queued_write_ops += n
@@ -749,6 +910,8 @@ class ShermanServer:
                        for w, c in self.calibration.items()})
         self._running = True
         self._draining = False
+        if self.auditor is not None:
+            self.auditor.start()
         self._thread = threading.Thread(target=self._loop,
                                         name="sherman-serve",
                                         daemon=True)
@@ -871,10 +1034,28 @@ class ShermanServer:
             self._thread.join(timeout)
         if self._wthread is not None:
             self._wthread.join(timeout)
+        if self.auditor is not None:
+            self.auditor.stop()  # final drain-all checker tick
         if self._sealed:
             DEV.get_ledger().unseal()
             self._sealed = False
         FR.record_event("serve.stop", served_ops=self.served_ops,
+                        acked_writes=self.acked_writes)
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Graceful drain: stop admitting, serve everything already
+        admitted (futures resolve or fail typed), push one final
+        covering fsync on the attached journal, then stop.  After
+        ``drain()`` returns, acked-but-unflushed is impossible by
+        construction: every write ack already gated on a covering
+        fsync, and the epilogue fsync closes the ``sync=False``
+        window too."""
+        self.stop(drain=True, timeout=timeout)
+        jrn = self.journal if self.journal is not None \
+            else getattr(self.eng, "journal", None)
+        if jrn is not None:
+            jrn.sync_now()  # no-op on a closed journal (its own guard)
+        FR.record_event("serve.drain", served_ops=self.served_ops,
                         acked_writes=self.acked_writes)
 
     def kill(self) -> None:
@@ -883,6 +1064,41 @@ class ShermanServer:
         leaves behind.  Every acked write is already covered by an
         fsync (the ack gate), so recovery replays to RPO 0."""
         self.stop(drain=False, timeout=5.0)
+
+    def attach_auditor(self, auditor) -> None:
+        """Attach (or detach, with None) the sampling history auditor;
+        started/stopped with the server when attached before
+        :meth:`start`."""
+        self.auditor = auditor
+
+    def seed_dedup(self, window, rejournal: bool = True) -> int:
+        """Adopt a recovered exactly-once window
+        (``RecoveryPlane.recover``'s ``plane.dedup_window``:
+        ``{(tenant, rid): (op_kind, ok array)}``, in ack order) — a
+        write retried across the cold crash then re-acks its ORIGINAL
+        result instead of re-applying.  ``rejournal`` (default) writes
+        the adopted window back into the live journal segment as one
+        J_ACK record: recovery re-bases onto a fresh chain (the old
+        segments' ack records are swept), so without it a SECOND crash
+        would forget the window.  Returns entries adopted."""
+        n = 0
+        acks = []
+        with self._lock:
+            for (tenant, rid), (opcode, ok) in window.items():
+                st = self._tenant(tenant)
+                st.dedup[int(rid)] = (int(opcode), np.array(ok))
+                st.dedup.move_to_end(int(rid))
+                while len(st.dedup) > max(1, self.cfg.dedup_window):
+                    st.dedup.popitem(last=False)
+                acks.append((int(rid), tenant, int(opcode),
+                             np.array(ok)))
+                n += 1
+        if rejournal and acks:
+            jrn = self.journal if self.journal is not None \
+                else getattr(self.eng, "journal", None)
+            if jrn is not None:
+                jrn.append_acks(acks)
+        return n
 
     @property
     def retraces(self) -> int:
@@ -947,10 +1163,22 @@ class ShermanServer:
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     raise
         # shutdown: drain the pipeline, wait out the write lane (its
-        # own drain loop exits on the same flags), then fail the rest
+        # own drain loop exits on the same flags), then fail the rest.
+        # A graceful drain completes in-flight slots with full
+        # semantics; a kill() abandons them through the ingress step's
+        # drain hook (materialize-and-answer WITHOUT straggler rescue —
+        # a crashing teardown must not launch fresh root descents)
         for slot in pend:
             try:
-                self._complete_read(slot)
+                if self._draining:
+                    self._complete_read(slot)
+                else:
+                    width, reqs, handle, _t0, tok = slot
+                    self._fail_batch(reqs, StateError(
+                        "server killed with the batch in flight"))
+                    self._steps[width].drain(handle)
+                    if tok is not None and self.auditor is not None:
+                        self.auditor.end_ops(tok)
             except BaseException:  # noqa: BLE001
                 pass
         if self._wthread is not None and self._wthread.is_alive() \
@@ -996,6 +1224,8 @@ class ShermanServer:
                             self._queued_write_ops -= n
                         elif req.fut.op == "read":
                             self._queued_read_ops -= n
+                        if req.fut.rid is not None:
+                            st.pending.pop(req.fut.rid, None)
                         req.fut._fail(err)
 
     def _check_degraded_transition(self) -> None:
@@ -1016,6 +1246,8 @@ class ShermanServer:
                             self._queued_write_ops -= n
                             st.rejected_degraded += 1
                             self.rejected_degraded += 1
+                            if req.fut.rid is not None:
+                                st.pending.pop(req.fut.rid, None)
                             req.fut._fail(DegradedError(reason))
             FR.record_event("serve.brownout_enter", degraded=True,
                             reason=reason)
@@ -1023,11 +1255,35 @@ class ShermanServer:
             FR.record_event("serve.brownout_exit", degraded=True)
         self._was_degraded = deg
 
+    def _shed_expired(self, st: _TenantState, q, now: float) -> None:
+        """Deadline shed at the queue head: a request whose budget
+        expired while queued fails typed BEFORE dispatch — the
+        contract's 'never silently served late' half.  Runs inside the
+        admission lock on the dispatch path (registered SL001 scope:
+        plain pops and adds, no device work)."""
+        while q and q[0].fut.deadline is not None \
+                and q[0].fut.deadline < now:
+            req = q.popleft()
+            n = req.fut.n_ops
+            st.queued_ops -= n
+            self._queued_ops -= n
+            if req.fut.op in WRITE_CLASSES:
+                self._queued_write_ops -= n
+            elif req.fut.op == "read":
+                self._queued_read_ops -= n
+            if req.fut.rid is not None:
+                st.pending.pop(req.fut.rid, None)
+            self._note_deadline_shed(st)
+            req.fut._fail(DeadlineExceededError(
+                "deadline expired while queued; shed before dispatch"))
+
     def _take(self, classes, budget_ops: int) -> list[_Request]:
         """Pop up to ``budget_ops`` ops of the given classes —
         round-robin across tenants (max-min fair service), FIFO within
-        a tenant, whole requests only (no mid-request splits)."""
+        a tenant, whole requests only (no mid-request splits).
+        Expired heads are deadline-shed typed as they surface."""
         out: list[_Request] = []
+        now = time.perf_counter()
         with self._lock:
             if not self._rr:
                 return out
@@ -1040,6 +1296,7 @@ class ShermanServer:
                 got = False
                 for cls in classes:
                     q = st.queues[cls]
+                    self._shed_expired(st, q, now)
                     if q and q[0].fut.n_ops <= took:
                         req = q.popleft()
                         n = req.fut.n_ops
@@ -1089,14 +1346,22 @@ class ShermanServer:
             return None
         keys = np.concatenate([r.keys for r in reqs]) \
             if len(reqs) > 1 else reqs[0].keys
+        # auditor intent for the whole flight: a pipelined read records
+        # its events a full iteration after dispatch — the checker's
+        # cut must not close a window over it meanwhile
+        tok = self.auditor.begin_ops(
+            min(r.fut.t_submit for r in reqs)) \
+            if self.auditor is not None else None
         t0 = time.perf_counter()
         try:
             handle = self._steps[width].dispatch(keys)
         except BaseException as e:  # noqa: BLE001 — the batch's futures
             # must carry the failure; the loop keeps serving
             self._fail_batch(reqs, e)
+            if tok is not None:
+                self.auditor.end_ops(tok)
             return None
-        return (width, reqs, handle, t0)
+        return (width, reqs, handle, t0, tok)
 
     def _fail_batch(self, reqs, e: BaseException) -> None:
         self.dispatch_errors += 1
@@ -1104,12 +1369,25 @@ class ShermanServer:
             else StateError(f"serve dispatch failed: {e!r}")
         FR.record_event("serve.dispatch_error", error=repr(e))
         for r in reqs:
-            r.fut._fail(err)
+            if r.fut.rid is not None:
+                with self._lock:
+                    st = self._tenants.get(r.fut.tenant)
+                    if st is not None:
+                        st.pending.pop(r.fut.rid, None)
+            if not r.fut.done():  # a deduped re-ack already resolved
+                r.fut._fail(err)
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
             raise e
 
     def _complete_read(self, slot) -> None:
-        width, reqs, handle, t0 = slot
+        width, reqs, handle, t0, tok = slot
+        try:
+            self._complete_read_inner(width, reqs, handle, t0)
+        finally:
+            if tok is not None and self.auditor is not None:
+                self.auditor.end_ops(tok)
+
+    def _complete_read_inner(self, width, reqs, handle, t0) -> None:
         try:
             vals, found = self._steps[width].complete(handle)
         except BaseException as e:  # noqa: BLE001
@@ -1145,6 +1423,9 @@ class ShermanServer:
                 return
         off = 0
         oldest = t1
+        # auditor feed: u64-register reads only (handle-bearing heap
+        # reads are outside the register model — see audit.py)
+        aud = self.auditor if self.value_heap is None else None
         for req in reqs:
             m = req.fut.n_ops
             try:
@@ -1169,6 +1450,10 @@ class ShermanServer:
             # governs, attributed per REQUEST (the client's unit of
             # experience) weighted by its ops
             self.tracker.observe("read", m, t1 - req.fut.t_submit)
+            if aud is not None:
+                aud.observe_read(req.keys, vals[off:off + m],
+                                 found[off:off + m],
+                                 req.fut.t_submit, t1)
             if req.fut.t_submit < oldest:
                 oldest = req.fut.t_submit
             st = self._tenants[req.fut.tenant]
@@ -1234,12 +1519,107 @@ class ShermanServer:
                 (time.perf_counter() - oldest) * 1e3 \
                 >= self.cfg.write_linger_ms
 
+    def _split_deduped(self, reqs):
+        """Dispatch-side exactly-once guard: re-ack any popped request
+        whose rid already sits in the window (a retry admitted before
+        :meth:`seed_dedup` ran, or a racing duplicate) and return the
+        remainder.  Applying such a request would be a duplicate apply
+        — the exact bug the contract plane exists to kill — so it is
+        counted ``duplicate_applies``-adjacent only if BOTH guards
+        miss (which this one makes structurally impossible)."""
+        if self.cfg.dedup_window <= 0:
+            return reqs
+        out = []
+        for r in reqs:
+            rid = r.fut.rid
+            if rid is not None:
+                with self._lock:
+                    st = self._tenant(r.fut.tenant)
+                    cached = st.dedup.get(rid)
+                    if cached is not None:
+                        self._note_dedup_hit(st)
+                        st.pending.pop(rid, None)
+                        r.fut.deduped = True
+                        r.fut._set(np.array(cached[1]))
+                        continue
+            out.append(r)
+        return out
+
+    def _ack_batch(self, reqs, results, opcode: int) -> None:
+        """Journal + cache a write batch's exactly-once results —
+        post-apply, PRE-ack: called before any of the batch's futures
+        resolve, under the same durability gate as the engine record
+        (one ``J_ACK`` frame covers every rid the flush coalesced; a
+        raising append fails the whole batch, so no ack can outrun its
+        record)."""
+        if self.cfg.dedup_window <= 0:
+            return
+        acks = [(r.fut.rid, r.fut.tenant, opcode, res)
+                for r, res in zip(reqs, results)
+                if r.fut.rid is not None]
+        if not acks:
+            return
+        jrn = self.journal if self.journal is not None \
+            else getattr(self.eng, "journal", None)
+        if jrn is not None:
+            try:
+                jrn.append_acks(acks)
+            except StateError:
+                # a checkpoint rotation swapped the engine's journal
+                # between this flush's engine record and its ack
+                # record: re-read once and land the acks in the fresh
+                # segment (same durability gate)
+                jrn2 = self.journal if self.journal is not None \
+                    else getattr(self.eng, "journal", None)
+                if jrn2 is None or jrn2 is jrn:
+                    raise
+                jrn2.append_acks(acks)
+        with self._lock:
+            for r, res in zip(reqs, results):
+                rid = r.fut.rid
+                if rid is None:
+                    continue
+                st = self._tenant(r.fut.tenant)
+                st.dedup[rid] = (opcode, np.array(res))
+                st.dedup.move_to_end(rid)
+                while len(st.dedup) > self.cfg.dedup_window:
+                    st.dedup.popitem(last=False)
+                st.pending.pop(rid, None)
+
+    def _audit_writes(self, op: int, reqs, results, t1: float,
+                      with_values: bool) -> None:
+        """Feed the attached auditor one completed write batch (sampled
+        per-key events; u64-value writes only — payload writes are
+        outside the auditor's register model)."""
+        aud = self.auditor
+        if aud is None:
+            return
+        for r, res in zip(reqs, results):
+            aud.observe_write(op, r.keys, r.fut.t_submit, t1,
+                              values=r.values if with_values else None,
+                              ok=res if with_values else None)
+
     def _maybe_flush_writes(self) -> bool:
         if not self._write_due():
             return False
-        reqs = self._take(WRITE_CLASSES, self.cfg.write_width)
+        reqs = self._split_deduped(
+            self._take(WRITE_CLASSES, self.cfg.write_width))
         if not reqs:
             return False
+        # auditor intent: the flush is about to APPLY writes whose
+        # events only land in the ring after the ack (journal fsync in
+        # between) — the intent pins the checker's drain cut so reads
+        # observing these writes are never judged without them
+        tok = self.auditor.begin_ops(
+            min(r.fut.t_submit for r in reqs)) \
+            if self.auditor is not None else None
+        try:
+            return self._flush_writes(reqs)
+        finally:
+            if tok is not None:
+                self.auditor.end_ops(tok)
+
+    def _flush_writes(self, reqs) -> bool:
         hins = [r for r in reqs
                 if r.fut.op == "insert" and r.payloads is not None]
         ins = [r for r in reqs
@@ -1256,9 +1636,11 @@ class ShermanServer:
                 t1 = time.perf_counter()
                 hto = np.asarray(hst["lock_timeout_keys"], np.uint64) \
                     if hst["lock_timeouts"] else None
-                for r in hins:
-                    r.fut._set(np.ones(r.fut.n_ops, bool) if hto is None
-                               else ~np.isin(r.keys, hto))
+                results = [np.ones(r.fut.n_ops, bool) if hto is None
+                           else ~np.isin(r.keys, hto) for r in hins]
+                self._ack_batch(hins, results, J.J_HEAP_PUT)
+                for r, ok in zip(hins, results):
+                    r.fut._set(ok)
                     self.tracker.observe("insert", r.fut.n_ops,
                                          t1 - r.fut.t_submit)
                     self._note_served(self._tenants[r.fut.tenant],
@@ -1280,15 +1662,17 @@ class ShermanServer:
                 t1 = time.perf_counter()
                 to = np.asarray(stats["lock_timeout_keys"], np.uint64) \
                     if stats["lock_timeouts"] else None
-                for r in ins:
-                    ok = np.ones(r.fut.n_ops, bool) if to is None \
-                        else ~np.isin(r.keys, to)
+                results = [np.ones(r.fut.n_ops, bool) if to is None
+                           else ~np.isin(r.keys, to) for r in ins]
+                self._ack_batch(ins, results, J.J_UPSERT)
+                for r, ok in zip(ins, results):
                     r.fut._set(ok)
                     self.tracker.observe("insert", r.fut.n_ops,
                                          t1 - r.fut.t_submit)
                     self._note_served(self._tenants[r.fut.tenant],
                                       r.fut.n_ops)
                     self.acked_writes += 1
+                self._audit_writes(1, ins, results, t1, True)
             except BaseException as e:  # noqa: BLE001 — a popped
                 # request's future must resolve even on non-Sherman
                 # failures (XLA runtime errors, OOM): _fail_batch
@@ -1304,15 +1688,20 @@ class ShermanServer:
                     if self.value_heap is not None \
                     else self.eng.delete(keys)
                 t1 = time.perf_counter()
-                off = 0
-                for r in dels:
-                    m = r.fut.n_ops
-                    r.fut._set(found[off:off + m])
-                    self.tracker.observe("delete", m,
+                results = [np.asarray(found[off:off + r.fut.n_ops])
+                           for off, r in zip(
+                               np.cumsum([0] + [r.fut.n_ops
+                                                for r in dels])[:-1],
+                               dels)]
+                self._ack_batch(dels, results, J.J_DELETE)
+                for r, fnd in zip(dels, results):
+                    r.fut._set(fnd)
+                    self.tracker.observe("delete", r.fut.n_ops,
                                          t1 - r.fut.t_submit)
-                    self._note_served(self._tenants[r.fut.tenant], m)
+                    self._note_served(self._tenants[r.fut.tenant],
+                                      r.fut.n_ops)
                     self.acked_writes += 1
-                    off += m
+                self._audit_writes(2, dels, results, t1, False)
             except BaseException as e:  # noqa: BLE001
                 self._fail_batch(dels, e)
         return True
@@ -1351,6 +1740,9 @@ class ShermanServer:
             "rejected_degraded": float(self.rejected_degraded),
             "brownout": 1.0 if self._brownout else 0.0,
             "retraces": float(self.retraces),
+            "dedup_hits": float(self.dedup_hits),
+            "deadline_shed": float(self.deadline_shed),
+            "duplicate_applies": float(self.duplicate_applies),
         })
         return flat
 
@@ -1366,8 +1758,21 @@ class ShermanServer:
                     "queued_ops": st.queued_ops,
                     "rejected_overload": st.rejected_overload,
                     "rejected_degraded": st.rejected_degraded,
+                    "weight": st.weight,
+                    "dedup_hits": st.dedup_hits,
+                    "deadline_shed": st.deadline_shed,
                 }
                 for name, st in self._tenants.items()
+            }
+            contract = {
+                "dedup_window": self.cfg.dedup_window,
+                "dedup_hits": self.dedup_hits,
+                "deadline_shed": self.deadline_shed,
+                "duplicate_applies": self.duplicate_applies,
+                "cached_rids": sum(len(st.dedup)
+                                   for st in self._tenants.values()),
+                "pending_rids": sum(len(st.pending)
+                                    for st in self._tenants.values()),
             }
         total_served = max(1, self.served_ops)
         for t in tenants.values():
@@ -1391,7 +1796,10 @@ class ShermanServer:
             "dispatch_errors": self.dispatch_errors,
             "sealed": self._sealed,
             "retraces": self.retraces,
+            "contract": contract,
         }
+        if self.auditor is not None:
+            out["audit"] = self.auditor.stats()
         if self.journal is not None:
             js = self.journal.stats()
             js["acks_per_fsync"] = (self.acked_writes / js["fsyncs"]
@@ -1404,3 +1812,178 @@ class ShermanServer:
         if self.value_heap is not None:
             out["value_heap"] = self.value_heap.stats()
         return out
+
+
+# ---------------------------------------------------------------------------
+# Client-side retry policy + hedging
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Client retry discipline against the front door's TYPED
+    backpressure (:class:`ServeOverloadError` — the only retryable
+    class by default; degraded/deadline rejects are policy decisions,
+    not transient congestion).
+
+    - capped exponential backoff with full jitter:
+      ``sleep ~ U(0, min(cap, base * 2^attempt))`` — the classic
+      thundering-herd antidote;
+    - **writes retry ONLY with a request id**: a blind write retry can
+      double-apply (the lost-update bug the dedup window kills), so a
+      rid-less write gets exactly one attempt;
+    - **read hedging**: after the tracker's observed p99 (times
+      ``hedge_mult``) with no answer, a duplicate read is submitted
+      and the first ack wins — tail-latency insurance that is safe
+      precisely because reads are idempotent.  Never applied to
+      writes.
+    """
+
+    max_attempts: int = 5
+    base_backoff_ms: float = 2.0
+    backoff_cap_ms: float = 200.0
+    hedge_reads: bool = True
+    hedge_mult: float = 3.0
+    #: hedge trigger floor when the tracker has no p99 yet
+    hedge_floor_ms: float = 25.0
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        cap = min(self.backoff_cap_ms,
+                  self.base_backoff_ms * (2.0 ** attempt))
+        return rng.uniform(0.0, cap) / 1e3
+
+
+class RetryingClient:
+    """One tenant's well-behaved client over a :class:`ShermanServer`:
+    assigns request ids to writes, applies :class:`RetryPolicy`, and
+    carries its own deadline default.  The contract drill's client
+    threads (and any embedding application) use this instead of raw
+    ``submit`` so retries are exactly-once by construction."""
+
+    def __init__(self, srv: ShermanServer, tenant: str = "default",
+                 policy: RetryPolicy | None = None, seed: int = 0,
+                 deadline_ms: float | None = None):
+        self.srv = srv
+        self.tenant = tenant
+        self.policy = policy or RetryPolicy()
+        self.deadline_ms = deadline_ms
+        self._rng = random.Random(seed)
+        # client-assigned request ids: unique per (client seed, op) —
+        # the exactly-once join key across retries AND across crashes
+        self._rid = (seed & 0xFFFF) << 48
+        self.retries = 0
+        self.hedges = 0
+        self.rejects = 0
+
+    def next_rid(self) -> int:
+        self._rid += 1
+        return self._rid
+
+    # -- reads ----------------------------------------------------------------
+
+    def _hedge_after_s(self) -> float:
+        w = self.srv.tracker.window().get("read") or {}
+        p99 = w.get("p99_ms") or 0.0
+        return max(self.policy.hedge_floor_ms,
+                   self.policy.hedge_mult * p99) / 1e3
+
+    def read(self, keys, deadline_ms=None):
+        """Submit-with-retry + hedging; returns ``(values, found)``.
+        Raises the last typed error when every attempt was rejected."""
+        pol = self.policy
+        deadline_ms = deadline_ms if deadline_ms is not None \
+            else self.deadline_ms
+        last: BaseException | None = None
+        for attempt in range(pol.max_attempts):
+            try:
+                fut = self.srv.submit("read", keys, tenant=self.tenant,
+                                      deadline_ms=deadline_ms)
+            except (ServeOverloadError, DegradedError) as e:
+                self.rejects += 1
+                last = e
+                self.retries += 1
+                time.sleep(pol.backoff_s(attempt, self._rng))
+                continue
+            if not pol.hedge_reads:
+                return fut.result(timeout=60)
+            try:
+                return fut.result(timeout=self._hedge_after_s())
+            except StateError:
+                pass  # primary still in flight past p99: hedge it
+            except DeadlineExceededError as e:
+                last = e
+                self.retries += 1
+                continue  # shed while queued: re-submit is safe
+            hedge = None
+            try:
+                hedge = self.srv.submit("read", keys,
+                                        tenant=self.tenant,
+                                        deadline_ms=deadline_ms)
+                self.hedges += 1
+            except (ServeOverloadError, DegradedError):
+                pass  # overloaded: the primary remains the only horse
+            # first ack wins (both are the same idempotent read)
+            while True:
+                for f in (fut, hedge):
+                    if f is not None and f.done():
+                        try:
+                            return f.result()
+                        except DeadlineExceededError as e:
+                            # shed copy: fall through to the other
+                            if f is fut:
+                                fut = None
+                            else:
+                                hedge = None
+                            last = e
+                            break
+                if fut is None and hedge is None:
+                    break
+                time.sleep(0.0005)
+            self.retries += 1
+        raise last if last is not None else StateError(
+            "read retries exhausted")
+
+    # -- writes (exactly-once: rid-gated retry) -------------------------------
+
+    def _write(self, op: str, keys, values=None, rid=None,
+               deadline_ms=None):
+        pol = self.policy
+        deadline_ms = deadline_ms if deadline_ms is not None \
+            else self.deadline_ms
+        if rid is None:
+            # no request id = no retry budget: a blind write retry can
+            # double-apply, which the client refuses to risk
+            fut = self.srv.submit(op, keys, values, tenant=self.tenant,
+                                  deadline_ms=deadline_ms)
+            return fut.result(timeout=60)
+        last: BaseException | None = None
+        for attempt in range(pol.max_attempts):
+            try:
+                fut = self.srv.submit(op, keys, values,
+                                      tenant=self.tenant, rid=rid,
+                                      deadline_ms=deadline_ms)
+                return fut.result(timeout=60)
+            except (ServeOverloadError, DeadlineExceededError) as e:
+                # both mean "never applied": the rid makes the
+                # re-submit exactly-once even if that ever changed
+                last = e
+                self.retries += 1
+                time.sleep(pol.backoff_s(attempt, self._rng))
+        raise last if last is not None else StateError(
+            f"{op} retries exhausted")
+
+    def insert(self, keys, values, rid=None, deadline_ms=None):
+        """Exactly-once insert: ``rid`` defaults to a fresh
+        client-assigned id (pass an explicit one to RETRY a prior
+        attempt across a timeout or a crash)."""
+        return self._write("insert", keys, values,
+                           rid=self.next_rid() if rid is None else rid,
+                           deadline_ms=deadline_ms)
+
+    def delete(self, keys, rid=None, deadline_ms=None):
+        return self._write("delete", keys,
+                           rid=self.next_rid() if rid is None else rid,
+                           deadline_ms=deadline_ms)
+
+    def stats(self) -> dict:
+        return {"retries": self.retries, "hedges": self.hedges,
+                "rejects": self.rejects}

@@ -20,7 +20,28 @@ or before their first record.
 Frame format (little-endian, after the 8-byte file magic)::
 
     [u32 length | u32 crc32(payload) | payload]
-    payload = u8 kind | u8 x 3 pad | u32 nrows | keys u64[n] (| vals u64[n])
+    payload = u8 kind | u8 flags | u8 x 2 pad | u32 nrows
+              (| rid u64 when flags & FLAG_RID)
+              | keys u64[n] (| vals u64[n])
+
+Format v2 (magic ``SHJRNL02``, PR 15): the second header byte is a
+FLAGS field; ``FLAG_RID`` marks a client request id (u64) riding the
+record — the exactly-once plane's join key (``sherman_tpu/serve.py``
+dedup window).  v1 segments (``SHJRNL01``) wrote that byte as zero
+pad, and readers decode them with flags forced to 0: old journals
+replay cleanly, just with no request ids — dedup is DISABLED for
+those segments (the client-contract back-compat rule).  Appends to a
+v1 segment keep writing v1 records (rid silently dropped, ack records
+refused as no-ops) so one segment never mixes formats.
+
+Ack records (``J_ACK``, v2 only): one frame carrying the CACHED
+RESULTS of a batch of client write requests — per ack ``(rid, tenant,
+op kind, ok-per-key bitmap)`` — appended by the serving front door
+after the engine batch record and BEFORE any future resolves (the
+same durability gate).  Replay hands them to ``ack_sink`` so
+``RecoveryPlane.recover`` reconstructs the exactly-once dedup window:
+a write retried across a cold crash re-acks its ORIGINAL result
+instead of re-applying.
 
 Torn-tail contract (crash mid-append): a frame that runs past EOF, or
 whose CRC fails **at the very tail**, is a partially flushed append —
@@ -57,15 +78,21 @@ import numpy as np
 from sherman_tpu import obs
 from sherman_tpu.errors import ConfigError, ShermanError, StateError
 
-MAGIC = b"SHJRNL01"
+MAGIC = b"SHJRNL02"      # format v2: flags byte + optional request id
+MAGIC_V1 = b"SHJRNL01"   # format v1: no flags (decoded with flags=0)
 _HDR = struct.Struct("<II")          # length, crc32(payload)
-_PAY = struct.Struct("<BxxxI")       # kind, nrows
+_PAY = struct.Struct("<BBxxI")       # kind, flags, nrows
+_ACK = struct.Struct("<QBBH")        # rid, op kind, tenant len, n_ops
+_RID = struct.Struct("<Q")
+
+FLAG_RID = 1     # payload carries a client request id after the header
 
 J_UPSERT = 1     # keys + values (engine insert / mixed write rows)
 J_DELETE = 2     # keys only
 J_HEAP_PUT = 3   # value-heap slab writes: keys + handles + payload blob
 J_HEAP_FREE = 4  # value-heap slab frees: keys + handles
-KINDS = (J_UPSERT, J_DELETE, J_HEAP_PUT, J_HEAP_FREE)
+J_ACK = 5        # client-contract ack batch: (rid, tenant, op, ok bits)
+KINDS = (J_UPSERT, J_DELETE, J_HEAP_PUT, J_HEAP_FREE, J_ACK)
 # kinds whose payload is keys + one u64 value lane (shared layout)
 _TWO_LANE = (J_UPSERT, J_HEAP_FREE)
 
@@ -103,21 +130,84 @@ class JournalSyncError(ShermanError, RuntimeError):
     (``RecoveryPlane._rotate_journal``) to resume."""
 
 
-def encode_record(kind: int, keys, values=None) -> bytes:
-    """One framed record (header + payload) for ``append``/tests."""
-    if kind not in KINDS or kind == J_HEAP_PUT:
+def encode_record(kind: int, keys, values=None, rid=None) -> bytes:
+    """One framed record (header + payload) for ``append``/tests.
+    ``rid`` (optional client request id, u64) rides the v2 flags
+    field — see the module docstring's exactly-once contract."""
+    if kind not in KINDS or kind in (J_HEAP_PUT, J_ACK):
         raise ConfigError(f"unknown journal record kind {kind}"
-                          if kind != J_HEAP_PUT else
-                          "J_HEAP_PUT records carry payload bytes: "
-                          "use encode_heap_record")
+                          if kind not in (J_HEAP_PUT, J_ACK) else
+                          "J_HEAP_PUT/J_ACK records have their own "
+                          "encoders: encode_heap_record / "
+                          "encode_ack_record")
     keys = np.ascontiguousarray(keys, np.uint64)
-    payload = _PAY.pack(kind, keys.size) + keys.tobytes()
+    flags = 0 if rid is None else FLAG_RID
+    payload = _PAY.pack(kind, flags, keys.size)
+    if rid is not None:
+        payload += _RID.pack(int(rid) & 0xFFFFFFFFFFFFFFFF)
+    payload += keys.tobytes()
     if kind in _TWO_LANE:
         values = np.ascontiguousarray(values, np.uint64)
         if values.shape != keys.shape:
             raise ConfigError("journal upsert needs one value per key")
         payload += values.tobytes()
     return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def encode_ack_record(acks) -> bytes:
+    """One framed ack-batch record: ``acks`` is a sequence of
+    ``(rid, tenant, op_kind, ok)`` with ``ok`` a bool array (one bit
+    per submitted op of the ORIGINAL request).  One frame covers every
+    client write a flush coalesced, so the exactly-once plane costs one
+    extra append (not one per request) per write batch."""
+    n = len(acks)
+    if n == 0 or n > 0xFFFFFFFF:
+        raise ConfigError(f"ack record wants 1..2^32-1 acks, got {n}")
+    payload = _PAY.pack(J_ACK, 0, n)
+    for rid, tenant, op, ok in acks:
+        tb = str(tenant).encode("utf-8")
+        if len(tb) > 255:
+            raise ConfigError(f"tenant name over 255 bytes: {tenant!r}")
+        ok = np.ascontiguousarray(ok, bool)
+        if ok.size > 0xFFFF:
+            raise ConfigError(
+                f"ack result of {ok.size} ops exceeds the u16 bound")
+        if op not in (J_UPSERT, J_DELETE, J_HEAP_PUT):
+            raise ConfigError(f"ack op kind {op}: want a write kind")
+        payload += _ACK.pack(int(rid) & 0xFFFFFFFFFFFFFFFF, op,
+                             len(tb), ok.size)
+        payload += tb + np.packbits(ok).tobytes()
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _decode_acks(body: bytes, n: int, off: int):
+    """-> [(rid, tenant, op_kind, ok bool[n_ops]), ...]"""
+    out = []
+    pos = 0
+    for _ in range(n):
+        if pos + _ACK.size > len(body):
+            raise JournalCorruptError(
+                f"journal record at byte {off}: ack batch overruns "
+                "its body")
+        rid, op, tlen, nops = _ACK.unpack_from(body, pos)
+        pos += _ACK.size
+        nbytes = (nops + 7) // 8
+        if pos + tlen + nbytes > len(body):
+            raise JournalCorruptError(
+                f"journal record at byte {off}: ack entry overruns "
+                "its body")
+        tenant = body[pos: pos + tlen].decode("utf-8")
+        pos += tlen
+        ok = np.unpackbits(
+            np.frombuffer(body[pos: pos + nbytes], np.uint8),
+            count=nops).astype(bool)
+        pos += nbytes
+        out.append((int(rid), tenant, int(op), ok))
+    if pos != len(body):
+        raise JournalCorruptError(
+            f"journal record at byte {off}: {len(body) - pos} trailing "
+            "bytes after the last ack")
+    return out
 
 
 def encode_heap_record(kind: int, keys, handles, payloads) -> bytes:
@@ -135,17 +225,31 @@ def encode_heap_record(kind: int, keys, handles, payloads) -> bytes:
         raise ConfigError("heap record needs one handle+payload per key")
     lens = np.asarray([len(b) for b in payloads], np.uint32)
     blob = b"".join(bytes(b) for b in payloads)
-    payload = (_PAY.pack(kind, keys.size) + keys.tobytes()
+    payload = (_PAY.pack(kind, 0, keys.size) + keys.tobytes()
                + handles.tobytes() + lens.tobytes() + blob)
     return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
 
 
-def _decode_payload(payload: bytes, off: int):
-    """payload bytes -> (kind, keys, aux); raises on bad shape.  ``aux``
-    is the value lane (u64, or None for J_DELETE), except J_HEAP_PUT
-    where it is ``(handles u64[n], payloads list[bytes])``."""
-    kind, n = _PAY.unpack_from(payload)
+def _decode_payload(payload: bytes, off: int, fmt: int = 2):
+    """payload bytes -> (kind, keys, aux, rid); raises on bad shape.
+    ``aux`` is the value lane (u64, or None for J_DELETE), except
+    J_HEAP_PUT where it is ``(handles u64[n], payloads list[bytes])``
+    and J_ACK where ``keys`` is None and ``aux`` the decoded ack list.
+    ``fmt`` is the segment format (1 = pre-rid: the flags byte was pad,
+    decoded as 0 — dedup disabled for that segment)."""
+    kind, flags, n = _PAY.unpack_from(payload)
+    if fmt < 2:
+        flags = 0
+    rid = None
     body = payload[_PAY.size:]
+    if flags & FLAG_RID:
+        if len(body) < _RID.size:
+            raise JournalCorruptError(
+                f"journal record at byte {off}: rid flag with no rid")
+        rid = _RID.unpack_from(body)[0]
+        body = body[_RID.size:]
+    if kind == J_ACK:
+        return kind, None, _decode_acks(body, n, off), rid
     if kind == J_HEAP_PUT:
         fixed = n * 8 * 2 + n * 4
         if len(body) < fixed:
@@ -165,7 +269,7 @@ def _decode_payload(payload: bytes, off: int):
         for ln in lens.tolist():
             payloads.append(blob[pos: pos + ln])
             pos += ln
-        return kind, keys, (handles, payloads)
+        return kind, keys, (handles, payloads), rid
     want = n * 8 * (2 if kind in _TWO_LANE else 1)
     if kind not in KINDS or len(body) != want:
         raise JournalCorruptError(
@@ -174,7 +278,7 @@ def _decode_payload(payload: bytes, off: int):
     keys = np.frombuffer(body[: n * 8], np.uint64).copy()
     vals = (np.frombuffer(body[n * 8:], np.uint64).copy()
             if kind in _TWO_LANE else None)
-    return kind, keys, vals
+    return kind, keys, vals, rid
 
 
 class Journal:
@@ -245,6 +349,17 @@ class Journal:
         self._entrants = 0
         self._entrants_lock = threading.Lock()
         fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        # format pinning: a fresh segment is v2; appending to an
+        # existing segment keeps ITS format (one segment never mixes —
+        # a v1 segment's appends stay rid-less and ack records are
+        # refused as no-ops: dedup disabled for that segment, the
+        # back-compat contract)
+        self.format = 2
+        if not fresh:
+            with open(path, "rb") as rf:
+                head = rf.read(len(MAGIC))
+            if head == MAGIC_V1:
+                self.format = 1
         self._f = open(path, "ab")
         # host-memory accountant source (obs/device.py): the live
         # segment's on-disk bytes as ``device.host_journal_bytes``.
@@ -274,16 +389,49 @@ class Journal:
                 finally:
                     os.close(dfd)
 
-    def append(self, kind: int, keys, values=None) -> int:
+    def append(self, kind: int, keys, values=None, rid=None) -> int:
         """Append one batch record; returns bytes written.  Durable on
         return when ``sync`` (the ack gate for RPO zero) — via one
         fsync per record, or one fsync per group under
-        ``group_commit_ms``."""
+        ``group_commit_ms``.  ``rid`` tags the record with a client
+        request id (v2 segments; silently dropped on a v1 segment —
+        dedup disabled there by the back-compat contract)."""
         keys = np.ascontiguousarray(keys, np.uint64)
         if keys.size == 0:
             return 0  # nothing applied: no record
-        rec = encode_record(kind, keys, values)
+        rec = encode_record(kind, keys, values,
+                            rid=rid if self.format >= 2 else None)
         return self._append_rec(rec, int(keys.size))
+
+    def append_acks(self, acks) -> int:
+        """Append one client-contract ack-batch record (see
+        :func:`encode_ack_record`) under the same durability gate as
+        :meth:`append` — the front door calls this AFTER the engine
+        batch record and BEFORE resolving any of the batch's futures,
+        so a crash can lose an unacked result but never an acked one.
+        No-op (returns 0) on an empty batch or a v1 segment."""
+        if not acks or self.format < 2:
+            return 0
+        rec = encode_ack_record(acks)
+        return self._append_rec(rec, len(acks))
+
+    def sync_now(self) -> None:
+        """Push a covering fsync for everything appended so far — the
+        graceful-drain epilogue (``ShermanServer.drain``).  Redundant
+        under ``sync=True`` (every ack already gated on a covering
+        fsync); for a ``sync=False`` journal it is the only flush."""
+        with self._lock:
+            if self._f.closed:
+                return
+            if self._failed is not None:
+                raise JournalSyncError(
+                    f"journal {self.path} poisoned by an earlier fsync "
+                    "failure; rotate to a fresh segment") from self._failed
+            self._f.flush()
+            _fsync(self._f.fileno())
+            self._synced_seq = self._written_seq
+            _OBS_FSYNCS.inc()
+            self.fsyncs += 1
 
     def append_heap(self, kind: int, keys, handles, payloads) -> int:
         """Append one value-heap batch record (keys + handles + payload
@@ -425,8 +573,12 @@ class Journal:
         self.close()
 
 
-def read_records(path: str, truncate_torn: bool = False) -> list[tuple]:
-    """Parse a segment -> [(kind, keys, values|None), ...].
+def read_records(path: str, truncate_torn: bool = False,
+                 with_rids: bool = False) -> list[tuple]:
+    """Parse a segment -> [(kind, keys, values|None), ...] — or
+    4-tuples ``(kind, keys, values, rid)`` when ``with_rids`` (the
+    exactly-once consumers; rid is None on v1 segments and untagged
+    records).
 
     Applies the torn-tail contract (see module docstring):
     partially-appended tail frames are dropped (and physically truncated
@@ -440,7 +592,11 @@ def read_records(path: str, truncate_torn: bool = False) -> list[tuple]:
         # a file torn inside the magic itself: an append never succeeded
         _truncate(path, 0, len(blob), truncate_torn)
         return []
-    if blob[: len(MAGIC)] != MAGIC:
+    if blob[: len(MAGIC)] == MAGIC:
+        fmt = 2
+    elif blob[: len(MAGIC)] == MAGIC_V1:
+        fmt = 1  # pre-rid segment: flags byte decodes as 0
+    else:
         raise JournalCorruptError(
             f"{path}: bad journal magic {blob[:8]!r}")
     out: list[tuple] = []
@@ -475,7 +631,8 @@ def read_records(path: str, truncate_torn: bool = False) -> list[tuple]:
                 f"{path}: CRC mismatch at byte {off} with "
                 f"{size - end} bytes following — content corruption, "
                 "refusing to replay")
-        out.append(_decode_payload(payload, off))
+        row = _decode_payload(payload, off, fmt)
+        out.append(row if with_rids else row[:3])
         off = end
     return out
 
@@ -495,14 +652,26 @@ def _truncate(path: str, off: int, size: int, do_truncate: bool) -> None:
             _fsync(f.fileno())
 
 
-def replay(path: str, eng) -> dict:
+def replay(path: str, eng, ack_sink=None) -> dict:
     """Re-apply one segment's records through a (writable) engine, in
     record order.  The engine's own journaling must be detached by the
     caller (RecoveryPlane does) so replay does not re-journal itself.
-    Returns {"records", "rows", "upserts", "deletes"}."""
+    ``ack_sink`` (a list) collects J_ACK entries ``(rid, tenant, op,
+    ok)`` in record order — the dedup-window reconstruction feed; with
+    no sink they are counted and skipped.  Returns {"records", "rows",
+    "upserts", "deletes", ..., "acks"}."""
     stats = {"records": 0, "rows": 0, "upserts": 0, "deletes": 0,
-             "heap_puts": 0, "heap_frees": 0}
+             "heap_puts": 0, "heap_frees": 0, "acks": 0}
     for kind, keys, vals in read_records(path, truncate_torn=True):
+        if kind == J_ACK:
+            # contract plane: cached client results, no engine state —
+            # replayed into the dedup window, never applied
+            if ack_sink is not None:
+                ack_sink.extend(vals)
+            stats["acks"] += len(vals)
+            stats["records"] += 1
+            _OBS_RP_RECORDS.inc()
+            continue
         if kind in (J_HEAP_PUT, J_HEAP_FREE):
             # value-heap records (models/value_heap.py): slab rewrites
             # at their RECORDED addresses — the engine must carry an

@@ -1584,8 +1584,21 @@ def make_ingress_step(eng, *, width: int, leaf_cache=None):
         two halves itself)."""
         return complete(dispatch(keys))
 
+    def drain(handle):
+        """Teardown-path completion (the front door's kill/drain hook):
+        materialize the handle's device work and discard it WITHOUT
+        the straggler rescue — a draining or crashing server must not
+        launch fresh root descents (``eng.search`` compiles programs,
+        takes the step mutex, and can raise through a degraded
+        engine).  The in-flight step's device buffers are blocked on
+        and released; nothing is returned — the caller has already
+        failed or resolved the slot's futures."""
+        _n, _U, _uk, _inv, done, found, vhi, vlo, *_ = handle
+        eng._unshard(done, found, vhi, vlo)
+
     step.dispatch = dispatch
     step.complete = complete
+    step.drain = drain
     step.width = width
     step.cache = leaf_cache is not None
     step.programs = {"serve_fanout": fn}

@@ -1,0 +1,356 @@
+"""Client-contract auditor (sherman_tpu/audit.py) fast tier.
+
+The PR 15 contract set: the per-key linearizability checker (legal
+histories pass; seeded duplicate-apply and stale-read violations flag
+— the checker is proven NON-VACUOUS), the soundness polarity
+machinery (unknown-initial vacuity, open-writes legality, the
+fixpoint window cut, batch intents), the bounded recorder (by-key
+sampling, ring drops reset the carry), the JSONL offline artifact,
+and the end-to-end serve hooks (a stomp behind the front door's back
+is flagged; a clean serving run is not; inline cost < 2% of the
+serve wall — the obs-cost-pin pattern).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from sherman_tpu import audit as A
+from sherman_tpu import obs
+from sherman_tpu.errors import ConfigError
+
+R, I, D = A.OP_READ, A.OP_INSERT, A.OP_DELETE
+
+
+def ev(key, op, t0, t1, val=None, found=True):
+    return (key, op, t0, t1, val, found)
+
+
+# -- checker units -------------------------------------------------------------
+
+def test_checker_legal_history_passes():
+    evs = [
+        ev(5, I, 0.0, 1.0, 100),
+        ev(5, R, 0.5, 1.5, 100),        # concurrent with the write: ok
+        ev(5, I, 2.0, 3.0, 200),
+        ev(5, R, 3.5, 4.0, 200),
+        ev(5, D, 5.0, 6.0),
+        ev(5, R, 6.5, 7.0, None, found=False),
+        ev(9, I, 0.0, 1.0, 7),          # second key: P-composition
+        ev(9, R, 2.0, 3.0, 7),
+    ]
+    res = A.check_events(evs)
+    assert res["linearizable"] and res["keys"] == 2 and res["reads"] == 4
+
+
+def test_checker_flags_duplicate_apply_as_stale_read():
+    # the duplicate-apply signature: v1 re-applied AFTER v2's ack, so
+    # a later read observes the superseded v1
+    evs = [
+        ev(5, I, 0.0, 1.0, 100),
+        ev(5, I, 2.0, 3.0, 200),
+        ev(5, R, 4.0, 5.0, 100),
+    ]
+    res = A.check_events(evs)
+    assert not res["linearizable"]
+    assert res["violations"][0]["kind"] == "stale_read"
+
+
+def test_checker_flags_stale_and_phantom_reads():
+    # stale: found=False after an insert fully completed (a delete
+    # that never happened)
+    res = A.check_events([ev(5, I, 0.0, 1.0, 100),
+                          ev(5, R, 2.0, 3.0, None, found=False)])
+    assert not res["linearizable"]
+    # phantom: a value nothing ever wrote
+    res2 = A.check_events([ev(5, I, 0.0, 1.0, 100),
+                           ev(5, R, 2.0, 3.0, 999)])
+    assert not res2["linearizable"]
+    assert res2["violations"][0]["kind"] == "phantom_read"
+
+
+def test_checker_concurrent_write_read_both_legal():
+    # read overlaps the second write: old OR new value both pass
+    base = [ev(5, I, 0.0, 1.0, 100), ev(5, I, 2.0, 4.0, 200)]
+    for seen in (100, 200):
+        res = A.check_events(base + [ev(5, R, 3.0, 5.0, seen)])
+        assert res["linearizable"], (seen, res["violations"])
+    # a write entirely between source and read DOES supersede
+    res = A.check_events([ev(5, I, 0.0, 1.0, 100),
+                          ev(5, I, 2.0, 3.0, 200),
+                          ev(5, R, 3.5, 4.0, 100)])
+    assert not res["linearizable"]
+
+
+def test_checker_initial_state_rules():
+    # unknown initial: a read before any recorded write passes vacuously
+    assert A.check_events([ev(5, R, 0.0, 1.0, 42)])["linearizable"]
+    # known initial is judged
+    res = A.check_events([ev(5, R, 0.0, 1.0, 42)],
+                         initial={5: (True, 41)})
+    assert not res["linearizable"]
+    assert A.check_events([ev(5, R, 0.0, 1.0, 41)],
+                          initial={5: (True, 41)})["linearizable"]
+    # initial stops being legal once a write fully precedes the read
+    res = A.check_events([ev(5, I, 0.0, 1.0, 100),
+                          ev(5, R, 2.0, 3.0, 41)],
+                         initial={5: (True, 41)})
+    assert not res["linearizable"]
+
+
+def test_checker_open_writes_always_legal():
+    # an in-flight (unacked) write's value is the at-least-once
+    # window, never a violation
+    evs = [ev(5, I, 0.0, 1.0, 100), ev(5, R, 2.0, 3.0, 777)]
+    assert not A.check_events(evs)["linearizable"]
+    assert A.check_events(evs, open_writes={5: [(True, 777)]}
+                          )["linearizable"]
+
+
+# -- recorder ------------------------------------------------------------------
+
+def test_recorder_sampling_is_by_key():
+    rec = A.HistoryRecorder(capacity=1 << 12, sample_mod=4)
+    keys = np.arange(1, 4097, dtype=np.uint64)
+    m1 = rec.sample_mask(keys)
+    m2 = rec.sample_mask(keys)
+    np.testing.assert_array_equal(m1, m2)  # deterministic per key
+    frac = m1.mean()
+    assert 0.15 < frac < 0.35  # ~1/4
+    # every op on a sampled key records; unsampled keys never do
+    rec.observe(A.OP_INSERT, keys, 0.0, 1.0, values=keys)
+    assert rec.events == int(m1.sum())
+
+
+def test_recorder_ring_bound_and_ok_mask():
+    rec = A.HistoryRecorder(capacity=8, sample_mod=1)
+    keys = np.arange(1, 13, dtype=np.uint64)
+    ok = np.ones(12, bool)
+    ok[0] = False  # a rejected row is never recorded
+    rec.observe(A.OP_INSERT, keys, 0.0, 1.0, values=keys, ok=ok)
+    assert rec.events == 11 and rec.dropped == 3
+    drained, retained, dropped = rec.drain()
+    assert len(drained) == 8 and dropped == 3
+    with pytest.raises(ConfigError):
+        A.HistoryRecorder(capacity=0)
+
+
+def test_recorder_fixpoint_cut_never_splits_overlap():
+    """The soundness core: a retained event (directly or transitively)
+    pins the cut at its invocation, so a drained window never loses a
+    write some retained read was concurrent with."""
+    rec = A.HistoryRecorder(sample_mod=1)
+    rec.observe(A.OP_INSERT, np.asarray([5], np.uint64), 1.0, 2.0,
+                values=np.asarray([100], np.uint64))
+    # read concurrent with the write below, responding EARLY
+    rec.observe(A.OP_READ, np.asarray([5], np.uint64), 3.0, 4.0,
+                values=np.asarray([200], np.uint64),
+                found=np.asarray([True]))
+    rec.observe(A.OP_INSERT, np.asarray([5], np.uint64), 3.5, 6.0,
+                values=np.asarray([200], np.uint64))
+    # a long-window read pinning the cut transitively
+    rec.observe(A.OP_READ, np.asarray([5], np.uint64), 3.8, 7.0,
+                values=np.asarray([200], np.uint64),
+                found=np.asarray([True]))
+    # candidate cut 5.0 would drain the early read (resp 4.0) away
+    # from the write it observed (resp 6.0) — the fixpoint refuses:
+    # the long read (resp 7.0 >= cut) clamps to 3.8, which retains
+    # the write (resp 6.0), which clamps to 3.5, retaining the early
+    # read (resp 4.0) too
+    drained, retained, _ = rec.drain(before=5.0)
+    assert [e[3] for e in drained] == [2.0]  # only the first write
+    assert len(rec.snapshot()) == 3
+
+
+def test_recorder_floor_holds_unrecorded_ops():
+    rec = A.HistoryRecorder(sample_mod=1)
+    rec.observe(A.OP_INSERT, np.asarray([5], np.uint64), 1.0, 2.0,
+                values=np.asarray([100], np.uint64))
+    drained, _, _ = rec.drain(before=10.0, floor=1.5)
+    assert drained == []  # the floor (an in-flight batch) blocks
+    drained, _, _ = rec.drain(before=10.0)
+    assert len(drained) == 1
+
+
+# -- the inline auditor --------------------------------------------------------
+
+def test_auditor_windows_carry_and_collector():
+    aud = A.Auditor(sample_mod=1, interval_s=60.0)
+    k = np.asarray([5], np.uint64)
+    aud.observe_write(A.OP_INSERT, k, 0.0, 1.0,
+                      values=np.asarray([100], np.uint64),
+                      ok=np.asarray([True]))
+    res = aud.tick(drain_all=True)
+    assert res["linearizable"] and aud.windows == 1
+    # the carried write is the next window's initial state
+    aud.observe_read(k, np.asarray([100], np.uint64),
+                     np.asarray([True]), 2.0, 3.0)
+    assert aud.tick(drain_all=True)["linearizable"]
+    aud.observe_read(k, np.asarray([999], np.uint64),
+                     np.asarray([True]), 4.0, 5.0)
+    res = aud.tick(drain_all=True)
+    assert not res["linearizable"] and aud.violations == 1
+    snap = obs.snapshot()
+    assert snap.get("audit.violations", 0) >= 1
+    assert snap.get("audit.windows", 0) >= 3
+    assert aud.stats()["linearizable"] is False
+
+
+def test_auditor_intents_pin_the_cut():
+    aud = A.Auditor(sample_mod=1, interval_s=60.0, horizon_s=0.0)
+    k = np.asarray([5], np.uint64)
+    t = time.perf_counter()
+    tok = aud.begin_ops(t - 10.0)
+    aud.observe_write(A.OP_INSERT, k, t - 9.0, t - 8.0,
+                      values=np.asarray([100], np.uint64),
+                      ok=np.asarray([True]))
+    res = aud.tick()
+    assert res["events"] == 0  # intent floor held the window closed
+    aud.end_ops(tok)
+    res = aud.tick()
+    assert res["events"] == 1 and res["linearizable"]
+
+
+def test_auditor_drop_resets_carry():
+    aud = A.Auditor(sample_mod=1, capacity=4, interval_s=60.0)
+    k = np.asarray([5], np.uint64)
+    aud.observe_write(A.OP_INSERT, k, 0.0, 1.0,
+                      values=np.asarray([100], np.uint64),
+                      ok=np.asarray([True]))
+    aud.tick(drain_all=True)
+    # overflow the ring: the carry must reset (UNKNOWN), not fabricate
+    keys = np.arange(10, 20, dtype=np.uint64)
+    aud.observe_write(A.OP_INSERT, keys, 2.0, 3.0, values=keys,
+                      ok=np.ones(10, bool))
+    aud.tick(drain_all=True)
+    assert aud.carry_resets == 1
+    # a read that would violate the OLD carry now passes vacuously
+    aud.observe_read(k, np.asarray([999], np.uint64),
+                     np.asarray([True]), 4.0, 5.0)
+    assert aud.tick(drain_all=True)["linearizable"]
+
+
+def test_auditor_seed_initial_judges_prehistory_reads():
+    aud = A.Auditor(sample_mod=1, interval_s=60.0)
+    keys = np.asarray([5, 6], np.uint64)
+    aud.seed_initial(keys, np.asarray([50, 60], np.uint64))
+    aud.observe_read(keys, np.asarray([50, 61], np.uint64),
+                     np.asarray([True, True]), 0.0, 1.0)
+    res = aud.tick(drain_all=True)
+    assert not res["linearizable"]
+    assert res["violations"][0]["key"] == 6
+
+
+def test_jsonl_round_trip(tmp_path):
+    evs = [ev(5, I, 0.0, 1.0, 100), ev(5, R, 2.0, 3.0, 100),
+           ev(5, D, 4.0, 5.0), ev(5, R, 6.0, 7.0, None, found=False)]
+    p = str(tmp_path / "hist.jsonl")
+    assert A.dump_jsonl(evs, p) == 4
+    res = A.check_jsonl(p)
+    assert res["linearizable"] and res["events"] == 4
+    # and a violating artifact stays violating after the round trip
+    A.dump_jsonl(evs + [ev(5, R, 8.0, 9.0, 100)], p)
+    assert not A.check_jsonl(p)["linearizable"]
+
+
+# -- end-to-end through the front door ----------------------------------------
+
+import contextlib
+
+
+def make_serving_stack(n=3000):
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig, TreeConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+
+    cfg = DSMConfig(machine_nr=1, pages_per_node=2048,
+                    locks_per_node=512, step_capacity=1024,
+                    chunk_pages=32)
+    tree = Tree(Cluster(cfg))
+    keys = np.arange(100, 100 + n * 3, 3, dtype=np.uint64)
+    vals = keys * np.uint64(7)
+    batched.bulk_load(tree, keys, vals)
+    eng = batched.BatchedEngine(tree, batch_per_node=256,
+                                tcfg=TreeConfig(sibling_chase_budget=2))
+    eng.attach_router()
+    return tree, eng, keys, vals
+
+
+@contextlib.contextmanager
+def serving(eng, keys, vals, auditor=None, **cfgkw):
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cfg = ServeConfig(widths=(128, 512),
+                      p99_targets_ms={c: 10_000.0 for c in
+                                      ("read", "scan", "insert",
+                                       "delete")},
+                      **cfgkw)
+    srv = ShermanServer(eng, cfg, auditor=auditor)
+    try:
+        srv.start(calib_keys=keys,
+                  calib_writes=(keys[:64], vals[:64]),
+                  calib_delete_keys=np.asarray([5], np.uint64))
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_auditor_end_to_end_clean_and_stomp_flagged(eight_devices):
+    """Non-vacuity, end to end: a clean serving run checks clean; a
+    duplicate apply injected BEHIND the front door's back (an older
+    value re-applied via the raw engine — exactly what a buggy replay
+    would do) flags the next read's history."""
+    tree, eng, keys, vals = make_serving_stack()
+    aud = A.Auditor(sample_mod=1, interval_s=60.0)
+    aud.seed_initial(keys, vals)
+    with serving(eng, keys, vals, auditor=aud) as srv:
+        k8 = keys[:8]
+        srv.submit("insert", k8, k8 ^ np.uint64(0xA1),
+                   rid=1).result(timeout=60)
+        got, found = srv.submit("read", k8).result(timeout=60)
+        assert found.all()
+        res = aud.tick(drain_all=True)
+        assert res["linearizable"], res["violations"][:2]
+        v0 = aud.violations
+        # newer acked write, then the DUPLICATE APPLY of the old value
+        # behind the auditor's back, then an audited read
+        srv.submit("insert", k8, k8 ^ np.uint64(0xB2),
+                   rid=2).result(timeout=60)
+        eng.insert(k8, k8 ^ np.uint64(0xA1))  # the seeded fault
+        got, found = srv.submit("read", k8).result(timeout=60)
+        np.testing.assert_array_equal(got, k8 ^ np.uint64(0xA1))
+        res = aud.tick(drain_all=True)
+        assert not res["linearizable"], \
+            "auditor missed a seeded duplicate apply"
+        assert aud.violations > v0
+        kinds = {v["kind"] for v in res["violations"]}
+        assert kinds <= {"stale_read", "phantom_read"}
+
+
+def test_auditor_inline_cost_under_2pct(eight_devices):
+    """The obs-cost pin: the auditor's self-timed inline observe cost
+    stays under 2% of the serve wall with full (sample_mod=1)
+    recording — sampled deployments only get cheaper."""
+    tree, eng, keys, vals = make_serving_stack()
+    aud = A.Auditor(sample_mod=1, interval_s=60.0)
+    with serving(eng, keys, vals, auditor=aud,
+                 max_queue_ops=16384) as srv:
+        rng = np.random.default_rng(5)
+        t0 = time.perf_counter()
+        futs = []
+        for i in range(24):
+            futs.append(srv.submit("read",
+                                   keys[rng.integers(0, keys.size,
+                                                     128)]))
+            if i % 6 == 0:
+                futs.append(srv.submit(
+                    "insert", keys[i * 16:(i + 1) * 16],
+                    keys[i * 16:(i + 1) * 16] ^ np.uint64(3),
+                    rid=100 + i))
+        for f in futs:
+            f.result(timeout=60)
+        wall = time.perf_counter() - t0
+    assert aud.rec.events > 0
+    frac = aud.cost_frac(wall)
+    assert frac < 0.02, f"inline auditor cost {frac:.4f} of serve wall"

@@ -557,3 +557,133 @@ def test_fuzz_value_heap_faults(eight_devices):
         vh.dsm.heap_write_cells([row], [off], [clean])
         got2, f2 = vh.get(np.asarray([vic], np.uint64))
         assert f2[0] and got2[0] == model[int(vic)]
+
+
+def test_fuzz_client_contract(eight_devices, tmp_path):
+    """Client-contract storm (sherman_tpu/serve.py + audit.py +
+    utils/journal.py): random retry storms (every write submitted 1-3x
+    under ONE rid — duplicates both while in flight and after the
+    ack), random deadline budgets, chaos faults between rounds, then a
+    torn journal tail + replay into a fresh engine with the
+    reconstructed dedup window.  Contract: every acked op appears
+    EXACTLY once in the final state (the last acked value per key —
+    never a duplicate apply resurrecting an older one, never a loss),
+    the recorded history checks linearizable per key, and every
+    client-visible failure is typed."""
+    from sherman_tpu import audit as A
+    from sherman_tpu import chaos as CH
+    from sherman_tpu.config import TreeConfig
+    from sherman_tpu.errors import ShermanError
+    from sherman_tpu.serve import (DeadlineExceededError, ServeConfig,
+                                   ShermanServer)
+    from sherman_tpu.utils import journal as J
+
+    rng = np.random.default_rng(113)
+    cfg = DSMConfig(machine_nr=1, pages_per_node=2048,
+                    locks_per_node=512, step_capacity=512,
+                    chunk_pages=32)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    keys = np.unique(rng.integers(1, 1 << 56, 900,
+                                  dtype=np.uint64))[:800]
+    vals = keys ^ np.uint64(0xF00D)
+    batched.bulk_load(tree, keys, vals)
+    eng = batched.BatchedEngine(
+        tree, batch_per_node=256,
+        tcfg=TreeConfig(sibling_chase_budget=2))
+    eng.attach_router()
+    jpath = str(tmp_path / "contract-fuzz.wal")
+    journal = J.Journal(jpath, sync=True, group_commit_ms=1.0)
+    aud = A.Auditor(sample_mod=1, interval_s=60.0)  # ticked manually
+    aud.seed_initial(keys, vals)
+    scfg = ServeConfig(widths=(128, 512), write_linger_ms=0.2,
+                       p99_targets_ms={c: 1e9 for c in
+                                       ("read", "scan", "insert",
+                                        "delete")})
+    srv = ShermanServer(eng, scfg, journal=journal, auditor=aud)
+    srv.start(calib_keys=keys, calib_writes=(keys[:64], vals[:64]))
+
+    acked: dict = {}          # key -> last acked value (ledger)
+    results: dict = {}        # rid -> first acked ok array
+    rid = 1000
+    for rnd in range(8):
+        # chaos between rounds: the absorbable serving-storm kinds
+        if rnd in (3, 5):
+            plan = CH.FaultPlan.random(rnd, n_faults=2, step_hi=1,
+                                       kinds=("wedge_lock",
+                                              "drop_cas"))
+            cluster.dsm.install_chaos(plan)
+            cluster.dsm.read_word(0, 0)
+            cluster.dsm.install_chaos(None)
+        for _ in range(6):
+            rid += 1
+            kreq = np.unique(keys[rng.integers(0, keys.size, 24)])
+            vreq = kreq ^ np.uint64(0xF00D) ^ np.uint64(rid << 4)
+            # RETRY STORM: 1-3 submissions of the SAME rid/payload,
+            # some racing the original in flight, some after the ack
+            futs = [srv.submit("insert", kreq, vreq, rid=rid,
+                               tenant="w")]
+            for _dup in range(int(rng.integers(0, 3))):
+                if rng.random() < 0.5:
+                    futs[0].result(timeout=60)  # duplicate AFTER ack
+                futs.append(srv.submit("insert", kreq, vreq, rid=rid,
+                                       tenant="w"))
+            oks = [f.result(timeout=60) for f in futs]
+            for ok in oks[1:]:  # every ack of one rid is THE SAME
+                np.testing.assert_array_equal(ok, oks[0])
+            results[rid] = oks[0]
+            for k, v, o in zip(kreq.tolist(), vreq.tolist(),
+                               oks[0].tolist()):
+                if o:
+                    acked[k] = v
+            # reads with random deadline budgets: served or TYPED
+            try:
+                probe = keys[rng.integers(0, keys.size, 32)]
+                got, found = srv.submit(
+                    "read", probe,
+                    deadline_ms=float(rng.choice([0.05, 50.0, 5000.0]))
+                ).result(timeout=60)
+                for k, g, f in zip(probe.tolist(), got.tolist(),
+                                   found.tolist()):
+                    assert f, hex(k)
+                    assert g == acked.get(k, k ^ 0xF00D)
+            except DeadlineExceededError:
+                pass  # shed typed: the legal outcome
+            except ShermanError as e:
+                raise AssertionError(
+                    f"non-contract failure leaked: {e!r}")
+        aud.tick(drain_all=False)
+    srv.kill()
+    res = aud.tick(drain_all=True)
+    assert aud.violations == 0, aud.last_violations[:3]
+
+    # torn tail + replay into a FRESH engine: exactly-once across the
+    # crash — state equals the acked ledger, window re-acks originals
+    with open(jpath, "ab") as f:
+        rec = J.encode_record(J.J_UPSERT,
+                              np.asarray([1 << 40], np.uint64),
+                              np.asarray([7], np.uint64), rid=1)
+        f.write(rec[: len(rec) - 5])
+    tree2 = Tree(Cluster(cfg))
+    batched.bulk_load(tree2, keys, vals)
+    eng2 = batched.BatchedEngine(
+        tree2, batch_per_node=256,
+        tcfg=TreeConfig(sibling_chase_budget=2))
+    eng2.attach_router()
+    sink: list = []
+    stats = J.replay(jpath, eng2, ack_sink=sink)
+    assert stats["acks"] > 0 and stats["upserts"] > 0
+    ak = np.asarray(sorted(acked), np.uint64)
+    av = np.asarray([acked[int(k)] for k in ak], np.uint64)
+    got, found = eng2.search(ak)
+    lost = int((~found).sum()) + int((got[found] != av[found]).sum())
+    assert lost == 0, f"{lost} acked ops wrong after replay"
+    # window reconstruction: every acked rid re-acks its ORIGINAL
+    window = {}
+    for r, tenant, op, ok in sink:
+        window[(tenant, r)] = (op, ok)
+    for r, ok0 in results.items():
+        cached = window.get(("w", r))
+        assert cached is not None, f"rid {r} missing from the window"
+        np.testing.assert_array_equal(cached[1], ok0)
+    journal.close()

@@ -656,3 +656,85 @@ def test_targeted_repair_failure_is_typed(eight_devices, tmp_path):
         plane.targeted_repair(scr, addrs=[v1])
     assert eng.degraded
     plane.close()
+
+
+def test_delta_crash_before_save_keeps_retired_segment(eight_devices,
+                                                       tmp_path,
+                                                       monkeypatch):
+    """The PR 15 review-found window: checkpoint_delta rotates the
+    journal BEFORE the snapshot (the live-dispatcher RPO race fix),
+    but the retired segment must survive until the delta artifact is
+    DURABLE — a crash between rotation and save must leave the
+    retired ops replayable (overlap replays convergently), never a
+    window where they exist nowhere on disk."""
+    import glob as _glob
+
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.utils import checkpoint as CK
+
+    cluster, tree, eng = _small_cluster()
+    keys, vals = _load(tree, eng, n=500, seed=19)
+    rdir = str(tmp_path / "r")
+    plane = RecoveryPlane(cluster, tree, eng, rdir)
+    plane.checkpoint_base()
+    v1 = keys[:64] ^ np.uint64(0x77)
+    eng.insert(keys[:64], v1)
+    eng.journal.append_acks([(901, "t", J.J_UPSERT,
+                              np.ones(64, bool))])
+
+    # crash INSIDE the delta save, after rotation already happened
+    real_delta = CK.checkpoint_delta
+
+    def exploding_delta(*a, **kw):
+        raise OSError("disk full mid-save (simulated crash)")
+
+    monkeypatch.setattr(CK, "checkpoint_delta", exploding_delta)
+    with pytest.raises(OSError):
+        plane.checkpoint_delta()
+    monkeypatch.setattr(CK, "checkpoint_delta", real_delta)
+    # BOTH segments still on disk: the retired ops exist somewhere
+    segs = sorted(_glob.glob(rdir + "/journal-*.wal"))
+    assert len(segs) == 2, segs
+    plane.close()
+    del cluster, tree, eng
+
+    # recover: the overlapping segments replay convergently and the
+    # pre-crash acked write + its ack window survive
+    plane2, c2, t2, e2, rec = RecoveryPlane.recover(
+        rdir, batch_per_node=128,
+        tcfg=TreeConfig(sibling_chase_budget=1))
+    got, found = e2.search(keys[:64])
+    assert found.all()
+    np.testing.assert_array_equal(got, v1)
+    assert ("t", 901) in plane2.dedup_window
+    # a SUCCESSFUL delta sweeps down to the single live segment
+    e2.insert(keys[:16], v1[:16])
+    plane2.checkpoint_delta()
+    assert len(_glob.glob(rdir + "/journal-*.wal")) == 1
+    plane2.close()
+
+
+def test_ack_carry_bound_and_disable(tmp_path, eight_devices):
+    """ack_carry bounds the re-forwarded window (most-recent wins) and
+    0 disables the carry entirely — not the [-0:] whole-list trap."""
+    from sherman_tpu.recovery import RecoveryPlane
+
+    cluster, tree, eng = _small_cluster()
+    keys, vals = _load(tree, eng, n=400, seed=23)
+    for carry, want in ((2, 2), (0, 0)):
+        rdir = str(tmp_path / f"r{carry}")
+        plane = RecoveryPlane(cluster, tree, eng, rdir,
+                              ack_carry=carry)
+        plane.checkpoint_base()
+        for rid in (1, 2, 3):
+            eng.journal.append_acks([(rid, "t", J.J_UPSERT,
+                                      np.ones(2, bool))])
+        eng.insert(keys[:8], keys[:8] ^ np.uint64(carry + 1))
+        plane.checkpoint_delta()
+        sink: list = []
+        J.replay(eng.journal.path, eng, ack_sink=sink)
+        assert len(sink) == want, (carry, sink)
+        if want:
+            # most-recent entries carried (rid 1 evicted first)
+            assert [r for r, *_ in sink] == [2, 3]
+        plane.close()

@@ -57,12 +57,12 @@ def targets(ms=10_000.0):
 
 @contextlib.contextmanager
 def serving(eng, keys, vals, *, widths=(128, 512), journal=None,
-            calibrate=True, **cfgkw):
+            calibrate=True, auditor=None, **cfgkw):
     cfg = ServeConfig(widths=widths,
                       p99_targets_ms=cfgkw.pop("p99_targets_ms",
                                                targets()),
                       **cfgkw)
-    srv = ShermanServer(eng, cfg, journal=journal)
+    srv = ShermanServer(eng, cfg, journal=journal, auditor=auditor)
     try:
         if calibrate:
             srv.start(calib_keys=keys,
@@ -427,17 +427,335 @@ def test_greedy_tenant_capped_live(eight_devices):
         assert st["polite"]["served_ops"] == 30 * 64
 
 
+# -- client contract: exactly-once, deadlines, weighted shares (PR 15) --------
+
+def test_exactly_once_retry_reacks_never_reapplies(eight_devices):
+    """The lost-update kill: a retried rid re-acks the ORIGINAL result
+    from the dedup window; a newer write between the original and the
+    retry survives (the retry does NOT re-apply)."""
+    tree, eng, keys, vals = make()
+    with serving(eng, keys, vals) as srv:
+        k8 = keys[:8]
+        v1 = k8 ^ np.uint64(0xA1)
+        ok1 = srv.submit("insert", k8, v1, rid=77,
+                         tenant="t").result(timeout=60)
+        assert ok1.all()
+        v2 = k8 ^ np.uint64(0xB2)
+        srv.submit("insert", k8, v2, rid=78,
+                   tenant="t").result(timeout=60)
+        fut = srv.submit("insert", k8, v1, rid=77, tenant="t")
+        okr = fut.result(timeout=60)
+        assert fut.deduped and np.array_equal(okr, ok1)
+        got, found = srv.submit("read", k8).result(timeout=60)
+        assert found.all()
+        np.testing.assert_array_equal(got, v2)  # v1 NOT re-applied
+        # delete results cache too
+        fnd = srv.submit("delete", k8[:2], rid=79,
+                         tenant="t").result(timeout=60)
+        f2 = srv.submit("delete", k8[:2], rid=79, tenant="t")
+        assert f2.deduped and np.array_equal(f2.result(timeout=60),
+                                             fnd)
+        st = srv.stats()["contract"]
+        assert st["dedup_hits"] == 2 and st["duplicate_applies"] == 0
+        assert st["cached_rids"] == 3 and st["pending_rids"] == 0
+        # per-tenant isolation: another tenant's same rid is fresh
+        f3 = srv.submit("insert", k8, v1, rid=77, tenant="other")
+        assert not f3.deduped
+        f3.result(timeout=60)
+        # ... and restore for later tests' probes
+        srv.submit("insert", k8, v2, rid=80,
+                   tenant="t").result(timeout=60)
+
+
+def test_dedup_window_is_bounded_and_evicts_oldest(eight_devices):
+    tree, eng, keys, vals = make()
+    with serving(eng, keys, vals, dedup_window=2) as srv:
+        for rid in (1, 2, 3):
+            srv.submit("insert", keys[:2], vals[:2], rid=rid,
+                       tenant="t").result(timeout=60)
+        # rid 1 evicted: a retry re-applies (idempotent same payload)
+        f = srv.submit("insert", keys[:2], vals[:2], rid=1,
+                       tenant="t")
+        f.result(timeout=60)
+        assert not f.deduped
+        f3 = srv.submit("insert", keys[:2], vals[:2], rid=3,
+                        tenant="t")
+        f3.result(timeout=60)
+        assert f3.deduped
+
+
+def test_dedup_inflight_retry_joins_same_future(eight_devices):
+    tree, eng, keys, vals = make()
+    srv = admission_only(eng)
+    f1 = srv.submit("insert", keys[:4], vals[:4], rid=5, tenant="t")
+    f2 = srv.submit("insert", keys[:4], vals[:4], rid=5, tenant="t")
+    assert f1 is f2  # one apply, one ack, shared
+    assert srv.stats()["contract"]["pending_rids"] == 1
+    srv._running = False
+    srv._fail_queued(StateError("test done"))
+    assert srv.stats()["contract"]["pending_rids"] == 0
+
+
+def test_seed_dedup_adopts_and_rejournals(eight_devices, tmp_path):
+    from sherman_tpu.serve import READ_CLASSES  # noqa: F401
+    tree, eng, keys, vals = make()
+    jpath = str(tmp_path / "seed-j.bin")
+    journal = J.Journal(jpath, sync=True)
+    window = {("t", 42): (J.J_UPSERT, np.asarray([True, False]))}
+    with serving(eng, keys, vals, journal=journal) as srv:
+        assert srv.seed_dedup(window) == 1
+        f = srv.submit("insert", keys[:2], vals[:2], rid=42,
+                       tenant="t")
+        ok = f.result(timeout=60)
+        assert f.deduped and list(ok) == [True, False]
+    # the adopted window was re-journaled: a SECOND recovery would
+    # still see it
+    acks = [a for kind, _k, aux in J.read_records(jpath)
+            if kind == J.J_ACK for a in aux]
+    assert any(rid == 42 and tenant == "t" for rid, tenant, _o, _ok
+               in acks)
+    journal.close()
+
+
+def test_ack_records_reach_journal_before_ack(eight_devices, tmp_path):
+    tree, eng, keys, vals = make()
+    jpath = str(tmp_path / "ack-rec.bin")
+    journal = J.Journal(jpath, sync=True, group_commit_ms=1.0)
+    with serving(eng, keys, vals, journal=journal) as srv:
+        srv.submit("insert", keys[:16], vals[:16], rid=9,
+                   tenant="w").result(timeout=60)
+        # the moment result() returned, the J_ACK record is parseable
+        recs = J.read_records(jpath, with_rids=True)
+        acks = [aux for kind, _k, aux, _r in recs if kind == J.J_ACK]
+        assert acks and acks[0][0][0] == 9
+        assert acks[0][0][1] == "w"
+        assert acks[0][0][3].all() and acks[0][0][3].size == 16
+    journal.close()
+
+
+def test_deadline_shed_typed_before_dispatch(eight_devices):
+    from sherman_tpu.serve import DeadlineExceededError
+    tree, eng, keys, vals = make()
+    srv = admission_only(eng)
+    fut = srv.submit("read", keys[:8], deadline_ms=0.01, tenant="t")
+    rid_fut = srv.submit("insert", keys[:4], vals[:4], rid=3,
+                         deadline_ms=0.01, tenant="t")
+    time.sleep(0.01)
+    assert srv._take(("read",), 512) == []  # shed, not served
+    assert srv._take(("insert", "delete"), 512) == []
+    with pytest.raises(DeadlineExceededError):
+        fut.result(timeout=1)
+    with pytest.raises(DeadlineExceededError):
+        rid_fut.result(timeout=1)
+    assert srv.deadline_shed == 2
+    # the shed write's rid is free again (pending cleared)
+    assert srv.stats()["contract"]["pending_rids"] == 0
+    # an unexpired request is NOT shed
+    f2 = srv.submit("read", keys[:8], deadline_ms=60_000.0,
+                    tenant="t")
+    assert len(srv._take(("read",), 512)) == 1
+    f2._fail(StateError("test done"))
+    with pytest.raises(ConfigError):
+        srv.submit("read", keys[:8], deadline_ms=-1.0)
+    srv._running = False
+    srv._fail_queued(StateError("test done"))
+
+
+def test_deadline_live_served_or_typed(eight_devices):
+    from sherman_tpu.serve import DeadlineExceededError
+    from sherman_tpu.errors import ShermanError
+    tree, eng, keys, vals = make()
+    with serving(eng, keys, vals) as srv:
+        outcomes = {"served": 0, "shed": 0}
+        for i in range(20):
+            try:
+                got, found = srv.submit(
+                    "read", keys[i::307],
+                    deadline_ms=0.02 if i % 2 else 5000.0
+                ).result(timeout=30)
+                outcomes["served"] += 1
+                assert found.all()
+            except DeadlineExceededError:
+                outcomes["shed"] += 1
+            except ShermanError:
+                raise
+        assert outcomes["served"] >= 10  # generous budgets all served
+
+
+def test_weighted_fair_share_admission_2to1(eight_devices):
+    """The ROADMAP weighted-shares item: a 2:1 weight split holds
+    2/3 vs 1/3 of the queue under contention; the lone-flooder
+    reserve still holds."""
+    tree, eng, keys, vals = make()
+    srv = admission_only(eng, max_queue_ops=900,
+                         tenant_weights={"gold": 2.0, "free": 1.0})
+    # lone gold flooder: reserve = w_gold + max_other(1.0) = 3 ->
+    # share = 900 * 2/3 = 600
+    for _ in range(6):
+        srv.submit("read", keys[:100], tenant="gold")
+    with pytest.raises(ServeOverloadError):
+        srv.submit("read", keys[:100], tenant="gold")
+    # free arrives into its 1/3 = 300
+    for _ in range(3):
+        srv.submit("read", keys[:100], tenant="free")
+    with pytest.raises(ServeOverloadError):
+        srv.submit("read", keys[:100], tenant="free")
+    st = srv.stats()["tenants"]
+    assert st["gold"]["queued_ops"] == 600
+    assert st["free"]["queued_ops"] == 300
+    assert st["gold"]["weight"] == 2.0
+    srv._running = False
+    srv._fail_queued(StateError("test done"))
+
+
+def test_weighted_env_parsing(monkeypatch):
+    monkeypatch.setenv("SHERMAN_SERVE_WEIGHTS", "gold:2,free:0.5")
+    monkeypatch.setenv("SHERMAN_SERVE_DEDUP", "128")
+    cfg = ServeConfig.from_env()
+    assert cfg.tenant_weights == {"gold": 2.0, "free": 0.5}
+    assert cfg.dedup_window == 128
+    monkeypatch.setenv("SHERMAN_SERVE_WEIGHTS", "gold:-1")
+    with pytest.raises(ConfigError):
+        ServeConfig.from_env()
+    monkeypatch.setenv("SHERMAN_SERVE_WEIGHTS", "nonsense")
+    with pytest.raises(ConfigError):
+        ServeConfig.from_env()
+
+
+def test_retry_policy_and_client(eight_devices):
+    from sherman_tpu.serve import RetryPolicy, RetryingClient
+    import random as _random
+    pol = RetryPolicy(base_backoff_ms=2.0, backoff_cap_ms=10.0)
+    rng = _random.Random(0)
+    for attempt in range(8):
+        b = pol.backoff_s(attempt, rng)
+        assert 0.0 <= b <= 0.010 + 1e-9  # capped
+    tree, eng, keys, vals = make()
+    with serving(eng, keys, vals) as srv:
+        cl = RetryingClient(srv, tenant="c", seed=3)
+        got, found = cl.read(keys[:32])
+        assert found.all()
+        np.testing.assert_array_equal(got, keys[:32] * np.uint64(7))
+        # writes auto-assign UNIQUE rids; an explicit rid is a retry
+        ok = cl.insert(keys[:4], keys[:4] ^ np.uint64(1))
+        assert ok.all()
+        rid = cl._rid
+        ok2 = cl.insert(keys[:4], keys[:4] ^ np.uint64(1), rid=rid)
+        assert ok2.all() and srv.dedup_hits >= 1  # re-acked
+        assert cl.next_rid() != rid
+        fnd = cl.delete(np.asarray([5], np.uint64))
+        assert not fnd.any()  # absent key
+
+
+def test_drain_serves_admitted_and_fsyncs(eight_devices, tmp_path):
+    tree, eng, keys, vals = make()
+    jpath = str(tmp_path / "drain-j.bin")
+    journal = J.Journal(jpath, sync=True, group_commit_ms=1.0)
+    cfg = ServeConfig(widths=(128, 512), p99_targets_ms=targets(),
+                      write_linger_ms=50.0)  # linger: writes pend
+    srv = ShermanServer(eng, cfg, journal=journal)
+    srv.start(calib_keys=keys, calib_writes=(keys[:64], vals[:64]))
+    futs = [srv.submit("read", keys[:64])]
+    futs.append(srv.submit("insert", keys[:8],
+                           keys[:8] ^ np.uint64(0xD1), rid=1))
+    fsyncs0 = journal.fsyncs
+    srv.drain()
+    for f in futs:
+        f.result(timeout=1)  # everything admitted was SERVED
+    assert journal.fsyncs > fsyncs0  # the epilogue fsync landed
+    with pytest.raises(StateError):
+        srv.submit("read", keys[:4])
+    journal.close()
+
+
+# -- journal record format v2 (request ids + ack records) ---------------------
+
+def test_journal_v2_rid_round_trip(tmp_path):
+    jp = str(tmp_path / "v2.bin")
+    j = J.Journal(jp, sync=True)
+    assert j.format == 2
+    j.append(J.J_UPSERT, np.asarray([1, 2], np.uint64),
+             np.asarray([3, 4], np.uint64), rid=0xABCD)
+    j.append(J.J_DELETE, np.asarray([9], np.uint64))
+    j.append_acks([(7, "tenant-x", J.J_UPSERT,
+                    np.asarray([True, False, True])),
+                   (8, "y", J.J_DELETE, np.asarray([True] * 9))])
+    j.close()
+    recs = J.read_records(jp, with_rids=True)
+    assert recs[0][3] == 0xABCD and recs[1][3] is None
+    kind, keys_, acks, _ = recs[2]
+    assert kind == J.J_ACK and len(acks) == 2 and keys_ is None
+    rid, tenant, op, ok = acks[0]
+    assert (rid, tenant, op) == (7, "tenant-x", J.J_UPSERT)
+    assert list(ok) == [True, False, True]
+    assert list(acks[1][3]) == [True] * 9
+    # default 3-tuple shape unchanged for old callers
+    assert len(J.read_records(jp)[0]) == 3
+
+
+def test_journal_v1_backcompat_missing_field(tmp_path):
+    """The missing-field round trip: an old (v1) journal replays
+    cleanly with rid=None everywhere — dedup disabled for the
+    segment — and appends to it stay v1 (no mixed-format file)."""
+    import struct
+    import zlib
+    jp = str(tmp_path / "v1.bin")
+    with open(jp, "wb") as f:
+        f.write(J.MAGIC_V1)
+        pay = struct.pack("<BxxxI", J.J_UPSERT, 2) \
+            + np.asarray([9, 10], np.uint64).tobytes() \
+            + np.asarray([11, 12], np.uint64).tobytes()
+        f.write(struct.pack("<II", len(pay), zlib.crc32(pay)) + pay)
+    recs = J.read_records(jp, with_rids=True)
+    assert recs[0][3] is None
+    np.testing.assert_array_equal(recs[0][1],
+                                  np.asarray([9, 10], np.uint64))
+    j = J.Journal(jp, sync=True)
+    assert j.format == 1
+    j.append(J.J_UPSERT, np.asarray([13], np.uint64),
+             np.asarray([14], np.uint64), rid=99)  # rid dropped
+    assert j.append_acks([(1, "t", J.J_UPSERT,
+                           np.asarray([True]))]) == 0  # refused
+    j.close()
+    recs = J.read_records(jp, with_rids=True)
+    assert len(recs) == 2 and recs[1][3] is None
+
+
+def test_journal_replay_collects_acks(eight_devices, tmp_path):
+    tree, eng, keys, vals = make()
+    jp = str(tmp_path / "rp.bin")
+    j = J.Journal(jp, sync=True)
+    j.append(J.J_UPSERT, keys[:4], keys[:4] ^ np.uint64(0xE1))
+    j.append_acks([(5, "t", J.J_UPSERT, np.asarray([True] * 4))])
+    j.close()
+    sink: list = []
+    stats = J.replay(jp, eng, ack_sink=sink)
+    assert stats["acks"] == 1 and stats["upserts"] == 1
+    assert sink[0][0] == 5 and sink[0][1] == "t"
+    got, found = eng.search(keys[:4])
+    assert found.all()
+    np.testing.assert_array_equal(got, keys[:4] ^ np.uint64(0xE1))
+    # restore for later tests sharing the session-scoped mesh
+    eng.insert(keys[:4], vals[:4])
+
+
 # -- sealed zero-retrace serving loop -----------------------------------------
 
 @pytest.mark.parametrize("fusion", ["aligned", "pipelined"])
 @pytest.mark.parametrize("cache", [False, True])
 def test_sealed_serving_loop_zero_retrace(eight_devices, fusion, cache):
+    """The PR 8 contract on the front door — now with the FULL client
+    contract plane armed (PR 15): exactly-once dedup, deadlines, and
+    the sampling auditor are pure host-side machinery, so the sealed
+    loop must stay zero-retrace with all three on."""
+    from sherman_tpu import audit as A
     tree, eng, keys, vals = make()
     if cache:
         lc = eng.attach_leaf_cache(slots=1024, admit_every=4)
+    aud = A.Auditor(sample_mod=4, interval_s=0.05)
     try:
         with serving(eng, keys, vals, fusion=fusion,
-                     max_queue_ops=16384) as srv:
+                     max_queue_ops=16384, auditor=aud) as srv:
             assert srv._sealed
             rng = np.random.default_rng(1)
             futs = []
@@ -445,16 +763,22 @@ def test_sealed_serving_loop_zero_retrace(eight_devices, fusion, cache):
                 # zipf-ish hot head so the sketch admits real keys
                 idx = rng.integers(0, 50 if i % 2 else keys.size, 120)
                 kreq = keys[idx]
-                futs.append((srv.submit("read", kreq), kreq))
+                futs.append((srv.submit(
+                    "read", kreq,
+                    deadline_ms=60_000.0 if i % 3 else None), kreq))
             for f, kreq in futs:
                 got, found = f.result(timeout=60)
                 assert found.all()
                 np.testing.assert_array_equal(got, kreq * np.uint64(7))
-            # writes + deletes + scans inside the sealed window too
-            srv.submit("insert", keys[:8],
-                       keys[:8] ^ np.uint64(2)).result(timeout=60)
-            srv.submit("delete",
-                       np.asarray([5], np.uint64)).result(timeout=60)
+            # writes + deletes + scans inside the sealed window too —
+            # rid-carrying (dedup window + J_ACK path) and retried
+            srv.submit("insert", keys[:8], keys[:8] ^ np.uint64(2),
+                       rid=501).result(timeout=60)
+            f = srv.submit("insert", keys[:8], keys[:8] ^ np.uint64(2),
+                           rid=501)
+            assert f.result(timeout=60).all() and f.deduped
+            srv.submit("delete", np.asarray([5], np.uint64),
+                       rid=502).result(timeout=60)
             srv.submit("scan", ranges=[(int(keys[0]), int(keys[9]))]
                        ).result(timeout=60)
             assert srv.retraces == 0, \
@@ -462,6 +786,8 @@ def test_sealed_serving_loop_zero_retrace(eight_devices, fusion, cache):
             if cache:
                 cs = srv.stats()["cache"]
                 assert cs["sketch"]["observed_batches"] > 0
+        assert aud.violations == 0
+        assert aud.rec.events > 0  # the auditor really watched
     finally:
         if cache:
             eng.detach_leaf_cache()
@@ -609,6 +935,34 @@ def test_perfgate_serve_gates_within_serve_rounds():
     retargeted = _serve_receipt(p99=20.0, target=25.0)
     res = perfgate.gate(retargeted, [base])
     assert "skipped" in res["metrics"]["serve_read_p99_ms"]
+
+
+def test_perfgate_contract_receipts_hard_pins():
+    """The retrace-red pattern for the contract drill: robustness
+    receipts are never throughput-gated, but duplicate_acks > 0 /
+    lost_acks > 0 / linearizable == false in a committed receipt is a
+    hard red (and a green-pinned receipt PASSES on its pins alone —
+    no exit-2 for carrying no comparable throughput metric)."""
+    import perfgate
+    closed = {"keys": 200_000, "batch": 4096, "value": 1_000_000,
+              "sustained_ops_s": 2_000_000,
+              "sus_dev_ms_per_step": 10.0, "_round": 5}
+    good = {"metric": "contract_drill", "duplicate_acks": 0,
+            "lost_acks": 0, "rpo_ops": 0, "linearizable": True}
+    res = perfgate.gate(good, [closed])
+    assert res["ok"] and "error" not in res, res
+    assert res["metrics"]["contract.duplicate_acks"]["ok"]
+    assert res["metrics"]["contract.linearizable"]["ok"]
+    for bad in ({"duplicate_acks": 1}, {"lost_acks": 3},
+                {"linearizable": False}):
+        res = perfgate.gate(dict(good, **bad), [closed])
+        assert not res["ok"], bad
+    # contract pins never rescue a CLOSED-LOOP receipt that merely
+    # carries the fields: a bench row still gates on throughput
+    cand = dict(closed, _round=None, sustained_ops_s=1_000_000,
+                duplicate_acks=0, linearizable=True)
+    res = perfgate.gate(cand, [closed])
+    assert not res["ok"]  # the -50% sustained loss still fails
 
 
 # -- journal instance stats ---------------------------------------------------

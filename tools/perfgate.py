@@ -61,6 +61,13 @@ Comparability rules (the trajectory's own lessons):
   between rounds whose ``serve.p99_targets_ms`` match: a target change
   re-aims the adaptive controller, which is a config change, not a
   regression;
+- CLIENT-CONTRACT receipts (``tools/contract_drill.py``, metric
+  ``contract_drill``) are robustness artifacts, never throughput-gated
+  — but their pins are HARD reds with no margin (the retrace-red
+  pattern): ``duplicate_acks > 0``, ``lost_acks > 0`` or
+  ``linearizable == false`` in a committed receipt fails the gate
+  outright; with the pins green the receipt passes on them alone
+  (no comparable throughput metric required);
 - a metric missing on either side is skipped, not failed — but a
   candidate with NO comparable metric at all exits 2 (the gate cannot
   vouch for it).
@@ -366,6 +373,48 @@ def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
             }
             out["gated_metrics"].append(mkey)
             out["ok"] = False
+
+    # -- client-contract hard pins (PR 15): the retrace-red pattern ----------
+    # Contract-drill receipts (tools/contract_drill.py) are ROBUSTNESS
+    # artifacts: they carry no comparable throughput metric and must
+    # never be throughput-gated — but a committed receipt claiming
+    # `duplicate_acks > 0`, `lost_acks > 0` or `linearizable == false`
+    # is a hard red with no margin: each is a count/verdict of a
+    # correctness hazard, not a wall.
+    if cand.get("metric") == "contract_drill" \
+            or "duplicate_acks" in cand or "linearizable" in cand:
+        for name in ("duplicate_acks", "lost_acks"):
+            val = cand.get(name)
+            if val is None:
+                continue
+            cok = int(val) == 0
+            out["metrics"][f"contract.{name}"] = {
+                "candidate": int(val), "baseline": 0,
+                "direction": "zero", "ok": cok}
+            out["gated_metrics"].append(f"contract.{name}")
+            if not cok:
+                out["ok"] = False
+        lin = cand.get("linearizable")
+        if lin is not None:
+            lok = bool(lin)
+            out["metrics"]["contract.linearizable"] = {
+                "candidate": lok, "baseline": True,
+                "direction": "true", "ok": lok}
+            out["gated_metrics"].append("contract.linearizable")
+            if not lok:
+                out["ok"] = False
+        # a contract receipt is judged by its pins, not by throughput
+        # comparability: clear the no-comparable-metric error (which
+        # would exit 2) and let the pins decide pass/red.  Other
+        # robustness receipts (reshard/recovery) still exit 2 here by
+        # design — they carry no gateable claim at all.
+        contract_gates = [m for m in out["gated_metrics"]
+                          if m.startswith("contract.")]
+        if out.get("error") and contract_gates:
+            out.pop("error")
+            out["ok"] = all(out["metrics"][m]["ok"]
+                            for m in out["gated_metrics"]
+                            if "ok" in out["metrics"][m])
 
     # -- lint provenance (PR 9): warn, never gate --------------------------
     # bench.py stamps config.lint_clean (shermanlint verdict of the tree
