@@ -110,7 +110,12 @@ client-contract drill (tools/contract_drill.py: exactly-once acks +
 deadlines + the linearizability auditor across chaos, a cold crash,
 recovery and a migration — duplicate_acks == 0, lost_acks == 0,
 linearizable == true) — see README "Client contract"; ``bench.py
---serve`` runs the serving
+--failover-drill`` runs the replication drill (tools/failover_drill.py:
+journal-shipped followers + lease-epoch promotion + replica-served
+reads; kill the primary under acked traffic -> promote the highest-
+watermark follower -> lost_acks == 0, duplicate_acks == 0,
+linearizable == true) — see README "Replication & failover";
+``bench.py --serve`` runs the serving
 front door's OPEN-loop bench (tools/serve_bench.py: multi-tenant paced
 clients through sherman_tpu/serve.py — SLO-adaptive step width,
 fair-share admission + typed backpressure, journaled write acks, and
@@ -1413,6 +1418,26 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import contract_drill
         contract_drill.main(sys.argv[1:])
+        return
+
+    if "--failover-drill" in sys.argv:
+        # Replication lane: journal-shipped replica groups + lease-
+        # epoch failover rehearsed end to end (follower tier applying
+        # the shipped journal through recovery's own apply core ->
+        # replica-served certified reads -> kill the primary under
+        # acked mixed traffic with a torn shipping tail -> lease-epoch
+        # promotion with the stale primary fenced typed -> front door
+        # resumed on the winner with the replayed exactly-once window
+        # -> retry-across-failover re-acked not re-applied), pinning
+        # lost_acks == 0, duplicate_acks == 0, linearizable == true
+        # plus published replication-lag and availability-gap ms.
+        # tools/failover_drill.py owns the sequence; it prints its own
+        # one-line JSON receipt.
+        sys.argv.remove("--failover-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import failover_drill
+        failover_drill.main(sys.argv[1:])
         return
 
     if "--reshard-drill" in sys.argv:

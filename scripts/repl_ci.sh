@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Replication-plane CI lane: pin the journal-shipped replica groups /
+# lease-epoch failover / replica-served reads plane
+# (sherman_tpu/replica.py + utils/journal.py apply_records +
+# models/leaf_cache.py payload sidecar + serve.py ack provenance).
+#
+# Runs (1) the replication fast tier — the tailer's shipping-boundary
+# contract (live torn tail waits, final torn tail skips, mid-file
+# corruption typed, mid-rotation ordering, sweep re-bootstrap,
+# v1-segment followers), durable watermarks, promote + typed fencing,
+# certified replica reads, the replica-off bit-identity pin, the
+# heap-ack provenance retry-across-crash pin, and the payload-sidecar
+# bit-identity/stale-handle pins; (2) the replication storm fuzz
+# round (random kills => the promoted state always converges); and
+# (3) the failover drill end to end with its receipt pins asserted.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== replication fast tier (tailer, watermarks, fencing, sidecar) =="
+python -m pytest tests/test_replica.py -q
+python -m pytest \
+    tests/test_value_heap.py::test_heap_ack_provenance_retry_across_crash \
+    tests/test_value_heap.py::test_serve_sidecar_skips_gather_bit_identical \
+    tests/test_leaf_cache.py::test_payload_sidecar_pin_hit_stale_capacity_flush \
+    -q
+
+echo "== replication storm fuzz round (random kills -> convergence) =="
+python -m pytest tests/test_fuzz.py::test_fuzz_repl_storm -q
+
+echo "== failover drill (kill primary under acked traffic -> promote) =="
+SHERMAN_FAILOVER_RECEIPT=/tmp/_repl_ci.json \
+    python bench.py --failover-drill --keys 3000 --secs 2
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/_repl_ci.json"))
+assert d["ok"], "drill not ok"
+assert d["lost_acks"] == 0, f"lost acks: {d['lost_acks']}"
+assert d["duplicate_acks"] == 0, f"duplicate acks: {d['duplicate_acks']}"
+assert d["linearizable"] is True, "history not linearizable"
+assert d["fenced_writes"] > 0, "stale primary never fenced"
+assert d["repl"]["applied_records"] > 0, "followers applied nothing"
+assert d["repl"]["reads_served"] > 0, "replica tier served no reads"
+assert d["repl"]["rebootstraps"] >= d["replicas"], \
+    "checkpoint sweep never re-bootstrapped the followers"
+assert d["retry_across_failover"]["retried"] > 0
+assert d["availability_gap_ms"] > 0 and d["repl"]["lag_ms"] >= 0
+print("failover drill:", d["repl"]["followers"], "followers,",
+      d["repl"]["applied_records"], "records shipped,",
+      d["repl"]["reads_served"], "replica reads served,",
+      d["retry_across_failover"]["retried"],
+      "rids retried across the failover; lag",
+      d["repl"]["lag_ms"], "ms, gap",
+      round(d["availability_gap_ms"]), "ms")
+EOF
+
+echo "== perfgate: committed failover receipt passes on its pins =="
+python tools/perfgate.py --receipt /tmp/_repl_ci.json --json
+echo "REPL-CI PASS"

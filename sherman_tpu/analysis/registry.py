@@ -134,6 +134,11 @@ DEFAULT_REGISTRY = Registry(
          "ValueHeap._get_resolve.kernel"),
         ("sherman_tpu/models/value_heap.py",
          "ValueHeap._get_fused.kernel"),
+        # replication plane (PR 16): the follower apply loop runs once
+        # per poll for EVERY shipped record batch — a stray host sync
+        # here turns replication lag into a per-record device
+        # round-trip, and the lag gauge is a headline receipt number
+        ("sherman_tpu/replica.py", "Follower.pump"),
     ],
     static_roots={"cfg", "config", "self", "C", "D", "CFG", "bits",
                   "layout"},
@@ -195,6 +200,13 @@ DEFAULT_REGISTRY = Registry(
         # accounting runs on every completed batch inside the serve
         # wall (the < 2% pin's own numerator must not allocate)
         ("sherman_tpu/audit.py", "Auditor._note_cost"),
+        # replication plane (PR 16): replica-read and fencing
+        # accounting — _note_reads runs on every replica-tier read
+        # batch and _note_fenced inside the durability gate's fence
+        # check; plain integer adds, the repl.* collector allocates at
+        # PULL time like every other collector
+        ("sherman_tpu/replica.py", "ReplicaGroup._note_reads"),
+        ("sherman_tpu/replica.py", "ReplicaGroup._note_fenced"),
     ],
     knob_docs=["BENCHMARKS.md"],
 )

@@ -153,6 +153,52 @@ def leaf_cache_slots() -> int:
     return n
 
 
+def replica_count() -> int:
+    """Replication-plane knob (``SHERMAN_REPL``): number of in-process
+    follower engines in the journal-shipped replica group
+    (:mod:`sherman_tpu.replica`), 0 = disabled.
+
+    Off is the SHIPPED DEFAULT (standing guardrail): with the knob
+    unset no follower is constructed, no tailer polls, and the primary
+    pool is bit-identical to a build without the subsystem (the
+    replica-off identity pin in ``tests/test_replica.py``).
+    ``SHERMAN_REPL=1`` runs one follower; any larger integer is the
+    follower count."""
+    import os
+    v = os.environ.get("SHERMAN_REPL", "0").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return 0
+    if v in ("1", "true", "on", "yes"):
+        return 1
+    try:
+        n = int(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_REPL={v!r}: want 0/1 or a follower count")
+    if n < 0:
+        raise ConfigError(f"SHERMAN_REPL={n}: want >= 0")
+    return n
+
+
+def replica_poll_ms() -> float:
+    """Replication tail cadence knob (``SHERMAN_REPL_POLL_MS``): how
+    often the follower tail polls the primary's live journal segment
+    for newly shipped records (milliseconds; the background-thread
+    mode of :class:`sherman_tpu.replica.ReplicaGroup` — drivers that
+    pump synchronously ignore it).  Lower = fresher followers
+    (smaller replication lag) at more filesystem polls."""
+    import os
+    v = os.environ.get("SHERMAN_REPL_POLL_MS", "20").strip()
+    try:
+        ms = float(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_REPL_POLL_MS={v!r}: want a float of milliseconds")
+    if ms <= 0:
+        raise ConfigError(f"SHERMAN_REPL_POLL_MS={ms}: want > 0")
+    return ms
+
+
 @dataclasses.dataclass(frozen=True)
 class DSMConfig:
     """Cluster + memory-pool shape (reference ``Config.h:13-22``).

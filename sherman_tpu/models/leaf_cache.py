@@ -289,6 +289,18 @@ class LeafCache:
         self.evictions = 0
         self.fills = 0
         self.placement_failures = 0
+        # payload sidecar (PR 16): {key: (heap handle, payload bytes)}
+        # pinned by the serving read path after a heap gather.  A later
+        # hit whose CACHED HANDLE still equals the tree's live value
+        # (the handle IS the value for a heap-backed tree, and its
+        # version field bumps on every rewrite) returns the pinned
+        # bytes and skips the fused heap-resolve gather entirely; any
+        # mismatch is stale — dropped and re-gathered, never served.
+        # Bounded by the same admitted-key budget as the tables.
+        self._sidecar: dict[int, tuple[int, bytes]] = {}
+        self.sidecar_hits = 0
+        self.sidecar_stale = 0
+        self.sidecar_pins = 0
         ref = weakref.ref(self)
 
         def _collect():
@@ -315,6 +327,10 @@ class LeafCache:
             "evictions": self.evictions,
             "fills": self.fills,
             "placement_failures": self.placement_failures,
+            "sidecar_hits": self.sidecar_hits,
+            "sidecar_stale": self.sidecar_stale,
+            "sidecar_pins": self.sidecar_pins,
+            "sidecar_keys": len(self._sidecar),
             "hit_ratio": (self.hits / total) if total else 0.0,
             "cached_keys": int((self._keys != 0).sum()),
             "slots": self.slots,
@@ -575,6 +591,10 @@ class LeafCache:
         if keys.size == 0:
             return 0
         with self._lock:
+            if self._sidecar:
+                # a pin needs no table slot, so drop by key directly
+                for k in keys:
+                    self._sidecar.pop(int(k), None)
             m = (self._keys != 0) & np.isin(self._keys, keys)
             return self._clear(m)
 
@@ -593,17 +613,69 @@ class LeafCache:
         """Drop everything — the degraded-entry / recovery / targeted-
         repair contract (the cache is volatile by design)."""
         with self._lock:
+            self._sidecar.clear()  # pins are volatile with the rest
             return self._clear(self._keys != 0)
 
     def _clear(self, m: np.ndarray) -> int:
         n = int(m.sum())
         if n:
+            if self._sidecar:
+                # pinned payloads ride the same invalidation: a write
+                # to the key bumps its handle, so the pin is dead
+                for k in self._keys[m]:
+                    self._sidecar.pop(int(k), None)
             for a in self._table_host():
                 a[m] = 0
             self._keys[m] = 0
             self._dirty = True
             self.invalidations += n
         return n
+
+    # -- payload sidecar (PR 16) ----------------------------------------------
+
+    def pin_payloads(self, keys, handles, blobs) -> int:
+        """Pin gathered payload bytes keyed by (key, heap handle) so
+        the NEXT read of the key skips the heap-resolve gather.  The
+        handle is the staleness token: serving checks it against the
+        tree's live value (which a rewrite always changes — new row,
+        or same row under a bumped version nibble).  Returns pins
+        stored; over-budget pins evict oldest-pinned first."""
+        n = 0
+        with self._lock:
+            for k, h, b in zip(keys, handles, blobs):
+                if b is None:
+                    continue
+                while len(self._sidecar) >= self.capacity:
+                    self._sidecar.pop(next(iter(self._sidecar)))
+                    self.evictions += 1
+                self._sidecar[int(k)] = (int(h), bytes(b))
+                n += 1
+            self.sidecar_pins += n
+        return n
+
+    def payload_hits(self, keys, handles) -> list:
+        """Per position: the pinned bytes when the sidecar holds the
+        key under EXACTLY the given live handle, else ``None``.  A
+        key pinned under a different handle is stale — dropped and
+        counted, and the caller re-gathers (a stale pin can delay a
+        gather, never falsify one)."""
+        out = []
+        hits = stale = 0
+        with self._lock:
+            for k, h in zip(keys, handles):
+                ent = self._sidecar.get(int(k))
+                if ent is None:
+                    out.append(None)
+                elif ent[0] == int(h):
+                    hits += 1
+                    out.append(ent[1])
+                else:
+                    stale += 1
+                    del self._sidecar[int(k)]
+                    out.append(None)
+            self.sidecar_hits += hits
+            self.sidecar_stale += stale
+        return out
 
     def cached_keys(self) -> np.ndarray:
         """The currently admitted key set (uint64, unordered)."""

@@ -350,7 +350,9 @@ class ValueHeap:
         """Upsert variable-length ``payloads`` (list of bytes) under
         uint64 ``keys``.  Duplicate keys in one batch: last writer
         wins (the engine's own upsert linearization).  Returns
-        {applied, allocated, freed, lock_timeouts, lock_timeout_keys}.
+        {applied, allocated, freed, lock_timeouts, lock_timeout_keys,
+        handle_map} — ``handle_map`` maps each applied key to the u64
+        handle (slab address + version) its payload landed at.
 
         Protocol — NEVER destroy before install: every record gets a
         FRESH slab (write payload -> journal J_HEAP_PUT -> install the
@@ -369,7 +371,8 @@ class ValueHeap:
             raise ConfigError("put needs one payload per key")
         if keys.size == 0:
             return {"applied": 0, "allocated": 0, "freed": 0,
-                    "lock_timeouts": 0, "lock_timeout_keys": []}
+                    "lock_timeouts": 0, "lock_timeout_keys": [],
+                    "handle_map": {}}
         # dedup keeping the LAST occurrence (upsert semantics)
         _, last_idx = np.unique(keys[::-1], return_index=True)
         order = np.sort(keys.size - 1 - last_idx)
@@ -408,11 +411,18 @@ class ValueHeap:
                     j.append(JJ.J_DELETE, ukeys[f_fresh])
         self._note_put(int(ukeys.size))
         _OBS_PUTS.inc(int(ukeys.size))
+        # handle_map: payload provenance per APPLIED key (the slab
+        # address + version its bytes landed at) — the serving front
+        # door journals these with the batch's J_ACK record (PR 16)
+        # so a recovered window entry attests WHERE an acked payload
+        # lives, not just that it was acked
         return {"applied": int(stats["applied"]),
                 "allocated": int(ukeys.size),
                 "freed": int(old_freeable.sum()),
                 "lock_timeouts": int(failed.sum()),
-                "lock_timeout_keys": ukeys[failed].tolist()}
+                "lock_timeout_keys": ukeys[failed].tolist(),
+                "handle_map": {int(k): int(h) for k, h in
+                               zip(ukeys[ok], handles[ok])}}
 
     def _handle_live(self, row: int, slab: int, cls: int,
                      ver: int) -> bool:

@@ -125,7 +125,9 @@ class RecoveryPlane:
         self._segment = 0
         #: exactly-once window reconstructed by :meth:`recover` from
         #: the journal's J_ACK records: {(tenant, rid): (op_kind, ok)}
-        #: in ack order — ``ShermanServer.seed_dedup`` adopts it so a
+        #: — heap-write entries carry a third payload-provenance
+        #: element (handles u64, PR 16) — in ack order;
+        #: ``ShermanServer.seed_dedup`` adopts it so a
         #: write retried across the crash re-acks its ORIGINAL result
         self.dedup_window: dict = {}
         # host-memory accountant source (obs/device.py): total on-disk
@@ -227,8 +229,12 @@ class RecoveryPlane:
                 carry: dict = {}
                 for kind, _keys, aux in J.read_records(old.path):
                     if kind == J.J_ACK:
-                        for rid, tenant, op, ok in aux:
-                            carry[(tenant, rid)] = (rid, tenant, op, ok)
+                        # star-unpack: provenance-bearing entries (heap
+                        # writes, PR 16) are 5-tuples and carry forward
+                        # whole — re-encoding preserves the handles
+                        for entry in aux:
+                            rid, tenant = entry[0], entry[1]
+                            carry[(tenant, rid)] = entry
                 acks = list(carry.values())[-self.ack_carry:] \
                     if self.ack_carry > 0 else []
                 if acks:
@@ -360,8 +366,8 @@ class RecoveryPlane:
         plane = cls(cluster, tree, eng, directory,
                     journal_sync=journal_sync,
                     group_commit_ms=group_commit_ms)
-        for rid, tenant, op, ok in acks:
-            plane.dedup_window[(tenant, rid)] = (op, ok)
+        for rid, tenant, op, ok, *prov in acks:
+            plane.dedup_window[(tenant, rid)] = (op, ok, *prov)
         plane.checkpoint_base()  # re-base: fresh chain, stale cid swept
         t_end = time.perf_counter()
         _OBS_RECOVERS.inc()
@@ -548,8 +554,8 @@ class RecoveryPlane:
                 replay_stats = J.replay(
                     self._journal_path(self._segment), self.eng,
                     ack_sink=acks)
-                for rid, tenant, op, ok in acks:
-                    self.dedup_window[(tenant, rid)] = (op, ok)
+                for rid, tenant, op, ok, *prov in acks:
+                    self.dedup_window[(tenant, rid)] = (op, ok, *prov)
             else:
                 replay_stats = {"records": 0, "rows": 0}
         finally:

@@ -1,0 +1,734 @@
+"""Replication plane — journal-shipped replica groups, lease-epoch
+failover, and replica-served reads.
+
+Sherman keeps exactly one copy of every page (survey L2/L3: the MN
+pool is singular), so the recovery plane's answer to node loss is a
+disk restore — RPO 0, but an availability gap of seconds while the
+chain restores and the journal replays.  This module closes that gap
+with the substrate the repo already has: the CRC-framed v2 journal
+(``utils/journal.py``) *is* a replication log, and the lease-epoch
+table (``cluster.py``) already names liveness.
+
+**Topology** (the repo's one-process-cluster emulation pattern): a
+:class:`ReplicaGroup` of N in-process **follower** engines, each built
+from the primary's on-disk checkpoint chain exactly the way
+``RecoveryPlane.recover`` builds one (restore chain -> Tree ->
+BatchedEngine -> heap rebuild), then fed by a **journal-shipping
+tail**: an incremental reader (:class:`JournalTailer`) over the
+primary's live segment directory.  Followers apply shipped
+J_UPSERT/J_DELETE/J_HEAP_*/J_ACK records through
+:func:`sherman_tpu.utils.journal.apply_records` — the SAME dispatch
+loop recovery replays through, so a follower's apply semantics and
+recovery's are identical by construction, not by convention.
+
+**Watermarks**: each follower publishes a durable ``applied_(cid,
+link, seq)`` watermark (atomic JSON + fsync in its own directory)
+after every apply batch — the promotion-time freshness order and the
+operator's replication-lag receipt.
+
+**Tail contract at the shipping boundary**: a torn frame at the tail
+of the LIVE segment is an append in flight — the follower WAITS (it
+must never truncate the primary's file; that is recovery's
+prerogative).  A torn tail on a segment that has a successor (or
+after the primary is declared dead) is final by the same rule
+recovery applies: skip it and advance.  Mid-file corruption raises
+the typed ``JournalCorruptError`` — a follower must refuse rather
+than silently diverge.  A swept current segment (a checkpoint
+retired it under the tail) or a re-based chain id triggers a
+re-bootstrap from the newer chain — convergent, because the chain
+captured everything the swept segment carried.
+
+**Failover** rides the lease-epoch table: the group registers a
+lease for the primary's write authority and fences every journal
+append through it (:class:`_FencedJournal`).  :meth:`ReplicaGroup.
+promote` expires that lease (``cluster.expire_client`` — the same
+epoch bump that makes a dead client's locks revocable), bumps the
+group epoch, catches every follower up to the durable journal end
+(records are fsync'd pre-ack, so the catch-up is RPO 0), and picks
+the highest-watermark follower.  A stale primary that keeps writing
+hits the epoch check at its own durability gate and fails typed
+(:class:`StalePrimaryError`) — fenced, never silently divergent.
+The promoted follower's replayed J_ACK window re-seeds the front
+door's exactly-once dedup window (``ShermanServer.seed_dedup``), so
+a write retried across the failover re-acks its original result.
+
+**Replica reads**: a follower serves the hot-key tier's traffic
+through the leaf cache's existing version-revalidation token against
+its OWN snapshot — a probe hit is re-certified against the
+follower's pool, bit-identical to a descent there; anything stale is
+a miss and forwards to the primary, never a lie.  The group serves
+replica reads only from a follower that is caught up to the durable
+journal end at its last pump (the freshness gate the drill pins).
+
+``tools/failover_drill.py`` (``bench.py --failover-drill``) rehearses
+kill -> promote -> retry-across-failover end to end and pins
+``lost_acks == 0``, ``duplicate_acks == 0``, ``linearizable ==
+true``.  OFF by default (``SHERMAN_REPL=0``): no follower is
+constructed and the primary is bit-identical to a build without the
+subsystem (the replica-off identity pin).
+
+Observability: the ``repl.`` collector (followers, applied records/
+rows, absorbed acks, torn-tail waits, re-bootstraps, promotions,
+fenced writes, replica reads served/forwarded, watermark, epoch) plus
+``repl.lag_ms`` / ``repl.availability_gap_ms`` gauges and flight
+events (``repl.promote``, ``repl.fenced``, ``repl.tail_torn_wait``,
+``repl.rebootstrap``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+import zlib
+
+import numpy as np
+
+from sherman_tpu import config as C
+from sherman_tpu import obs
+from sherman_tpu.errors import ConfigError, StateError
+from sherman_tpu.utils import journal as J
+
+_OBS_LAG_MS = obs.gauge("repl.lag_ms")
+_OBS_GAP_MS = obs.gauge("repl.availability_gap_ms")
+_OBS_APPLIED = obs.counter("repl.applied_records")
+_OBS_PROMOTIONS = obs.counter("repl.promotions")
+_OBS_FENCED = obs.counter("repl.fenced_writes")
+
+
+class StalePrimaryError(StateError):
+    """A write reached the durability gate under an EXPIRED primary
+    lease: the group promoted a follower (epoch bumped past this
+    primary's), so appending would fork the journal behind the new
+    primary's back.  The write fails typed — the fence that makes
+    split-brain structurally impossible instead of merely unlikely."""
+
+
+class _ResyncRequired(StateError):
+    """Internal tailer signal: the current segment was swept (a
+    checkpoint covered it) or the chain re-based — re-bootstrap the
+    follower from the newer chain (convergent by the checkpoint
+    coverage argument)."""
+
+
+# -- incremental segment reader ---------------------------------------------
+
+
+class JournalTailer:
+    """Incremental frame reader over one recovery directory's live
+    journal segments — the shipping feed.  Tracks (segment, byte
+    offset); :meth:`poll` decodes every frame fully landed since the
+    last call and advances across rotations.  See the module
+    docstring for the torn-tail / sweep / re-base contract."""
+
+    def __init__(self, directory: str, cid: str):
+        self.dir = directory
+        self.cid = cid
+        self._cur: str | None = None   # current segment path
+        self._off = 0                  # consumed bytes (past magic)
+        self._fmt = 2
+        self.torn_waits = 0
+        # anchor EAGERLY: the tailer owes its creator every record in
+        # the earliest segment alive NOW.  A lazy (first-poll) anchor
+        # would let a checkpoint sweep that segment unseen — the
+        # records would land in a delta the follower never restored,
+        # and the tail would silently resume past them.  Anchored,
+        # the sweep trips the `_cur not in segs` resync check above.
+        segs = self._segments()
+        if segs:
+            self._cur = segs[0]
+
+    def _segments(self) -> list[str]:
+        from sherman_tpu.recovery import RecoveryPlane
+        cid, _deltas, journals = RecoveryPlane._discover(self.dir)
+        if cid != self.cid:
+            raise _ResyncRequired(
+                f"chain re-based ({self.cid} -> {cid})")
+        return journals
+
+    def poll(self, final: bool = False) -> list[tuple]:
+        """-> decoded records (``with_rids`` 4-tuples) newly durable
+        since the last poll, across any number of rotations.  With
+        ``final`` (the primary is dead — promotion's catch-up pass) a
+        torn tail on the LAST segment is final too: skipped, exactly
+        as recovery would truncate it."""
+        out: list[tuple] = []
+        while True:
+            segs = self._segments()
+            if self._cur is not None and self._cur not in segs:
+                # the segment under the tail was swept: a checkpoint
+                # covers it, but bytes may have landed there after our
+                # last read — only the chain knows, so re-bootstrap
+                # (always safe; sweeps happen once per checkpoint)
+                raise _ResyncRequired(
+                    f"segment {os.path.basename(self._cur)} swept "
+                    "under the tail")
+            if self._cur is None:
+                if not segs:
+                    return out
+                self._cur, self._off, self._fmt = segs[0], 0, 2
+            # list-then-read ordering matters: a successor listed NOW
+            # proves the current segment was closed before we read it,
+            # so a torn tail below is final, not in flight
+            recs, torn = self._poll_segment(self._cur)
+            out.extend(recs)
+            later = [s for s in segs if s > self._cur]
+            if later:
+                # rotation: finish here (torn tail, if any, is final —
+                # the successor supersedes it) and advance
+                self._cur, self._off, self._fmt = later[0], 0, 2
+                continue
+            if torn and not final:
+                # live-tail rule: an append may be in flight — wait.
+                self.torn_waits += 1
+                obs.record_event("repl.tail_torn_wait",
+                                 segment=os.path.basename(self._cur),
+                                 at_byte=self._off)
+            return out
+
+    def _poll_segment(self, path: str) -> tuple[list[tuple], bool]:
+        """-> (records decoded from complete frames past the offset,
+        torn) — ``torn`` True when a partial frame remains at the
+        tail.  Never writes the file (the primary owns it)."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._off)
+                blob = f.read()
+        except FileNotFoundError:
+            raise _ResyncRequired(
+                f"segment {os.path.basename(path)} swept under the "
+                "tail")
+        base = self._off
+        pos = 0
+        if base == 0:
+            if len(blob) < len(J.MAGIC):
+                return [], True  # magic still landing
+            head = blob[: len(J.MAGIC)]
+            if head == J.MAGIC:
+                self._fmt = 2
+            elif head == J.MAGIC_V1:
+                self._fmt = 1  # pre-rid segment: dedup-disabled replay
+            else:
+                raise J.JournalCorruptError(
+                    f"{path}: bad journal magic {head!r}")
+            pos = len(J.MAGIC)
+        out: list[tuple] = []
+        size = len(blob)
+        while pos < size:
+            if pos + J._HDR.size > size:
+                break  # torn header
+            length, crc = J._HDR.unpack_from(blob, pos)
+            end = pos + J._HDR.size + length
+            if length > J.MAX_PAYLOAD:
+                if end > size or end < 0:
+                    break  # torn length word — tail rule
+                raise J.JournalCorruptError(
+                    f"{path}: frame at byte {base + pos} claims "
+                    f"{length} bytes (> {J.MAX_PAYLOAD}) with bytes "
+                    "following")
+            if end > size:
+                break  # torn payload
+            payload = blob[pos + J._HDR.size: end]
+            if zlib.crc32(payload) != crc:
+                if end == size:
+                    break  # torn append at the tail
+                raise J.JournalCorruptError(
+                    f"{path}: CRC mismatch at byte {base + pos} with "
+                    f"{size - end} bytes following — content "
+                    "corruption, refusing to apply")
+            out.append(J._decode_payload(payload, base + pos,
+                                         self._fmt))
+            pos = end
+        self._off = base + pos
+        return out, pos < size
+
+
+# -- the epoch fence at the durability gate ---------------------------------
+
+
+class _FencedJournal:
+    """Journal proxy that checks the primary's lease epoch before
+    every append — the write fence.  Everything else (close, stats,
+    path, rotation handoff) delegates to the wrapped segment, so the
+    recovery plane's rotation protocol is untouched."""
+
+    def __init__(self, inner, group: "ReplicaGroup"):
+        self._inner = inner
+        self._group = group
+
+    def append(self, *a, **kw):
+        self._group._check_fence()
+        return self._inner.append(*a, **kw)
+
+    def append_acks(self, *a, **kw):
+        self._group._check_fence()
+        return self._inner.append_acks(*a, **kw)
+
+    def append_heap(self, *a, **kw):
+        self._group._check_fence()
+        return self._inner.append_heap(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+# -- one follower -----------------------------------------------------------
+
+
+class Follower:
+    """One in-process follower engine: bootstrapped from the
+    primary's on-disk chain the way ``RecoveryPlane.recover``
+    bootstraps (the shared-code contract), tailed from its journal
+    directory, publishing a durable applied watermark."""
+
+    def __init__(self, group: "ReplicaGroup", idx: int):
+        self.group = group
+        self.idx = idx
+        self.dir = os.path.join(group.dir, f"follower-{idx}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.stats: dict = {}
+        #: replayed exactly-once entries {(tenant, rid): (op, ok[,
+        #: handles])} — promotion re-seeds the front door from the
+        #: winner's window (``ShermanServer.seed_dedup``)
+        self.window: dict = {}
+        self.rebootstraps = -1  # first bootstrap is not a re-
+        self.caught_up = False
+        self.cluster = self.tree = self.eng = None
+        self.cid = None
+        self.link = 0   # delta links restored at (re)bootstrap
+        self.seq = 0    # records applied since (re)bootstrap
+        self._bootstrap()
+
+    def _bootstrap(self) -> None:
+        """(Re)build the engine from the primary's chain — the same
+        restore -> Tree -> engine -> heap-rebuild sequence
+        ``RecoveryPlane.recover`` runs, minus the re-base (a follower
+        never writes the chain it follows)."""
+        from sherman_tpu.models.batched import BatchedEngine
+        from sherman_tpu.models.btree import Tree
+        from sherman_tpu.recovery import RecoveryPlane
+        from sherman_tpu.utils import checkpoint as CK
+
+        g = self.group
+        cid, deltas, _journals = RecoveryPlane._discover(g.primary_dir)
+        cluster = CK.restore_chain(
+            os.path.join(g.primary_dir, "base.npz"), deltas)
+        tree = Tree(cluster)
+        eng = BatchedEngine(tree, batch_per_node=g.batch_per_node,
+                            tcfg=g.tcfg)
+        eng.attach_router()
+        if cluster.cfg.heap_pages_per_node > 0:
+            from sherman_tpu.models.value_heap import ValueHeap
+            ValueHeap(eng).rebuild()
+        if g.cache_slots:
+            eng.attach_leaf_cache(slots=g.cache_slots)
+        self.cluster, self.tree, self.eng = cluster, tree, eng
+        self.cid = cid
+        self.link = len(deltas)
+        self.seq = 0
+        self.window.clear()
+        self.caught_up = False
+        self.tailer = JournalTailer(g.primary_dir, cid)
+        # a checkpoint that lands between the restore above and the
+        # tailer's anchor would sweep records into a delta we did not
+        # restore while the tailer anchors past them — re-discover and
+        # start over if the chain moved (bounded: one loop per
+        # checkpoint, and checkpoints are seconds apart)
+        cid2, deltas2, _ = RecoveryPlane._discover(g.primary_dir)
+        if cid2 != cid or len(deltas2) != len(deltas):
+            self._bootstrap()
+            return
+        self.rebootstraps += 1
+        if self.rebootstraps:
+            obs.record_event("repl.rebootstrap", follower=self.idx,
+                             cid=cid, link=self.link)
+        self._publish_watermark()
+
+    def pump(self, final: bool = False) -> int:
+        """Poll the tail and apply every newly durable record through
+        the shared :func:`~sherman_tpu.utils.journal.apply_records`
+        core; publish the watermark.  Returns records applied."""
+        try:
+            recs = self.tailer.poll(final=final)
+        except _ResyncRequired:
+            self._bootstrap()
+            recs = self.tailer.poll(final=final)
+        if not recs:
+            self.caught_up = True
+            return 0
+        sink: list = []
+        J.apply_records(recs, self.eng, ack_sink=sink,
+                        stats=self.stats)
+        for entry in sink:
+            # later acks override earlier — the front door's own
+            # last-writer window semantics; provenance rides along
+            rid, tenant = entry[0], entry[1]
+            self.window[(tenant, rid)] = tuple(entry[2:])
+        self.seq += len(recs)
+        self.caught_up = True
+        _OBS_APPLIED.inc(len(recs))
+        self._publish_watermark()
+        return len(recs)
+
+    def watermark(self) -> tuple[str, int, int]:
+        """``(cid, link, seq)`` — the promotion freshness order
+        (compared lexicographically on (link, seq) within one cid;
+        promote catches every follower up first, so the order only
+        breaks ties between already-converged followers)."""
+        return (self.cid, self.link, self.seq)
+
+    def _publish_watermark(self) -> None:
+        """Durable ``applied_(cid, seq)`` watermark: atomic JSON
+        (tmp + rename + fsync) in the follower's own directory — an
+        operator (or a future cold-started group) reads how far this
+        follower got without touching its engine."""
+        path = os.path.join(self.dir, "watermark.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"cid": self.cid, "link": self.link,
+                                "seq": self.seq}))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def serve_read(self, keys):
+        """Replica-served reads through the leaf cache's revalidation
+        token against the follower's OWN snapshot: a probe hit is
+        re-certified against this pool (bit-identical to a descent
+        here); a stale or absent entry is a miss.  Returns ``(vals,
+        hit)`` — or ``None`` when this follower may not serve at all
+        (no cache attached, or not caught up to the durable journal
+        end at its last pump: staleness forwards, never lies)."""
+        cache = self.eng.leaf_cache
+        if cache is None or not self.caught_up:
+            return None
+        from sherman_tpu.ops import bits
+        eng = self.eng
+        keys = np.asarray(keys, np.uint64)
+        n = keys.shape[0]
+        total = eng.cfg.machine_nr * eng.B
+        vals = np.zeros(n, np.uint64)
+        hit = np.zeros(n, bool)
+        for i in range(0, n, total):
+            chunk = keys[i:i + total]
+            khi, klo = bits.keys_to_pairs(chunk)
+            (khi, _), (klo, _) = eng._pad(khi), eng._pad(klo)
+            active, _ = eng._pad(np.ones(chunk.shape[0], bool))
+            h, vhi, vlo = cache.probe(khi, klo, active)
+            v = bits.pairs_to_keys(vhi, vlo)
+            vals[i:i + total] = v[: chunk.shape[0]]
+            hit[i:i + total] = h[: chunk.shape[0]]
+        return vals, hit
+
+    def admit(self, keys) -> dict:
+        """Admit ``keys`` into the follower's leaf cache (resolved
+        against its own snapshot) — the replica read set."""
+        if self.eng.leaf_cache is None:
+            raise StateError("follower has no leaf cache attached "
+                             "(ReplicaGroup(cache_slots=...))")
+        return self.eng.leaf_cache.fill(np.asarray(keys, np.uint64))
+
+
+# -- the group --------------------------------------------------------------
+
+
+class ReplicaGroup:
+    """N journal-shipped followers + the lease-epoch failover plane
+    over one primary ``RecoveryPlane``.  See the module docstring for
+    the full protocol; lifecycle::
+
+        plane.checkpoint_base()          # the chain followers feed on
+        group = ReplicaGroup(plane, n=2)
+        group.start()                    # background tail (or pump())
+        ...
+        srv.kill()                       # primary dies
+        rcpt = group.promote(t_dead=t)   # fence + catch-up + pick
+        new_eng = group.promoted.eng     # resume the front door here
+        srv2 = ShermanServer(new_eng, cfg)
+        srv2.start(...)
+        srv2.seed_dedup(group.promoted_window())
+        group.note_resumed()             # availability-gap receipt
+    """
+
+    def __init__(self, plane, n: int | None = None, *,
+                 poll_ms: float | None = None,
+                 batch_per_node: int = 512, tcfg=None,
+                 cache_slots: int | None = None,
+                 directory: str | None = None):
+        n = C.replica_count() if n is None else int(n)
+        if n <= 0:
+            raise ConfigError(
+                "ReplicaGroup wants >= 1 follower (replication is OFF "
+                "by default — SHERMAN_REPL=0; use ReplicaGroup."
+                "from_env for knob-gated construction)")
+        if plane.cid is None:
+            raise StateError("primary has no chain yet: "
+                             "plane.checkpoint_base() first")
+        self.plane = plane
+        self.primary_dir = plane.dir
+        self.batch_per_node = int(batch_per_node)
+        self.tcfg = tcfg
+        self.cache_slots = cache_slots
+        self.poll_ms = C.replica_poll_ms() if poll_ms is None \
+            else float(poll_ms)
+        self.dir = directory or os.path.join(plane.dir, "replicas")
+        os.makedirs(self.dir, exist_ok=True)
+        #: group epoch: bumped at every promotion; the fence below
+        #: rides the CLUSTER lease-epoch table, this mirrors it for
+        #: receipts
+        self.epoch = 1
+        # the primary's write authority as a lease on its own cluster:
+        # promotion expires it (the same epoch bump that revokes a
+        # dead client's locks) and the fence checks it per append
+        self._lease = plane.cluster.register_client()
+        self._install_fence(plane.eng)
+        self.promoted: Follower | None = None
+        self._t_dead: float | None = None
+        self.availability_gap_ms: float | None = None
+        # receipt counters (plain adds on the accounting paths, SL006)
+        self.promotions = 0
+        self.fenced_writes = 0
+        self.reads_served = 0
+        self.reads_forwarded = 0
+        self.last_pump_records = 0
+        self._last_pump_t = 0.0
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._pump_lock = threading.Lock()
+        self.followers = [Follower(self, i) for i in range(n)]
+        ref = weakref.ref(self)
+
+        def _collect():
+            g = ref()
+            return g._collect() if g is not None else {}
+
+        obs.register_collector("repl", _collect)
+
+    @classmethod
+    def from_env(cls, plane, **kw):
+        """Knob-gated construction: ``None`` when ``SHERMAN_REPL`` is
+        unset/0 (the shipped default — no follower, no tailer, the
+        primary bit-identical to a build without the subsystem)."""
+        n = C.replica_count()
+        return None if n == 0 else cls(plane, n, **kw)
+
+    # -- hot accounting (SL006 scope: plain adds only) -----------------------
+
+    def _note_reads(self, served: int, forwarded: int) -> None:
+        self.reads_served += served
+        self.reads_forwarded += forwarded
+
+    def _note_fenced(self) -> None:
+        self.fenced_writes += 1
+
+    # -- fencing -------------------------------------------------------------
+
+    def _install_fence(self, eng) -> None:
+        """Wrap the primary engine's journal attachment so EVERY
+        segment (current and every future rotation) appends through
+        the epoch check — the fence survives checkpoint rotations
+        because it wraps the attach point, not one segment."""
+        group = self
+        orig_attach = eng.attach_journal
+
+        def fenced_attach(journal):
+            orig_attach(None if journal is None
+                        else _FencedJournal(journal, group))
+
+        eng.attach_journal = fenced_attach
+        if eng.journal is not None:
+            orig_attach(_FencedJournal(eng.journal, group))
+
+    def _check_fence(self) -> None:
+        cl = self.plane.cluster
+        if not cl.lease_is_live(self._lease.tag, self._lease.epoch):
+            self._note_fenced()
+            _OBS_FENCED.inc()
+            obs.record_event("repl.fenced", epoch=self.epoch,
+                             owner_tag=self._lease.tag)
+            raise StalePrimaryError(
+                "primary lease expired (group promoted under epoch "
+                f"{self.epoch}): this write is fenced — a stale "
+                "primary must not fork the journal")
+
+    # -- tailing -------------------------------------------------------------
+
+    def pump(self, final: bool = False) -> int:
+        """One synchronous shipping round: every follower polls the
+        tail and applies what landed.  Returns records applied (max
+        over followers — they consume the same feed)."""
+        with self._pump_lock:
+            applied = [f.pump(final=final) for f in self.followers]
+            self._last_pump_t = time.perf_counter()
+        self.last_pump_records = max(applied) if applied else 0
+        return self.last_pump_records
+
+    def start(self) -> None:
+        """Background shipping at ``poll_ms`` cadence (the knob-driven
+        mode; drills that want determinism call :meth:`pump`)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.pump()
+                except Exception as e:  # noqa: BLE001 — the tail must
+                    # not die silently mid-drill; surface and stop
+                    obs.record_event("repl.tail_error", error=repr(e))
+                    break
+                self._stop.wait(self.poll_ms / 1e3)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="sherman-repl-tail")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def measure_lag(self) -> float:
+        """Replication lag receipt: wall ms from 'records are durable
+        in the primary journal' to 'every follower has applied them'
+        — one synchronous pump, timed.  Published as ``repl.lag_ms``
+        (the SLO plane's gauge)."""
+        t0 = time.perf_counter()
+        self.pump()
+        ms = (time.perf_counter() - t0) * 1e3
+        _OBS_LAG_MS.set(ms)
+        return ms
+
+    # -- replica reads -------------------------------------------------------
+
+    def read(self, keys, forward=None):
+        """Serve a read batch from the replica tier: pump, pick the
+        next caught-up follower round-robin, serve its certified
+        cache hits, and forward everything else (misses, stale
+        entries, or a follower that may not serve) to ``forward``
+        (default: the primary engine's read path).  Never a lie: a
+        served value is certified against the follower's own pool AND
+        the follower was caught up to the durable journal end."""
+        keys = np.asarray(keys, np.uint64)
+        if forward is None:
+            forward = self.plane.eng.search
+        # pump at the poll cadence, not per read — a read burst must
+        # not turn every request into a full tail drain (the caught-up
+        # gate below still bounds staleness to one poll window)
+        if time.perf_counter() - self._last_pump_t \
+                >= self.poll_ms / 1e3:
+            self.pump()
+        f = self.followers[self._rr % len(self.followers)]
+        self._rr += 1
+        res = f.serve_read(keys)
+        if res is None:
+            vals, found = forward(keys)
+            self._note_reads(0, int(keys.size))
+            return np.asarray(vals), np.asarray(found)
+        vals, hit = res
+        out_v = np.array(vals)
+        out_f = np.array(hit)
+        miss = ~hit
+        if miss.any():
+            fv, ff = forward(keys[miss])
+            out_v[miss] = np.asarray(fv)
+            out_f[miss] = np.asarray(ff)
+        self._note_reads(int(hit.sum()), int(miss.sum()))
+        return out_v, out_f
+
+    # -- failover ------------------------------------------------------------
+
+    def promote(self, t_dead: float | None = None) -> dict:
+        """Fail over: expire the primary's lease (every later append
+        through its journal is fenced typed), bump the group epoch,
+        catch every follower up to the durable journal end (``final``
+        poll — the dead primary appends nothing more, so a torn tail
+        is final), and pick the highest-watermark follower.  Returns
+        the promotion receipt; the caller resumes the front door on
+        ``self.promoted.eng`` and adopts :meth:`promoted_window`."""
+        t0 = time.perf_counter()
+        self._t_dead = t_dead if t_dead is not None else t0
+        self.stop()
+        self.plane.cluster.expire_client(self._lease.tag)
+        old_epoch, self.epoch = self.epoch, self.epoch + 1
+        for f in self.followers:
+            f.pump(final=True)
+        self.promoted = max(self.followers,
+                            key=lambda f: (f.link, f.seq))
+        self.promotions += 1
+        _OBS_PROMOTIONS.inc()
+        ms = (time.perf_counter() - t0) * 1e3
+        receipt = {
+            "winner": self.promoted.idx,
+            "epoch": {"old": old_epoch, "new": self.epoch},
+            "watermarks": [{"follower": f.idx, "cid": f.cid,
+                            "link": f.link, "seq": f.seq}
+                           for f in self.followers],
+            "window": len(self.promoted.window),
+            "promote_ms": round(ms, 1),
+        }
+        obs.record_event("repl.promote", winner=self.promoted.idx,
+                         epoch=self.epoch,
+                         seq=self.promoted.seq,
+                         promote_ms=receipt["promote_ms"])
+        return receipt
+
+    def promoted_window(self) -> dict:
+        """The winner's replayed exactly-once window, in
+        ``seed_dedup`` shape ``{(tenant, rid): (op, ok[, handles])}``
+        — heap-write entries keep their payload provenance."""
+        if self.promoted is None:
+            raise StateError("no promotion yet: promote() first")
+        return dict(self.promoted.window)
+
+    def note_resumed(self) -> float:
+        """The availability-gap receipt: call when the promoted front
+        door serves its first request — gap = that instant minus
+        ``t_dead`` (the kill), published as
+        ``repl.availability_gap_ms``."""
+        if self._t_dead is None:
+            raise StateError("no failover in flight: promote() first")
+        ms = (time.perf_counter() - self._t_dead) * 1e3
+        self.availability_gap_ms = round(ms, 1)
+        _OBS_GAP_MS.set(ms)
+        return self.availability_gap_ms
+
+    # -- receipts ------------------------------------------------------------
+
+    def _collect(self) -> dict:
+        """``repl.`` pull collector — flat numbers only (the obs
+        collector contract)."""
+        st: dict = {}
+        for f in self.followers:
+            for k, v in f.stats.items():
+                st[k] = st.get(k, 0) + int(v)
+        top = max(self.followers, key=lambda f: (f.link, f.seq))
+        return {
+            "followers": len(self.followers),
+            "epoch": self.epoch,
+            "applied_records": st.get("records", 0),
+            "applied_rows": st.get("rows", 0),
+            "absorbed_acks": st.get("acks", 0),
+            "torn_waits": sum(f.tailer.torn_waits
+                              for f in self.followers),
+            "rebootstraps": sum(f.rebootstraps
+                                for f in self.followers),
+            "watermark_link": top.link,
+            "watermark_seq": top.seq,
+            "promotions": self.promotions,
+            "fenced_writes": self.fenced_writes,
+            "reads_served": self.reads_served,
+            "reads_forwarded": self.reads_forwarded,
+            "last_pump_records": self.last_pump_records,
+        }
+
+    def stats(self) -> dict:
+        return self._collect()
+
+    def close(self) -> None:
+        self.stop()

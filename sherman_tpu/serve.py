@@ -1084,14 +1084,19 @@ class ShermanServer:
         n = 0
         acks = []
         with self._lock:
-            for (tenant, rid), (opcode, ok) in window.items():
+            for (tenant, rid), entry in window.items():
+                # entries are (op, ok) or (op, ok, handles) — heap
+                # writes carry payload provenance (PR 16); both the
+                # adopted window and the re-journaled record keep it
+                opcode, ok = entry[0], entry[1]
+                prov = entry[2:] if len(entry) > 2 else ()
                 st = self._tenant(tenant)
-                st.dedup[int(rid)] = (int(opcode), np.array(ok))
+                st.dedup[int(rid)] = (int(opcode), np.array(ok), *prov)
                 st.dedup.move_to_end(int(rid))
                 while len(st.dedup) > max(1, self.cfg.dedup_window):
                     st.dedup.popitem(last=False)
                 acks.append((int(rid), tenant, int(opcode),
-                             np.array(ok)))
+                             np.array(ok), *prov))
                 n += 1
         if rejournal and acks:
             jrn = self.journal if self.journal is not None \
@@ -1412,10 +1417,22 @@ class ShermanServer:
         # every payload-requesting request in this step (stale handles
         # fall back to the heap's revalidate-and-retry read per slice)
         pay = nb = vok = None
+        side = cache = None
         if self.value_heap is not None \
                 and any(r.resolve_payloads for r in reqs):
+            # payload sidecar (PR 16): positions whose pinned bytes are
+            # certified by the LIVE handle (the tree value just read —
+            # a rewrite always changes it) skip the resolve gather;
+            # with every position pinned the gather is skipped whole
+            gather_found = found
+            cache = self.eng.leaf_cache
+            if cache is not None:
+                side, gather_found = self._sidecar_hits(
+                    reqs, vals, found, cache)
             try:
-                pay, nb, vok = self.value_heap.resolve_u64(vals, found)
+                if bool(np.asarray(gather_found).any()):
+                    pay, nb, vok = self.value_heap.resolve_u64(
+                        vals, gather_found)
             except BaseException as e:  # noqa: BLE001 — every future in
                 # the slot must resolve; a hung client is worse than a
                 # failed batch
@@ -1431,7 +1448,8 @@ class ShermanServer:
             try:
                 if req.resolve_payloads:
                     req.fut._set(self._payload_result(
-                        req, vals, found, pay, nb, vok, off, m))
+                        req, vals, found, pay, nb, vok, off, m,
+                        side=side, cache=cache))
                 else:
                     req.fut._set((vals[off:off + m],
                                   found[off:off + m]))
@@ -1476,21 +1494,61 @@ class ShermanServer:
                     w["p99_ms"],
                     queue_dominated=self._qwait_ratio > 1.0)
 
+    def _sidecar_hits(self, reqs, vals, found, cache):
+        """Probe the leaf cache's payload sidecar for every found
+        payload position in the slot.  -> (side, gather_found):
+        ``side[p]`` holds certified pinned bytes (the pin's handle
+        equals the live tree value at ``p``), and those positions are
+        masked OUT of the resolve gather — all-hit slots skip the
+        fused gather entirely."""
+        side = [None] * int(np.asarray(vals).shape[0])
+        pk, ph, pp = [], [], []
+        off = 0
+        for r in reqs:
+            m = r.fut.n_ops
+            if r.resolve_payloads:
+                for j in range(m):
+                    if found[off + j]:
+                        pk.append(r.keys[j])
+                        ph.append(vals[off + j])
+                        pp.append(off + j)
+            off += m
+        if not pk:
+            return side, found
+        blobs = cache.payload_hits(pk, ph)
+        gf = None
+        for b, p in zip(blobs, pp):
+            if b is not None:
+                side[p] = b
+                if gf is None:
+                    gf = np.array(found)
+                gf[p] = False
+        return side, (found if gf is None else gf)
+
     def _payload_result(self, req, vals, found, pay, nb, vok,
-                        off: int, m: int):
+                        off: int, m: int, side=None, cache=None):
         """Assemble one payload-read request's result slice from the
-        batch's resolve gather; stale handles revalidate through the
-        heap's bounded-retry read."""
+        sidecar pins + the batch's resolve gather; stale handles
+        revalidate through the heap's bounded-retry read.  Fresh
+        gather results are pinned (key + live handle) so the next
+        read of the key serves bytes without a gather."""
         vh = self.value_heap
         sl_found = np.array(found[off:off + m])
         out: list = [None] * m
         stale = []
+        fresh_k, fresh_h, fresh_b = [], [], []
         for j in range(m):
             if not sl_found[j]:
                 continue
-            if vok[off + j]:
-                out[j] = vh._words_to_bytes(pay[off + j],
-                                            int(nb[off + j]))
+            if side is not None and side[off + j] is not None:
+                out[j] = side[off + j]
+            elif vok is not None and vok[off + j]:
+                b = vh._words_to_bytes(pay[off + j], int(nb[off + j]))
+                out[j] = b
+                if cache is not None:
+                    fresh_k.append(req.keys[j])
+                    fresh_h.append(vals[off + j])
+                    fresh_b.append(b)
             else:
                 stale.append(j)
         if stale:
@@ -1498,6 +1556,8 @@ class ShermanServer:
             for k, j in enumerate(stale):
                 out[j] = p2[k]
                 sl_found[j] = bool(f2[k])
+        if fresh_k:
+            cache.pin_payloads(fresh_k, fresh_h, fresh_b)
         return out, sl_found
 
     def _write_due(self) -> bool:
@@ -1545,18 +1605,27 @@ class ShermanServer:
             out.append(r)
         return out
 
-    def _ack_batch(self, reqs, results, opcode: int) -> None:
+    def _ack_batch(self, reqs, results, opcode: int,
+                   provenance=None) -> None:
         """Journal + cache a write batch's exactly-once results —
         post-apply, PRE-ack: called before any of the batch's futures
         resolve, under the same durability gate as the engine record
         (one ``J_ACK`` frame covers every rid the flush coalesced; a
         raising append fails the whole batch, so no ack can outrun its
-        record)."""
+        record).  ``provenance`` (heap writes, PR 16): per-request u64
+        handle arrays aligned with ``results`` — journaled into the
+        ack entries so a recovered window attests where each acked
+        payload lives (slab address + version), not just its bits."""
         if self.cfg.dedup_window <= 0:
             return
-        acks = [(r.fut.rid, r.fut.tenant, opcode, res)
-                for r, res in zip(reqs, results)
-                if r.fut.rid is not None]
+        if provenance is None:
+            acks = [(r.fut.rid, r.fut.tenant, opcode, res)
+                    for r, res in zip(reqs, results)
+                    if r.fut.rid is not None]
+        else:
+            acks = [(r.fut.rid, r.fut.tenant, opcode, res, prov)
+                    for r, res, prov in zip(reqs, results, provenance)
+                    if r.fut.rid is not None]
         if not acks:
             return
         jrn = self.journal if self.journal is not None \
@@ -1575,12 +1644,15 @@ class ShermanServer:
                     raise
                 jrn2.append_acks(acks)
         with self._lock:
-            for r, res in zip(reqs, results):
+            for i, (r, res) in enumerate(zip(reqs, results)):
                 rid = r.fut.rid
                 if rid is None:
                     continue
                 st = self._tenant(r.fut.tenant)
-                st.dedup[rid] = (opcode, np.array(res))
+                st.dedup[rid] = (opcode, np.array(res)) \
+                    if provenance is None \
+                    else (opcode, np.array(res),
+                          np.array(provenance[i]))
                 st.dedup.move_to_end(rid)
                 while len(st.dedup) > self.cfg.dedup_window:
                     st.dedup.popitem(last=False)
@@ -1638,7 +1710,15 @@ class ShermanServer:
                     if hst["lock_timeouts"] else None
                 results = [np.ones(r.fut.n_ops, bool) if hto is None
                            else ~np.isin(r.keys, hto) for r in hins]
-                self._ack_batch(hins, results, J.J_HEAP_PUT)
+                # payload provenance (PR 16): the handle each acked
+                # payload landed at rides the J_ACK entry (0 for keys
+                # that timed out or were superseded within the batch)
+                hmap = hst.get("handle_map") or {}
+                provenance = [np.asarray(
+                    [hmap.get(int(k), 0) for k in r.keys], np.uint64)
+                    for r in hins]
+                self._ack_batch(hins, results, J.J_HEAP_PUT,
+                                provenance=provenance)
                 for r, ok in zip(hins, results):
                     r.fut._set(ok)
                     self.tracker.observe("insert", r.fut.n_ops,

@@ -87,6 +87,14 @@ _RID = struct.Struct("<Q")
 
 FLAG_RID = 1     # payload carries a client request id after the header
 
+# ack-entry op-byte flag (PR 16): the entry carries payload PROVENANCE
+# — one u64 handle per op (slab address + slab version packed by
+# models/value_heap.pack_handles; 0 = no provenance for that op) after
+# the ok bitmap.  Old readers never see it (old records never set the
+# bit) and old records decode unchanged (4-tuples), so the wire format
+# stays back-compatible in both directions.
+ACK_PROV = 0x80
+
 J_UPSERT = 1     # keys + values (engine insert / mixed write rows)
 J_DELETE = 2     # keys only
 J_HEAP_PUT = 3   # value-heap slab writes: keys + handles + payload blob
@@ -157,14 +165,21 @@ def encode_record(kind: int, keys, values=None, rid=None) -> bytes:
 def encode_ack_record(acks) -> bytes:
     """One framed ack-batch record: ``acks`` is a sequence of
     ``(rid, tenant, op_kind, ok)`` with ``ok`` a bool array (one bit
-    per submitted op of the ORIGINAL request).  One frame covers every
-    client write a flush coalesced, so the exactly-once plane costs one
-    extra append (not one per request) per write batch."""
+    per submitted op of the ORIGINAL request), optionally extended to
+    ``(rid, tenant, op_kind, ok, handles)`` where ``handles`` (u64,
+    one per op; 0 = none) is payload provenance for heap writes — the
+    slab address + version the acked payload landed at (flagged with
+    :data:`ACK_PROV` in the op byte; see the flag's comment).  One
+    frame covers every client write a flush coalesced, so the
+    exactly-once plane costs one extra append (not one per request)
+    per write batch."""
     n = len(acks)
     if n == 0 or n > 0xFFFFFFFF:
         raise ConfigError(f"ack record wants 1..2^32-1 acks, got {n}")
     payload = _PAY.pack(J_ACK, 0, n)
-    for rid, tenant, op, ok in acks:
+    for entry in acks:
+        rid, tenant, op, ok = entry[:4]
+        handles = entry[4] if len(entry) > 4 else None
         tb = str(tenant).encode("utf-8")
         if len(tb) > 255:
             raise ConfigError(f"tenant name over 255 bytes: {tenant!r}")
@@ -174,14 +189,26 @@ def encode_ack_record(acks) -> bytes:
                 f"ack result of {ok.size} ops exceeds the u16 bound")
         if op not in (J_UPSERT, J_DELETE, J_HEAP_PUT):
             raise ConfigError(f"ack op kind {op}: want a write kind")
-        payload += _ACK.pack(int(rid) & 0xFFFFFFFFFFFFFFFF, op,
+        opb = int(op)
+        hb = b""
+        if handles is not None:
+            handles = np.ascontiguousarray(handles, np.uint64)
+            if handles.shape != ok.shape:
+                raise ConfigError(
+                    "ack provenance wants one handle per op")
+            opb |= ACK_PROV
+            hb = handles.tobytes()
+        payload += _ACK.pack(int(rid) & 0xFFFFFFFFFFFFFFFF, opb,
                              len(tb), ok.size)
-        payload += tb + np.packbits(ok).tobytes()
+        payload += tb + np.packbits(ok).tobytes() + hb
     return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
 
 
 def _decode_acks(body: bytes, n: int, off: int):
-    """-> [(rid, tenant, op_kind, ok bool[n_ops]), ...]"""
+    """-> [(rid, tenant, op_kind, ok bool[n_ops]), ...] — entries
+    flagged :data:`ACK_PROV` come back as 5-tuples with a trailing
+    ``handles`` u64[n_ops] provenance lane (star-unpack tolerant:
+    ``rid, tenant, op, ok, *prov = entry``)."""
     out = []
     pos = 0
     for _ in range(n):
@@ -191,18 +218,27 @@ def _decode_acks(body: bytes, n: int, off: int):
                 "its body")
         rid, op, tlen, nops = _ACK.unpack_from(body, pos)
         pos += _ACK.size
-        nbytes = (nops + 7) // 8
+        prov = bool(op & ACK_PROV)
+        op &= ~ACK_PROV
+        nbytes = (nops + 7) // 8 + (nops * 8 if prov else 0)
         if pos + tlen + nbytes > len(body):
             raise JournalCorruptError(
                 f"journal record at byte {off}: ack entry overruns "
                 "its body")
         tenant = body[pos: pos + tlen].decode("utf-8")
         pos += tlen
+        nok = (nops + 7) // 8
         ok = np.unpackbits(
-            np.frombuffer(body[pos: pos + nbytes], np.uint8),
+            np.frombuffer(body[pos: pos + nok], np.uint8),
             count=nops).astype(bool)
-        pos += nbytes
-        out.append((int(rid), tenant, int(op), ok))
+        pos += nok
+        if prov:
+            handles = np.frombuffer(
+                body[pos: pos + nops * 8], np.uint64).copy()
+            pos += nops * 8
+            out.append((int(rid), tenant, int(op), ok, handles))
+        else:
+            out.append((int(rid), tenant, int(op), ok))
     if pos != len(body):
         raise JournalCorruptError(
             f"journal record at byte {off}: {len(body) - pos} trailing "
@@ -652,17 +688,32 @@ def _truncate(path: str, off: int, size: int, do_truncate: bool) -> None:
             _fsync(f.fileno())
 
 
-def replay(path: str, eng, ack_sink=None) -> dict:
-    """Re-apply one segment's records through a (writable) engine, in
-    record order.  The engine's own journaling must be detached by the
-    caller (RecoveryPlane does) so replay does not re-journal itself.
-    ``ack_sink`` (a list) collects J_ACK entries ``(rid, tenant, op,
-    ok)`` in record order — the dedup-window reconstruction feed; with
-    no sink they are counted and skipped.  Returns {"records", "rows",
-    "upserts", "deletes", ..., "acks"}."""
-    stats = {"records": 0, "rows": 0, "upserts": 0, "deletes": 0,
-             "heap_puts": 0, "heap_frees": 0, "acks": 0}
-    for kind, keys, vals in read_records(path, truncate_torn=True):
+def apply_records(records, eng, ack_sink=None, stats=None) -> dict:
+    """Apply decoded journal records through a (writable) engine, in
+    record order — the SHARED apply core of recovery replay
+    (:func:`replay` / ``RecoveryPlane.recover``) and the replication
+    followers (``sherman_tpu/replica.py``): both planes converge on
+    this one dispatch loop, so a follower applies a shipped segment
+    exactly the way recovery would replay it, by construction.
+
+    ``records`` is any iterable of decoded tuples — 3-tuples
+    ``(kind, keys, aux)`` or the ``with_rids`` 4-tuples; extra
+    elements are ignored.  The engine's own journaling must be
+    detached by the caller (RecoveryPlane and followers both do) so
+    applying does not re-journal.  ``ack_sink`` (a list) collects
+    J_ACK entries in record order — the dedup-window reconstruction
+    feed; with no sink they are counted and skipped.  ``stats`` (an
+    existing dict) accumulates in place across calls — the follower's
+    incremental tail applies batches as they ship.  Returns the stats
+    dict {"records", "rows", "upserts", "deletes", "heap_puts",
+    "heap_frees", "acks"}."""
+    if stats is None:
+        stats = {}
+    for k in ("records", "rows", "upserts", "deletes", "heap_puts",
+              "heap_frees", "acks"):
+        stats.setdefault(k, 0)
+    for rec in records:
+        kind, keys, vals = rec[0], rec[1], rec[2]
         if kind == J_ACK:
             # contract plane: cached client results, no engine state —
             # replayed into the dedup window, never applied
@@ -700,3 +751,12 @@ def replay(path: str, eng, ack_sink=None) -> dict:
         _OBS_RP_RECORDS.inc()
         _OBS_RP_ROWS.inc(int(keys.size))
     return stats
+
+
+def replay(path: str, eng, ack_sink=None) -> dict:
+    """Re-apply one segment's records through a (writable) engine, in
+    record order — :func:`read_records` (torn tails truncated, the
+    recovery contract) fed through the shared :func:`apply_records`
+    core.  See ``apply_records`` for the sink/stats semantics."""
+    return apply_records(read_records(path, truncate_torn=True), eng,
+                         ack_sink=ack_sink)

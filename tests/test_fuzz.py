@@ -687,3 +687,108 @@ def test_fuzz_client_contract(eight_devices, tmp_path):
         assert cached is not None, f"rid {r} missing from the window"
         np.testing.assert_array_equal(cached[1], ok0)
     journal.close()
+
+
+def test_fuzz_repl_storm(eight_devices, tmp_path):
+    """Replication storm (sherman_tpu/replica.py): rounds of random
+    writes/deletes interleaved with journal rotations, a mid-storm
+    checkpoint sweep (re-bootstrap under load), replica-served reads,
+    then repeated primary kills with torn tails at the shipping
+    boundary.  Contract: after EVERY promotion the winner's state
+    equals the acked model dict exactly (no loss, no resurrection),
+    the stale primary is fenced typed, and replica reads never lie."""
+    from sherman_tpu.config import TreeConfig
+    from sherman_tpu.errors import ShermanError
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.replica import ReplicaGroup, StalePrimaryError
+    from sherman_tpu.utils import journal as J
+
+    rng = np.random.default_rng(61)
+    cfg = DSMConfig(machine_nr=2, pages_per_node=1024,
+                    locks_per_node=256, step_capacity=256,
+                    chunk_pages=32)
+    tcfg = TreeConfig(sibling_chase_budget=1)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    keys = np.unique(rng.integers(1, 1 << 56, 700,
+                                  dtype=np.uint64))[:600]
+    vals = keys ^ np.uint64(0xFA2E)
+    batched.bulk_load(tree, keys, vals)
+    eng = batched.BatchedEngine(tree, batch_per_node=128, tcfg=tcfg)
+    eng.attach_router()
+    model = dict(zip(keys.tolist(), vals.tolist()))
+
+    def check_converged(who, engine):
+        ak = np.asarray(sorted(model), np.uint64)
+        av = np.asarray([model[int(k)] for k in ak], np.uint64)
+        got, found = engine.search(ak)
+        assert found.all(), f"{who}: acked keys lost"
+        np.testing.assert_array_equal(got, av, err_msg=who)
+        gone = np.asarray(
+            [int(k) for k in keys.tolist() if int(k) not in model][:64],
+            np.uint64)
+        if gone.size:
+            _, f2 = engine.search(gone)
+            assert not f2.any(), f"{who}: deleted keys resurrected"
+
+    for cycle in range(2):
+        rdir = str(tmp_path / f"storm-{cycle}")
+        plane = RecoveryPlane(cluster, tree, eng, rdir)
+        plane.checkpoint_base()
+        group = ReplicaGroup(plane, 2, batch_per_node=128, tcfg=tcfg,
+                             cache_slots=512, poll_ms=1e9)
+        for rnd in range(3):
+            for _ in range(3):
+                kreq = np.unique(keys[rng.integers(0, keys.size, 48)])
+                vreq = kreq ^ np.uint64(0xFA2E) \
+                    ^ np.uint64((cycle << 20) | (rnd << 10) | 7)
+                eng.insert(kreq, vreq)
+                model.update(zip(kreq.tolist(), vreq.tolist()))
+                if rng.random() < 0.5:
+                    kd = np.unique(keys[rng.integers(0, keys.size, 8)])
+                    fnd = eng.delete(kd)
+                    for k, f in zip(kd.tolist(), np.asarray(fnd).tolist()):
+                        if f:
+                            model.pop(int(k), None)
+            roll = rng.random()
+            if roll < 0.3:
+                # rotation WITHOUT sweep: the tailer must advance
+                plane._rotate_journal(plane._segment + 1)
+            elif roll < 0.5 and rnd == 1:
+                # checkpoint sweep under the tail: re-bootstrap path
+                plane.checkpoint_delta()
+            group.pump()
+            for f in group.followers:
+                check_converged(f"cycle {cycle} round {rnd} "
+                                f"follower {f.idx}", f.eng)
+            # replica-served reads never lie (certified or forwarded)
+            sample = keys[rng.integers(0, keys.size, 64)]
+            group.followers[rnd % 2].admit(sample[:32])
+            got, found = group.read(sample)
+            for k, g, fd in zip(sample.tolist(), got.tolist(),
+                                np.asarray(found).tolist()):
+                if int(k) in model:
+                    assert fd and g == model[int(k)]
+                else:
+                    assert not fd
+        # KILL: torn half-frame at the shipping boundary, promote
+        rec = J.encode_record(J.J_UPSERT,
+                              np.asarray([1 << 41], np.uint64),
+                              np.asarray([9], np.uint64), rid=4)
+        with open(eng.journal.path, "ab") as fh:
+            fh.write(rec[: len(rec) // 2])
+        rcpt = group.promote()
+        assert rcpt["epoch"]["new"] == 2  # fresh group each cycle
+        with pytest.raises(ShermanError) as ei:
+            eng.insert(keys[:2], keys[:2])
+        exc = ei.value
+        while exc is not None and not isinstance(exc,
+                                                 StalePrimaryError):
+            exc = exc.__cause__
+        assert isinstance(exc, StalePrimaryError)
+        win = group.promoted
+        check_converged(f"cycle {cycle} promoted", win.eng)
+        # the winner becomes the next cycle's primary
+        group.stop()
+        plane.close()
+        cluster, tree, eng = win.cluster, win.tree, win.eng

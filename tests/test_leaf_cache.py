@@ -561,3 +561,42 @@ def test_fill_eviction_and_window_overflow_accounting(eight_devices):
     # absent keys resolve to nothing and never occupy slots
     r3 = cache.fill(keys[:8] + np.uint64(1))
     assert r3["resolved"] == 0 and cache.stats()["cached_keys"] == 0
+
+
+# -- payload sidecar (PR 16) --------------------------------------------------
+
+def test_payload_sidecar_pin_hit_stale_capacity_flush(eight_devices):
+    """The sidecar serves pinned payload bytes ONLY under the exact
+    handle that pinned them: a handle mismatch (the slab was rewritten
+    with a bumped version) drops the entry and misses — stale bytes
+    are structurally unservable.  Pins are capacity-bounded and
+    volatile with the rest of the cache."""
+    _, tree, eng = make()
+    keys, vals = load(tree, eng, n=500)
+    cache = eng.attach_leaf_cache(slots=64)  # capacity 32
+    k = [int(x) for x in keys[:4]]
+    h = [11, 22, 33, 44]
+    assert cache.pin_payloads(k, h, [b"a", b"bb", None, b"dddd"]) == 3
+    out = cache.payload_hits(k, h)
+    assert out == [b"a", b"bb", None, b"dddd"]
+    st = cache.stats()
+    assert st["sidecar_pins"] == 3 and st["sidecar_hits"] == 3
+    assert st["sidecar_keys"] == 3
+    # stale handle: dropped on sight, and a retry under the OLD
+    # handle misses too (the entry is gone, not resurrected)
+    assert cache.payload_hits(k[:1], [12]) == [None]
+    assert cache.stats()["sidecar_stale"] == 1
+    assert cache.payload_hits(k[:1], [11]) == [None]
+    assert cache.stats()["sidecar_keys"] == 2
+    # a write to a pinned key pops its pin with the table entry
+    cache.pin_payloads(k[:2], h[:2], [b"a", b"bb"])
+    eng.insert(keys[:1], vals[:1] ^ np.uint64(5))
+    assert cache.payload_hits(k[:1], h[:1]) == [None]
+    assert cache.payload_hits(k[1:2], h[1:2]) == [b"bb"]
+    # capacity bound: pins evict FIFO past cache.capacity, never grow
+    many = [int(x) for x in keys[100:100 + cache.capacity + 8]]
+    cache.pin_payloads(many, [7] * len(many), [b"x"] * len(many))
+    assert cache.stats()["sidecar_keys"] <= cache.capacity
+    # flush drops every pin with the rest of the cache
+    cache.flush()
+    assert cache.stats()["sidecar_keys"] == 0

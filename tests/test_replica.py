@@ -1,0 +1,340 @@
+"""Replication-plane tests (PR 16): journal-shipped followers, the
+tailer's shipping-boundary contract, lease-epoch failover fencing,
+replayed-ack windows with heap-write provenance, replica-served
+reads, and the leaf cache's payload sidecar.
+
+The follower applies shipped records through the SAME
+``journal.apply_records`` core recovery replays through, so most of
+what these tests pin is the REPLICATION-specific delta: tail
+semantics (wait vs final vs re-bootstrap), watermarks, fencing, and
+the caught-up read gate.  Replication is OFF by default
+(``SHERMAN_REPL=0``) — the off path must be bit-identical to a build
+without the subsystem.
+"""
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from sherman_tpu import config as C
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig, TreeConfig
+from sherman_tpu.errors import ConfigError, StateError
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.recovery import RecoveryPlane
+from sherman_tpu.replica import (JournalTailer, ReplicaGroup,
+                                 StalePrimaryError)
+from sherman_tpu.utils import journal as J
+
+SALT = 0xAB5E_11E5
+
+
+def make(pages=1024, B=128, heap_pages=0):
+    cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
+                    locks_per_node=256, step_capacity=512,
+                    chunk_pages=32, heap_pages_per_node=heap_pages)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=B,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    return cluster, tree, eng
+
+
+def load(tree, eng, n=500, seed=5):
+    rng = np.random.default_rng(seed)
+    keys = np.unique(rng.integers(1, 1 << 56, int(n * 1.2),
+                                  dtype=np.uint64))[:n]
+    vals = keys ^ np.uint64(SALT)
+    batched.bulk_load(tree, keys, vals)
+    eng.attach_router()
+    return keys, vals
+
+
+def primary(tmp_path, heap_pages=0, n=500):
+    cluster, tree, eng = make(heap_pages=heap_pages)
+    keys, vals = load(tree, eng, n=n)
+    plane = RecoveryPlane(cluster, tree, eng, str(tmp_path / "chain"))
+    plane.checkpoint_base()
+    return cluster, tree, eng, plane, keys, vals
+
+
+# ---------------------------------------------------------------------------
+# Knobs + the OFF default.
+# ---------------------------------------------------------------------------
+
+def test_replica_knobs(monkeypatch):
+    for off in ("", "0", "false", "off", "no"):
+        monkeypatch.setenv("SHERMAN_REPL", off)
+        assert C.replica_count() == 0
+    monkeypatch.delenv("SHERMAN_REPL", raising=False)
+    assert C.replica_count() == 0  # OFF by default
+    for on, n in (("1", 1), ("true", 1), ("on", 1), ("yes", 1),
+                  ("3", 3)):
+        monkeypatch.setenv("SHERMAN_REPL", on)
+        assert C.replica_count() == n
+    monkeypatch.setenv("SHERMAN_REPL", "lots")
+    with pytest.raises(ConfigError):
+        C.replica_count()
+    monkeypatch.delenv("SHERMAN_REPL_POLL_MS", raising=False)
+    assert C.replica_poll_ms() == 20.0
+    monkeypatch.setenv("SHERMAN_REPL_POLL_MS", "5.5")
+    assert C.replica_poll_ms() == 5.5
+    monkeypatch.setenv("SHERMAN_REPL_POLL_MS", "-1")
+    with pytest.raises(ConfigError):
+        C.replica_poll_ms()
+
+
+def test_replica_off_by_default(eight_devices, tmp_path, monkeypatch):
+    monkeypatch.delenv("SHERMAN_REPL", raising=False)
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=200)
+    # knob-gated construction: OFF -> no group, nothing attached
+    assert ReplicaGroup.from_env(plane) is None
+    assert type(eng.journal) is J.Journal  # no fence wrapper
+    with pytest.raises(ConfigError):
+        ReplicaGroup(plane)  # explicit construction wants >= 1
+    plane.close()
+    # a group needs a chain to feed followers from
+    cluster2, tree2, eng2 = make()
+    load(tree2, eng2, n=200)
+    p2 = RecoveryPlane(cluster2, tree2, eng2, str(tmp_path / "c2"))
+    with pytest.raises(StateError):
+        ReplicaGroup(p2, 1)
+    p2.close()
+
+
+def test_replica_on_primary_bit_identity(eight_devices, tmp_path):
+    """Attaching a tailing ReplicaGroup must not perturb the primary
+    data plane: the same write sequence lands a bit-identical pool
+    with replication ON and OFF (the replica-off identity pin — the
+    group only READS the journal directory)."""
+    pools = []
+    for with_group in (False, True):
+        cluster, tree, eng, plane, keys, vals = primary(
+            tmp_path / f"g{with_group}", n=300)
+        group = ReplicaGroup(plane, 1) if with_group else None
+        eng.insert(keys[:64], vals[:64] ^ np.uint64(0x77))
+        eng.delete(keys[64:80])
+        if group is not None:
+            assert group.pump() > 0
+            gv, gf = group.followers[0].eng.search(keys[:64])
+            assert gf.all()
+            np.testing.assert_array_equal(
+                gv, vals[:64] ^ np.uint64(0x77))
+            group.close()
+        pools.append(np.asarray(cluster.dsm.pool).copy())
+        plane.close()
+    np.testing.assert_array_equal(pools[0], pools[1])
+
+
+# ---------------------------------------------------------------------------
+# Shipping, watermarks, promotion, fencing.
+# ---------------------------------------------------------------------------
+
+def test_ship_watermark_promote_fence(eight_devices, tmp_path):
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path)
+    group = ReplicaGroup(plane, 1)
+    f = group.followers[0]
+    wm_path = os.path.join(f.dir, "watermark.json")
+    assert json.load(open(wm_path)) == {"cid": plane.cid, "link": 0,
+                                        "seq": 0}
+    # ship an upsert + a delete, in order
+    eng.insert(keys[:48], vals[:48] ^ np.uint64(0x99))
+    eng.delete(keys[48:56])
+    assert group.pump() == 2
+    got, found = f.eng.search(keys[:56])
+    assert found[:48].all() and not found[48:].any()
+    np.testing.assert_array_equal(got[:48], vals[:48] ^ np.uint64(0x99))
+    wm1 = json.load(open(wm_path))
+    assert wm1["seq"] == 2 and wm1["cid"] == plane.cid
+    # the ack window is absorbed WITH heap-write provenance riding it
+    okv = np.asarray([True, False, True])
+    prov = np.asarray([11, 0, 13], np.uint64)
+    eng.journal.append_acks([(7, "t", J.J_UPSERT, okv),
+                             (8, "t", J.J_HEAP_PUT, okv, prov)])
+    group.pump()
+    assert json.load(open(wm_path))["seq"] == 3  # durable + monotonic
+    w = f.window
+    op, ok = w[("t", 7)]
+    assert op == J.J_UPSERT and np.array_equal(ok, okv)
+    op, ok, h = w[("t", 8)]
+    assert op == J.J_HEAP_PUT and np.array_equal(h, prov)
+    # promote: lease expires, epoch bumps, the winner is caught up
+    rcpt = group.promote()
+    assert rcpt["epoch"] == {"old": 1, "new": 2}
+    assert rcpt["winner"] == 0 and group.promoted is f
+    assert group.promoted_window()[("t", 8)] == w[("t", 8)]
+    # the stale primary's next write is fenced TYPED at the
+    # durability gate — never a silent journal fork
+    with pytest.raises(StalePrimaryError):
+        eng.insert(keys[:4], vals[:4])
+    assert group.fenced_writes >= 1
+    # the promoted follower serves every pre-kill acked write
+    got, found = f.eng.search(keys[:48])
+    assert found.all()
+    plane.close()
+
+
+# ---------------------------------------------------------------------------
+# The tailer's shipping-boundary contract.
+# ---------------------------------------------------------------------------
+
+def test_tailer_waits_on_live_torn_tail(eight_devices, tmp_path):
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    group = ReplicaGroup(plane, 1)
+    f = group.followers[0]
+    eng.insert(keys[:16], vals[:16])
+    assert group.pump() == 1
+    # a torn half-frame at the LIVE tail is an append in flight:
+    # the follower WAITS (and never truncates the primary's file)
+    rec = J.encode_record(J.J_UPSERT, np.asarray([1 << 40], np.uint64),
+                          np.asarray([7], np.uint64), rid=0xDEAD)
+    jpath = eng.journal.path
+    size0 = os.path.getsize(jpath)
+    with open(jpath, "ab") as fh:
+        fh.write(rec[: len(rec) // 2])
+    assert group.pump() == 0
+    assert f.tailer.torn_waits == 1
+    assert os.path.getsize(jpath) == size0 + len(rec) // 2  # untouched
+    assert group.pump() == 0 and f.tailer.torn_waits == 2  # still waits
+    # after the primary is declared dead the torn tail is FINAL:
+    # skipped without error, exactly as recovery would truncate it
+    assert f.pump(final=True) == 0
+    assert f.seq == 1
+    plane.close()
+
+
+def test_tailer_midfile_corruption_is_typed(eight_devices, tmp_path):
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    eng.insert(keys[:16], vals[:16])
+    eng.insert(keys[16:32], vals[16:32])
+    jpath = eng.journal.path
+    blob = bytearray(open(jpath, "rb").read())
+    blob[len(J.MAGIC) + J._HDR.size + 2] ^= 0x40  # first frame payload
+    open(jpath, "wb").write(bytes(blob))
+    t = JournalTailer(plane.dir, plane.cid)
+    with pytest.raises(J.JournalCorruptError):
+        t.poll()  # bytes follow the bad CRC: refuse, never diverge
+    plane.close()
+
+
+def test_tailer_mid_rotation_order(eight_devices, tmp_path):
+    """Rotation WITHOUT a sweep (the crash-window overlap recovery
+    tolerates): the tailer finishes the retired segment, advances to
+    its successor, and applies in order — no re-bootstrap."""
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    group = ReplicaGroup(plane, 1)
+    f = group.followers[0]
+    eng.insert(keys[:16], vals[:16] ^ np.uint64(1))
+    plane._rotate_journal(plane._segment + 1)  # no sweep
+    eng.insert(keys[:16], vals[:16] ^ np.uint64(2))  # fresh segment
+    assert f.rebootstraps == 0
+    group.pump()
+    assert f.rebootstraps == 0  # both segments present: pure advance
+    got, found = f.eng.search(keys[:16])
+    assert found.all()
+    np.testing.assert_array_equal(got, vals[:16] ^ np.uint64(2))
+    plane.close()
+
+
+def test_sweep_rebootstrap_converges(eight_devices, tmp_path):
+    """A checkpoint retires + sweeps the segment under the tail:
+    records the follower never consumed exist only in the chain, so
+    it re-bootstraps — and converges, counted."""
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path)
+    group = ReplicaGroup(plane, 1)
+    f = group.followers[0]
+    eng.insert(keys[:64], vals[:64] ^ np.uint64(0x31))
+    plane.checkpoint_delta()  # rotate -> save -> sweep, unpumped
+    eng.insert(keys[64:96], vals[64:96] ^ np.uint64(0x32))
+    group.pump()
+    assert f.rebootstraps == 1 and f.link == 1
+    got, found = f.eng.search(keys[:96])
+    assert found.all()
+    np.testing.assert_array_equal(got[:64], vals[:64] ^ np.uint64(0x31))
+    np.testing.assert_array_equal(got[64:], vals[64:96] ^ np.uint64(0x32))
+    assert json.load(open(os.path.join(
+        f.dir, "watermark.json")))["link"] == 1
+    plane.close()
+
+
+def test_v1_segment_follower(eight_devices, tmp_path):
+    """A v1 (pre-rid) successor segment ships cleanly: decoded with
+    flags=0 — the records apply, dedup stays disabled for them."""
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    group = ReplicaGroup(plane, 1)
+    f = group.followers[0]
+    eng.insert(keys[:8], vals[:8])
+    group.pump()
+    # craft a v1 successor by hand (the repo's v1 byte layout)
+    v1 = os.path.join(plane.dir, f"journal-{plane.cid}-000099.wal")
+    nk = np.asarray([3 << 40], np.uint64)
+    nv = np.asarray([123], np.uint64)
+    pay = struct.pack("<BxxxI", J.J_UPSERT, 1) \
+        + nk.tobytes() + nv.tobytes()
+    with open(v1, "wb") as fh:
+        fh.write(J.MAGIC_V1)
+        fh.write(struct.pack("<II", len(pay), zlib.crc32(pay)) + pay)
+    assert group.pump() == 1
+    got, found = f.eng.search(nk)
+    assert found.all() and int(got[0]) == 123
+    plane.close()
+
+
+# ---------------------------------------------------------------------------
+# Replica-served reads: certified, caught-up only.
+# ---------------------------------------------------------------------------
+
+def test_replica_reads_certified_and_forwarded(eight_devices, tmp_path):
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path)
+    # a huge poll window pins the pump cadence: reads below must not
+    # re-pump behind the test's back (caught_up is toggled by hand)
+    group = ReplicaGroup(plane, 1, cache_slots=256, poll_ms=1e9)
+    f = group.followers[0]
+    group.pump()
+    f.admit(keys[:64])
+    got, found = group.read(keys[:64])
+    assert found.all()
+    np.testing.assert_array_equal(got, vals[:64])
+    assert group.reads_served > 0
+    # keys outside the admitted set miss the cache and FORWARD to the
+    # primary — served from there, never a lie
+    got, found = group.read(keys[100:140])
+    assert found.all()
+    np.testing.assert_array_equal(got, vals[100:140])
+    assert group.reads_forwarded > 0
+    # a follower that is not caught up may not serve at all
+    f.caught_up = False
+    assert f.serve_read(keys[:8]) is None
+    served0 = group.reads_served
+    got, found = group.read(keys[:8])  # forwards wholesale
+    assert found.all() and group.reads_served == served0
+    plane.close()
+
+
+# ---------------------------------------------------------------------------
+# Ack provenance: journal encode/decode + recovery window arity.
+# ---------------------------------------------------------------------------
+
+def test_ack_provenance_roundtrip(tmp_path):
+    path = str(tmp_path / "seg.wal")
+    okv = np.asarray([True, False, True])
+    prov = np.asarray([0x11, 0, 0x33], np.uint64)
+    with J.Journal(path) as j:
+        j.append_acks([(1, "t", J.J_UPSERT, okv),            # plain
+                       (2, "t", J.J_HEAP_PUT, okv, prov)])   # + prov
+        with pytest.raises(ConfigError):  # one handle per op
+            j.append_acks([(3, "t", J.J_HEAP_PUT, okv,
+                            np.asarray([1], np.uint64))])
+    (kind, _keys, acks, _rid), = J.read_records(path, with_rids=True)
+    assert kind == J.J_ACK and len(acks) == 2
+    assert len(acks[0]) == 4  # plain acks decode exactly as before
+    rid, tenant, op, ok = acks[0]
+    assert (rid, tenant, op) == (1, "t", J.J_UPSERT)
+    rid, tenant, op, ok, h = acks[1]
+    assert (rid, tenant, op) == (2, "t", J.J_HEAP_PUT)
+    np.testing.assert_array_equal(h, prov)

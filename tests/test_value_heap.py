@@ -607,3 +607,129 @@ def test_free_wrong_class_handle_typed():
     # the real record is untouched
     got, found = vh.get(keys[:1])
     assert found[0] and got[0] == b"tiny"
+
+
+# -- replication-era satellites (PR 16) ---------------------------------------
+
+def test_serve_sidecar_skips_gather_bit_identical():
+    """Leaf-cache payload sidecar: a repeated payload read serves the
+    PINNED bytes — the fused heap gather is skipped entirely — and the
+    served bytes stay bit-identical to the resolver's.  A rewrite
+    invalidates the pin (with the leaf-cache entry), so the next read
+    gathers fresh and re-pins: stale bytes are never served."""
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cluster, tree, eng, vh, keys, pay = loaded(n=300)
+    cache = eng.attach_leaf_cache(slots=1024)
+    calls = []
+    real_resolve = vh.resolve_u64
+    vh.resolve_u64 = lambda *a, **kw: (calls.append(1)
+                                       or real_resolve(*a, **kw))
+    cfg = ServeConfig(widths=(256, 1024), p99_targets_ms={
+        c: 200.0 for c in ("read", "scan", "insert", "delete")},
+        calib_steps=1, seal=False, write_linger_ms=0.5,
+        write_lane=True)
+    srv = ShermanServer(eng, cfg)
+    srv.start(calib_keys=keys)
+    try:
+        k = keys[:64]
+        got1, found = srv.submit("read", k, resolve_payloads=True) \
+                         .result(timeout=30)
+        assert found.all()
+        assert all(got1[i] == pay[i] for i in range(64))
+        assert calls, "first read must gather"
+        assert cache.stats()["sidecar_pins"] >= 64
+        n_calls = len(calls)
+        got2, found2 = srv.submit("read", k, resolve_payloads=True) \
+                          .result(timeout=30)
+        assert found2.all()
+        assert all(got2[i] == pay[i] for i in range(64))  # bit-identity
+        assert len(calls) == n_calls, "sidecar hit must skip the gather"
+        assert cache.stats()["sidecar_hits"] >= 64
+        # rewrite: the pin dies with the leaf-cache entry — the next
+        # read gathers the NEW bytes (and re-pins them), never stale
+        ok = srv.submit("insert", k[:8],
+                        payloads=[b"rewritten!" for _ in range(8)]) \
+                .result(timeout=30)
+        assert ok.all()
+        got3, found3 = srv.submit("read", k[:8],
+                                  resolve_payloads=True) \
+                          .result(timeout=30)
+        assert found3.all()
+        assert all(g == b"rewritten!" for g in got3)
+        assert len(calls) > n_calls
+    finally:
+        srv.stop()
+
+
+def test_heap_ack_provenance_retry_across_crash(tmp_path):
+    """Heap-write acks journal payload provenance (the installed
+    handles ride the J_ACK record): after a crash the recovered dedup
+    window carries them, ``seed_dedup`` re-journals them, and a write
+    retried across the crash re-acks its ORIGINAL result without
+    stomping a newer payload."""
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cluster, tree, eng, vh, keys, pay = loaded(n=200)
+    rdir = str(tmp_path / "rec")
+    plane = RecoveryPlane(cluster, tree, eng, rdir)
+    plane.checkpoint_base()
+    cfg = ServeConfig(widths=(256, 1024), p99_targets_ms={
+        c: 200.0 for c in ("read", "scan", "insert", "delete")},
+        calib_steps=1, seal=False, write_linger_ms=0.5,
+        write_lane=True)
+    srv = ShermanServer(eng, cfg)
+    srv.start(calib_keys=keys)
+    k = keys[:8]
+    orig = [bytes([65 + i]) * 16 for i in range(8)]
+    ok0 = srv.submit("insert", k, payloads=orig, rid=500,
+                     tenant="t").result(timeout=30)
+    assert ok0.all()
+    srv.stop()
+    # the live segment's ack entry for rid 500 is a 5-tuple whose
+    # provenance lane carries the installed (nonzero) handles
+    jpath = eng.journal.path
+    acks = [a for kind, _k, aux, _r in
+            J.read_records(jpath, with_rids=True)
+            if kind == J.J_ACK for a in aux]
+    withprov = [a for a in acks if a[0] == 500 and len(a) == 5]
+    assert withprov, "heap-write ack must carry provenance"
+    assert (np.asarray(withprov[-1][4]) != 0).all()
+    # crash with a torn tail frame, then recover
+    plane.close()
+    rec = J.encode_record(J.J_UPSERT, k[:1], k[:1])
+    with open(jpath, "ab") as f:
+        f.write(rec[: len(rec) // 2])
+    del srv, vh, cluster, tree, eng
+    plane2, c2, t2, e2, receipt = RecoveryPlane.recover(
+        rdir, batch_per_node=256)
+    entry = plane2.dedup_window[("t", 500)]
+    assert len(entry) == 3, "recovered window keeps the provenance"
+    np.testing.assert_array_equal(entry[1], ok0)
+    assert (np.asarray(entry[2]) != 0).all()
+    # adopt + re-journal; a newer payload lands under a fresh rid,
+    # then the pre-crash rid retries: deduped, original ack, no stomp
+    srv2 = ShermanServer(e2, cfg)
+    srv2.start(calib_keys=keys)
+    try:
+        assert srv2.seed_dedup(plane2.dedup_window) >= 1
+        okn = srv2.submit("insert", k, rid=501, tenant="t",
+                          payloads=[b"newer-payload"] * 8) \
+                  .result(timeout=30)
+        assert okn.all()
+        f = srv2.submit("insert", k, payloads=orig, rid=500,
+                        tenant="t")
+        okr = f.result(timeout=30)
+        assert f.deduped and np.array_equal(okr, ok0)
+        got, fnd = srv2.submit("read", k, resolve_payloads=True) \
+                       .result(timeout=30)
+        assert fnd.all()
+        assert all(g == b"newer-payload" for g in got)
+        # seed_dedup re-journaled the provenance into the NEW segment:
+        # a second crash would still recover the 5-tuple
+        acks2 = [a for kind, _k, aux, _r in
+                 J.read_records(e2.journal.path, with_rids=True)
+                 if kind == J.J_ACK for a in aux]
+        assert any(a[0] == 500 and len(a) == 5 for a in acks2)
+    finally:
+        srv2.stop()
+    plane2.close()

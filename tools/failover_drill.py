@@ -1,0 +1,488 @@
+#!/usr/bin/env python
+"""Failover drill: journal-shipped replicas, lease-epoch promotion,
+and the client contract held ACROSS the failover.
+
+The fifth end-to-end rehearsal (chaos = detection, recovery =
+durability, reshard = capacity, contract = the front door) — this one
+pins the REPLICATION plane (``sherman_tpu/replica.py``):
+
+  phase 1  build + bulk-load an N-node CPU mesh, arm the recovery
+           plane (base checkpoint + v2 journal), start the front door
+           with exactly-once dedup, and attach a ReplicaGroup of R
+           journal-shipped followers (each bootstrapped from the
+           chain exactly the way ``recover()`` bootstraps, applying
+           shipped records through the SAME ``apply_records`` core).
+  phase A  open-loop writers (exactly-once rids) + readers hammer the
+           primary while the group tails the live journal; a slice of
+           reads is served by the REPLICAS through the leaf cache's
+           certified probe (caught-up followers only — staleness
+           forwards, never lies).  A delta checkpoint mid-stream
+           retires + sweeps the shipped segment under the tail: every
+           follower must re-bootstrap from the chain and converge.
+           Replication lag is measured and published
+           (``repl.lag_ms``).
+  kill     the primary front door is KILLED mid-traffic (no drain)
+           and the journal tail is TORN at the shipping boundary
+           (half a frame) — in-flight, never acked.
+  promote  ``group.promote``: the primary's lease EXPIRES (epoch
+           bump), every follower catches up to the durable journal
+           end (RPO 0 — acks gated on fsync), and the
+           highest-watermark follower wins.  The dead primary then
+           tries to write: the append is FENCED at the durability
+           gate (typed ``StalePrimaryError``, pinned >= 1).
+  resume   a fresh front door starts on the promoted engine (with its
+           own new recovery plane — the new primary is itself
+           recoverable), adopts the winner's replayed J_ACK window
+           via ``seed_dedup``, and serves; the kill -> first-serve
+           gap is the published availability gap.
+  retry    pre-kill rids are retried against the NEW primary after
+           the keys moved on: the window must re-ack the ORIGINAL
+           result (``fut.deduped``), never re-apply —
+           ``duplicate_acks == 0``.
+  audit    every acked write is served by the promoted primary
+           (``lost_acks == 0``, plus an untouched-key probe) and the
+           merged client history (both sides of the failover) checks
+           linearizable offline (``sherman_tpu/audit.py``).
+
+Runs on the CPU mesh anywhere (``bench.py --failover-drill`` forwards
+here; ``scripts/repl_ci.sh`` pins it in CI).  Prints ONE JSON line
+``{"metric": "failover_drill", "ok": true, "lost_acks": 0,
+"duplicate_acks": 0, "linearizable": true, ...}`` and mirrors it to
+``SHERMAN_FAILOVER_RECEIPT`` when set.  perfgate treats the committed
+receipt as a robustness artifact: never throughput-gated (replicated
+receipts are not comparable to unreplicated rounds), but
+``lost_acks > 0`` / ``duplicate_acks > 0`` / ``linearizable ==
+false`` is a marginless hard red.  Env knobs: SHERMAN_DRILL_KEYS
+(default 4000), SHERMAN_DRILL_NODES (default 2), SHERMAN_REPL
+(follower count, default 2 here), SHERMAN_CHAOS_SEED,
+SHERMAN_DRILL_SECS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from common import build_cluster, pages_for_keys, setup_platform
+
+SALT = 0xFA110FEB  # bulk-load value stamp (key ^ SALT)
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--keys", type=int,
+                   default=int(os.environ.get("SHERMAN_DRILL_KEYS", 4000)))
+    # default 1 node: the drill runs THREE concurrent executors (the
+    # primary's serve loop, the follower apply pump, the stale-primary
+    # probe) and XLA's CPU collective rendezvous can interleave across
+    # concurrent multi-device launches and deadlock — single-device
+    # programs have no rendezvous.  Chip meshes pass --nodes explicitly
+    # (one executor per launch group there).
+    p.add_argument("--nodes", type=int,
+                   default=int(os.environ.get("SHERMAN_DRILL_NODES", 1)))
+    p.add_argument("--replicas", type=int,
+                   default=int(os.environ.get("SHERMAN_REPL", 0) or 2))
+    p.add_argument("--seed", type=int,
+                   default=int(os.environ.get("SHERMAN_CHAOS_SEED", 7)))
+    p.add_argument("--secs", type=float,
+                   default=float(os.environ.get("SHERMAN_DRILL_SECS", 3.0)))
+    p.add_argument("--dir", default=None,
+                   help="drill directory (default: a tempdir)")
+    a = p.parse_args(argv)
+    setup_platform(a.nodes)
+
+    from sherman_tpu import audit as A
+    from sherman_tpu import obs
+    from sherman_tpu.errors import ShermanError
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.validate import check_structure_device
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.replica import ReplicaGroup, StalePrimaryError
+    from sherman_tpu.serve import (RetryingClient, RetryPolicy,
+                                   ServeConfig, ShermanServer)
+    from sherman_tpu.utils import journal as J
+
+    t_start = time.time()
+    out: dict = {"metric": "failover_drill", "seed": a.seed, "ok": False,
+                 "nodes": a.nodes, "replicas": a.replicas}
+    root = a.dir or tempfile.mkdtemp(prefix="sherman_failover_")
+    rdir = os.path.join(root, "primary")
+    rdir2 = os.path.join(root, "promoted")
+    out["dir"] = root
+
+    # -- phase 1: primary + replica group -------------------------------------
+    ppn = pages_for_keys(a.keys)
+    cluster, tree, eng = build_cluster(
+        a.nodes, ppn, batch_per_node=512,
+        locks_per_node=1024, chunk_pages=64)
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 56, int(a.keys * 1.05),
+                                  dtype=np.uint64))[:a.keys]
+    vals = keys ^ np.uint64(SALT)
+    batched.bulk_load(tree, keys, vals)
+    eng.attach_router()
+    check_structure_device(tree)
+    plane = RecoveryPlane(cluster, tree, eng, rdir, group_commit_ms=2.0)
+    plane.checkpoint_base()
+    group = ReplicaGroup(plane, a.replicas, cache_slots=2048)
+
+    widths = (256 * a.nodes, 1024 * a.nodes)
+    big = {c: 1e9 for c in ("read", "scan", "insert", "delete")}
+
+    def front_door(engine):
+        cfg = ServeConfig(widths=widths, p99_targets_ms=dict(big),
+                          write_linger_ms=0.5, write_width=2048,
+                          group_commit_ms=2.0)
+        srv = ShermanServer(engine, cfg)
+        absent = np.asarray([1 << 60], np.uint64)
+        # VALUE-PRESERVING calibration (a promoted engine's state
+        # already carries acked writes — re-stamping bulk values here
+        # would be a silent lost update the final audit flags)
+        ck = keys[:256]
+        cv, cf = engine.search(ck)
+        srv.start(calib_keys=keys,
+                  calib_writes=(ck[cf], np.asarray(cv)[cf]),
+                  calib_delete_keys=absent)
+        return srv
+
+    srv = front_door(eng)
+    snap0 = obs.snapshot()
+
+    # writer slices cover the FIRST n_writers*per keys; the last slice
+    # is never written — the immutable set replica reads serve from
+    n_writers, n_readers = 2, 1
+    per = a.keys // (n_writers + 1)
+    imm = keys[n_writers * per:]
+    acked: list[dict] = [dict() for _ in range(n_writers)]
+    unacked: list[dict] = [dict() for _ in range(n_writers)]
+    rid_ledger: list[dict] = [dict() for _ in range(n_writers)]
+    events: list[list] = [[] for _ in range(n_writers + n_readers + 1)]
+    stop = threading.Event()
+
+    gens = [0] * n_writers
+
+    def writer(w: int, n_reqs: int):
+        # bounded rounds of paced exactly-once writes: every journaled
+        # write is applied R more times by the follower tier in this
+        # one process, so an open-ended unthrottled writer measures
+        # apply backlog, not failover (the chip-queue entry carries
+        # the full-rate run); ``n_reqs == 0`` runs open-ended until
+        # the stop flag — the in-flight-at-the-kill round
+        my = keys[w * per:(w + 1) * per]
+        cl = RetryingClient(srv, tenant=f"writer{w}",
+                            policy=RetryPolicy(max_attempts=6),
+                            seed=100 + w + gens[w])
+        ev = events[w]
+        wrng = np.random.default_rng(1000 * w + gens[w])
+        done = 0
+        while not stop.is_set() and (n_reqs == 0 or done < n_reqs):
+            gens[w] += 1
+            done += 1
+            time.sleep(0.005)
+            kreq = np.unique(my[wrng.integers(0, my.size, 48)])
+            vreq = kreq ^ np.uint64(SALT) ^ np.uint64(gens[w] << 8)
+            rid = cl.next_rid()
+            t_inv = time.perf_counter()
+            try:
+                ok = cl.insert(kreq, vreq, rid=rid)
+            except ShermanError:
+                # in flight at the kill: result unknown, not owed —
+                # legal for concurrent readers (open_writes below)
+                for k, v in zip(kreq.tolist(), vreq.tolist()):
+                    unacked[w].setdefault(k, []).append((True, v))
+                continue
+            t_resp = time.perf_counter()
+            rid_ledger[w][rid] = (kreq, vreq, np.array(ok))
+            for k, v, o in zip(kreq.tolist(), vreq.tolist(),
+                               ok.tolist()):
+                if o:
+                    acked[w][k] = v
+                    ev.append((k, A.OP_INSERT, t_inv, t_resp, v, True))
+
+    def reader(r: int):
+        cl = RetryingClient(srv, tenant=f"reader{r}",
+                            policy=RetryPolicy(max_attempts=4),
+                            seed=200 + r, deadline_ms=5000.0)
+        ev = events[n_writers + r]
+        rrng = np.random.default_rng(50 + r)
+        while not stop.is_set():
+            kreq = np.unique(keys[rrng.integers(0, keys.size, 64)])
+            t_inv = time.perf_counter()
+            try:
+                got, found = cl.read(kreq)
+            except ShermanError:
+                continue
+            t_resp = time.perf_counter()
+            for k, g, f in zip(kreq.tolist(), got.tolist(),
+                               found.tolist()):
+                ev.append((k, A.OP_READ, t_inv, t_resp,
+                           g if f else None, bool(f)))
+            time.sleep(0.001)
+
+    repl_read_fail = [0]
+
+    def replica_reader():
+        # the replica tier: certified cache hits served by a
+        # caught-up follower, misses forwarded to the primary engine
+        ev = events[n_writers + n_readers]
+        rrng = np.random.default_rng(77)
+        while not stop.is_set():
+            kreq = np.unique(imm[rrng.integers(0, imm.size, 48)])
+            t_inv = time.perf_counter()
+            try:
+                got, found = group.read(kreq)
+            except ShermanError:
+                repl_read_fail[0] += 1
+                continue
+            t_resp = time.perf_counter()
+            for k, g, f in zip(kreq.tolist(),
+                               np.asarray(got).tolist(),
+                               np.asarray(found).tolist()):
+                ev.append((k, A.OP_READ, t_inv, t_resp,
+                           g if f else None, bool(f)))
+            time.sleep(0.002)
+
+    for f in group.followers:
+        f.admit(imm)
+    readers = [threading.Thread(target=reader, args=(r,), daemon=True)
+               for r in range(n_readers)] + \
+              [threading.Thread(target=replica_reader, daemon=True)]
+    for t in readers:
+        t.start()
+    n_round = max(4, int(a.secs * 5))
+
+    # round 1: bounded write load under the live tail
+    ws = [threading.Thread(target=writer, args=(w, n_round),
+                           daemon=True) for w in range(n_writers)]
+    for t in ws:
+        t.start()
+    for t in ws:
+        t.join(timeout=300)
+    group.pump()
+
+    # delta checkpoint mid-stream: rotation retires + SWEEPS the
+    # shipped segment under the live tail — followers re-bootstrap
+    # from the chain and must converge (pinned below).  The pump lock
+    # is held across it so a background pump cannot slip through the
+    # rotate->sweep window and advance the tail first (which would
+    # make the sweep invisible and the re-bootstrap pin vacuous).
+    with group._pump_lock:
+        d1 = plane.checkpoint_delta()
+    out["delta1"] = {"pages": int(d1["pages"])}
+    # absorb the re-bootstrap here so the lag probe below measures a
+    # steady-state shipping round, not an engine rebuild
+    group.pump()
+
+    # round 2: more acked writes on the fresh segment
+    ws = [threading.Thread(target=writer, args=(w, n_round),
+                           daemon=True) for w in range(n_writers)]
+    for t in ws:
+        t.start()
+    for t in ws:
+        t.join(timeout=300)
+    lag_ms = group.measure_lag()
+
+    # round 3: open-ended writers — the in-flight-at-the-kill load
+    ws = [threading.Thread(target=writer, args=(w, 0), daemon=True)
+          for w in range(n_writers)]
+    for t in ws:
+        t.start()
+    time.sleep(min(0.5, a.secs / 4))
+
+    # -- kill: no drain, torn tail at the shipping boundary -------------------
+    t_kill = time.perf_counter()
+    srv.kill()
+    stop.set()
+    for t in ws + readers:
+        t.join(timeout=120)
+    live_rids = {w: dict(rid_ledger[w]) for w in range(n_writers)}
+    jpath = eng.journal.path
+    with open(jpath, "ab") as f:  # crash mid-append: torn half-frame
+        rec = J.encode_record(J.J_UPSERT,
+                              np.asarray([1 << 40], np.uint64),
+                              np.asarray([7], np.uint64), rid=0xDEAD)
+        f.write(rec[: len(rec) // 2])
+
+    # -- promote: fence + catch-up + highest watermark ------------------------
+    rcpt = group.promote(t_dead=t_kill)
+    out["promote"] = rcpt
+    # the dead primary keeps writing: fenced TYPED at the durability
+    # gate (the epoch check), never a silent journal fork
+    try:
+        eng.insert(np.asarray([1 << 41], np.uint64),
+                   np.asarray([1], np.uint64))
+        raise AssertionError("stale-primary write was NOT fenced")
+    except ShermanError as e:
+        tip = e
+        while tip is not None and \
+                not isinstance(tip, StalePrimaryError):
+            tip = tip.__cause__
+        assert isinstance(tip, StalePrimaryError) \
+            or isinstance(e, StalePrimaryError), \
+            f"fence raised untyped {type(e).__name__}: {e}"
+    out["fenced_writes"] = group.fenced_writes
+    assert group.fenced_writes >= 1
+
+    # -- resume: new front door on the promoted engine ------------------------
+    win = group.promoted
+    eng2 = win.eng
+    plane2 = RecoveryPlane(win.cluster, win.tree, eng2, rdir2,
+                           group_commit_ms=2.0)
+    plane2.checkpoint_base()  # the new primary is itself recoverable
+    srv2 = front_door(eng2)
+    adopted = srv2.seed_dedup(group.promoted_window())
+    # first post-failover serve closes the availability gap
+    _g0, f0 = srv2.submit("read", keys[:64]).result(timeout=60)
+    assert np.asarray(f0).all()
+    gap_ms = group.note_resumed()
+    out["availability_gap_ms"] = gap_ms
+    out["dedup"] = {"adopted": adopted}
+    assert adopted > 0, "promotion adopted an empty exactly-once window"
+
+    # -- RPO: every acked write served by the promoted primary ----------------
+    merged_acked: dict = {}
+    for d in acked:
+        merged_acked.update(d)
+    assert merged_acked, "drill acked no writes before the kill"
+    ak = np.asarray(sorted(merged_acked), np.uint64)
+    av = np.asarray([merged_acked[int(k)] for k in ak], np.uint64)
+    t_inv = time.perf_counter()
+    # chunk by the widest dispatch class — the audit set can exceed it
+    wmax = max(widths)
+    parts = [srv2.submit("read", ak[i:i + wmax]).result(timeout=120)
+             for i in range(0, ak.size, wmax)]
+    got = np.concatenate([np.asarray(g) for g, _ in parts])
+    found = np.concatenate([np.asarray(f) for _, f in parts])
+    t_resp = time.perf_counter()
+    lost = int((~found).sum()) + int((got[found] != av[found]).sum())
+    post_events = [(int(k), A.OP_READ, t_inv, t_resp,
+                    int(g) if f else None, bool(f))
+                   for k, g, f in zip(ak.tolist(), got.tolist(),
+                                      found.tolist())]
+    # untouched-key probe: bulk values still served verbatim
+    probe = keys[~np.isin(keys, ak)][:: max(1, a.keys // 512)]
+    got, found = srv2.submit("read", probe).result(timeout=120)
+    lost += int((~found).sum()) + int(
+        (got[found] != (probe ^ np.uint64(SALT))[found]).sum())
+    out["lost_acks"] = lost
+    assert lost == 0, f"{lost} acked ops lost across the failover"
+
+    # -- retry across the failover: re-ack, never re-apply --------------------
+    duplicate_acks = 0
+    retried = 0
+    for w in range(n_writers):
+        sample = list(live_rids[w].items())[-4:]
+        for rid, (kreq, vreq, ok0) in sample:
+            if not ok0.any():
+                continue
+            retried += 1
+            # 1) move the keys PAST the old write (fresh rid)
+            vnew = kreq ^ np.uint64(SALT) ^ np.uint64(0x7777_0000)
+            t_inv = time.perf_counter()
+            ok2 = srv2.submit("insert", kreq, vnew,
+                              tenant=f"writer{w}",
+                              rid=(0x7777 << 32) | (rid & 0xFFFFFFFF)
+                              ).result(timeout=60)
+            t_resp = time.perf_counter()
+            for k, v, o in zip(kreq.tolist(), vnew.tolist(),
+                               ok2.tolist()):
+                if o:
+                    merged_acked[k] = v
+                    post_events.append((k, A.OP_INSERT, t_inv,
+                                        t_resp, v, True))
+            # 2) retry the PRE-KILL rid with its original payload: the
+            # promoted window must re-ack the ORIGINAL result
+            fut = srv2.submit("insert", kreq, vreq,
+                              tenant=f"writer{w}", rid=rid)
+            okr = fut.result(timeout=60)
+            if not fut.deduped or not np.array_equal(okr, ok0):
+                duplicate_acks += 1
+                continue
+            got, found = srv2.submit("read", kreq).result(timeout=60)
+            stomped = int(np.sum(found & ok2 & (got == vreq)
+                                 & (vreq != vnew)))
+            if stomped:
+                duplicate_acks += 1
+    out["retry_across_failover"] = {"retried": retried,
+                                    "dedup_hits": srv2.dedup_hits}
+    out["duplicate_acks"] = duplicate_acks
+    assert retried > 0, "drill retried nothing across the failover"
+    assert duplicate_acks == 0, \
+        f"{duplicate_acks} retried writes re-applied (lost updates)"
+    srv2.drain()
+    plane2.close()
+
+    # -- offline linearizability over BOTH sides of the failover --------------
+    all_events = [e for ev in events for e in ev] + post_events
+    initial = {int(k): (True, int(v)) for k, v in zip(keys, vals)}
+    open_w: dict = {}
+    for d in unacked:
+        for k, outs in d.items():
+            open_w.setdefault(k, []).extend(outs)
+    verdict = A.check_events(all_events, initial=initial,
+                             open_writes=open_w)
+    out["audit"] = {
+        "events": verdict["events"],
+        "keys": verdict["keys"],
+        "reads_checked": verdict["reads"],
+        "violations": len(verdict["violations"]),
+        "linearizable": bool(verdict["linearizable"]),
+    }
+    out["linearizable"] = bool(verdict["linearizable"])
+    if verdict["violations"]:
+        out["audit"]["first_violations"] = verdict["violations"][:3]
+    assert verdict["linearizable"], \
+        f"history not linearizable: {verdict['violations'][:3]}"
+    assert verdict["reads"] > 0, "audit checked no reads"
+    jsonl = os.path.join(root, "history.jsonl")
+    A.dump_jsonl(all_events, jsonl)
+    out["history_jsonl"] = jsonl
+
+    # -- the replication receipt ----------------------------------------------
+    st = group.stats()
+    out["repl"] = {
+        "followers": st["followers"],
+        "applied_records": st["applied_records"],
+        "applied_rows": st["applied_rows"],
+        "absorbed_acks": st["absorbed_acks"],
+        "rebootstraps": st["rebootstraps"],
+        "torn_waits": st["torn_waits"],
+        "lag_ms": round(lag_ms, 2),
+        "reads_served": st["reads_served"],
+        "reads_forwarded": st["reads_forwarded"],
+        "read_failures": repl_read_fail[0],
+        "epoch": st["epoch"],
+        "watermark": {"link": st["watermark_link"],
+                      "seq": st["watermark_seq"]},
+    }
+    assert st["applied_records"] > 0, "the tail shipped nothing"
+    assert st["rebootstraps"] >= a.replicas, \
+        "the mid-stream sweep never forced a re-bootstrap"
+    assert st["reads_served"] > 0, "no replica-served reads"
+
+    d = obs.delta(snap0, obs.snapshot())
+    out["obs"] = {k: round(float(d[k]), 2) for k in sorted(d)
+                  if k in ("repl.applied_records", "repl.promotions",
+                           "repl.fenced_writes", "repl.lag_ms",
+                           "repl.availability_gap_ms")}
+    out["elapsed_s"] = round(time.time() - t_start, 1)
+    out["ok"] = True
+    line = json.dumps(out)
+    print(line)
+    receipt = os.environ.get("SHERMAN_FAILOVER_RECEIPT")
+    if receipt:
+        with open(receipt, "w") as f:
+            f.write(line + "\n")
+    print("FAILOVER-DRILL PASS", file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,13 @@ Comparability rules (the trajectory's own lessons):
   ``linearizable == false`` in a committed receipt fails the gate
   outright; with the pins green the receipt passes on them alone
   (no comparable throughput metric required);
+- REPLICATION (PR 16) is incomparable config: a receipt with the
+  replication plane ON (a ``repl`` block, a ``replicas`` config, or
+  metric ``failover_drill``) never throughput-gates against
+  unreplicated rounds — the follower tier re-applies every journaled
+  write R more times in the same process.  Failover-drill receipts
+  carry the same marginless hard-red pins as contract receipts
+  (``lost_acks`` / ``duplicate_acks`` / ``linearizable``);
 - a metric missing on either side is skipped, not failed — but a
   candidate with NO comparable metric at all exits 2 (the gate cannot
   vouch for it).
@@ -199,6 +206,19 @@ def _serve_mode(r: dict) -> bool:
                 or r.get("metric") == "serve_bench")
 
 
+def _replicated(r: dict) -> bool:
+    """A receipt ran with the replication plane ON: a ``repl`` block
+    (the ReplicaGroup's receipt), a follower count in its config, or
+    the failover-drill metric itself.  Missing everything = the
+    unreplicated fact (replication is OFF by default), so the whole
+    committed trajectory keeps comparing."""
+    if isinstance(r.get("repl"), dict) \
+            or r.get("metric") == "failover_drill":
+        return True
+    return bool(r.get("replicas")
+                or (r.get("config") or {}).get("replicas"))
+
+
 def _comparable(cand: dict, r: dict, metric: str) -> bool:
     if r.get("keys") != cand.get("keys") \
             or r.get("batch") != cand.get("batch"):
@@ -206,6 +226,12 @@ def _comparable(cand: dict, r: dict, metric: str) -> bool:
     # serve-mode wall: front-door receipts gate only within serve-mode
     # rounds, closed-loop receipts only within closed-loop rounds
     if _serve_mode(cand) != _serve_mode(r):
+        return False
+    # replication wall (PR 16): a replicated round's follower tier
+    # re-applies every journaled write R more times in the same
+    # process — its walls and throughputs never gate against
+    # unreplicated rounds (and vice versa)
+    if _replicated(cand) != _replicated(r):
         return False
     if metric.startswith("serve_"):
         # per-class p99 gates only between rounds aiming at the SAME
@@ -381,7 +407,7 @@ def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
     # `duplicate_acks > 0`, `lost_acks > 0` or `linearizable == false`
     # is a hard red with no margin: each is a count/verdict of a
     # correctness hazard, not a wall.
-    if cand.get("metric") == "contract_drill" \
+    if cand.get("metric") in ("contract_drill", "failover_drill") \
             or "duplicate_acks" in cand or "linearizable" in cand:
         for name in ("duplicate_acks", "lost_acks"):
             val = cand.get(name)
