@@ -194,7 +194,8 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     from sherman_tpu.obs import device as dev_obs
     from sherman_tpu.cluster import Cluster
     from sherman_tpu.config import (DSMConfig, LEAF_CAP, TreeConfig,
-                                    staged_fusion)
+                                    prep_impl, staged_fusion,
+                                    write_combine)
     from sherman_tpu.models import batched
     from sherman_tpu.models.btree import Tree
     from sherman_tpu.ops import bits
@@ -1250,6 +1251,13 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             "value_bytes": 8,
             "value_dist": "fixed",
             "value_heap": False,
+            # request-plane placement (PR 17): where batch prep
+            # (combine/sort/route) ran and whether same-leaf writes were
+            # grouped under one lock.  perfgate treats a differing prep
+            # placement as INCOMPARABLE — host prep burns wall clock the
+            # device-prep runs don't pay.
+            "prep_impl": prep_impl(),
+            "write_combine": write_combine(),
         },
         # hot-key tier receipt (models/leaf_cache.py; None = cache off,
         # the shipped default — optional block, schema stays 3).

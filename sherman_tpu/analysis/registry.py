@@ -117,6 +117,17 @@ DEFAULT_REGISTRY = Registry(
         # belongs in the complete() half, which materializes by design)
         ("sherman_tpu/workload/device_prep.py",
          "make_ingress_step.dispatch"),
+        # device-resident request plane (PR 17): the on-device prep
+        # program family (combine/sort/route in one compiled ladder
+        # rung) and the device-mode ingress dispatch closure — the
+        # whole point of device prep is that nothing syncs before the
+        # fused fan-out launches, so a stray host sync here re-creates
+        # the host-prep serialization the knob exists to remove
+        ("sherman_tpu/workload/device_prep.py",
+         "make_device_prep.prep_core"),
+        ("sherman_tpu/workload/device_prep.py", "make_device_prep.*"),
+        ("sherman_tpu/workload/device_prep.py",
+         "make_ingress_step.dispatch_device"),
         ("sherman_tpu/serve.py", "ShermanServer._dispatch_reads"),
         # client-contract plane (PR 15): the dispatch-path queue pops
         # run per formed step under the admission lock — deadline
@@ -200,6 +211,12 @@ DEFAULT_REGISTRY = Registry(
         # accounting runs on every completed batch inside the serve
         # wall (the < 2% pin's own numerator must not allocate)
         ("sherman_tpu/audit.py", "Auditor._note_cost"),
+        # write combining (PR 17): per-batch combined-kernel accounting
+        # runs inside the insert/mixed write wall — plain integer adds;
+        # the group/saved counts live in device counter slots and the
+        # combine.* collector allocates at PULL time like every other
+        ("sherman_tpu/models/batched.py",
+         "BatchedEngine._note_combine_step"),
         # replication plane (PR 16): replica-read and fencing
         # accounting — _note_reads runs on every replica-tier read
         # batch and _note_fenced inside the durability gate's fence

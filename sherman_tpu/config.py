@@ -97,6 +97,56 @@ def staged_fusion() -> str:
     return v
 
 
+def prep_impl() -> str:
+    """Request-plane placement knob (``SHERMAN_PREP_IMPL``): where the
+    per-batch combining/dedup/sort/route prep of the serving front
+    door's ingress step (``workload.device_prep.make_ingress_step``)
+    runs.
+
+    - ``host`` (default): the PR-13 path — ``np.unique`` dedup +
+      host router probe, then the fused device fan-out.  Default per
+      the measurement-driven-flips guardrail: the device path ships
+      behind the chip A/B queued in BENCHMARKS.md.
+    - ``device``: one fused device program sorts, dedups, and
+      router-probes the raw request pairs (``lax.sort`` + segment
+      scan), emitting staged inputs bit-identical to the host path
+      (CI-pinned, including straggler/partial-active widths) with the
+      host out of the per-batch path.  Falls back to ``host`` for
+      steps constructed with a leaf cache attached: the cache probe is
+      host-in/host-out (it syncs its hit count), so composing it with
+      device prep would reintroduce the very host round-trip the knob
+      removes."""
+    import os
+    v = os.environ.get("SHERMAN_PREP_IMPL", "host").strip().lower()
+    if v not in ("host", "device"):
+        raise ConfigError(
+            f"SHERMAN_PREP_IMPL={v!r}: want host|device")
+    return v
+
+
+def write_combine() -> bool:
+    """Write-combining knob (``SHERMAN_WRITE_COMBINE``): when on, the
+    leaf-apply kernels consult each page-group's lock word ONCE per
+    group instead of once per row — the TPU analog of Sherman's HOCL
+    local-lock-table handover (many same-leaf writes ride one lock
+    acquisition).  Statuses, acks, journal order, pool bits stay
+    identical by construction (rows of one page hash to ONE lock word,
+    so per-row verdicts within a group were always uniform); only the
+    lock-consult count and the ``combine.*`` counters change.
+
+    Off is the SHIPPED DEFAULT (standing guardrail: flips are
+    measurement-driven — the chip A/B queued in BENCHMARKS.md decides
+    it)."""
+    import os
+    v = os.environ.get("SHERMAN_WRITE_COMBINE", "0").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return False
+    if v in ("1", "true", "on", "yes"):
+        return True
+    raise ConfigError(
+        f"SHERMAN_WRITE_COMBINE={v!r}: want 0/1")
+
+
 def value_heap_pages() -> int:
     """Out-of-line value heap knob (``SHERMAN_VALUE_HEAP``): heap pages
     per node of the second DSM region storing variable-length payloads

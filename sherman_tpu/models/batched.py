@@ -413,7 +413,8 @@ def search_spmd(pool, counters, khi, klo, root, active, start=None, *,
 # ---------------------------------------------------------------------------
 
 def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
-                    cfg: DSMConfig, update_only: bool = False):
+                    cfg: DSMConfig, update_only: bool = False,
+                    combine: bool = False):
     """Apply routed insert requests to this node's leaf pages.
 
     inc: dict of [M] arrays — active, addr (leaf), khi, klo, vhi, vlo.
@@ -435,6 +436,19 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     requests are deduped (stable request order: lowest (source, slot)
     wins) — the intra-step linearization that replaces local-lock
     hand-over.
+
+    ``combine`` (static) is HOCL-style write combining (the reference's
+    local-lock-table handover, Tree.cpp:218-239): the lock verdict is
+    consulted ONCE per page group (the sort's outer key is the page)
+    and handed to every row of the group, instead of one lock-word
+    gather per row.  Bit-identical by construction — all rows of a page
+    hash to ONE lock word (``bits.lock_index`` is per-addr), so the
+    per-row verdicts inside a group were always uniform; the only
+    observable deltas are the lock-consult count and the
+    ``CNT_COMBINE_*`` counter slots.  Deletes
+    (:func:`leaf_delete_apply_spmd`) stay uncombined: their per-row
+    verdict feeds a row-compacted CAS path with no group structure to
+    ride.
 
     Splits (Tree.cpp:922-963, TPU-shaped): the first overflowing insert
     winner of a page (its in-page rank equals the page's free-slot count)
@@ -470,12 +484,15 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
         pg = lax.optimization_barrier(pool[safe_page])     # [M, PW] snapshot
 
     lock_idx = bits.lock_index(inc["addr"], cfg.locks_per_node)
-    locked = locks[jnp.clip(lock_idx, 0, L - 1)] != 0
+    if not combine:
+        locked = locks[jnp.clip(lock_idx, 0, L - 1)] != 0
 
     sane = act & (page_idx >= 0) & (page_idx < P) \
         & (layout.h_level(pg) == 0) & layout.in_fence(pg, khi, klo) \
         & layout.page_consistent(pg)
-    ok_req = sane & ~locked
+    # combined mode defers the lock verdict to the per-group consult
+    # below (sane rows enter the sort; their page-group head decides)
+    ok_req = sane if combine else (sane & ~locked)
 
     found, _, _, fslot = layout.leaf_find_key(pg, khi, klo)
     if update_only:
@@ -501,9 +518,41 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     # acked write would otherwise be observably absent.
     idx0 = jnp.arange(M, dtype=jnp.int32)
     pk = jnp.where(ok_req, page_idx, P)
-    sp, skhi, sklo, sidx, sfound, sfreec = lax.sort(
-        (pk, bits._ux(khi), bits._ux(klo), idx0, found, freec), num_keys=3)
-    sok = sp < P
+    if combine:
+        # -- HOCL-style handover: one lock consult per page group -----
+        # The sort already groups rows by page; carry the lock index
+        # along, consult the lock word only at each group's head, and
+        # hand the verdict down the group with a position-encoded
+        # running max (same encoding as the dedup-winner broadcast
+        # below).  Locked groups' rows fall out of ``sok`` exactly as
+        # the per-row gather would have dropped them — same page ⇒
+        # same lock word ⇒ uniform verdict — so everything downstream
+        # (dedup, ranks, splits, write-back, statuses) is unchanged.
+        sp, skhi, sklo, sidx, sfound, sfreec, slidx = lax.sort(
+            (pk, bits._ux(khi), bits._ux(klo), idx0, found, freec,
+             lock_idx), num_keys=3)
+        sok_all = sp < P
+        page_head_all = jnp.concatenate(
+            [sok_all[:1], (sp[1:] != sp[:-1]) & sok_all[1:]])
+        head_lw = locks[jnp.where(page_head_all,
+                                  jnp.clip(slidx, 0, L - 1), 0)]
+        head_locked = page_head_all & (head_lw != 0)
+        encL = lax.associative_scan(
+            jnp.maximum,
+            jnp.where(page_head_all,
+                      idx0 * 2 + head_locked.astype(jnp.int32), -1))
+        locked_s = sok_all & ((encL & 1) == 1)
+        sok = sok_all & ~locked_s
+        u32c = lambda m: jnp.sum(m.astype(jnp.uint32))
+        counters = counters.at[D.CNT_COMBINE_GROUPS].add(
+            u32c(page_head_all))
+        counters = counters.at[D.CNT_COMBINE_SAVED].add(
+            u32c(sok_all) - u32c(page_head_all))
+    else:
+        sp, skhi, sklo, sidx, sfound, sfreec = lax.sort(
+            (pk, bits._ux(khi), bits._ux(klo), idx0, found, freec),
+            num_keys=3)
+        sok = sp < P
     same_prev = jnp.concatenate([
         jnp.zeros(1, bool),
         (sp[1:] == sp[:-1]) & (skhi[1:] == skhi[:-1]) & (sklo[1:] == sklo[:-1])
@@ -558,7 +607,13 @@ def leaf_apply_spmd(pool, locks, counters, inc, fresh=None, *,
     # un-sort via a 2-operand key-value sort (sidx is a permutation of
     # [0, M)): ~1 ms at 2 M rows on v5e vs ~15 ms for the equivalent
     # full-width scatter
-    _, code = lax.sort((sidx, code_s), num_keys=1)
+    if combine:
+        # carry the group verdict back to row space for the status line
+        _, code, locked_i = lax.sort(
+            (sidx, code_s, locked_s.astype(jnp.int32)), num_keys=1)
+        locked = locked_i != 0
+    else:
+        _, code = lax.sort((sidx, code_s), num_keys=1)
     winner_upd = code == -1
     superseded = code == -2
     loser_retry = code == -4
@@ -851,7 +906,8 @@ def _route_and_apply(pool, locks, counters, dirty, apply_fn, addr, eligible,
 def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
                      active, start=None, fresh=None, *, cfg: DSMConfig,
                      iters: int, axis_name: str = AXIS,
-                     update_only: bool = False, dirty=None):
+                     update_only: bool = False, combine: bool = False,
+                     dirty=None):
     """One batched insert step: descend + route to owners + leaf apply.
 
     With ``fresh`` (per-node pre-allocated pages), full leaves split
@@ -874,7 +930,7 @@ def insert_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
         pool, counters, khi, klo, root, active, start, cfg=cfg,
         iters=iters, axis_name=axis_name)
     apply_fn = functools.partial(leaf_apply_spmd, fresh=fresh,
-                                 update_only=update_only)
+                                 update_only=update_only, combine=combine)
     if fresh is not None and dirty is not None:
         # granted split pages are written owner-side this step; marking
         # every OFFERED grant over-marks unconsumed ones (spare delta
@@ -979,7 +1035,8 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
                     active_r, active_w, start=None, *, cfg: DSMConfig,
                     iters: int, axis_name: str = AXIS,
                     write_lo: int | None = None,
-                    update_only: bool = False, dirty=None):
+                    update_only: bool = False, combine: bool = False,
+                    dirty=None):
     """One fused step of searches (``active_r``) and upserts (``active_w``).
 
     The reference interleaves reads and writes per thread from one open
@@ -1023,7 +1080,8 @@ def mixed_step_spmd(pool, locks, counters, khi, klo, vhi, vlo, root,
         pad = write_lo
     pool, counters, dirty, st_w, _ = _route_and_apply(
         pool, locks, counters, dirty,
-        functools.partial(leaf_apply_spmd, update_only=update_only),
+        functools.partial(leaf_apply_spmd, update_only=update_only,
+                          combine=combine),
         addr[w], (done & active_w)[w],
         {"khi": khi[w], "klo": klo[w], "vhi": vhi[w], "vlo": vlo[w]},
         cfg=cfg, axis_name=axis_name)
@@ -1070,12 +1128,20 @@ class BatchedEngine:
 
     def __init__(self, tree, batch_per_node: int = 1024,
                  tcfg: TreeConfig | None = None,
-                 split_slots: int | None = None):
+                 split_slots: int | None = None,
+                 write_combine: bool | None = None):
         self.tree = tree
         self.dsm = tree.dsm
         self.cfg = tree.cfg
         self.tcfg = tcfg if tcfg is not None else TreeConfig()
         self.B = batch_per_node
+        # HOCL-style write combining (leaf_apply_spmd's ``combine``
+        # static): one lock consult per same-leaf write group.  None
+        # (default) reads the SHERMAN_WRITE_COMBINE knob; explicit
+        # True/False pins it for A/B drivers and tests.  Static per
+        # engine — it selects which program the jit caches compile.
+        self._write_combine = (C.write_combine() if write_combine is None
+                               else bool(write_combine))
         # device-split grant slots per node per insert round; unused grants
         # are cached host-side and re-offered (free() is a no-op, so
         # abandoning them would leak pages every round).  The default
@@ -1163,6 +1229,33 @@ class BatchedEngine:
         # dispatch is async, so the mutex is held microseconds and never
         # across a host DSM op (threading.Lock is not reentrant).
         self._step_mutex = self.dsm._step_mutex
+        # Write-combining observability: the device kernels accumulate
+        # group/saved counts in the DSM counter slots (no per-step host
+        # sync); this pull-time collector names them the combine.* way
+        # the receipts and dashboards expect.  Registered only when the
+        # knob is on, so combine-off scrapes are bit-identical to a
+        # build without the subsystem.  Weakly bound like the dsm
+        # collector.
+        self._combine_steps = 0
+        self._combine_rows = 0
+        if self._write_combine:
+            import weakref
+            _dref = weakref.ref(self.dsm)
+            _eref = weakref.ref(self)
+
+            def _combine_collect():
+                d = _dref()
+                e = _eref()
+                if d is None or e is None:
+                    return {}
+                snap = d.counter_snapshot()
+                groups = snap["combine_groups"]
+                saved = snap["combine_locks_saved"]
+                return {"groups": groups, "locks_saved": saved,
+                        "ops_combined": saved,
+                        "steps": float(e._combine_steps),
+                        "rows": float(e._combine_rows)}
+            obs.register_collector("combine", _combine_collect)
 
     # -- degraded mode (read-only serving after unrecoverable damage) --------
 
@@ -1192,6 +1285,15 @@ class BatchedEngine:
             # the postmortem starts from the moment the engine gave up
             FR.record_event("engine.degraded_enter", reason=reason)
             FR.auto_dump("degraded_entry")
+
+    def _note_combine_step(self, rows: int) -> None:
+        """Per-batch write-combining accounting (plain integer adds —
+        SL006-registered: this runs inside the write wall).  The
+        group/saved counts themselves accumulate in the DSM counter
+        slots on device; this only tracks how many batches/rows went
+        through the combined kernel."""
+        self._combine_steps += 1
+        self._combine_rows += rows
 
     def exit_degraded(self) -> None:
         """Clear degraded mode — only after the damage is actually gone
@@ -1326,9 +1428,13 @@ class BatchedEngine:
         splitter ranking, split-page detection and split-apply machinery
         drop out of the program entirely (~30 ms/step at 2 M rows).
         ``update_only`` additionally compiles the 3-word write-back
-        steady-state kernel (absent keys escalate, see leaf_apply_spmd)."""
+        steady-state kernel (absent keys escalate, see leaf_apply_spmd).
+        The engine's ``_write_combine`` (SHERMAN_WRITE_COMBINE) selects
+        the HOCL-style group-lock-consult variant — part of the cache
+        key so A/B drivers flipping it per engine never collide."""
         assert not (update_only and with_fresh)
-        key = (iters, with_start, with_fresh, update_only)
+        combine = self._write_combine
+        key = (iters, with_start, with_fresh, update_only, combine)
         fn = self._insert_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
@@ -1349,7 +1455,7 @@ class BatchedEngine:
                 return insert_step_spmd(
                     pool, locks, counters, khi, klo, vhi, vlo,
                     root, active, start, fresh, cfg=self.cfg, iters=iters,
-                    update_only=update_only, dirty=dirty)
+                    update_only=update_only, combine=combine, dirty=dirty)
 
             sm = jax.shard_map(
                 kernel,
@@ -1398,8 +1504,10 @@ class BatchedEngine:
         """``write_lo`` (static, per-node offset): callers that lay each
         node's shard out as [reads | writes] get the half-width apply
         (see mixed_step_spmd).  ``update_only``: the 4-word steady-state
-        apply (absent keys escalate with ST_FULL)."""
-        key = (iters, with_start, write_lo, update_only)
+        apply (absent keys escalate with ST_FULL).  ``_write_combine``
+        selects the group-lock-consult apply, like ``_get_insert``."""
+        combine = self._write_combine
+        key = (iters, with_start, write_lo, update_only, combine)
         fn = self._mixed_cache.get(key)
         if fn is None:
             spec, rep = self._spec, self._rep
@@ -1415,7 +1523,7 @@ class BatchedEngine:
                     pool, locks, counters, khi, klo, vhi, vlo, root,
                     active_r, active_w, start, cfg=self.cfg, iters=iters,
                     write_lo=write_lo, update_only=update_only,
-                    dirty=dirty)
+                    combine=combine, dirty=dirty)
 
             sm = jax.shard_map(
                 kernel,
@@ -1473,6 +1581,8 @@ class BatchedEngine:
             ar = ar & ~c_hit
         use_router = self.router is not None
         fn = self._get_mixed(self._iters(), use_router)
+        if self._write_combine:
+            self._note_combine_step(int(np.count_nonzero(~is_read)))
         # batch prep (router probe, host->device transfers) OUTSIDE the
         # step mutex — only the handle read -> launch -> handle write is
         # locked (see __init__); holding it across prep would stall
@@ -2161,6 +2271,8 @@ class BatchedEngine:
             # multihost always keeps the fixed with-fresh shape
             with_fresh = self._mh or bool(fresh_np.any())
             fn = self._get_insert(self._iters(), use_router, with_fresh)
+            if self._write_combine:
+                self._note_combine_step(int(np.count_nonzero(active)))
             args = [self._shard(khi), self._shard(klo),
                     self._shard(vhi), self._shard(vlo),
                     np.int32(self.tree._root_addr), self._shard(active)]

@@ -250,6 +250,27 @@ def u64_shr(hi, lo, s: int):
     return jnp.zeros_like(hi), hi >> (s - 32)
 
 
+def u64_shr_dyn(hi, lo, s):
+    """Logical right shift of a (hi, lo) uint32 pair by a TRACED shift
+    ``s`` (uint32 scalar or array, 0 <= s <= 63).  The static
+    :func:`u64_shr` branches in Python; the device router probe needs
+    the shift as data (the span grows under serving and a static shift
+    would retrace the sealed prep program).  Shift amounts are clamped
+    before use — XLA shifts >= bit width are undefined, so each branch
+    only ever sees an in-range amount and ``jnp.where`` selects."""
+    s = jnp.asarray(s, jnp.uint32)
+    s_lo = jnp.minimum(s, jnp.uint32(31))          # safe for the s<32 lanes
+    s_hi = jnp.where(s >= jnp.uint32(32), s - jnp.uint32(32), jnp.uint32(0))
+    lo_small = (lo >> s_lo) | jnp.where(
+        s_lo > 0, hi << (jnp.uint32(32) - s_lo), jnp.uint32(0))
+    hi_small = hi >> s_lo
+    lo_big = hi >> s_hi
+    big = s >= jnp.uint32(32)
+    out_hi = jnp.where(big, jnp.uint32(0), hi_small)
+    out_lo = jnp.where(big, lo_big, jnp.where(s == 0, lo, lo_small))
+    return out_hi, out_lo
+
+
 _MIX64_C1 = (0xBF58476D, 0x1CE4E5B9)  # splitmix64 finalizer constants
 _MIX64_C2 = (0x94D049BB, 0x133111EB)
 

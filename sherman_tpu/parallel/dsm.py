@@ -77,7 +77,15 @@ CNT_WRITE_WORDS = 3
 CNT_CAS_OPS = 4
 CNT_FAA_OPS = 5
 CNT_WW_OPS = 6
-N_COUNTERS = 8
+# Write-combining accounting (PR 17): fed by the leaf-apply kernels when
+# config.write_combine() is on — per-batch page-group head count and the
+# lock consults the HOCL-style handover saved (rows that rode a group
+# head's verdict instead of gathering their own lock word).  Device-side
+# slots so the hot path never syncs; the ``combine.*`` obs collector
+# materializes them at PULL time like every other collector.
+CNT_COMBINE_GROUPS = 7
+CNT_COMBINE_SAVED = 8
+N_COUNTERS = 10  # slot 9 spare
 
 # Host-side step counter (device op counts ride the sharded counters
 # array and surface via the registry's "dsm" collector; this one counts
@@ -916,6 +924,8 @@ class DSM(_HostOps):
             "cas_ops": int(tot[CNT_CAS_OPS]),
             "faa_ops": int(tot[CNT_FAA_OPS]),
             "write_word_ops": int(tot[CNT_WW_OPS]),
+            "combine_groups": int(tot[CNT_COMBINE_GROUPS]),
+            "combine_locks_saved": int(tot[CNT_COMBINE_SAVED]),
         }
 
 

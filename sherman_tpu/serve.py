@@ -1820,6 +1820,11 @@ class ShermanServer:
             "rejected_degraded": float(self.rejected_degraded),
             "brownout": 1.0 if self._brownout else 0.0,
             "retraces": float(self.retraces),
+            "prep_impl_device": 1.0 if any(
+                getattr(s, "prep_impl", "host") == "device"
+                for s in self._steps.values()) else 0.0,
+            "write_combine": 1.0 if getattr(
+                self.eng, "_write_combine", False) else 0.0,
             "dedup_hits": float(self.dedup_hits),
             "deadline_shed": float(self.deadline_shed),
             "duplicate_applies": float(self.duplicate_applies),
@@ -1877,6 +1882,12 @@ class ShermanServer:
             "sealed": self._sealed,
             "retraces": self.retraces,
             "contract": contract,
+            "request_plane": {
+                "prep_impl": {str(w): getattr(s, "prep_impl", "host")
+                              for w, s in self._steps.items()},
+                "write_combine": bool(getattr(self.eng, "_write_combine",
+                                              False)),
+            },
         }
         if self.auditor is not None:
             out["audit"] = self.auditor.stats()

@@ -1460,7 +1460,95 @@ def make_staged_mixed_step(eng, *, n_keys: int, theta: float, salt: int,
     return step, (new_carry, table_d, rtable_d, rkey_d)
 
 
-def make_ingress_step(eng, *, width: int, leaf_cache=None):
+def make_device_prep(eng, *, width: int):
+    """Fused DEVICE request-plane prep for the ingress step (PR 17's
+    ``config.prep_impl = "device"``): one compiled program per ladder
+    width that performs on device exactly what the host path's
+    ``np.unique`` + ``LeafRouter.host_start`` + zero-padding do —
+    duplicate-key combining, dedup, key sort, router probe — emitting
+    the staged fan-out inputs ``(khi, klo, active, start, inv)``
+    BIT-IDENTICALLY (the CI pin in tests/test_prep.py), plus the
+    unique count as a replicated device scalar.
+
+    Anatomy (the same two-sort discipline as :func:`_sort_combine`,
+    generalized to partial batches): a 5-operand ``lax.sort`` orders
+    the raw (hi, lo) pairs unsigned and carries the original index; a
+    segment scan numbers the unique groups (this IS the host ``inv`` —
+    ``np.unique``'s inverse is the rank of each key in sorted unique
+    order); a flag-sort compacts the first-occurrence rows (already
+    key-sorted, so the unique set matches ``np.unique``'s order); and
+    the router probe reuses the HOST table uploaded as a replicated
+    device array with the shift as TRACED data
+    (:func:`sherman_tpu.ops.bits.u64_shr_dyn`) — a span grow updates a
+    scalar input instead of retracing the sealed program.
+
+    Padding contract: rows past ``n`` carry the KEY_POS_INF sentinel
+    pair ``(-1, -1)`` — excluded from the valid key range
+    (config.KEY_MAX < KEY_POS_INF), it can never collide with a client
+    key, sorts strictly last, and therefore forms exactly ONE trailing
+    unique group iff ``n < width`` — subtracting it yields the host
+    ``U``.  Masked unique rows and the inverse map then zero exactly
+    like the host path's padding.
+
+    Returns ``(prep_fn, upload)``: ``prep_fn(khi_raw, klo_raw, n,
+    rtable, shift) -> (khi, klo, active, start, inv, n_uniq)`` with the
+    five arrays node-sharded for the fan-out and ``n_uniq`` replicated;
+    ``upload(x)`` places host values as replicated device arrays
+    (multihost-aware)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dsm = eng.dsm
+    rep_sharding = jax.sharding.NamedSharding(
+        dsm.mesh, jax.sharding.PartitionSpec())
+
+    def prep_core(khi_raw, klo_raw, n, rtable, shift):
+        idx0 = jnp.arange(width, dtype=jnp.int32)
+        # sort by unsigned 64-bit key, carrying the raw pair + index
+        _, _, skhi, sklo, sidx = lax.sort(
+            (bits._ux(khi_raw), bits._ux(klo_raw), khi_raw, klo_raw,
+             idx0), num_keys=2)
+        first = jnp.concatenate([
+            jnp.ones((1,), bool),
+            (skhi[1:] != skhi[:-1]) | (sklo[1:] != sklo[:-1])])
+        seg = (jnp.cumsum(first.astype(jnp.int32)) - 1)
+        # the sentinel contributes one trailing group iff padding exists
+        n_uniq = seg[-1] + 1 - (n < width).astype(jnp.int32)
+        # compact first-occurrence rows (key-sorted, = np.unique order);
+        # the sentinel group's head lands at position n_uniq and is
+        # masked to zero with the rest of the tail, like the host pad
+        flag = (~first).astype(jnp.int32)
+        _, _, _, ckhi, cklo = lax.sort(
+            (flag, bits._ux(skhi), bits._ux(sklo), skhi, sklo),
+            num_keys=3)
+        active = idx0 < n_uniq
+        ukhi = jnp.where(active, ckhi, 0)
+        uklo = jnp.where(active, cklo, 0)
+        # router probe, dynamic shift (host_start twin: key 0 -> bucket
+        # 0 -> table[0] covers the masked tail exactly like the host)
+        nb = rtable.shape[0]
+        bhi, blo = bits.u64_shr_dyn(
+            lax.bitcast_convert_type(ukhi, jnp.uint32),
+            lax.bitcast_convert_type(uklo, jnp.uint32), shift)
+        bucket = jnp.where(bhi != 0, jnp.uint32(nb - 1),
+                           jnp.minimum(blo, jnp.uint32(nb - 1)))
+        start = rtable[bucket.astype(jnp.int32)]
+        # un-sort the segment map: inv[i] = unique rank of client row i
+        _, inv = lax.sort((sidx, seg), num_keys=1)
+        inv = jnp.where(idx0 < n, inv, 0)
+        return ukhi, uklo, active, start, inv, n_uniq
+
+    prep_fn = DEV.wrap_program(
+        "serve.device_prep",
+        jax.jit(prep_core,
+                out_shardings=(dsm.shard, dsm.shard, dsm.shard,
+                               dsm.shard, dsm.shard, rep_sharding)))
+    return prep_fn, (lambda x: _rep_put(dsm, x))
+
+
+def make_ingress_step(eng, *, width: int, leaf_cache=None,
+                      prep_impl: str | None = None):
     """External-driver hook on the staged serving substrate — the
     serving front door's read path (:mod:`sherman_tpu.serve`).
 
@@ -1521,11 +1609,34 @@ def make_ingress_step(eng, *, width: int, leaf_cache=None):
             f"ingress width {width} must be a positive multiple of "
             f"machine_nr={eng.cfg.machine_nr} (the batch shards over "
             "the node mesh)")
+    if prep_impl is None:
+        prep_impl = C.prep_impl()
+    if prep_impl not in ("host", "device"):
+        raise ConfigError(
+            f"make_ingress_step: prep_impl={prep_impl!r}: want "
+            "host|device")
+    if prep_impl == "device" and leaf_cache is not None:
+        # documented fallback (config.prep_impl): the cache probe is
+        # host-in/host-out (it syncs its hit count), so device prep
+        # composed with it would reintroduce the per-batch host
+        # round-trip the knob exists to remove
+        prep_impl = "host"
     iters = eng._iters()
     fn = eng._get_search_fanout(iters)
     root = np.int32(eng.tree._root_addr)
+    # prep-phase attribution (PR 17): per-dispatch host wall of the
+    # request plane, split host-vs-device — histogram handles created
+    # here so dispatch (SL001-hot) only records plain floats
+    import time as _time
+    from sherman_tpu import obs as _obs
+    _h_prep = _obs.histogram(
+        "prep.device_dispatch_ms" if prep_impl == "device"
+        else "prep.host_ms")
+    _obs.gauge("prep.impl_device").set(
+        1.0 if prep_impl == "device" else 0.0)
 
     def dispatch(keys):
+        t0p = _time.perf_counter()
         n = keys.shape[0]
         uk, inv = np.unique(keys, return_inverse=True)
         U = uk.shape[0]
@@ -1553,6 +1664,7 @@ def make_ingress_step(eng, *, width: int, leaf_cache=None):
         with eng._step_mutex:  # launch-only, the engine step contract
             eng.dsm.counters, done, found, vhi, vlo = fn(
                 eng.dsm.pool, eng.dsm.counters, *args)
+        _h_prep.record((_time.perf_counter() - t0p) * 1e3)
         return (n, U, uk, inv, done, found, vhi, vlo, chit, cvhi, cvlo)
 
     def complete(handle):
@@ -1596,11 +1708,127 @@ def make_ingress_step(eng, *, width: int, leaf_cache=None):
         _n, _U, _uk, _inv, done, found, vhi, vlo, *_ = handle
         eng._unshard(done, found, vhi, vlo)
 
+    prep_fn = None
+    if prep_impl == "device":
+        import jax
+
+        prep_fn, _upload = make_device_prep(eng, width=width)
+        # router-table snapshot versioned by the split/grow counters:
+        # plain Python ints, so staleness detection costs two compares
+        # per dispatch and the re-upload happens only when the table
+        # actually moved (splits_noted / span_grows bump)
+        _rt = {"ver": None, "rtable": None, "shift": None}
+
+        def _router_state():
+            ver = (router.splits_noted, router.span_grows)
+            if _rt["ver"] != ver:
+                with router._read_locked():
+                    table = np.array(router.table_np)
+                    shift = np.uint32(router.shift)
+                    ver = (router.splits_noted, router.span_grows)
+                _rt["rtable"] = _upload(table)
+                _rt["shift"] = _upload(shift)
+                _rt["ver"] = ver
+            return _rt["rtable"], _rt["shift"]
+
+        def dispatch_device(keys):
+            """Device-prep twin of ``dispatch`` (same SL001 hot-path
+            contract: launch-only, no host syncs of device data): the
+            host's only per-batch work is the pair split + sentinel
+            pad + three scalar/array uploads — combining, dedup, sort
+            and the router probe all run in the sealed ``prep_fn``
+            program, whose outputs feed the serve fan-out without
+            touching the host."""
+            t0p = _time.perf_counter()
+            n = keys.shape[0]
+            kh, kl = bits.keys_to_pairs(keys)
+            khi_raw = np.full(width, -1, np.int32)   # KEY_POS_INF pair
+            klo_raw = np.full(width, -1, np.int32)
+            khi_raw[:n] = kh
+            klo_raw[:n] = kl
+            rtable, shift = _router_state()
+            khi, klo, active, start, inv_p, n_uniq = prep_fn(
+                jax.device_put(khi_raw), jax.device_put(klo_raw),
+                jax.device_put(np.int32(n)), rtable, shift)
+            with eng._step_mutex:  # launch-only, the engine step contract
+                eng.dsm.counters, done, found, vhi, vlo = fn(
+                    eng.dsm.pool, eng.dsm.counters, khi, klo, root,
+                    active, start, inv_p)
+            _h_prep.record((_time.perf_counter() - t0p) * 1e3)
+            return (n, n_uniq, (khi, klo), inv_p, done, found, vhi, vlo,
+                    None, None, None)
+
+        def complete_device(handle):
+            """Completion half (materializes by design): the unique
+            count syncs here, and the straggler rescue lazily
+            materializes the unique set + inverse map only when a
+            descent actually overran."""
+            n, n_uniq, ukpair, inv_p, done, found, vhi, vlo, *_ = handle
+            done, found, vhi, vlo = eng._unshard(done, found, vhi, vlo)
+            U = int(np.asarray(n_uniq))
+            if not bool(np.asarray(done[:U]).all()):
+                ukhi, uklo = eng._unshard(*ukpair)
+                uk = bits.pairs_to_keys(ukhi[:U], uklo[:U])
+                inv = np.asarray(eng._unshard(inv_p))[:n]
+                vals_u, found_u = eng.search(uk)
+                return vals_u[inv], found_u[inv]
+            vals = np.array(bits.pairs_to_keys(vhi[:n], vlo[:n]))
+            return vals, np.array(found[:n])
+
+        dispatch, complete = dispatch_device, complete_device
+
+    def prep_profile(keys, reps: int = 8) -> dict:
+        """Chained-delta wall of the request-plane prep ALONE for this
+        step's impl — the host-vs-device A/B's per-phase number
+        (tools/profile_prep.py publishes it; record_phase_obs routes it
+        into the ``prep.*`` histograms).  Host mode times the actual
+        ``np.unique`` + router-probe + pad sequence; device mode chains
+        ``prep_fn`` dispatches and blocks once at the end, so the
+        per-dispatch overhead cancels exactly like every other
+        chained-delta phase receipt."""
+        keys = np.asarray(keys, np.uint64)
+        n = keys.shape[0]
+        if prep_impl == "device":
+            import jax
+
+            kh, kl = bits.keys_to_pairs(keys)
+            khi_raw = np.full(width, -1, np.int32)
+            klo_raw = np.full(width, -1, np.int32)
+            khi_raw[:n] = kh
+            klo_raw[:n] = kl
+            rtable, shift = _router_state()
+            dk, dl = jax.device_put(khi_raw), jax.device_put(klo_raw)
+            dn = jax.device_put(np.int32(n))
+
+            def loop(k):
+                out = None
+                for _ in range(k):
+                    out = prep_fn(dk, dl, dn, rtable, shift)
+                np.asarray(out[-1])  # drain
+            return {"prep_device_ms": _delta_ms(loop, reps)}
+
+        def loop(k):
+            for _ in range(k):
+                uk, inv = np.unique(keys, return_inverse=True)
+                U = uk.shape[0]
+                kh, kl = bits.keys_to_pairs(uk)
+                khi = np.zeros(width, kh.dtype)
+                klo = np.zeros(width, kl.dtype)
+                khi[:U] = kh
+                klo[:U] = kl
+                router.host_start(khi, klo)
+        return {"prep_host_ms": _delta_ms(loop, reps)}
+
     step.dispatch = dispatch
     step.complete = complete
     step.drain = drain
     step.width = width
     step.cache = leaf_cache is not None
+    step.prep_impl = prep_impl
+    step.prep_profile = prep_profile
     step.programs = {"serve_fanout": fn}
     step.phase_labels = {"serve_fanout": fn.label}
+    if prep_fn is not None:
+        step.programs["device_prep"] = prep_fn
+        step.phase_labels["device_prep"] = prep_fn.label
     return step

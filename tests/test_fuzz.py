@@ -570,6 +570,20 @@ def test_fuzz_client_contract(eight_devices, tmp_path):
     never a duplicate apply resurrecting an older one, never a loss),
     the recorded history checks linearizable per key, and every
     client-visible failure is typed."""
+    _client_contract_storm(tmp_path, write_combine=False)
+
+
+def test_fuzz_client_contract_write_combine(eight_devices, tmp_path):
+    """PR 17 combining round: the SAME contract storm with HOCL-style
+    write combining armed on both the serving engine and the replay
+    engine — grouped same-leaf lock acquisitions must leave the
+    exactly-once ledger, the per-rid ack window and the torn-tail
+    replay equality untouched (journal record order == apply order is
+    the invariant combining must preserve)."""
+    _client_contract_storm(tmp_path, write_combine=True)
+
+
+def _client_contract_storm(tmp_path, *, write_combine):
     from sherman_tpu import audit as A
     from sherman_tpu import chaos as CH
     from sherman_tpu.config import TreeConfig
@@ -590,7 +604,8 @@ def test_fuzz_client_contract(eight_devices, tmp_path):
     batched.bulk_load(tree, keys, vals)
     eng = batched.BatchedEngine(
         tree, batch_per_node=256,
-        tcfg=TreeConfig(sibling_chase_budget=2))
+        tcfg=TreeConfig(sibling_chase_budget=2),
+        write_combine=write_combine)
     eng.attach_router()
     jpath = str(tmp_path / "contract-fuzz.wal")
     journal = J.Journal(jpath, sync=True, group_commit_ms=1.0)
@@ -656,6 +671,10 @@ def test_fuzz_client_contract(eight_devices, tmp_path):
     srv.kill()
     res = aud.tick(drain_all=True)
     assert aud.violations == 0, aud.last_violations[:3]
+    if write_combine:
+        # the combined kernel really ran (groups accumulate on device)
+        snap = eng.dsm.counter_snapshot()
+        assert snap["combine_groups"] > 0
 
     # torn tail + replay into a FRESH engine: exactly-once across the
     # crash — state equals the acked ledger, window re-acks originals
@@ -668,7 +687,8 @@ def test_fuzz_client_contract(eight_devices, tmp_path):
     batched.bulk_load(tree2, keys, vals)
     eng2 = batched.BatchedEngine(
         tree2, batch_per_node=256,
-        tcfg=TreeConfig(sibling_chase_budget=2))
+        tcfg=TreeConfig(sibling_chase_budget=2),
+        write_combine=write_combine)
     eng2.attach_router()
     sink: list = []
     stats = J.replay(jpath, eng2, ack_sink=sink)

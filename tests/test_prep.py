@@ -1,0 +1,472 @@
+"""Device-resident request plane (PR 17) fast tier.
+
+The two tentpole contracts:
+
+- DEVICE PREP bit-identity: ``make_device_prep`` (one fused lax.sort +
+  segment-scan + dynamic-shift router probe program) must emit the
+  ingress staged inputs ``(khi, klo, active, start, inv)`` and the
+  unique count EXACTLY as the host path's ``np.unique`` +
+  ``LeafRouter.host_start`` + zero-padding do — fuzzed over the shape
+  classes that exercise the sentinel-padding contract (full-width
+  duplicate-heavy, straggler, all-duplicate, single key, pre-sorted).
+
+- WRITE COMBINING bit-identity: with ``write_combine`` armed the
+  leaf-apply kernels take one lock consult per same-leaf group instead
+  of one per row; statuses, pool bits and every counter except the
+  combine slots must be bit-identical to the uncombined kernels —
+  including a host-held lock inside a combined group (typed ST_LOCKED
+  per key, no group-wide poisoning) and a fresh-leaf split burst.
+
+Plus the knob parsing, the leaf-cache fallback, the u64_shr_dyn twin,
+the sealed zero-retrace pin with BOTH knobs on, and the perfgate
+prep-placement comparability wall (both directions).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from sherman_tpu import config as C
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig, TreeConfig
+from sherman_tpu.errors import ConfigError
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.ops import bits
+from sherman_tpu.parallel import dsm as D
+from sherman_tpu.workload.device_prep import (make_device_prep,
+                                              make_ingress_step)
+
+from conftest import run_insert_kernel
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+def make(n=3000, B=256, pages=2048, step=3, *, write_combine=False):
+    cfg = DSMConfig(machine_nr=1, pages_per_node=pages,
+                    locks_per_node=512, step_capacity=1024,
+                    chunk_pages=32)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    keys = np.arange(100, 100 + n * step, step, dtype=np.uint64)
+    vals = keys * np.uint64(7)
+    batched.bulk_load(tree, keys, vals)
+    eng = batched.BatchedEngine(tree, batch_per_node=B,
+                                tcfg=TreeConfig(sibling_chase_budget=2),
+                                write_combine=write_combine)
+    eng.attach_router()
+    return tree, eng, keys, vals
+
+
+# -- knob parsing --------------------------------------------------------------
+
+def test_prep_impl_knob(monkeypatch):
+    monkeypatch.delenv("SHERMAN_PREP_IMPL", raising=False)
+    assert C.prep_impl() == "host"  # shipped default
+    monkeypatch.setenv("SHERMAN_PREP_IMPL", "device")
+    assert C.prep_impl() == "device"
+    monkeypatch.setenv("SHERMAN_PREP_IMPL", "HOST")
+    assert C.prep_impl() == "host"
+    monkeypatch.setenv("SHERMAN_PREP_IMPL", "gpu")
+    with pytest.raises(ConfigError):
+        C.prep_impl()
+
+
+def test_write_combine_knob(monkeypatch):
+    monkeypatch.delenv("SHERMAN_WRITE_COMBINE", raising=False)
+    assert C.write_combine() is False  # shipped default
+    for v in ("", "0", "false", "off", "no"):
+        monkeypatch.setenv("SHERMAN_WRITE_COMBINE", v)
+        assert C.write_combine() is False
+    for v in ("1", "true", "on", "YES"):
+        monkeypatch.setenv("SHERMAN_WRITE_COMBINE", v)
+        assert C.write_combine() is True
+    monkeypatch.setenv("SHERMAN_WRITE_COMBINE", "maybe")
+    with pytest.raises(ConfigError):
+        C.write_combine()
+
+
+# -- u64_shr_dyn: the dynamic-shift twin ---------------------------------------
+
+def test_u64_shr_dyn_matches_static(eight_devices):
+    """The traced-shift 64-bit logical right shift must agree with the
+    static ``u64_shr`` for EVERY shift 0..63 (the router probe's span
+    can grow to any resolution without retracing)."""
+    import jax
+
+    rng = np.random.default_rng(7)
+    hi = rng.integers(0, 1 << 32, 256, dtype=np.uint64).astype(np.uint32)
+    lo = rng.integers(0, 1 << 32, 256, dtype=np.uint64).astype(np.uint32)
+    # edge rows: all-ones, zero, single bits
+    hi[:3] = [0xFFFFFFFF, 0, 0x80000000]
+    lo[:3] = [0xFFFFFFFF, 0, 1]
+    dyn = jax.jit(bits.u64_shr_dyn)
+    for s in range(64):
+        eh, el = bits.u64_shr(hi, lo, s)
+        gh, gl = dyn(hi, lo, np.uint32(s))
+        np.testing.assert_array_equal(np.asarray(gh), np.asarray(eh),
+                                      err_msg=f"hi word, shift {s}")
+        np.testing.assert_array_equal(np.asarray(gl), np.asarray(el),
+                                      err_msg=f"lo word, shift {s}")
+
+
+# -- device prep bit-identity --------------------------------------------------
+
+def _host_staging(eng, keys, width):
+    """The host ingress staging, verbatim from make_ingress_step's
+    dispatch: np.unique + zero-pad + router probe + padded inverse."""
+    n = keys.shape[0]
+    uk, inv = np.unique(keys, return_inverse=True)
+    U = uk.shape[0]
+    kh, kl = bits.keys_to_pairs(uk)
+    khi = np.zeros(width, kh.dtype)
+    klo = np.zeros(width, kl.dtype)
+    khi[:U] = kh
+    klo[:U] = kl
+    active = np.zeros(width, bool)
+    active[:U] = True
+    start = eng.router.host_start(khi, klo)
+    inv_p = np.zeros(width, np.int32)
+    inv_p[:n] = inv.astype(np.int32)
+    return khi, klo, active, start, inv_p, U
+
+
+def _device_staging(eng, prep_fn, upload, keys, width):
+    import jax
+
+    n = keys.shape[0]
+    kh, kl = bits.keys_to_pairs(keys)
+    khi_raw = np.full(width, -1, np.int32)
+    klo_raw = np.full(width, -1, np.int32)
+    khi_raw[:n] = kh
+    klo_raw[:n] = kl
+    router = eng.router
+    with router._read_locked():
+        rtable = upload(np.array(router.table_np))
+        shift = upload(np.uint32(router.shift))
+    out = prep_fn(jax.device_put(khi_raw), jax.device_put(klo_raw),
+                  jax.device_put(np.int32(n)), rtable, shift)
+    khi, klo, active, start, inv_p, n_uniq = (np.asarray(x) for x in
+                                              eng._unshard(*out[:5])
+                                              + (out[5],))
+    return khi, klo, active, start, inv_p, int(n_uniq)
+
+
+@pytest.mark.parametrize("case", ["random_dup", "straggler", "all_dup",
+                                  "single", "presorted"])
+def test_device_prep_bit_identity(eight_devices, case):
+    """The CI pin: staged inputs from the fused device program ==
+    host staging, bit for bit, across the padding shape classes."""
+    tree, eng, keys, vals = make()
+    width = 128
+    prep_fn, upload = make_device_prep(eng, width=width)
+    rng = np.random.default_rng(23)
+    batch = {
+        "random_dup": rng.choice(keys, width, replace=True),
+        "straggler": rng.choice(keys, 97, replace=True),
+        "all_dup": np.full(33, keys[7], np.uint64),
+        "single": keys[:1],
+        "presorted": np.sort(rng.choice(keys, 120, replace=False)),
+    }[case].astype(np.uint64)
+    host = _host_staging(eng, batch, width)
+    dev = _device_staging(eng, prep_fn, upload, batch, width)
+    for name, h, d in zip(("khi", "klo", "active", "start", "inv"),
+                          host[:5], dev[:5]):
+        np.testing.assert_array_equal(d, h, err_msg=f"{name} ({case})")
+    assert dev[5] == host[5], f"unique count ({case})"
+
+
+def test_device_prep_bit_identity_fuzz(eight_devices):
+    """Randomized widths/duplication rates against the host twin —
+    including batches whose keys all collide into few leaves."""
+    tree, eng, keys, vals = make()
+    width = 256
+    prep_fn, upload = make_device_prep(eng, width=width)
+    rng = np.random.default_rng(41)
+    for trial in range(12):
+        n = int(rng.integers(1, width + 1))
+        pool = keys[: int(rng.choice([4, 32, keys.size]))]
+        batch = rng.choice(pool, n, replace=True).astype(np.uint64)
+        host = _host_staging(eng, batch, width)
+        dev = _device_staging(eng, prep_fn, upload, batch, width)
+        for name, h, d in zip(("khi", "klo", "active", "start", "inv"),
+                              host[:5], dev[:5]):
+            np.testing.assert_array_equal(
+                d, h, err_msg=f"{name} (trial {trial}, n={n})")
+        assert dev[5] == host[5]
+
+
+def test_ingress_step_host_vs_device_answers(eight_devices):
+    """End to end: the device-prep ingress step serves the same
+    answers as the host-prep step (and the truth) on shared batches,
+    including partial widths and duplicate-heavy traffic."""
+    tree, eng, keys, vals = make()
+    h = make_ingress_step(eng, width=128, prep_impl="host")
+    d = make_ingress_step(eng, width=128, prep_impl="device")
+    assert h.prep_impl == "host" and d.prep_impl == "device"
+    rng = np.random.default_rng(5)
+    for n in (128, 97, 1):
+        batch = rng.choice(keys, n, replace=True).astype(np.uint64)
+        hv, hf = h(batch)
+        dv, df = d(batch)
+        np.testing.assert_array_equal(dv, hv)
+        np.testing.assert_array_equal(df, hf)
+        assert hf.all()
+        np.testing.assert_array_equal(hv, batch * np.uint64(7))
+
+
+def test_device_prep_profile_and_fallback(eight_devices):
+    """prep_profile publishes the per-impl phase number; a leaf cache
+    forces the documented fallback to host (the probe is
+    host-in/host-out)."""
+    tree, eng, keys, vals = make()
+    d = make_ingress_step(eng, width=128, prep_impl="device")
+    p = d.prep_profile(keys[:100], reps=2)
+    assert set(p) == {"prep_device_ms"} and p["prep_device_ms"] >= 0
+    h = make_ingress_step(eng, width=128, prep_impl="host")
+    p = h.prep_profile(keys[:100], reps=2)
+    assert set(p) == {"prep_host_ms"} and p["prep_host_ms"] >= 0
+    assert "device_prep" in d.programs and "device_prep" not in h.programs
+    lc = eng.attach_leaf_cache(slots=256, admit_every=4)
+    try:
+        f = make_ingress_step(eng, width=128, leaf_cache=lc,
+                              prep_impl="device")
+        assert f.prep_impl == "host"  # documented cache fallback
+    finally:
+        eng.detach_leaf_cache()
+
+
+def test_ingress_step_bad_impl_typed(eight_devices):
+    tree, eng, keys, vals = make()
+    with pytest.raises(ConfigError):
+        make_ingress_step(eng, width=128, prep_impl="gpu")
+
+
+# -- write combining -----------------------------------------------------------
+
+def _counters_sans_combine(eng):
+    c = np.asarray(eng._unshard(eng.dsm.counters)).reshape(
+        -1, D.N_COUNTERS).copy()
+    c[:, D.CNT_COMBINE_GROUPS] = 0
+    c[:, D.CNT_COMBINE_SAVED] = 0
+    return c
+
+
+def test_write_combine_bit_identity_insert(eight_devices):
+    """Grouped lock acquisition == per-row acquisition, bit for bit:
+    statuses, pool, every counter except the combine slots — on a
+    duplicate-leaf batch that also triggers fresh-leaf splits."""
+    outs = {}
+    for combine in (False, True):
+        tree, eng, keys, vals = make(write_combine=combine)
+        # duplicate-leaf pressure: neighbors share leaves; fresh keys
+        # past the loaded range force the split path inside the step
+        upd = np.concatenate([
+            np.repeat(keys[100:140], 4),       # same-leaf groups
+            keys[500:520],                     # singles
+            np.arange(keys[-1] + 10, keys[-1] + 10 + 60 * 3, 3,
+                      dtype=np.uint64),        # fresh keys -> splits
+        ])
+        nv = upd ^ np.uint64(0xBEEF)
+        st = run_insert_kernel(eng, upd, nv)
+        outs[combine] = (st, np.asarray(eng._unshard(eng.dsm.pool)),
+                         _counters_sans_combine(eng),
+                         eng.dsm.counter_snapshot())
+    st0, pool0, c0, _ = outs[False]
+    st1, pool1, c1, snap1 = outs[True]
+    np.testing.assert_array_equal(st1, st0)
+    np.testing.assert_array_equal(pool1, pool0)
+    np.testing.assert_array_equal(c1, c0)
+    # the combined kernel really combined: fewer consults than rows
+    assert snap1["combine_groups"] > 0
+    assert snap1["combine_locks_saved"] > 0
+
+
+def test_write_combine_locked_group_typed_status(eight_devices):
+    """A host-held lock inside a combined group: every row of that
+    group reports typed ST_LOCKED (exactly as uncombined), rows of
+    OTHER groups still apply — no group-wide or batch-wide poisoning —
+    and after the unlock the same batch lands."""
+    results = {}
+    for combine in (False, True):
+        tree, eng, keys, vals = make(write_combine=combine)
+        victim = int(keys[1500])
+        leaf_addr, _, _ = tree._descend(victim, 0)
+        upd = keys[1460:1560]
+        nv = upd + np.uint64(9)
+        leaf_of = np.array([tree._descend(int(k), 0)[0] for k in upd])
+        same_leaf = leaf_of == leaf_addr
+        assert same_leaf.any() and (~same_leaf).any()
+        la = tree._lock(leaf_addr)
+        try:
+            st = run_insert_kernel(eng, upd, nv, use_router=False)
+        finally:
+            tree._unlock(la)
+        assert (st[same_leaf] == batched.ST_LOCKED).all(), st
+        assert (st[~same_leaf] == batched.ST_APPLIED).all(), st
+        results[combine] = st
+        # post-unlock: the group applies
+        st2 = run_insert_kernel(eng, upd, nv, use_router=False)
+        ok = ((st2 == batched.ST_APPLIED)
+              | (st2 == batched.ST_SUPERSEDED))
+        assert ok.all(), st2
+        got, found = eng.search(upd)
+        assert found.all()
+        np.testing.assert_array_equal(got, nv)
+    np.testing.assert_array_equal(results[True], results[False])
+
+
+def test_write_combine_mixed_bit_identity(eight_devices):
+    """The mixed read/write lane under combining: statuses, answers
+    and pool bits identical to the uncombined engine on a duplicate-
+    heavy 50/50 batch."""
+    outs = {}
+    for combine in (False, True):
+        tree, eng, keys, vals = make(write_combine=combine)
+        rng = np.random.default_rng(9)
+        k = np.repeat(rng.choice(keys, 64, replace=False), 3)
+        is_read = (np.arange(k.size) % 2) == 0
+        v = k ^ np.uint64(0x1234)
+        got, found, status = eng.mixed(k, v, is_read)
+        outs[combine] = (got, found, status,
+                         np.asarray(eng._unshard(eng.dsm.pool)))
+    g0, f0, s0, p0 = outs[False]
+    g1, f1, s1, p1 = outs[True]
+    np.testing.assert_array_equal(g1, g0)
+    np.testing.assert_array_equal(f1, f0)
+    np.testing.assert_array_equal(s1, s0)
+    np.testing.assert_array_equal(p1, p0)
+
+
+def test_write_combine_exactly_once_acks(eight_devices, tmp_path):
+    """The serving front door with combining armed: per-rid
+    exactly-once acks and journal record order == apply order (replay
+    into a fresh uncombined engine reproduces the acked state)."""
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    from sherman_tpu.utils import journal as J
+
+    tree, eng, keys, vals = make(write_combine=True)
+    jpath = str(tmp_path / "combine.wal")
+    journal = J.Journal(jpath, sync=True, group_commit_ms=0.5)
+    scfg = ServeConfig(widths=(128,), write_linger_ms=0.2,
+                       p99_targets_ms={c: 1e9 for c in
+                                       ("read", "scan", "insert",
+                                        "delete")})
+    srv = ShermanServer(eng, scfg, journal=journal)
+    srv.start(calib_keys=keys, calib_writes=(keys[:64], vals[:64]))
+    try:
+        upd = np.repeat(keys[200:232], 4)  # duplicate-leaf write burst
+        nv = upd ^ np.uint64(0xACED)
+        f = srv.submit("insert", upd, nv, rid=901)
+        ok = f.result(timeout=60)
+        assert ok.all()
+        f2 = srv.submit("insert", upd, nv, rid=901)  # retry same rid
+        np.testing.assert_array_equal(f2.result(timeout=60), ok)
+        assert f2.deduped
+    finally:
+        srv.kill()
+    snap = eng.dsm.counter_snapshot()
+    assert snap["combine_locks_saved"] > 0  # duplicate-leaf really combined
+    journal.close()
+    # replay into a fresh UNCOMBINED engine: same final state
+    tree2, eng2, _, _ = make(write_combine=False)
+    J.replay(jpath, eng2)
+    got, found = eng2.search(np.unique(upd))
+    assert found.all()
+    np.testing.assert_array_equal(
+        got, np.unique(upd) ^ np.uint64(0xACED))
+
+
+def test_sealed_zero_retrace_both_knobs(eight_devices, monkeypatch):
+    """BOTH PR 17 knobs armed (SHERMAN_PREP_IMPL=device +
+    write_combine): the sealed serving loop stays zero-retrace through
+    reads (partial widths), rid-carrying writes and deletes — the
+    dynamic router shift and the combine-aware kernels are part of the
+    sealed program set, not retrace sources."""
+    monkeypatch.setenv("SHERMAN_PREP_IMPL", "device")
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+
+    tree, eng, keys, vals = make(write_combine=True)
+    scfg = ServeConfig(widths=(128, 512), max_queue_ops=16384,
+                       p99_targets_ms={c: 1e9 for c in
+                                       ("read", "scan", "insert",
+                                        "delete")})
+    srv = ShermanServer(eng, scfg)
+    srv.start(calib_keys=keys, calib_writes=(keys[:64], vals[:64]),
+              calib_delete_keys=np.asarray([5], np.uint64))
+    try:
+        assert srv._sealed
+        assert srv.stats()["request_plane"]["write_combine"] is True
+        assert set(srv.stats()["request_plane"]["prep_impl"]
+                   .values()) == {"device"}
+        rng = np.random.default_rng(3)
+        futs = []
+        for i in range(16):
+            n = int(rng.choice([120, 60, 7]))
+            kreq = keys[rng.integers(0, keys.size, n)]
+            futs.append((srv.submit("read", kreq), kreq))
+        for f, kreq in futs:
+            got, found = f.result(timeout=60)
+            assert found.all()
+            np.testing.assert_array_equal(got, kreq * np.uint64(7))
+        srv.submit("insert", keys[:8], keys[:8] ^ np.uint64(2),
+                   rid=601).result(timeout=60)
+        srv.submit("delete", np.asarray([5], np.uint64),
+                   rid=602).result(timeout=60)
+        assert srv.retraces == 0, \
+            "compile inside the sealed serving loop with PR 17 knobs on"
+    finally:
+        srv.kill()
+
+
+# -- perfgate: prep-placement comparability wall -------------------------------
+
+def _receipt(**cfg):
+    r = {"keys": 10_000_000, "batch": 4_194_304, "value": 30e6,
+         "sustained_ops_s": 33e6, "sus_dev_ms_per_step": 70.0}
+    if cfg:
+        r["config"] = cfg
+    return r
+
+
+def test_perfgate_prep_placement_wall_both_directions(eight_devices):
+    import perfgate
+
+    host = _receipt()                       # pre-field round
+    host_explicit = _receipt(prep_impl="host", write_combine=False)
+    dev = _receipt(prep_impl="device")
+    comb = _receipt(write_combine=True)
+    # absent fields == explicit host/off: the trajectory keeps gating
+    assert perfgate._comparable(host_explicit, host, "sustained_ops_s")
+    assert perfgate._comparable(host, host_explicit, "sustained_ops_s")
+    # differing placement never gates, in EITHER direction
+    for a, b in ((dev, host), (host, dev), (comb, host), (host, comb),
+                 (dev, comb)):
+        assert not perfgate._comparable(a, b, "sustained_ops_s")
+        assert not perfgate._comparable(a, b, "value")
+    # the gate itself: a device-prep candidate against a host-only
+    # trajectory exits "no comparable metric" instead of gating
+    rounds = [dict(host, _round=15), dict(host_explicit, _round=16)]
+    res = perfgate.gate(dict(dev), rounds)
+    assert not res["ok"] and "no comparable metric" in res["error"]
+    res = perfgate.gate(dict(host_explicit), rounds[:1])
+    assert res["ok"] and "sustained_ops_s" in res["gated_metrics"]
+
+
+def test_counter_slots_roundtrip(eight_devices):
+    """The combine counter slots ride every snapshot/collector surface
+    without disturbing the existing layout."""
+    tree, eng, keys, vals = make(write_combine=True)
+    snap = eng.dsm.counter_snapshot()
+    assert {"combine_groups", "combine_locks_saved"} <= set(snap)
+    from sherman_tpu import obs
+    upd = np.repeat(keys[100:116], 8)
+    eng.insert(upd, upd)
+    flat = obs.snapshot()
+    assert flat.get("combine.locks_saved", 0) > 0
+    assert flat.get("combine.groups", 0) > 0
+    assert flat.get("combine.ops_combined") == flat["combine.locks_saved"]
+    assert flat.get("combine.steps", 0) >= 1

@@ -208,6 +208,31 @@ def test_profile_staged2_pipelined(eight_devices, capsys, monkeypatch):
     assert r["modes"] == j["modes"]
 
 
+def test_profile_prep_ab_driver(eight_devices, capsys, monkeypatch):
+    """Host-vs-device request-plane A/B (CPU smoke of
+    tools/profile_prep): both impls priced through step.prep_profile's
+    chained-delta, the combine ratio measured on a duplicate-leaf
+    write batch, and the JSON receipt as the last stdout line."""
+    import json
+
+    for k, v in (("KEYS", "4000"), ("W", "512"), ("K", "2"),
+                 ("DUP", "8")):
+        monkeypatch.setenv(k, v)
+    import profile_prep
+    r = profile_prep.main()
+    out = capsys.readouterr().out
+    j = json.loads(out.strip().splitlines()[-1])
+    assert j["metric"] == "prep_ab"
+    assert set(j["impls"]) == {"host", "device"}
+    for row in j["impls"].values():
+        assert row["prep_ms"] >= 0 and row["step_ms"] > 0
+    assert j["impls"]["host"]["phase_key"] == "prep_host_ms"
+    assert j["impls"]["device"]["phase_key"] == "prep_device_ms"
+    assert j["combine"]["locks_saved"] > 0
+    assert 0 < j["combine"]["ratio"] <= 1
+    assert r["impls"] == j["impls"]
+
+
 def test_ckpt_bench_journal_group_commit_ab(eight_devices, capsys):
     """The group-commit A/B rides the ckpt driver: per-op fsync vs
     bounded-delay windows, with the >= 2x acks-per-fsync coalescing

@@ -75,6 +75,14 @@ Comparability rules (the trajectory's own lessons):
   write R more times in the same process.  Failover-drill receipts
   carry the same marginless hard-red pins as contract receipts
   (``lost_acks`` / ``duplicate_acks`` / ``linearizable``);
+- a PREP-PLACEMENT change is incomparable config (PR 17): rows whose
+  ``config.prep_impl`` or ``config.write_combine`` differ never
+  throughput-gate against each other — host prep serializes
+  ``np.unique``/sort/route wall clock into every step that device prep
+  moves onto the chip, and write combining changes the lock-acquisition
+  count per batch wholesale.  Receipts predating the fields compare as
+  ``("host", False)`` (the hardcoded pre-PR-17 fact), so the committed
+  trajectory keeps gating;
 - a metric missing on either side is skipped, not failed — but a
   candidate with NO comparable metric at all exits 2 (the gate cannot
   vouch for it).
@@ -196,6 +204,16 @@ def _value_cfg(r: dict) -> tuple:
             bool(c.get("value_heap")))
 
 
+def _prep_cfg(r: dict) -> tuple:
+    """The receipt's request-plane placement (config.prep_impl /
+    write_combine, PR 17).  Absent fields = the pre-PR-17 fact: every
+    committed round ran host prep with combining off (both knobs ship
+    OFF and the fields didn't exist), so older artifacts compare as
+    ("host", False) rather than skipping."""
+    c = r.get("config") or {}
+    return (c.get("prep_impl") or "host", bool(c.get("write_combine")))
+
+
 def _serve_mode(r: dict) -> bool:
     """True for a serving-front-door receipt (open-loop, admission-
     paced — ``tools/serve_bench.py``): the ``serve`` block or the
@@ -252,6 +270,14 @@ def _comparable(cand: dict, r: dict, metric: str) -> bool:
     # phase.  Missing fields = the pre-heap inline fact (see
     # _value_cfg), so the whole committed trajectory keeps comparing.
     if _value_cfg(r) != _value_cfg(cand):
+        return False
+    # prep-placement rule (PR 17): differing config.prep_impl or
+    # config.write_combine never gate against each other — host prep
+    # pays np.unique/sort/route wall clock device prep doesn't, and
+    # combining changes locks-per-batch wholesale.  Missing fields =
+    # ("host", False), the pre-field fact (see _prep_cfg), so the
+    # committed trajectory keeps comparing.
+    if _prep_cfg(r) != _prep_cfg(cand):
         return False
     if r.get(metric) is None or cand.get(metric) is None:
         return False

@@ -1,16 +1,29 @@
 #!/usr/bin/env python
-"""Decompose the device-staged PREP pipeline on the real chip.
+"""Price the request-plane prep: host vs device A/B + chip stage deltas.
 
-Builds cumulative cut-down versions of the prep program (PRNG only ->
-+zipf table gather -> +mix64 -> +pair sort -> +flag-sort compaction ->
-+router probe = full) and times each; the successive deltas price every
-phase.  Informs the sustained-loop optimization (BENCHMARKS.md round-5
-section): prep serializes with the serve on one chip, so every ms cut
-here is ms off the sustained step.
+Two modes:
 
-Env: KEYS (default 10_000_000), B (batch, default 4_194_304), K (reps).
+* default (``main()``): the PR 17 host-vs-device A/B.  Builds a small
+  engine, constructs the SHIPPED ingress step twice (``prep_impl=host``
+  and ``prep_impl=device``), and prices the prep phase of each with the
+  same chained-delta discipline every phase receipt uses
+  (``step.prep_profile``).  Also runs a duplicate-leaf write batch
+  through the write-combining kernel and publishes the measured combine
+  ratio (``combine.locks_saved / lock-acquisitions-uncombined``).  The
+  last stdout line is the JSON receipt BENCHMARKS rounds consume;
+  ``main()`` returns the same dict (the test_tools driver contract).
+
+* ``--stages`` (or ``MODE=stages``): the round-5 cumulative cut-down
+  profiler of the device-staged PREP pipeline (PRNG -> +zipf gather ->
+  +mix64 -> +pair sort -> +flag compact -> +router probe); successive
+  deltas price every phase on the real chip.
+
+Env: KEYS (default 20_000), W (ingress width, default 1024), K (reps,
+default 8), DUP (combine-batch duplication factor, default 8).  Stage
+mode keeps its own knobs (KEYS, B, DEVB, K, LB, RT).
 """
 
+import json
 import os
 import sys
 import time
@@ -20,7 +33,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
+def stage_deltas():
+    """Cumulative cut-down stage profiler (chip mode; prints a table)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -124,6 +138,97 @@ def main():
         print(f"{name:16s} {ms:8.1f} ms  (delta {ms - prev:+7.1f})",
               flush=True)
         prev = ms
+
+
+def _make_engine(n, *, write_combine=False):
+    from sherman_tpu.cluster import Cluster
+    from sherman_tpu.config import DSMConfig, TreeConfig
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.btree import Tree
+
+    cfg = DSMConfig(machine_nr=1,
+                    pages_per_node=max(2048, n // 8),
+                    locks_per_node=512, step_capacity=1024,
+                    chunk_pages=32)
+    tree = Tree(Cluster(cfg))
+    keys = np.arange(100, 100 + n * 3, 3, dtype=np.uint64)
+    vals = keys * np.uint64(7)
+    batched.bulk_load(tree, keys, vals)
+    eng = batched.BatchedEngine(
+        tree, batch_per_node=256,
+        tcfg=TreeConfig(sibling_chase_budget=2),
+        write_combine=write_combine)
+    eng.attach_router()
+    return eng, keys, vals
+
+
+def main():
+    if "--stages" in sys.argv[1:] or os.environ.get("MODE") == "stages":
+        stage_deltas()
+        return None
+
+    from sherman_tpu.workload.device_prep import make_ingress_step
+
+    n = int(os.environ.get("KEYS", 20_000))
+    width = int(os.environ.get("W", 1024))
+    reps = int(os.environ.get("K", 8))
+    dup = int(os.environ.get("DUP", 8))
+
+    eng, keys, vals = _make_engine(n)
+    rng = np.random.default_rng(17)
+    batch = rng.choice(keys, size=width, replace=True).astype(np.uint64)
+
+    # -- host-vs-device prep A/B: same batch, same chained-delta timer,
+    # the only variable is where combine/sort/route ran
+    impls = {}
+    for impl in ("host", "device"):
+        step = make_ingress_step(eng, width=width, prep_impl=impl)
+        prof = step.prep_profile(batch, reps=reps)
+        (key, ms), = prof.items()
+        # end-to-end ingress step (prep + fused fan-out serve), chained
+        t0 = time.perf_counter()
+        for _ in range(2):
+            step(batch)
+        t_warm = time.perf_counter()
+        for _ in range(reps):
+            step(batch)
+        step_ms = (time.perf_counter() - t_warm) / reps * 1e3
+        del t0
+        impls[impl] = {"prep_ms": round(ms, 4),
+                       "step_ms": round(step_ms, 4),
+                       "phase_key": key}
+        print(f"prep[{impl:6s}]  prep {ms:8.3f} ms   "
+              f"full step {step_ms:8.3f} ms", flush=True)
+
+    # -- write-combining ratio on a duplicate-leaf write batch: DUP
+    # writers per key land on the same leaf page, so the combined
+    # kernel takes one lock per group instead of one per row
+    ceng, ckeys, _ = _make_engine(max(2048, n // 4), write_combine=True)
+    wk = np.repeat(ckeys[: max(1, 512 // dup)], dup)[:512].astype(np.uint64)
+    ceng.insert(wk, wk * np.uint64(3))
+    snap = ceng.dsm.counter_snapshot()
+    groups = int(snap["combine_groups"])
+    saved = int(snap["combine_locks_saved"])
+    ratio = saved / (groups + saved) if (groups + saved) else 0.0
+    combine = {"groups": groups, "locks_saved": saved,
+               "ops_combined": saved,
+               "ratio": round(ratio, 4)}
+    print(f"combine      groups {groups}  locks_saved {saved}  "
+          f"ratio {ratio:.3f}", flush=True)
+
+    out = {
+        "metric": "prep_ab",
+        "keys": n,
+        "width": width,
+        "reps": reps,
+        "impls": impls,
+        "speedup_prep": round(
+            impls["host"]["prep_ms"] / impls["device"]["prep_ms"], 3)
+        if impls["device"]["prep_ms"] else None,
+        "combine": combine,
+    }
+    print(json.dumps(out), flush=True)
+    return out
 
 
 if __name__ == "__main__":
