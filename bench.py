@@ -1448,6 +1448,26 @@ def main() -> None:
         failover_drill.main(sys.argv[1:])
         return
 
+    if "--partition-drill" in sys.argv:
+        # Partition lane: the replication plane under a seeded fault
+        # layer rehearsed end to end (quorum-gated acks with the
+        # measured latency delta and a bounded typed timeout ->
+        # anti-entropy divergence detection/quarantine/repair ->
+        # split-brain: lease-scope partition, promotion fence point,
+        # the stale primary's post-fence acks counted and PROVABLY
+        # rejected -> front door resumed on the winner, the client
+        # re-driving through the new dedup window), pinning
+        # lost_acks == 0, duplicate_acks == 0, linearizable == true,
+        # fenced_acks_merged == 0 and >= 1 detected-and-repaired
+        # follower divergence.  tools/partition_drill.py owns the
+        # sequence; it prints its own one-line JSON receipt.
+        sys.argv.remove("--partition-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import partition_drill
+        partition_drill.main(sys.argv[1:])
+        return
+
     if "--reshard-drill" in sys.argv:
         # Capacity lane: live N->M elastic reshard under mixed traffic
         # (background lock-lease page migration -> chaos-injected crash

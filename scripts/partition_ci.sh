@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Partition-plane CI lane: pin the replication chaos / quorum acks /
+# split-brain fencing / anti-entropy follower repair plane
+# (sherman_tpu/chaos.py ReplChaos + replica.py quorum+fence+repair +
+# serve.py quorum gate + audit.py check_fenced_rejected).
+#
+# Runs (1) the partition fast tier — the replication fault grammar
+# (seed-deterministic directives, holds, the frozen lease view), the
+# quorum token/wait contract, the tailer watchdog's typed stall, the
+# chaos-detection accounting through the pump, anti-entropy
+# detect->quarantine->repair->re-admit, the split-brain fence point +
+# fenced-suffix count, and the serve-side quorum gate (validation,
+# the quorum-off bit-identity pin, typed bounded expiry, same-rid
+# retry dedup); (2) the partition storm fuzz round (random fault
+# storms x quorum on/off -> convergence, never silent divergence);
+# and (3) the partition drill end to end with its receipt pins
+# asserted.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== partition fast tier (chaos grammar, quorum, fence, repair) =="
+python -m pytest tests/test_replica.py tests/test_chaos.py -q
+python -m pytest \
+    tests/test_serve.py::test_quorum_config_validation \
+    tests/test_serve.py::test_quorum_off_bit_identity \
+    tests/test_serve.py::test_quorum_gate_end_to_end \
+    -q
+
+echo "== partition storm fuzz round (fault storms -> convergence) =="
+python -m pytest tests/test_fuzz.py::test_fuzz_partition_storm -q
+
+echo "== partition drill (chaos + quorum + split-brain + repair) =="
+SHERMAN_PARTITION_RECEIPT=/tmp/_partition_ci.json \
+    python bench.py --partition-drill --keys 3000
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/_partition_ci.json"))
+assert d["ok"], "drill not ok"
+assert d["lost_acks"] == 0, f"lost acks: {d['lost_acks']}"
+assert d["duplicate_acks"] == 0, f"duplicate acks: {d['duplicate_acks']}"
+assert d["linearizable"] is True, "history not linearizable"
+assert d["fenced_acks_merged"] == 0, \
+    f"fenced acks merged: {d['fenced_acks_merged']}"
+assert d["diverged_followers_unrepaired"] == 0, \
+    "anti-entropy left a diverged follower unrepaired"
+assert d["anti_entropy"]["divergences"] >= 1, \
+    "the drill never detected a planted divergence"
+assert d["anti_entropy"]["repairs"] >= 1, "divergence never repaired"
+assert d["chaos"]["injected"] >= 3, "the fault plan barely fired"
+assert d["quorum_timeout"]["typed"], "quorum expiry was untyped"
+assert d["quorum_retry_deduped"], "quorum retry re-applied"
+assert d["stale_rejected_typed"], "stale primary not typed-fenced"
+assert d["fenced_suffix_records"] > 0, "no fenced suffix counted"
+assert d["redriven"] > 0, "fenced writes never re-driven"
+print("partition drill:", d["replicas"], "followers,",
+      d["chaos"]["injected"], "faults injected /",
+      d["chaos"]["detected"], "detected,",
+      d["anti_entropy"]["repairs"], "follower repair(s) in",
+      round(d["anti_entropy"]["rejoin_catchup_ms"]), "ms; quorum +",
+      d["quorum_latency"]["delta_ms"], "ms p50, gap",
+      round(d["availability_gap_ms"]), "ms")
+EOF
+
+echo "== perfgate: committed partition receipt passes on its pins =="
+python tools/perfgate.py --receipt /tmp/_partition_ci.json --json
+echo "PARTITION-CI PASS"

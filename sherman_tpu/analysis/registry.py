@@ -150,6 +150,12 @@ DEFAULT_REGISTRY = Registry(
         # here turns replication lag into a per-record device
         # round-trip, and the lag gauge is a headline receipt number
         ("sherman_tpu/replica.py", "Follower.pump"),
+        # partition plane (PR 18): the tailer poll loop runs per
+        # shipping round per follower, now with the chaos-directive
+        # and fence checks inline — a host sync here stalls every
+        # follower's apply cadence and the quorum-ack wait that pumps
+        # through it
+        ("sherman_tpu/replica.py", "JournalTailer.poll"),
     ],
     static_roots={"cfg", "config", "self", "C", "D", "CFG", "bits",
                   "layout"},
@@ -179,6 +185,13 @@ DEFAULT_REGISTRY = Registry(
         # cached in the dedup window must be durable before any future
         # resolves (PR 15)
         ("sherman_tpu/utils/journal.py", "Journal.append_acks"),
+        # quorum acks (PR 18): the fence proxy is the SAME fsync
+        # domain — it delegates every append to the wrapped segment
+        # after the lease check, so a quorum ack released on its
+        # return is released on durable bytes (SL005 sees the pure
+        # delegation and the wrapped Journal.append's own fsync)
+        ("sherman_tpu/replica.py", "_FencedJournal.append"),
+        ("sherman_tpu/replica.py", "_FencedJournal.append_acks"),
     ],
     obs_hot_functions=[
         ("sherman_tpu/obs/registry.py", "Counter.inc"),
@@ -224,6 +237,12 @@ DEFAULT_REGISTRY = Registry(
         # PULL time like every other collector
         ("sherman_tpu/replica.py", "ReplicaGroup._note_reads"),
         ("sherman_tpu/replica.py", "ReplicaGroup._note_fenced"),
+        # quorum acks (PR 18): the wait accounting runs once per
+        # quorum-gated ack inside the serve write wall (the latency-
+        # delta receipt's own numerator) — plain adds only; the
+        # server-side twin is covered by the ShermanServer._note_*
+        # glob above
+        ("sherman_tpu/replica.py", "ReplicaGroup._note_quorum"),
     ],
     knob_docs=["BENCHMARKS.md"],
 )

@@ -214,6 +214,37 @@ def check_jsonl(path: str, initial=None) -> dict:
     return check_events(events, initial=initial)
 
 
+def check_fenced_rejected(read_fn, fenced) -> dict:
+    """Prove fenced acks never merged (PR 18's split-brain pin).
+
+    ``fenced``: iterable of ``(key, value)`` pairs a STALE primary
+    acked after its lease epoch was bumped — writes that landed past
+    the promotion fence point and must never become visible.
+    ``read_fn``: ``keys ndarray -> (values, found)`` against the
+    promoted primary's live state.  A fenced pair counts as MERGED
+    only when the key is found AND carries the fenced value — a
+    found key with a different value is the re-driven client's own
+    legitimate write through the new primary's dedup window, which
+    is exactly the contract (typed rejection then re-drive), not a
+    merge.  -> ``{"fenced", "merged", "violations": [...]}`` with
+    ``merged`` the drill's ``fenced_acks_merged`` receipt field.
+    """
+    pairs = [(int(k), int(v)) for k, v in fenced]
+    if not pairs:
+        return {"fenced": 0, "merged": 0, "violations": []}
+    keys = np.asarray([k for k, _ in pairs], np.uint64)
+    vals, found = read_fn(keys)
+    vals = np.asarray(vals)
+    found = np.asarray(found, bool)
+    violations = []
+    for i, (k, v) in enumerate(pairs):
+        if bool(found[i]) and int(vals[i]) == v:
+            violations.append({"key": k, "fenced_value": v,
+                               "kind": "fenced_ack_merged"})
+    return {"fenced": len(pairs), "merged": len(violations),
+            "violations": violations}
+
+
 # ---------------------------------------------------------------------------
 # Bounded recorder
 # ---------------------------------------------------------------------------

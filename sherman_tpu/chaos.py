@@ -40,6 +40,38 @@ Scope: single-process meshes (drills, CI, the CPU fuzz tier).  The
 corruptions target the shared pool/locks arrays, so they are seen by
 EVERY program — engine steps, staged loops, scrub kernels — not just
 host-API steps; only ``drop_cas``/``stale_read`` are host-step-local.
+
+**Replication fault layer (PR 18).**  The data-plane kinds above
+perturb POOL state; the ``repl_*`` kinds perturb the REPLICATION
+plane's two message boundaries instead — the journal-shipping tail a
+follower polls, and the lease-table view the primary's durability
+fence consults:
+
+- ``repl_drop``: a poll's fetch is lost — the follower sees no new
+  bytes this round (offset untouched, natural retry);
+- ``repl_delay``: shipped bytes are in flight — same observable as a
+  drop in the pull model (nothing new until the window closes, then
+  everything arrives at once), counted separately;
+- ``repl_reorder``: the fetched byte view has two chunks swapped (the
+  reordered-packet analogue) — per-frame CRC must detect it and the
+  follower must retry a clean view, never apply;
+- ``repl_partition``: the follower (scope ``"ship"``) cannot reach the
+  primary's journal at all, and/or the PRIMARY (scope ``"lease"``)
+  sees a frozen snapshot of the cluster lease table — the split-brain
+  ingredient: a fenced primary that cannot observe its own epoch bump
+  keeps acking until the partition heals;
+- ``repl_slow``: the follower's poll stalls ``ms`` before fetching —
+  the slow-node tail that quorum waits must absorb or time out on.
+
+View faults never touch the journal FILE — they perturb one poll's
+read of it, so detection-then-clean-retry is always possible and the
+primary's durability story is never confused with the fault.
+``ReplFault`` windows are measured on the layer's replication clock
+(one tick per tailer poll across the group); the same seed over the
+same poll sequence fires the same faults.  Drills drive partitions
+manually with :meth:`ReplChaos.hold` / :meth:`ReplChaos.heal`.
+Counters ride ``chaos.repl_*``; every window start is a
+``chaos.repl_inject`` flight event.
 """
 
 from __future__ import annotations
@@ -57,6 +89,8 @@ from sherman_tpu.ops import bits
 
 KINDS = ("torn_page", "flip_entry_ver", "wedge_lock", "drop_cas",
          "stale_read")
+REPL_KINDS = ("repl_drop", "repl_delay", "repl_reorder",
+              "repl_partition", "repl_slow")
 
 # a lease word no live client can own: unregistered owner tag + an
 # epoch far from any real client's generation
@@ -65,6 +99,9 @@ DEAD_OWNER_EPOCH = 0x5A
 
 _OBS = {k: obs.counter(f"chaos.{k}") for k in KINDS}
 _OBS_TOTAL = obs.counter("chaos.faults_injected")
+_OBS_REPL = {k: obs.counter(f"chaos.{k}") for k in REPL_KINDS}
+_OBS_REPL_TOTAL = obs.counter("chaos.repl_faults_injected")
+_OBS_REPL_DETECTED = obs.counter("chaos.repl_detected")
 
 
 @dataclasses.dataclass
@@ -92,18 +129,254 @@ class Fault:
                              f"want one of {KINDS}")
 
 
-class FaultPlan:
-    """A deterministic schedule of data-plane faults over one DSM."""
+@dataclasses.dataclass
+class ReplFault:
+    """One scheduled replication fault.  ``poll`` is the window start
+    on the layer's replication clock (one tick per tailer poll across
+    the whole group), ``span`` the window length in ticks.
+    ``follower`` restricts ship-side faults to one follower index
+    (-1 = all).  ``ms`` is the per-poll stall for ``repl_slow``.
+    ``scope`` applies to ``repl_partition`` only: ``"ship"`` cuts the
+    follower's view of the journal tail, ``"lease"`` freezes the
+    PRIMARY's view of the cluster lease table, ``"both"`` does both."""
+
+    kind: str
+    poll: int = 0
+    span: int = 1
+    follower: int = -1
+    ms: float = 2.0
+    scope: str = "ship"
+    fired: bool = dataclasses.field(default=False, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in REPL_KINDS:
+            raise ConfigError(f"unknown repl fault kind {self.kind!r}; "
+                              f"want one of {REPL_KINDS}")
+        if self.scope not in ("ship", "lease", "both"):
+            raise ConfigError(f"repl fault scope {self.scope!r}: want "
+                              "'ship', 'lease' or 'both'")
+        if self.span < 1:
+            raise ConfigError(f"repl fault span {self.span}: want >= 1")
+
+
+class ReplChaos:
+    """The replication-plane fault layer a :class:`FaultPlan` exposes.
+
+    Attached to a ``ReplicaGroup`` (``group.attach_chaos``); the
+    journal tailer asks :meth:`on_poll` for this poll's directives and
+    routes fetched bytes through :meth:`view` when told to reorder;
+    the primary's durability fence routes the lease table through
+    :meth:`lease_view`.  Everything is deterministic in (plan, seed,
+    poll sequence).  Drills drive partitions by hand with
+    :meth:`hold`/:meth:`heal` — scheduled windows and manual holds
+    compose."""
 
     def __init__(self, faults, seed: int = 0):
-        self.faults = [f if isinstance(f, Fault) else Fault(**f)
+        self.faults = [f if isinstance(f, ReplFault) else ReplFault(**f)
                        for f in faults]
         self.seed = int(seed)
+        self._rng = np.random.default_rng(self.seed ^ 0x5EA1)
+        self._clock = 0             # replication time: one tick per poll
+        self._held: set[str] = set()
+        self._lease_frozen = None   # snapshot while a lease cut is active
+        self.injected = 0
+        self.detected = 0
+
+    @classmethod
+    def storm(cls, seed: int, n_faults: int = 8, poll_hi: int = 24,
+              span_hi: int = 4, followers: int = 2,
+              kinds=REPL_KINDS) -> "ReplChaos":
+        """Seeded random storm over the shipping tail: windows of
+        drop/delay/reorder/partition/slow spread over ``poll_hi`` ticks
+        of replication time.  Ship scope only — lease cuts change WHO
+        may ack and belong to the drills' manual holds, not a fuzz
+        storm's background noise."""
+        rng = np.random.default_rng(int(seed))
+        faults = [ReplFault(
+            kind=str(rng.choice(list(kinds))),
+            poll=int(rng.integers(0, max(poll_hi, 1))),
+            span=1 + int(rng.integers(0, max(span_hi, 1))),
+            follower=int(rng.integers(-1, max(followers, 1))),
+            ms=float(rng.integers(1, 4)))
+            for _ in range(n_faults)]
+        return cls(faults, seed=seed)
+
+    # -- scheduling -----------------------------------------------------------
+
+    def _active(self, t: int, follower: int, side: str):
+        """Faults whose window covers tick ``t`` for this follower and
+        boundary (``side`` in {"ship", "lease"})."""
+        out = []
+        for f in self.faults:
+            if not (f.poll <= t < f.poll + f.span):
+                continue
+            if side == "lease":
+                if f.kind == "repl_partition" and f.scope in ("lease",
+                                                             "both"):
+                    out.append(f)
+                continue
+            if f.kind == "repl_partition" and f.scope == "lease":
+                continue
+            if f.follower not in (-1, follower):
+                continue
+            out.append(f)
+        return out
+
+    def _fire(self, f: ReplFault, t: int) -> None:
+        if f.fired:
+            return
+        f.fired = True
+        self.injected += 1
+        _OBS_REPL_TOTAL.inc()
+        _OBS_REPL[f.kind].inc()
+        obs.record_event("chaos.repl_inject", fault=f.kind, poll=t,
+                         span=int(f.span), follower=int(f.follower),
+                         scope=f.scope)
+
+    # -- the tailer hook (journal-shipping boundary) --------------------------
+
+    def on_poll(self, follower: int = 0) -> dict | None:
+        """Directives for this poll of ``follower``'s tailer, or None
+        when nothing is active (the zero-cost common case).  Ticks the
+        replication clock."""
+        t = self._clock
+        self._clock += 1
+        live = self._active(t, follower, "ship")
+        held = "ship" in self._held or "both" in self._held
+        if not live and not held:
+            return None
+        d = {"drop": False, "freeze": False, "reorder": False,
+             "partition": held, "slow_ms": 0.0}
+        for f in live:
+            self._fire(f, t)
+            if f.kind == "repl_drop":
+                d["drop"] = True
+            elif f.kind == "repl_delay":
+                d["freeze"] = True
+            elif f.kind == "repl_reorder":
+                d["reorder"] = True
+            elif f.kind == "repl_partition":
+                d["partition"] = True
+            else:  # repl_slow
+                d["slow_ms"] = max(d["slow_ms"], float(f.ms))
+        return d
+
+    def view(self, blob: bytes) -> bytes:
+        """The reorder perturbation: swap two chunks of one poll's
+        fetched byte view (the file itself is never touched).  Per-
+        frame CRC must refuse the view; the next clean poll re-reads
+        the true bytes from the unchanged offset."""
+        n = len(blob)
+        b = bytearray(blob)
+        if n < 48:
+            if n:                     # too short to swap: flip one bit
+                b[n // 2] ^= 0x01
+            return bytes(b)
+        ch = 16
+        i = int(self._rng.integers(0, n - 2 * ch))
+        j = int(self._rng.integers(i + ch, n - ch + 1))
+        b[i:i + ch], b[j:j + ch] = b[j:j + ch], b[i:i + ch]
+        if bytes(b) == blob:          # identical chunks: force a change
+            b[i] ^= 0x01
+        return bytes(b)
+
+    def note_detected(self) -> None:
+        """A perturbed view was refused (typed corruption / empty
+        fetch absorbed) — the detection half of every injection."""
+        self.detected += 1
+        _OBS_REPL_DETECTED.inc()
+
+    # -- the fence hook (lease-table boundary) --------------------------------
+
+    def lease_view(self, epochs: dict) -> dict:
+        """The lease table as the PRIMARY's durability fence sees it.
+        While a lease-scope partition is active the view is frozen at
+        the cut's first observation — the primary cannot watch its own
+        epoch get bumped, so it keeps acking (split-brain's stale
+        half); healing restores the live table and the fence fires."""
+        t = self._clock
+        active = ("lease" in self._held or "both" in self._held
+                  or bool(self._active(t, -1, "lease")))
+        if not active:
+            self._lease_frozen = None
+            return epochs
+        for f in self._active(t, -1, "lease"):
+            self._fire(f, t)
+        if self._lease_frozen is None:
+            self._lease_frozen = dict(epochs)
+        return self._lease_frozen
+
+    # -- manual partition control (drills) ------------------------------------
+
+    def hold(self, scope: str = "both") -> None:
+        """Open a partition by hand (``scope`` in ship/lease/both) —
+        held until :meth:`heal`.  Counted and flight-recorded like a
+        scheduled window."""
+        if scope not in ("ship", "lease", "both"):
+            raise ConfigError(f"hold scope {scope!r}: want 'ship', "
+                              "'lease' or 'both'")
+        self._held.add(scope)
+        self.injected += 1
+        _OBS_REPL_TOTAL.inc()
+        _OBS_REPL["repl_partition"].inc()
+        obs.record_event("chaos.repl_inject", fault="repl_partition",
+                         poll=self._clock, span=-1, follower=-1,
+                         scope=scope)
+
+    def heal(self) -> None:
+        """Close every manual partition; the next fence check sees the
+        live lease table and the next poll fetches real bytes."""
+        self._held.clear()
+        self._lease_frozen = None
+        obs.record_event("chaos.repl_heal", poll=self._clock)
+
+    @property
+    def exhausted(self) -> bool:
+        """Every scheduled window has passed and no manual hold is
+        open — the storm is over."""
+        return not self._held and all(
+            f.poll + f.span <= self._clock for f in self.faults)
+
+    def describe(self) -> list[dict]:
+        return [{"kind": f.kind, "poll": f.poll, "span": f.span,
+                 "follower": f.follower, "scope": f.scope,
+                 "fired": f.fired} for f in self.faults]
+
+
+class FaultPlan:
+    """A deterministic schedule of data-plane faults over one DSM.
+    ``repl_*`` kinds in the same grammar are split out into the
+    replication layer (:meth:`repl_layer`) instead of the DSM hook."""
+
+    def __init__(self, faults, seed: int = 0):
+        self.faults = []
+        repl = []
+        for f in faults:
+            if isinstance(f, ReplFault):
+                repl.append(f)
+            elif isinstance(f, Fault):
+                self.faults.append(f)
+            elif isinstance(f, dict) and f.get("kind") in REPL_KINDS:
+                repl.append(ReplFault(**f))
+            else:
+                self.faults.append(Fault(**f))
+        self.seed = int(seed)
+        self.repl_faults = repl
+        self._repl_layer: ReplChaos | None = None
         self._rng = np.random.default_rng(self.seed)
         self._steps = 0
         self._undo: list = []       # (space, row, col, old_value)
         self._stale_pool = None     # np snapshot for stale_read serving
         self.injected = 0
+
+    def repl_layer(self) -> "ReplChaos | None":
+        """The plan's replication fault layer (None when the plan has
+        no ``repl_*`` faults); built once, shared by every caller so
+        the replication clock is group-global."""
+        if self._repl_layer is None and self.repl_faults:
+            self._repl_layer = ReplChaos(self.repl_faults,
+                                         seed=self.seed)
+        return self._repl_layer
 
     # -- construction ---------------------------------------------------------
 
@@ -359,8 +632,17 @@ class FaultPlan:
 
     @property
     def exhausted(self) -> bool:
+        """Every DATA-plane fault has fired (repl windows are judged by
+        :attr:`ReplChaos.exhausted` on the layer's own clock)."""
         return all(f.fired for f in self.faults)
 
     def describe(self) -> list[dict]:
-        return [{"kind": f.kind, "step": f.step, "addr": f.addr,
-                 "fired": f.fired} for f in self.faults]
+        out = [{"kind": f.kind, "step": f.step, "addr": f.addr,
+                "fired": f.fired} for f in self.faults]
+        if self._repl_layer is not None:
+            out.extend(self._repl_layer.describe())
+        else:
+            out.extend({"kind": f.kind, "poll": f.poll, "span": f.span,
+                        "follower": f.follower, "scope": f.scope,
+                        "fired": f.fired} for f in self.repl_faults)
+        return out

@@ -249,6 +249,77 @@ def replica_poll_ms() -> float:
     return ms
 
 
+def ack_quorum() -> int:
+    """Quorum-ack knob (``SHERMAN_ACK_QUORUM``): how many DURABLE
+    copies a write needs before its ack resolves — the primary's
+    fsync'd journal record counts as 1, every follower whose applied
+    watermark covers the record adds 1.
+
+    1 is the SHIPPED DEFAULT (standing guardrail): primary-durability
+    acks, bit-identical to the pre-quorum front door — the server
+    never consults the replica group on the ack path (the quorum-off
+    identity pin in ``tests/test_serve.py``).  ``K > 1`` gates every
+    write ack on ``K - 1`` follower watermarks with a bounded wait
+    (typed ``QuorumTimeoutError`` on expiry; the rid stays in the
+    exactly-once window, so the client's retry re-acks the original
+    result once replication catches up — never a re-apply)."""
+    import os
+    v = os.environ.get("SHERMAN_ACK_QUORUM", "1").strip().lower()
+    if v in ("", "0", "1", "false", "off", "no"):
+        return 1
+    try:
+        n = int(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_ACK_QUORUM={v!r}: want a copy count >= 1")
+    if n < 1:
+        raise ConfigError(f"SHERMAN_ACK_QUORUM={n}: want >= 1")
+    return n
+
+
+def tail_wait_s() -> float:
+    """Tailer stall watchdog knob (``SHERMAN_TAIL_WAIT_S``): how long
+    a follower's journal tail may wait on a live torn frame (an
+    append in flight) before probing the lease table.  A torn tail
+    whose primary's lease is DEAD after this long is a stall, not an
+    append — the tailer surfaces a typed ``TailStalledError`` (plus a
+    flight event) instead of hanging the follower forever; a live
+    primary keeps the wait (slow appends are legal, evented once)."""
+    import os
+    v = os.environ.get("SHERMAN_TAIL_WAIT_S", "5").strip()
+    try:
+        s = float(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_TAIL_WAIT_S={v!r}: want a float of seconds")
+    if s <= 0:
+        raise ConfigError(f"SHERMAN_TAIL_WAIT_S={s}: want > 0")
+    return s
+
+
+def anti_entropy_s() -> float:
+    """Anti-entropy audit cadence knob (``SHERMAN_ANTI_ENTROPY_S``):
+    seconds between periodic follower audits (watermark freshness +
+    consumed-segment CRC + sampled pool-page compare against the
+    primary) in :class:`sherman_tpu.replica.AntiEntropy`'s background
+    mode.  0 disables the background thread (the SHIPPED DEFAULT —
+    drills and operators call ``tick()`` explicitly); a divergent
+    follower is quarantined out of the read-serving set and re-shipped
+    from the checkpoint chain + journal before re-admission."""
+    import os
+    v = os.environ.get("SHERMAN_ANTI_ENTROPY_S", "0").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return 0.0
+    try:
+        s = float(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_ANTI_ENTROPY_S={v!r}: want a float of seconds")
+    if s < 0:
+        raise ConfigError(f"SHERMAN_ANTI_ENTROPY_S={s}: want >= 0")
+    return s
+
+
 @dataclasses.dataclass(frozen=True)
 class DSMConfig:
     """Cluster + memory-pool shape (reference ``Config.h:13-22``).

@@ -255,6 +255,23 @@ class RecoveryPlane:
                 except OSError:
                     pass
 
+    def journal_frontier(self) -> tuple[str, int]:
+        """The durable journal frontier ``(live segment path, size)``
+        — the coverage token the replication plane's quorum acks and
+        promotion fence point resolve against (PR 18).  Appends fsync
+        before returning, so a frontier captured AFTER an engine op
+        returned bounds every byte of that op's records; a follower
+        tailer whose consumed ``(segment, offset)`` reaches it holds
+        everything acked so far."""
+        if self.cid is None:
+            raise StateError("no chain yet: checkpoint_base() first")
+        path = self._journal_path(self._segment)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        return (path, int(size))
+
     def checkpoint_base(self) -> dict:
         """Full checkpoint -> new chain (new cid); sweeps the superseded
         chain's artifacts and starts journal segment 1."""

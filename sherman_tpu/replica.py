@@ -73,6 +73,48 @@ fenced writes, replica reads served/forwarded, watermark, epoch) plus
 ``repl.lag_ms`` / ``repl.availability_gap_ms`` gauges and flight
 events (``repl.promote``, ``repl.fenced``, ``repl.tail_torn_wait``,
 ``repl.rebootstrap``).
+
+**Partition plane (PR 18).**  Four additions close the replication
+plane's impolite-failure half:
+
+- **Quorum acks** (:meth:`ReplicaGroup.wait_quorum`, rode by the
+  front door's ``ServeConfig.ack_quorum`` / ``SHERMAN_ACK_QUORUM``,
+  default 1 = primary durability only, bit-identical when off): an
+  ack resolves only after K-1 follower watermarks COVER the durable
+  journal frontier captured when the write's engine op returned — a
+  coverage token ``(segment, size)``, compared against each tailer's
+  consumed ``(segment, offset)``.  Bounded wait, typed
+  :class:`QuorumTimeoutError` on expiry; the write is already durable
+  on the primary and its rid is already in the dedup window, so a
+  client retry re-acks exactly-once.
+- **Replication chaos** (:meth:`ReplicaGroup.attach_chaos`): a
+  ``chaos.ReplChaos`` layer perturbs tailer polls (drop / delay /
+  reorder / partition / slow) and the fence's lease-table view (a
+  frozen snapshot = the primary cannot see its own epoch bump — the
+  split-brain ingredient).  Reordered views fail the per-frame CRC
+  typed and are retried clean: detection-or-refusal, never silent
+  divergence.
+- **Split-brain fence point**: :meth:`ReplicaGroup.promote` expires
+  the lease and captures the durable frontier ATOMICALLY (under the
+  journal's own append lock), fencing every tailer at that byte.  A
+  lease-partitioned stale primary keeps acking past the fence; those
+  bytes are provably never shipped (the fence caps every poll), the
+  heal surfaces :class:`StalePrimaryError` to the next write, and
+  :meth:`ReplicaGroup.count_fenced_suffix` counts the rejected
+  suffix for the drill's ``fenced_acks_merged == 0`` pin.
+- **Anti-entropy repair** (:class:`AntiEntropy`): a periodic audit
+  (watermark freshness + consumed-segment CRC vs a re-read of the
+  same byte range + pool-page compare against the primary, sampled
+  or full) that QUARANTINES a divergent follower out of the
+  read-serving set and every quorum, re-ships it through the same
+  restore-then-replay core bootstrap uses, re-audits, and re-admits.
+  ``SHERMAN_ANTI_ENTROPY_S`` drives a background cadence (0 = off,
+  the shipped default); drills call :meth:`AntiEntropy.tick`.
+
+A live torn tail is additionally watched: after ``SHERMAN_TAIL_WAIT_S``
+at one position the tailer probes the primary's lease and raises a
+typed :class:`TailStalledError` when it is dead (satellite: a
+follower must never hang forever on a dead primary's torn tail).
 """
 
 from __future__ import annotations
@@ -96,6 +138,12 @@ _OBS_GAP_MS = obs.gauge("repl.availability_gap_ms")
 _OBS_APPLIED = obs.counter("repl.applied_records")
 _OBS_PROMOTIONS = obs.counter("repl.promotions")
 _OBS_FENCED = obs.counter("repl.fenced_writes")
+_OBS_QUORUM_MS = obs.gauge("repl.quorum_wait_ms")
+_OBS_QUORUM_TIMEOUTS = obs.counter("repl.quorum_timeouts")
+_OBS_STALLS = obs.counter("repl.tail_stalls")
+_OBS_AUDITS = obs.counter("repl.anti_entropy_audits")
+_OBS_QUARANTINES = obs.counter("repl.quarantines")
+_OBS_REPAIRS = obs.counter("repl.repairs")
 
 
 class StalePrimaryError(StateError):
@@ -104,6 +152,24 @@ class StalePrimaryError(StateError):
     primary's), so appending would fork the journal behind the new
     primary's back.  The write fails typed — the fence that makes
     split-brain structurally impossible instead of merely unlikely."""
+
+
+class QuorumTimeoutError(StateError):
+    """A quorum-ack wait expired: fewer than ``ack_quorum - 1``
+    follower watermarks covered the write's durable journal frontier
+    within the bounded wait (partitioned, quarantined or slow
+    followers).  The write IS durable on the primary and its rid is
+    already in the exactly-once dedup window — a client retry re-acks
+    the original result once the quorum recovers."""
+
+
+class TailStalledError(StateError):
+    """The journal-shipping tail waited ``SHERMAN_TAIL_WAIT_S`` at one
+    torn-tail position and the primary's lease is no longer live: the
+    in-flight append will never complete (the appender is dead), so
+    waiting longer just hangs the follower.  The caller escalates —
+    typically by promoting (whose ``final`` catch-up pass skips the
+    torn tail exactly as recovery truncates it)."""
 
 
 class _ResyncRequired(StateError):
@@ -130,6 +196,34 @@ class JournalTailer:
         self._off = 0                  # consumed bytes (past magic)
         self._fmt = 2
         self.torn_waits = 0
+        #: replication fault layer (``chaos.ReplChaos``) + this
+        #: tailer's follower index on its clock — group-attached
+        self.chaos = None
+        self.follower_idx = 0
+        #: fence point ``(segment path, byte limit)``: promotion caps
+        #: every poll here — bytes past it are a stale primary's
+        #: fenced suffix, never shipped
+        self.fence: tuple[str, int] | None = None
+        #: rolling CRC32 over every byte CONSUMED of the current
+        #: segment (from byte 0) — the anti-entropy audit re-reads the
+        #: same range and must reproduce it exactly
+        self.seg_crc = 0
+        #: stall watchdog: ``() -> bool`` probe of the primary's lease
+        #: + the bounded torn-tail wait (SHERMAN_TAIL_WAIT_S)
+        self.lease_probe = None
+        self.tail_wait_s = C.tail_wait_s()
+        self.stalls = 0
+        self._torn_pos: tuple | None = None
+        self._torn_since = 0.0
+        self._stall_evented = False
+        #: what the fault layer did to the LAST poll — ``pump`` uses
+        #: these to classify a typed refusal as provably transient
+        #: (perturbed view) and an empty poll as a cut feed (the
+        #: caught-up gate must not certify freshness through a
+        #: partition)
+        self.last_poll_perturbed = False
+        self.last_poll_cut = False
+        self._perturb_next = False
         # anchor EAGERLY: the tailer owes its creator every record in
         # the earliest segment alive NOW.  A lazy (first-poll) anchor
         # would let a checkpoint sweep that segment unseen — the
@@ -153,10 +247,49 @@ class JournalTailer:
         since the last poll, across any number of rotations.  With
         ``final`` (the primary is dead — promotion's catch-up pass) a
         torn tail on the LAST segment is final too: skipped, exactly
-        as recovery would truncate it."""
+        as recovery would truncate it.
+
+        The replication fault layer, when attached, perturbs THIS
+        POLL'S VIEW only: a drop/delay/partition directive loses the
+        fetch (no new bytes, offset untouched — the natural retry), a
+        slow directive stalls first, a reorder directive routes the
+        fetched bytes through :meth:`chaos.ReplChaos.view` so the
+        per-frame CRC refuses them typed.  The file is never touched.
+        """
+        self.last_poll_perturbed = False
+        self.last_poll_cut = False
+        self._perturb_next = False
+        if self.chaos is not None:
+            d = self.chaos.on_poll(self.follower_idx)
+            if d is not None:
+                if d["slow_ms"]:
+                    time.sleep(d["slow_ms"] / 1e3)
+                if d["partition"] or d["drop"] or d["freeze"]:
+                    # the fetch never arrives this round
+                    self.last_poll_cut = True
+                    return []
+                self._perturb_next = d["reorder"]
         out: list[tuple] = []
+        try:
+            self._poll_into(out, final)
+        except J.JournalCorruptError:
+            if not out:
+                raise
+            # records from EARLIER segments in this round were already
+            # consumed (their offsets advanced): return them — losing
+            # them here would be silent divergence.  The corrupt
+            # segment's offset is untouched, so the error re-manifests
+            # (or a clean view supersedes a perturbed one) next poll.
+        return out
+
+    def _poll_into(self, out: list, final: bool) -> None:
         while True:
             segs = self._segments()
+            if self.fence is not None:
+                # promotion's fence point: the old chain ends at an
+                # exact byte — segments past it (a stale primary's
+                # rotations) do not exist for this tailer
+                segs = [s for s in segs if s <= self.fence[0]]
             if self._cur is not None and self._cur not in segs:
                 # the segment under the tail was swept: a checkpoint
                 # covers it, but bytes may have landed there after our
@@ -167,8 +300,9 @@ class JournalTailer:
                     "under the tail")
             if self._cur is None:
                 if not segs:
-                    return out
+                    return
                 self._cur, self._off, self._fmt = segs[0], 0, 2
+                self.seg_crc = 0
             # list-then-read ordering matters: a successor listed NOW
             # proves the current segment was closed before we read it,
             # so a torn tail below is final, not in flight
@@ -179,6 +313,7 @@ class JournalTailer:
                 # rotation: finish here (torn tail, if any, is final —
                 # the successor supersedes it) and advance
                 self._cur, self._off, self._fmt = later[0], 0, 2
+                self.seg_crc = 0
                 continue
             if torn and not final:
                 # live-tail rule: an append may be in flight — wait.
@@ -186,7 +321,56 @@ class JournalTailer:
                 obs.record_event("repl.tail_torn_wait",
                                  segment=os.path.basename(self._cur),
                                  at_byte=self._off)
-            return out
+                self._note_torn_wait()
+            return
+
+    def _note_torn_wait(self) -> None:
+        """Bounded-wait watchdog: a torn tail stuck at ONE position
+        past ``tail_wait_s`` is either a slow-but-live appender (lease
+        live: keep waiting, event once) or a dead primary's forever-
+        torn append (lease dead — or no probe to ask: raise typed
+        rather than hang the follower)."""
+        now = time.monotonic()
+        pos = (self._cur, self._off)
+        if self._torn_pos != pos:
+            self._torn_pos = pos
+            self._torn_since = now
+            self._stall_evented = False
+            return
+        waited = now - self._torn_since
+        if waited < self.tail_wait_s:
+            return
+        if self.lease_probe is not None and self.lease_probe():
+            if not self._stall_evented:
+                self._stall_evented = True
+                obs.record_event(
+                    "repl.tail_slow",
+                    segment=os.path.basename(self._cur),
+                    at_byte=self._off, waited_s=round(waited, 3))
+            return
+        self.stalls += 1
+        _OBS_STALLS.inc()
+        obs.record_event("repl.tail_stalled",
+                         segment=os.path.basename(self._cur),
+                         at_byte=self._off, waited_s=round(waited, 3))
+        raise TailStalledError(
+            f"journal tail torn at {os.path.basename(self._cur)}"
+            f":{self._off} for {waited:.1f}s with the primary's lease "
+            "dead — the in-flight append will never land; promote "
+            "(the final catch-up pass skips it) instead of waiting")
+
+    def covers(self, path: str, size: int) -> bool:
+        """True when every byte of ``path[:size]`` has been consumed —
+        this follower's durable watermark reaches the frontier token
+        (the quorum-ack coverage test).  Segment names sort in append
+        order within one chain, so a LATER current segment means
+        ``path`` was fully consumed (or swept into the chain this
+        follower restored — covered either way)."""
+        if self._cur is None:
+            return False
+        if self._cur > path:
+            return True
+        return self._cur == path and self._off >= int(size)
 
     def _poll_segment(self, path: str) -> tuple[list[tuple], bool]:
         """-> (records decoded from complete frames past the offset,
@@ -201,6 +385,15 @@ class JournalTailer:
                 f"segment {os.path.basename(path)} swept under the "
                 "tail")
         base = self._off
+        if self.fence is not None and path == self.fence[0]:
+            # cap the view at the fence point: bytes past it are a
+            # stale primary's fenced suffix (mid-frame cut decodes as
+            # a torn tail, which the final pass skips)
+            blob = blob[: max(0, self.fence[1] - base)]
+        if self._perturb_next and blob:
+            blob = self.chaos.view(blob)
+            self.last_poll_perturbed = True
+            self._perturb_next = False
         pos = 0
         if base == 0:
             if len(blob) < len(J.MAGIC):
@@ -241,6 +434,11 @@ class JournalTailer:
             out.append(J._decode_payload(payload, base + pos,
                                          self._fmt))
             pos = end
+        # consumed frames are CRC-clean, so the prefix is byte-equal
+        # to the true file even under a perturbed view (any changed
+        # byte fails its covering frame and stops consumption first)
+        if pos:
+            self.seg_crc = zlib.crc32(blob[:pos], self.seg_crc)
         self._off = base + pos
         return out, pos < size
 
@@ -295,6 +493,10 @@ class Follower:
         self.window: dict = {}
         self.rebootstraps = -1  # first bootstrap is not a re-
         self.caught_up = False
+        #: anti-entropy verdict: a quarantined follower serves no
+        #: replica read and counts toward no quorum until repaired
+        self.quarantined = False
+        self.chaos_detected = 0  # perturbed views refused typed
         self.cluster = self.tree = self.eng = None
         self.cid = None
         self.link = 0   # delta links restored at (re)bootstrap
@@ -331,6 +533,7 @@ class Follower:
         self.window.clear()
         self.caught_up = False
         self.tailer = JournalTailer(g.primary_dir, cid)
+        g._arm_tailer(self)
         # a checkpoint that lands between the restore above and the
         # tailer's anchor would sweep records into a delta we did not
         # restore while the tailer anchors past them — re-discover and
@@ -355,8 +558,33 @@ class Follower:
         except _ResyncRequired:
             self._bootstrap()
             recs = self.tailer.poll(final=final)
+        except J.JournalCorruptError:
+            if not self.tailer.last_poll_perturbed:
+                raise  # real mid-file corruption: refuse, typed
+            # the fault layer perturbed THIS poll's view — provably
+            # transient (the file was never touched, the offset never
+            # advanced past a refused frame): count the detection and
+            # retry a clean view next poll
+            if self.tailer.chaos is not None:
+                self.tailer.chaos.note_detected()
+            self.chaos_detected += 1
+            self.caught_up = False
+            return 0
         if not recs:
-            self.caught_up = True
+            if self.tailer.last_poll_perturbed:
+                # the perturbed view was refused WITHOUT an error: the
+                # damage landed in the last frame, which decodes as a
+                # torn tail (CRC break at end-of-view) — refused all
+                # the same, so it counts as a detection; the offset
+                # never advanced, the next clean poll supersedes
+                if self.tailer.chaos is not None:
+                    self.tailer.chaos.note_detected()
+                self.chaos_detected += 1
+                self.caught_up = False
+                return 0
+            # an empty poll certifies freshness only when the feed was
+            # actually read — a cut fetch (drop/partition) says nothing
+            self.caught_up = not self.tailer.last_poll_cut
             return 0
         sink: list = []
         J.apply_records(recs, self.eng, ack_sink=sink,
@@ -367,7 +595,15 @@ class Follower:
             rid, tenant = entry[0], entry[1]
             self.window[(tenant, rid)] = tuple(entry[2:])
         self.seq += len(recs)
-        self.caught_up = True
+        if self.tailer.last_poll_perturbed:
+            # a clean prefix applied ahead of the refused damage (the
+            # prefix is byte-equal to the true file — any changed byte
+            # fails its covering frame first): still one detection
+            if self.tailer.chaos is not None:
+                self.tailer.chaos.note_detected()
+            self.chaos_detected += 1
+        self.caught_up = not (self.tailer.last_poll_perturbed
+                              or self.tailer.last_poll_cut)
         _OBS_APPLIED.inc(len(recs))
         self._publish_watermark()
         return len(recs)
@@ -399,10 +635,11 @@ class Follower:
         re-certified against this pool (bit-identical to a descent
         here); a stale or absent entry is a miss.  Returns ``(vals,
         hit)`` — or ``None`` when this follower may not serve at all
-        (no cache attached, or not caught up to the durable journal
-        end at its last pump: staleness forwards, never lies)."""
+        (no cache attached, not caught up to the durable journal end
+        at its last pump, or quarantined by the anti-entropy audit:
+        staleness forwards, never lies)."""
         cache = self.eng.leaf_cache
-        if cache is None or not self.caught_up:
+        if cache is None or not self.caught_up or self.quarantined:
             return None
         from sherman_tpu.ops import bits
         eng = self.eng
@@ -493,6 +730,15 @@ class ReplicaGroup:
         self.reads_served = 0
         self.reads_forwarded = 0
         self.last_pump_records = 0
+        self.quorum_acks = 0
+        self.quorum_timeouts = 0
+        self.quorum_wait_ms = 0.0
+        self.quorum_timeout_s = 5.0
+        self.fenced_suffix_records = 0
+        self._chaos = None                       # chaos.ReplChaos
+        self._ship_chaos_off = False  # promote detaches the ship side
+        self._fence: tuple[str, int] | None = None
+        self.anti_entropy: "AntiEntropy | None" = None
         self._last_pump_t = 0.0
         self._rr = 0
         self._stop = threading.Event()
@@ -524,6 +770,99 @@ class ReplicaGroup:
     def _note_fenced(self) -> None:
         self.fenced_writes += 1
 
+    def _note_quorum(self, ms: float) -> None:
+        self.quorum_acks += 1
+        self.quorum_wait_ms += ms
+
+    # -- replication chaos ---------------------------------------------------
+
+    def attach_chaos(self, layer) -> None:
+        """Install a replication fault layer (``chaos.ReplChaos``):
+        every tailer poll routes through its directives and the
+        durability fence reads the lease table through its (possibly
+        frozen) view.  Detach with ``attach_chaos(None)``."""
+        self._chaos = layer
+        for f in self.followers:
+            self._arm_tailer(f)
+
+    def _arm_tailer(self, f: Follower) -> None:
+        """(Re)wire a follower's tailer to the group-level hooks —
+        called at every (re)bootstrap so a fresh tailer inherits the
+        fault layer, the stall probe and the promotion fence."""
+        t = f.tailer
+        t.follower_idx = f.idx
+        t.chaos = None if self._ship_chaos_off else self._chaos
+        t.lease_probe = self._lease_probe
+        t.fence = self._fence
+
+    def _lease_probe(self) -> bool:
+        """Is the primary's write lease still live?  The stall
+        watchdog's question — asked of the TRUE lease table (the
+        followers sit on the majority side; only the partitioned
+        primary's own view can be frozen by chaos)."""
+        return self.plane.cluster.lease_is_live(self._lease.tag,
+                                                self._lease.epoch)
+
+    # -- quorum acks ---------------------------------------------------------
+
+    def quorum_token(self) -> tuple[str, int]:
+        """The durable journal frontier ``(segment path, size)`` — the
+        coverage token quorum waits resolve against
+        (``RecoveryPlane.journal_frontier``).  Appends fsync before
+        returning, so a token captured AFTER an engine op returned
+        bounds every byte of that op's records."""
+        return self.plane.journal_frontier()
+
+    def wait_quorum(self, need: int, timeout_s: float | None = None,
+                    token: tuple[str, int] | None = None) -> dict:
+        """Block until ``need`` non-quarantined follower watermarks
+        COVER the durable journal frontier (``token``, default:
+        captured now) — the quorum-ack gate.  Pumps the tail while
+        waiting; raises :class:`QuorumTimeoutError` at the bounded
+        deadline.  Returns ``{"needed", "covered", "waited_ms"}``."""
+        need = int(need)
+        rc = {"needed": need, "covered": 0, "waited_ms": 0.0}
+        if need <= 0:
+            return rc
+        if need > len(self.followers):
+            raise ConfigError(
+                f"quorum of {need} followers wanted but the group has "
+                f"{len(self.followers)} — ack_quorum counts the "
+                "primary plus at most every follower")
+        path, size = token if token is not None else self.quorum_token()
+        t0 = time.perf_counter()
+        deadline = t0 + (self.quorum_timeout_s if timeout_s is None
+                         else float(timeout_s))
+        while True:
+            n = 0
+            for f in self.followers:
+                if not f.quarantined and f.tailer.covers(path, size):
+                    n += 1
+            if n >= need:
+                break
+            if time.perf_counter() >= deadline:
+                self.quorum_timeouts += 1
+                _OBS_QUORUM_TIMEOUTS.inc()
+                obs.record_event("repl.quorum_timeout", needed=need,
+                                 covered=n,
+                                 segment=os.path.basename(path),
+                                 size=size)
+                raise QuorumTimeoutError(
+                    f"quorum ack: {n}/{need} followers cover the "
+                    f"frontier ({os.path.basename(path)}:{size}) at "
+                    "the deadline — partitioned, quarantined or slow "
+                    "followers; the write IS durable on the primary "
+                    "and its rid stays in the dedup window, so a "
+                    "retry re-acks exactly-once")
+            if self.pump() == 0:
+                time.sleep(0.001)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._note_quorum(ms)
+        _OBS_QUORUM_MS.set(ms)
+        rc["covered"] = n
+        rc["waited_ms"] = ms
+        return rc
+
     # -- fencing -------------------------------------------------------------
 
     def _install_fence(self, eng) -> None:
@@ -544,7 +883,18 @@ class ReplicaGroup:
 
     def _check_fence(self) -> None:
         cl = self.plane.cluster
-        if not cl.lease_is_live(self._lease.tag, self._lease.epoch):
+        if self._chaos is not None:
+            # the lease-table boundary's fault hook: under a lease-
+            # scope partition the PRIMARY sees a frozen snapshot — it
+            # cannot watch its own epoch get bumped, so it keeps
+            # acking until the heal (the split-brain ingredient the
+            # fence point + fenced-suffix accounting make safe)
+            view = self._chaos.lease_view(cl.lease_epochs)
+            live = view.get(int(self._lease.tag)) \
+                == int(self._lease.epoch)
+        else:
+            live = cl.lease_is_live(self._lease.tag, self._lease.epoch)
+        if not live:
             self._note_fenced()
             _OBS_FENCED.inc()
             obs.record_event("repl.fenced", epoch=self.epoch,
@@ -654,9 +1004,37 @@ class ReplicaGroup:
         t0 = time.perf_counter()
         self._t_dead = t_dead if t_dead is not None else t0
         self.stop()
-        self.plane.cluster.expire_client(self._lease.tag)
+        # the split-brain FENCE POINT: expire the lease and capture
+        # the durable frontier ATOMICALLY with respect to appenders
+        # (the journal's own append lock quiesces them), so "before
+        # the epoch bump" names an exact byte.  Every byte past it is
+        # a stale primary's fenced suffix: the tailers below are
+        # capped there and never ship it.
+        jrn = self.plane.eng.journal
+        inner = getattr(jrn, "_inner", jrn)
+        lock = getattr(inner, "_lock", None) \
+            if inner is not None else None
+        fence = None
+        if lock is not None:
+            with lock:
+                self.plane.cluster.expire_client(self._lease.tag)
+                try:
+                    fence = (inner.path, os.path.getsize(inner.path))
+                except OSError:
+                    fence = None
+        else:
+            self.plane.cluster.expire_client(self._lease.tag)
         old_epoch, self.epoch = self.epoch, self.epoch + 1
+        self._fence = fence
+        # the majority side can reach the journal store by definition
+        # of majority: the catch-up pass runs with the fault layer
+        # detached from the SHIP side (the fence above still caps it
+        # at the epoch bump).  The lease-table view stays chaos-routed
+        # — a lease-partitioned stale primary must keep seeing its
+        # frozen snapshot until the drill heals it.
+        self._ship_chaos_off = True
         for f in self.followers:
+            self._arm_tailer(f)
             f.pump(final=True)
         self.promoted = max(self.followers,
                             key=lambda f: (f.link, f.seq))
@@ -671,6 +1049,9 @@ class ReplicaGroup:
                            for f in self.followers],
             "window": len(self.promoted.window),
             "promote_ms": round(ms, 1),
+            "fence": None if fence is None else {
+                "segment": os.path.basename(fence[0]),
+                "size": fence[1]},
         }
         obs.record_event("repl.promote", winner=self.promoted.idx,
                          epoch=self.epoch,
@@ -697,6 +1078,39 @@ class ReplicaGroup:
         self.availability_gap_ms = round(ms, 1)
         _OBS_GAP_MS.set(ms)
         return self.availability_gap_ms
+
+    def count_fenced_suffix(self) -> int:
+        """Complete CRC-valid frames past the promotion fence point:
+        writes a lease-partitioned stale primary durably appended
+        (and acked) AFTER the epoch bump — the provably-rejected set
+        the drill pins against ``fenced_acks_merged``.  Trailing torn
+        bytes are an unacked in-flight append, not counted.  Call
+        after the heal (the suffix grows while the partition lasts);
+        updates the collector's ``fenced_suffix_records``."""
+        fence = self._fence
+        if fence is None:
+            return 0
+        path, base = fence
+        try:
+            with open(path, "rb") as f:
+                f.seek(base)
+                blob = f.read()
+        except OSError:
+            return 0
+        n = 0
+        pos = 0
+        size = len(blob)
+        while pos + J._HDR.size <= size:
+            length, crc = J._HDR.unpack_from(blob, pos)
+            end = pos + J._HDR.size + length
+            if length > J.MAX_PAYLOAD or end > size:
+                break
+            if zlib.crc32(blob[pos + J._HDR.size: end]) != crc:
+                break
+            n += 1
+            pos = end
+        self.fenced_suffix_records = n
+        return n
 
     # -- receipts ------------------------------------------------------------
 
@@ -725,10 +1139,208 @@ class ReplicaGroup:
             "reads_served": self.reads_served,
             "reads_forwarded": self.reads_forwarded,
             "last_pump_records": self.last_pump_records,
+            "quorum_acks": self.quorum_acks,
+            "quorum_timeouts": self.quorum_timeouts,
+            "quorum_wait_ms": round(self.quorum_wait_ms, 3),
+            "tail_stalls": sum(f.tailer.stalls
+                               for f in self.followers),
+            "chaos_detected": sum(f.chaos_detected
+                                  for f in self.followers),
+            "fenced_suffix_records": self.fenced_suffix_records,
+            "quarantined": sum(1 for f in self.followers
+                               if f.quarantined),
+            "anti_entropy_audits": 0 if self.anti_entropy is None
+            else self.anti_entropy.audits,
+            "anti_entropy_repairs": 0 if self.anti_entropy is None
+            else self.anti_entropy.repairs,
+            "divergences": 0 if self.anti_entropy is None
+            else self.anti_entropy.divergences,
         }
 
     def stats(self) -> dict:
         return self._collect()
 
     def close(self) -> None:
+        if self.anti_entropy is not None:
+            self.anti_entropy.stop()
         self.stop()
+
+
+# -- anti-entropy follower repair --------------------------------------------
+
+
+class AntiEntropy:
+    """Periodic follower audit -> quarantine -> re-ship -> re-admit.
+
+    Three checks per follower, run under the group's pump lock with
+    the tail pumped and the durable frontier STABLE across the
+    compare (so a mismatch is divergence, not lag):
+
+    - **watermark freshness**: after a pump the tailer covers the
+      durable journal frontier (a partitioned/lagging follower is not
+      divergent — it just skips the page compare this round);
+    - **consumed-segment CRC**: the rolling CRC the tailer accumulated
+      over every byte it CONSUMED must equal a re-read of the same
+      byte range from the primary's file (``journal.crc_of_range``) —
+      a mismatch means the follower applied bytes the chain never
+      shipped;
+    - **pool-page compare**: rows of the follower's pool must be
+      bit-identical to the primary's (the apply loop is shared code
+      and deterministic, so byte equality IS the contract) — sampled
+      (``sample_rows``) for the cheap background cadence, full
+      (``sample_rows=0``) for the drill's detection pin.
+
+    A divergent follower is **quarantined** (serves no replica read,
+    counts toward no quorum), re-shipped through the SAME
+    restore-then-replay core bootstrap uses (chain restore + journal
+    tail), re-audited with a FULL page compare, and re-admitted only
+    when clean — a follower that still diverges stays quarantined and
+    shows up in the collector's ``quarantined`` /
+    ``diverged_followers_unrepaired`` receipt (perfgate hard-reds it).
+
+    ``SHERMAN_ANTI_ENTROPY_S`` drives the background thread cadence
+    (0 disables it — the shipped default); drills and tests call
+    :meth:`tick` deterministically."""
+
+    def __init__(self, group: ReplicaGroup, *,
+                 period_s: float | None = None, sample_rows: int = 128,
+                 seed: int = 0):
+        self.group = group
+        self.period_s = C.anti_entropy_s() if period_s is None \
+            else float(period_s)
+        self.sample_rows = int(sample_rows)
+        self._rng = np.random.default_rng(int(seed))
+        self.audits = 0
+        self.divergences = 0
+        self.repairs = 0
+        self.last_repair_ms = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        group.anti_entropy = self
+
+    def tick(self) -> dict:
+        """One audit round over every follower; divergent followers
+        are quarantined, re-shipped and (when the re-audit is clean)
+        re-admitted.  Returns the round receipt."""
+        g = self.group
+        out = []
+        with g._pump_lock:
+            for f in g.followers:
+                r = self._audit_one(f)
+                self.audits += 1
+                _OBS_AUDITS.inc()
+                if r["diverged"]:
+                    self.divergences += 1
+                    self._quarantine(f, r)
+                    r["repair"] = self._repair(f)
+                out.append(r)
+        return {"followers": out,
+                "quarantined": sum(1 for f in g.followers
+                                   if f.quarantined)}
+
+    def unrepaired(self) -> int:
+        """Divergent followers still quarantined after their repair
+        attempt — the drill's ``diverged_followers_unrepaired`` pin
+        (perfgate marginless hard red when > 0)."""
+        return sum(1 for f in self.group.followers if f.quarantined)
+
+    # -- the audit -----------------------------------------------------------
+
+    def _audit_one(self, f: Follower) -> dict:
+        g = self.group
+        f.pump()
+        tok = g.quorum_token()
+        t = f.tailer
+        r: dict = {"follower": f.idx, "diverged": False,
+                   "watermark_ok": None, "seg_crc_ok": None,
+                   "pages_ok": None}
+        fresh = f.caught_up and t.covers(*tok)
+        r["watermark_ok"] = bool(fresh)
+        if t._cur is not None and t._off > 0:
+            try:
+                want = J.crc_of_range(t._cur, 0, t._off)
+            except OSError:
+                want = None  # segment swept mid-audit: next round
+            if want is not None:
+                ok = t.seg_crc == want
+                r["seg_crc_ok"] = bool(ok)
+                r["diverged"] |= not ok
+        if fresh and g.quorum_token() == tok:
+            # frontier stable across the compare: a mismatch cannot
+            # be lag
+            ok = self._pages_equal(f, full=False)
+            r["pages_ok"] = bool(ok)
+            r["diverged"] |= not ok
+        return r
+
+    def _pages_equal(self, f: Follower, *, full: bool) -> bool:
+        pp = np.asarray(self.group.plane.cluster.dsm.pool)
+        fp = np.asarray(f.cluster.dsm.pool)
+        if pp.shape != fp.shape:
+            return False
+        n = pp.shape[0]
+        k = self.sample_rows
+        if full or not k or k >= n:
+            return bool(np.array_equal(pp, fp))
+        rows = np.unique(self._rng.integers(0, n, k))
+        return bool(np.array_equal(pp[rows], fp[rows]))
+
+    # -- quarantine / repair -------------------------------------------------
+
+    def _quarantine(self, f: Follower, r: dict) -> None:
+        f.quarantined = True
+        _OBS_QUARANTINES.inc()
+        obs.record_event("repl.quarantine", follower=f.idx,
+                         watermark_ok=bool(r["watermark_ok"]),
+                         seg_crc_ok=r["seg_crc_ok"] is not False,
+                         pages_ok=r["pages_ok"] is not False)
+
+    def _repair(self, f: Follower) -> dict:
+        """Re-ship the follower through the restore-then-replay core
+        (the same chain + journal sequence bootstrap and recovery
+        run), re-audit with a FULL page compare, re-admit when clean.
+        Returns ``{"ok", "catchup_ms"}``."""
+        t0 = time.perf_counter()
+        f._bootstrap()
+        f.pump()
+        ok = f.caught_up and self._pages_equal(f, full=True)
+        ms = (time.perf_counter() - t0) * 1e3
+        self.last_repair_ms = round(ms, 1)
+        if ok:
+            f.quarantined = False
+            self.repairs += 1
+            _OBS_REPAIRS.inc()
+        obs.record_event("repl.repair", follower=f.idx, ok=bool(ok),
+                         catchup_ms=self.last_repair_ms)
+        return {"ok": bool(ok), "catchup_ms": self.last_repair_ms}
+
+    # -- background cadence --------------------------------------------------
+
+    def start(self) -> None:
+        """Background audits every ``period_s`` (the knob-driven mode;
+        no thread when the period is 0 — the shipped default)."""
+        if self.period_s <= 0 or self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                if self._stop.wait(self.period_s):
+                    return
+                try:
+                    self.tick()
+                except Exception as e:  # noqa: BLE001 — the audit
+                    # must not die silently; surface and stop
+                    obs.record_event("repl.anti_entropy_error",
+                                     error=repr(e))
+                    return
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="sherman-anti-entropy")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

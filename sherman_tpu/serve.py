@@ -141,6 +141,7 @@ from sherman_tpu.models.batched import DegradedError
 from sherman_tpu.obs import device as DEV
 from sherman_tpu.obs import recorder as FR
 from sherman_tpu.obs import slo as SLO
+from sherman_tpu.replica import QuorumTimeoutError
 from sherman_tpu.utils import journal as J
 from sherman_tpu.workload.device_prep import make_ingress_step
 
@@ -291,6 +292,19 @@ class ServeConfig:
     #: exactly-once dedup window per tenant, in write REQUESTS (rids);
     #: 0 disables the contract plane entirely
     dedup_window: int = 4096
+    #: quorum acks (``SHERMAN_ACK_QUORUM``): a write ack resolves only
+    #: after this many COPIES hold it durably — the primary counts as
+    #: one, so K means the primary plus K-1 follower watermarks
+    #: covering the write's journal frontier
+    #: (``ReplicaGroup.wait_quorum``).  1 = primary durability only,
+    #: the shipped default: the quorum path is never entered and the
+    #: front door is bit-identical to a build without it.  Needs an
+    #: attached group (:meth:`ShermanServer.attach_replica_group`).
+    ack_quorum: int = dataclasses.field(default_factory=C.ack_quorum)
+    #: bounded quorum wait per flushed write lane; expiry fails the
+    #: lane's futures with the typed ``QuorumTimeoutError`` (the rid
+    #: is already in the dedup window, so a retry re-acks)
+    quorum_timeout_ms: float = 5000.0
     #: p99 model: est_p99(W) = model_mult x measured wall(W) (formation
     #: wait + service; the open-loop 1.5x-span model plus slack)
     model_mult: float = 2.0
@@ -312,6 +326,14 @@ class ServeConfig:
             raise ConfigError(
                 f"ServeConfig.fusion={self.fusion!r}: want "
                 "aligned|pipelined")
+        if int(self.ack_quorum) < 1:
+            raise ConfigError(
+                f"ServeConfig.ack_quorum={self.ack_quorum}: want a "
+                "copy count >= 1 (1 = primary durability only)")
+        if self.quorum_timeout_ms <= 0:
+            raise ConfigError(
+                f"ServeConfig.quorum_timeout_ms="
+                f"{self.quorum_timeout_ms}: want > 0")
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -643,6 +665,11 @@ class ShermanServer:
         self.duplicate_applies = 0  # window misses that re-applied an
         # already-acked rid (the exactly-once invariant: must stay 0 —
         # both guards would have to fail for it to move)
+        # quorum-ack counters (PR 18; all zero with ack_quorum=1)
+        self.quorum_acks = 0        # write lanes released by a quorum
+        self.quorum_timeouts = 0    # bounded waits that expired typed
+        self.quorum_wait_ms = 0.0   # summed quorum wait
+        self.replica_group = None   # ReplicaGroup quorum waits ride
         self.calibration: dict[int, dict] = {}
         ref = weakref.ref(self)
 
@@ -679,6 +706,10 @@ class ShermanServer:
     def _note_deadline_shed(self, st: _TenantState) -> None:
         st.deadline_shed += 1
         self.deadline_shed += 1
+
+    def _note_quorum(self, ms: float) -> None:
+        self.quorum_acks += 1
+        self.quorum_wait_ms += ms
 
     # -- admission -----------------------------------------------------------
 
@@ -894,6 +925,12 @@ class ShermanServer:
         retraces."""
         if self._running:
             raise StateError("server already running")
+        if int(self.cfg.ack_quorum) > 1 and self.replica_group is None:
+            raise ConfigError(
+                f"ack_quorum={self.cfg.ack_quorum} promises "
+                "multi-copy durability but no replica group is "
+                "attached (attach_replica_group) — acking K copies "
+                "without K-1 followers would be a lie")
         ledger = DEV.get_ledger()
         FR.record_event("serve.start", widths=list(self.cfg.widths),
                         fusion=self.cfg.fusion)
@@ -1070,6 +1107,35 @@ class ShermanServer:
         started/stopped with the server when attached before
         :meth:`start`."""
         self.auditor = auditor
+
+    def attach_replica_group(self, group) -> None:
+        """Attach (or detach, with None) the replica group whose
+        follower watermarks quorum acks resolve against
+        (``cfg.ack_quorum`` > 1).  With the default ``ack_quorum=1``
+        an attached group is ignored by the write path entirely."""
+        self.replica_group = group
+
+    def _quorum_gate(self) -> None:
+        """The quorum-ack gate: with ``ack_quorum`` K > 1 and a group
+        attached, block until K-1 non-quarantined follower watermarks
+        COVER the durable journal frontier (captured now — after the
+        lane's engine op and ack record returned, so the frontier
+        bounds both).  Raises the typed ``QuorumTimeoutError`` at the
+        bounded deadline; the lane's rids are already durable in the
+        dedup window, so a client retry re-acks exactly-once.  Never
+        entered with K=1 (the shipped default): zero added work,
+        bit-identical acks."""
+        g = self.replica_group
+        need = int(self.cfg.ack_quorum) - 1
+        if g is None or need <= 0:
+            return
+        try:
+            rc = g.wait_quorum(
+                need, timeout_s=self.cfg.quorum_timeout_ms / 1e3)
+        except QuorumTimeoutError:
+            self.quorum_timeouts += 1
+            raise
+        self._note_quorum(rc["waited_ms"])
 
     def seed_dedup(self, window, rejournal: bool = True) -> int:
         """Adopt a recovered exactly-once window
@@ -1590,6 +1656,7 @@ class ShermanServer:
         if self.cfg.dedup_window <= 0:
             return reqs
         out = []
+        hits = []
         for r in reqs:
             rid = r.fut.rid
             if rid is not None:
@@ -1599,10 +1666,23 @@ class ShermanServer:
                     if cached is not None:
                         self._note_dedup_hit(st)
                         st.pending.pop(rid, None)
-                        r.fut.deduped = True
-                        r.fut._set(np.array(cached[1]))
+                        hits.append((r, cached))
                         continue
             out.append(r)
+        if hits:
+            # a re-ack honors the same quorum promise as the original
+            # ack: the retry path across a QuorumTimeoutError lands
+            # HERE, and resolving before coverage would let a K-copy
+            # ack outrun its K copies (no-op with ack_quorum=1)
+            try:
+                self._quorum_gate()
+            except QuorumTimeoutError as e:
+                for r, _ in hits:
+                    r.fut._fail(e)
+            else:
+                for r, cached in hits:
+                    r.fut.deduped = True
+                    r.fut._set(np.array(cached[1]))
         return out
 
     def _ack_batch(self, reqs, results, opcode: int,
@@ -1719,6 +1799,7 @@ class ShermanServer:
                     for r in hins]
                 self._ack_batch(hins, results, J.J_HEAP_PUT,
                                 provenance=provenance)
+                self._quorum_gate()
                 for r, ok in zip(hins, results):
                     r.fut._set(ok)
                     self.tracker.observe("insert", r.fut.n_ops,
@@ -1745,6 +1826,9 @@ class ShermanServer:
                 results = [np.ones(r.fut.n_ops, bool) if to is None
                            else ~np.isin(r.keys, to) for r in ins]
                 self._ack_batch(ins, results, J.J_UPSERT)
+                # quorum acks (PR 18): the futures below resolve only
+                # after K-1 followers cover this flush's frontier
+                self._quorum_gate()
                 for r, ok in zip(ins, results):
                     r.fut._set(ok)
                     self.tracker.observe("insert", r.fut.n_ops,
@@ -1774,6 +1858,7 @@ class ShermanServer:
                                                 for r in dels])[:-1],
                                dels)]
                 self._ack_batch(dels, results, J.J_DELETE)
+                self._quorum_gate()
                 for r, fnd in zip(dels, results):
                     r.fut._set(fnd)
                     self.tracker.observe("delete", r.fut.n_ops,
@@ -1828,6 +1913,10 @@ class ShermanServer:
             "dedup_hits": float(self.dedup_hits),
             "deadline_shed": float(self.deadline_shed),
             "duplicate_applies": float(self.duplicate_applies),
+            "ack_quorum": float(self.cfg.ack_quorum),
+            "quorum_acks": float(self.quorum_acks),
+            "quorum_timeouts": float(self.quorum_timeouts),
+            "quorum_wait_ms": round(float(self.quorum_wait_ms), 3),
         })
         return flat
 
@@ -1882,6 +1971,12 @@ class ShermanServer:
             "sealed": self._sealed,
             "retraces": self.retraces,
             "contract": contract,
+            "quorum": {
+                "ack_quorum": int(self.cfg.ack_quorum),
+                "acks": self.quorum_acks,
+                "timeouts": self.quorum_timeouts,
+                "wait_ms": round(self.quorum_wait_ms, 3),
+            },
             "request_plane": {
                 "prep_impl": {str(w): getattr(s, "prep_impl", "host")
                               for w, s in self._steps.items()},

@@ -673,6 +673,25 @@ def read_records(path: str, truncate_torn: bool = False,
     return out
 
 
+def crc_of_range(path: str, start: int, end: int) -> int:
+    """CRC32 of the raw segment bytes ``[start, end)`` — the anti-
+    entropy audit's ground truth.  A follower's tailer accumulates the
+    same rolling CRC over every byte it CONSUMED; re-reading the range
+    from the primary's file must reproduce it exactly, or the follower
+    applied bytes the chain never shipped (divergence, not lag)."""
+    with open(path, "rb") as f:
+        f.seek(max(0, int(start)))
+        crc = 0
+        left = int(end) - int(start)
+        while left > 0:
+            chunk = f.read(min(left, 1 << 20))
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            left -= len(chunk)
+    return crc
+
+
 def _truncate(path: str, off: int, size: int, do_truncate: bool) -> None:
     _OBS_TORN.inc()
     obs.record_event("journal.torn_tail", path=path, at_byte=off,

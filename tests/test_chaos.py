@@ -341,3 +341,84 @@ def test_validator_flags_torn_slot(small_cluster):
         [CH.Fault(kind="flip_entry_ver", step=0, addr=victim, slot=2)]))
     with pytest.raises(RuntimeError, match="bad_torn_slot"):
         check_structure_device(tree)
+
+
+# -- Replication fault layer (PR 18) ------------------------------------------
+
+def test_repl_fault_grammar_and_split():
+    """``repl_*`` kinds ride the same FaultPlan grammar but are split
+    into the replication layer, never the DSM hook."""
+    p = CH.FaultPlan.parse(
+        '[{"kind": "repl_drop", "poll": 2, "span": 3},'
+        ' {"kind": "wedge_lock", "step": 1, "addr": 5},'
+        ' {"kind": "repl_partition", "poll": 4, "scope": "lease"}]')
+    assert len(p.faults) == 1 and len(p.repl_faults) == 2
+    layer = p.repl_layer()
+    assert layer is not None and layer is p.repl_layer()  # cached
+    assert any("repl_drop" in d["kind"] for d in p.describe())
+    # a plan with no repl faults has no layer
+    assert CH.FaultPlan([{"kind": "wedge_lock", "step": 0,
+                          "addr": 1}]).repl_layer() is None
+    # validation is typed at construction
+    from sherman_tpu.errors import ConfigError
+    with pytest.raises(ConfigError):
+        CH.ReplFault(kind="repl_nope")
+    with pytest.raises(ConfigError):
+        CH.ReplFault(kind="repl_drop", span=0)
+    with pytest.raises(ConfigError):
+        CH.ReplFault(kind="repl_partition", scope="wat")
+    with pytest.raises(ConfigError):
+        CH.ReplChaos([]).hold("sideways")
+
+
+def test_repl_chaos_directives_deterministic():
+    """Same (plan, seed) -> the same directive sequence and the same
+    byte perturbations; the storm constructor is seed-stable too."""
+    def run(layer):
+        seq = []
+        for _ in range(30):
+            seq.append(layer.on_poll(0))
+        return seq
+
+    mk = lambda: CH.ReplChaos([
+        CH.ReplFault(kind="repl_drop", poll=1, span=2),
+        CH.ReplFault(kind="repl_delay", poll=4, span=1, follower=1),
+        CH.ReplFault(kind="repl_reorder", poll=6, span=2),
+        CH.ReplFault(kind="repl_slow", poll=9, span=1, ms=3.0),
+    ], seed=5)
+    a, b = mk(), mk()
+    assert run(a) == run(b)
+    blob = bytes(range(200)) * 2
+    assert a.view(blob) == b.view(blob) != blob
+    # follower filter: a follower-1 delay never freezes follower 0
+    c = mk()
+    d0 = [c.on_poll(0) for _ in range(6)]
+    assert not any(d and d["freeze"] for d in d0)
+    s1 = CH.ReplChaos.storm(7, n_faults=6).describe()
+    s2 = CH.ReplChaos.storm(7, n_faults=6).describe()
+    assert s1 == s2 and len(s1) == 6
+    assert all(f["scope"] == "ship" for f in s1)  # no lease noise
+
+
+def test_repl_chaos_hold_heal_and_lease_freeze():
+    """Manual holds: a ship hold partitions every poll; a lease hold
+    freezes the primary's lease view at first observation until the
+    heal restores the live table."""
+    layer = CH.ReplChaos([], seed=0)
+    assert layer.on_poll(0) is None          # zero-cost common case
+    layer.hold("ship")
+    d = layer.on_poll(0)
+    assert d and d["partition"]
+    assert not layer.exhausted
+    layer.heal()
+    assert layer.on_poll(0) is None and layer.exhausted
+    # lease scope: frozen at the FIRST view under the cut
+    layer.hold("lease")
+    assert layer.on_poll(1) is None          # ship side unaffected
+    live = {7: 1}
+    frozen = layer.lease_view(live)
+    assert frozen == {7: 1}
+    live[7] = 2                              # the epoch bump
+    assert layer.lease_view(live) == {7: 1}  # still the old world
+    layer.heal()
+    assert layer.lease_view(live) == {7: 2}  # live again

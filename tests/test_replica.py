@@ -338,3 +338,308 @@ def test_ack_provenance_roundtrip(tmp_path):
     rid, tenant, op, ok, h = acks[1]
     assert (rid, tenant, op) == (2, "t", J.J_HEAP_PUT)
     np.testing.assert_array_equal(h, prov)
+
+
+# ---------------------------------------------------------------------------
+# Partition plane (PR 18): quorum acks, the stall watchdog, the
+# replication fault layer, anti-entropy repair, split-brain fencing.
+# ---------------------------------------------------------------------------
+
+def test_partition_knobs(monkeypatch):
+    for off in ("", "0", "1", "false", "off", "no"):
+        monkeypatch.setenv("SHERMAN_ACK_QUORUM", off)
+        assert C.ack_quorum() == 1
+    monkeypatch.delenv("SHERMAN_ACK_QUORUM", raising=False)
+    assert C.ack_quorum() == 1  # primary-only acks by default
+    monkeypatch.setenv("SHERMAN_ACK_QUORUM", "3")
+    assert C.ack_quorum() == 3
+    for bad in ("lots", "-1"):
+        monkeypatch.setenv("SHERMAN_ACK_QUORUM", bad)
+        with pytest.raises(ConfigError):
+            C.ack_quorum()
+    monkeypatch.delenv("SHERMAN_TAIL_WAIT_S", raising=False)
+    assert C.tail_wait_s() == 5.0
+    monkeypatch.setenv("SHERMAN_TAIL_WAIT_S", "0.25")
+    assert C.tail_wait_s() == 0.25
+    for bad in ("0", "-2", "soon"):
+        monkeypatch.setenv("SHERMAN_TAIL_WAIT_S", bad)
+        with pytest.raises(ConfigError):
+            C.tail_wait_s()
+    monkeypatch.delenv("SHERMAN_ANTI_ENTROPY_S", raising=False)
+    assert C.anti_entropy_s() == 0.0  # no background thread shipped
+    monkeypatch.setenv("SHERMAN_ANTI_ENTROPY_S", "2.5")
+    assert C.anti_entropy_s() == 2.5
+    monkeypatch.setenv("SHERMAN_ANTI_ENTROPY_S", "-1")
+    with pytest.raises(ConfigError):
+        C.anti_entropy_s()
+
+
+def test_quorum_covers_and_wait(eight_devices, tmp_path):
+    from sherman_tpu.replica import QuorumTimeoutError
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    group = ReplicaGroup(plane, 1)
+    f = group.followers[0]
+    eng.insert(keys[:32], vals[:32] ^ np.uint64(0x5))
+    tok = group.quorum_token()
+    assert not f.tailer.covers(*tok)  # nothing pumped yet
+    rc = group.wait_quorum(1, timeout_s=30.0, token=tok)
+    assert rc["covered"] == 1 and rc["waited_ms"] >= 0.0
+    assert f.tailer.covers(*tok)
+    # a later frontier is not covered; an earlier segment is
+    assert not f.tailer.covers(tok[0], tok[1] + 10)
+    assert f.tailer.covers(tok[0].replace("-000001", "-000000"), 1)
+    assert group.quorum_acks == 1
+    # the group cannot promise more copies than it has followers
+    with pytest.raises(ConfigError):
+        group.wait_quorum(2)
+    # need 0 is the quorum-off no-op
+    assert group.wait_quorum(0)["covered"] == 0
+    # a full ship partition expires the bounded wait TYPED; the heal
+    # lets the same token resolve
+    from sherman_tpu.chaos import ReplChaos
+    chaos = ReplChaos([], seed=0)
+    group.attach_chaos(chaos)
+    chaos.hold("ship")
+    eng.insert(keys[32:48], vals[32:48])
+    with pytest.raises(QuorumTimeoutError):
+        group.wait_quorum(1, timeout_s=0.2)
+    assert group.quorum_timeouts == 1
+    chaos.heal()
+    assert group.wait_quorum(1, timeout_s=30.0)["covered"] == 1
+    # a quarantined follower counts toward NO quorum
+    f.quarantined = True
+    with pytest.raises(QuorumTimeoutError):
+        group.wait_quorum(1, timeout_s=0.2)
+    f.quarantined = False
+    plane.close()
+
+
+def test_tail_watchdog_stalled_typed(eight_devices, tmp_path):
+    """A torn tail stuck at one position past the watchdog budget:
+    lease dead (or no probe) -> typed TailStalledError; lease live ->
+    keep waiting (slow appends are legal, evented once)."""
+    import time as _time
+
+    from sherman_tpu.replica import TailStalledError
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    eng.insert(keys[:16], vals[:16])
+    rec = J.encode_record(J.J_UPSERT, np.asarray([1 << 40], np.uint64),
+                          np.asarray([7], np.uint64), rid=0xDEAD)
+    with open(eng.journal.path, "ab") as fh:
+        fh.write(rec[: len(rec) // 2])
+    t = JournalTailer(plane.dir, plane.cid)
+    t.tail_wait_s = 0.05
+    assert len(t.poll()) == 1   # consumes the whole frame, arms timer
+    _time.sleep(0.1)
+    with pytest.raises(TailStalledError):
+        t.poll()                # no probe to ask: typed, never a hang
+    assert t.stalls == 1
+    # a live lease keeps the wait: evented once, no error
+    t2 = JournalTailer(plane.dir, plane.cid)
+    t2.tail_wait_s = 0.05
+    t2.lease_probe = lambda: True
+    t2.poll()
+    _time.sleep(0.1)
+    t2.poll()
+    t2.poll()
+    assert t2.stalls == 0 and t2._stall_evented
+    plane.close()
+
+
+def test_repl_chaos_detection_through_pump(eight_devices, tmp_path):
+    """Ship-side faults through the full pump path: a drop/partition
+    poll loses the fetch (offset untouched, caught_up false — an
+    empty poll under a cut certifies nothing), a reorder poll's bytes
+    are refused by the per-frame CRC and absorbed as DETECTED, and
+    the next clean poll converges bit-for-bit."""
+    from sherman_tpu.chaos import ReplChaos, ReplFault
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    group = ReplicaGroup(plane, 1)
+    f = group.followers[0]
+    chaos = ReplChaos([
+        ReplFault(kind="repl_drop", poll=0, span=1),
+        ReplFault(kind="repl_reorder", poll=1, span=1),
+    ], seed=3)
+    group.attach_chaos(chaos)
+    eng.insert(keys[:48], vals[:48] ^ np.uint64(0x11))
+    assert group.pump() == 0          # poll 0: dropped
+    assert not f.caught_up and f.tailer.last_poll_cut
+    assert group.pump() == 0          # poll 1: reordered -> refused
+    assert f.chaos_detected == 1 and chaos.detected == 1
+    assert not f.caught_up
+    assert group.pump() == 1          # poll 2: clean retry applies
+    assert f.caught_up and chaos.exhausted
+    got, found = f.eng.search(keys[:48])
+    assert found.all()
+    np.testing.assert_array_equal(got, vals[:48] ^ np.uint64(0x11))
+    assert group.stats()["chaos_detected"] == 1
+    plane.close()
+
+
+def test_anti_entropy_detect_quarantine_repair(eight_devices, tmp_path):
+    import jax
+
+    from sherman_tpu.replica import AntiEntropy
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    group = ReplicaGroup(plane, 2, cache_slots=256)
+    eng.insert(keys[:64], vals[:64] ^ np.uint64(0x21))
+    group.pump()
+    ae = AntiEntropy(group, period_s=0, sample_rows=0)
+    assert group.anti_entropy is ae
+    rc = ae.tick()                    # clean group: nothing diverges
+    assert ae.audits == 2 and ae.divergences == 0
+    assert all(r["seg_crc_ok"] for r in rc["followers"])
+    # corrupt one follower's pool: detected, quarantined, re-shipped
+    # through the restore-then-replay core, re-admitted clean
+    victim = group.followers[1]
+    fdsm = victim.cluster.dsm
+    fdsm.pool = jax.device_put(
+        fdsm.pool.at[5, 3].set(np.int32(0x0BAD)), fdsm.shard)
+    rc = ae.tick()
+    assert ae.divergences == 1 and ae.repairs == 1
+    assert ae.unrepaired() == 0 and not victim.quarantined
+    rep = rc["followers"][1]
+    assert rep["diverged"] and rep["repair"]["ok"]
+    assert rep["repair"]["catchup_ms"] > 0
+    np.testing.assert_array_equal(
+        np.asarray(cluster.dsm.pool), np.asarray(victim.cluster.dsm.pool))
+    st = group.stats()
+    assert st["anti_entropy_audits"] == 4 and st["divergences"] == 1
+    assert st["anti_entropy_repairs"] == 1 and st["quarantined"] == 0
+    # a quarantined follower serves NO replica read and no quorum
+    victim.pump()
+    victim.quarantined = True
+    assert victim.serve_read(keys[:8]) is None
+    victim.quarantined = False
+    assert victim.serve_read(keys[:8]) is not None
+    plane.close()
+
+
+def test_anti_entropy_background_cadence(eight_devices, tmp_path):
+    import time as _time
+
+    from sherman_tpu.replica import AntiEntropy
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=200)
+    group = ReplicaGroup(plane, 1)
+    ae = AntiEntropy(group, period_s=0.05, sample_rows=8)
+    ae.start()
+    deadline = _time.monotonic() + 10.0
+    while ae.audits == 0 and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    ae.stop()
+    assert ae.audits >= 1 and ae.divergences == 0
+    # period 0 (the shipped default) never starts a thread
+    ae2 = AntiEntropy(group, period_s=0)
+    ae2.start()
+    assert ae2._thread is None
+    group.close()  # close() stops anti-entropy first
+    plane.close()
+
+
+def test_split_brain_fence_point_and_suffix(eight_devices, tmp_path):
+    """The split-brain drill's core: a lease-scope partition freezes
+    the primary's view, promotion captures the fence point, the stale
+    primary keeps acking PAST it (never shipped), the heal fires the
+    typed fence, and the fenced suffix is countable."""
+    from sherman_tpu.chaos import ReplChaos
+    cluster, tree, eng, plane, keys, vals = primary(tmp_path, n=300)
+    group = ReplicaGroup(plane, 1)
+    chaos = ReplChaos([], seed=0)
+    group.attach_chaos(chaos)
+    eng.insert(keys[:32], vals[:32] ^ np.uint64(0x31))
+    group.pump()
+    chaos.hold("lease")
+    # one write under the cut BEFORE the bump freezes the pre-bump
+    # view (and is itself pre-fence: shipped, owed)
+    eng.insert(keys[32:40], vals[32:40] ^ np.uint64(0x32))
+    rcpt = group.promote()
+    assert rcpt["fence"] is not None
+    # the stale primary cannot see its own epoch bump: it keeps
+    # acking — every byte lands past the fence point
+    eng.insert(keys[40:48], vals[40:48] ^ np.uint64(0xFE))
+    eng.insert(keys[48:56], vals[48:56] ^ np.uint64(0xFE))
+    assert group.fenced_writes == 0     # acked, not fenced (yet)
+    # the fenced suffix never ships: the winner serves the pre-fence
+    # world only
+    win = group.promoted
+    got, found = win.eng.search(keys[32:40])
+    assert found.all()
+    np.testing.assert_array_equal(got, vals[32:40] ^ np.uint64(0x32))
+    got, found = win.eng.search(keys[40:56])
+    np.testing.assert_array_equal(
+        got[found], (keys[40:56] ^ np.uint64(SALT))[found])
+    # heal: the very next write fails typed
+    chaos.heal()
+    with pytest.raises(StalePrimaryError):
+        eng.insert(keys[56:58], vals[56:58])
+    assert group.fenced_writes >= 1
+    n = group.count_fenced_suffix()
+    assert n > 0
+    assert group.stats()["fenced_suffix_records"] == n
+    plane.close()
+
+
+def test_fenced_probe_counts_merges():
+    """audit.check_fenced_rejected: a fenced (key, value) pair counts
+    as merged only when visible VERBATIM — a re-driven write's new
+    value on the same key is the contract, not a merge."""
+    from sherman_tpu import audit as A
+    state = {10: 111, 11: 222}
+
+    def read_fn(ks):
+        vals = np.asarray([state.get(int(k), 0) for k in ks],
+                          np.uint64)
+        found = np.asarray([int(k) in state for k in ks], bool)
+        return vals, found
+
+    r = A.check_fenced_rejected(read_fn, [])
+    assert r == {"fenced": 0, "merged": 0, "violations": []}
+    r = A.check_fenced_rejected(
+        read_fn, [(10, 999), (11, 222), (12, 5)])
+    assert r["fenced"] == 3 and r["merged"] == 1
+    assert r["violations"] == [{"key": 11, "fenced_value": 222,
+                                "kind": "fenced_ack_merged"}]
+
+
+# -- perfgate: the quorum wall + the partition pins ---------------------------
+
+def test_perfgate_quorum_wall_and_partition_pins(eight_devices):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import perfgate
+
+    base = {"keys": 10_000_000, "batch": 4_194_304, "value": 30e6,
+            "sustained_ops_s": 33e6, "sus_dev_ms_per_step": 70.0}
+    q1 = dict(base, config={"ack_quorum": 1})
+    q2 = dict(base, config={"ack_quorum": 2})
+    # missing == explicit 1 (the shipped default): keeps comparing
+    assert perfgate._quorum_cfg(base) == 1
+    assert perfgate._comparable(q1, base, "sustained_ops_s")
+    assert perfgate._comparable(base, q1, "sustained_ops_s")
+    # differing ack_quorum never gates, in EITHER direction
+    for a, b in ((q2, base), (base, q2), (q2, q1), (q1, q2)):
+        assert not perfgate._comparable(a, b, "sustained_ops_s")
+        assert not perfgate._comparable(a, b, "value")
+    # the repl.quorum receipt block carries the config too
+    r = dict(base, repl={"quorum": {"ack_quorum": 2}})
+    assert perfgate._quorum_cfg(r) == 2
+    # partition-drill pins: green passes on pins alone, each red
+    # fails marginless
+    green = {"metric": "partition_drill", "lost_acks": 0,
+             "duplicate_acks": 0, "linearizable": True,
+             "fenced_acks_merged": 0,
+             "diverged_followers_unrepaired": 0}
+    res = perfgate.gate(dict(green), [])
+    assert res["ok"]
+    assert "contract.fenced_acks_merged" in res["gated_metrics"]
+    assert "contract.diverged_followers_unrepaired" \
+        in res["gated_metrics"]
+    for red_field in ("fenced_acks_merged",
+                      "diverged_followers_unrepaired",
+                      "lost_acks", "duplicate_acks"):
+        red = dict(green)
+        red[red_field] = 1
+        assert not perfgate.gate(red, [])["ok"]
+    red = dict(green, linearizable=False)
+    assert not perfgate.gate(red, [])["ok"]

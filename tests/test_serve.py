@@ -1013,3 +1013,134 @@ def test_serve_future_contract():
     f2._fail(ServeOverloadError("nope"))
     with pytest.raises(ServeOverloadError):
         f2.result()
+
+
+# -- quorum acks (PR 18) ------------------------------------------------------
+
+def _quorum_rig(tmp_path, tag, n=1200):
+    """A serve engine whose writes journal through a RecoveryPlane —
+    the chain a ReplicaGroup's followers feed on (quorum acks resolve
+    against follower watermarks over THIS journal)."""
+    from sherman_tpu.recovery import RecoveryPlane
+    tree, eng, keys, vals = make(n=n, pages=1024, B=128, cap=512)
+    plane = RecoveryPlane(tree.cluster, tree, eng,
+                          str(tmp_path / tag))
+    plane.checkpoint_base()
+    return tree, eng, keys, vals, plane
+
+
+def test_quorum_config_validation(eight_devices, tmp_path):
+    """The quorum knobs refuse bad values typed, and ack_quorum > 1
+    without an attached group is a start()-time ConfigError — acking
+    K copies without K-1 followers would be a lie."""
+    with pytest.raises(ConfigError):
+        ServeConfig(widths=(128,), p99_targets_ms=targets(),
+                    ack_quorum=0)
+    with pytest.raises(ConfigError):
+        ServeConfig(widths=(128,), p99_targets_ms=targets(),
+                    quorum_timeout_ms=0.0)
+    tree, eng, keys, vals = make(n=900, pages=1024, B=128, cap=512)
+    cfg = ServeConfig(widths=(128,), p99_targets_ms=targets(),
+                      ack_quorum=2)
+    srv = ShermanServer(eng, cfg)
+    with pytest.raises(ConfigError):
+        srv.start()
+
+
+def test_quorum_off_bit_identity(eight_devices, tmp_path):
+    """ack_quorum=1 (the shipped default) with a group attached takes
+    the exact write path of a build without the quorum gate: the
+    quorum wait is never entered and the pool is bit-identical."""
+    from sherman_tpu.replica import ReplicaGroup
+    pools = []
+    for tag, attach in (("bi-off", False), ("bi-on", True)):
+        tree, eng, keys, vals, plane = _quorum_rig(tmp_path, tag)
+        cfg = ServeConfig(widths=(128,), p99_targets_ms=targets(),
+                          write_linger_ms=0.0)
+        assert cfg.ack_quorum == 1  # SHERMAN_ACK_QUORUM default
+        srv = ShermanServer(eng, cfg)
+        group = None
+        if attach:
+            group = ReplicaGroup(plane, 1)
+            srv.attach_replica_group(group)
+        srv.start()
+        try:
+            kreq = keys[:256]
+            vreq = kreq ^ np.uint64(0xC0DE)
+            srv.submit("insert", kreq, vreq).result(timeout=60)
+            srv.submit("delete", keys[300:316]).result(timeout=60)
+            srv.drain()
+            assert srv.quorum_acks == 0  # the gate never ran
+        finally:
+            srv.stop()
+        pools.append(np.asarray(tree.cluster.dsm.pool))
+        if group is not None:
+            group.close()
+        plane.close()
+    assert pools[0].shape == pools[1].shape
+    assert bool(np.all(pools[0] == pools[1])), \
+        "quorum-off write path diverged from the no-group build"
+
+
+def test_quorum_gate_end_to_end(eight_devices, tmp_path):
+    """ack_quorum=2 through the front door: acks resolve only after a
+    follower's durable watermark covers them (counters in stats()),
+    a full ship partition expires the bounded wait TYPED, and the
+    same-rid retry after the heal re-acks through the dedup window
+    (exactly-once across quorum retries)."""
+    from sherman_tpu.chaos import ReplChaos
+    from sherman_tpu.replica import QuorumTimeoutError, ReplicaGroup
+    tree, eng, keys, vals, plane = _quorum_rig(tmp_path, "gate")
+    group = ReplicaGroup(plane, 1)
+    chaos = ReplChaos([], seed=0)
+    group.attach_chaos(chaos)
+    cfg = ServeConfig(widths=(128,), p99_targets_ms=targets(),
+                      write_linger_ms=0.0, ack_quorum=2,
+                      quorum_timeout_ms=400.0)
+    srv = ShermanServer(eng, cfg)
+    srv.attach_replica_group(group)
+    srv.start()
+    try:
+        kreq = keys[:48]
+        vreq = kreq ^ np.uint64(0xACDC)
+        ok = srv.submit("insert", kreq, vreq, tenant="q") \
+                .result(timeout=60)
+        assert int(np.sum(ok)) > 0
+        assert srv.quorum_acks >= 1
+        q = srv.stats()["quorum"]
+        assert q["ack_quorum"] == 2 and q["acks"] >= 1 \
+            and q["timeouts"] == 0
+        # the resolved ack's frontier is durably covered downstream
+        tok = group.quorum_token()
+        assert group.followers[0].tailer.covers(*tok)
+        # full ship partition: the bounded wait expires typed
+        chaos.hold("ship")
+        rid = (0x77 << 32) | 3
+        k2 = keys[64:80]
+        v2 = k2 ^ np.uint64(0xD1CE)
+        t0 = time.perf_counter()
+        with pytest.raises(Exception) as ei:
+            srv.submit("insert", k2, v2, tenant="q",
+                       rid=rid).result(timeout=30)
+        tip, typed = ei.value, False
+        while tip is not None:
+            if isinstance(tip, QuorumTimeoutError):
+                typed = True
+                break
+            tip = tip.__cause__
+        assert typed, f"untyped quorum expiry: {ei.value!r}"
+        assert time.perf_counter() - t0 < 5.0, "wait was not bounded"
+        assert srv.quorum_timeouts >= 1
+        # heal -> the SAME rid re-acks the original result (dedup),
+        # never a second apply; the re-ack honors the quorum promise
+        chaos.heal()
+        fut = srv.submit("insert", k2, v2, tenant="q", rid=rid)
+        ok2 = fut.result(timeout=60)
+        assert fut.deduped, "quorum retry re-applied, not re-acked"
+        assert np.asarray(ok2).shape == k2.shape
+        assert srv.duplicate_applies == 0
+        srv.drain()
+    finally:
+        srv.stop()
+    group.close()
+    plane.close()

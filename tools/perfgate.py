@@ -75,6 +75,17 @@ Comparability rules (the trajectory's own lessons):
   write R more times in the same process.  Failover-drill receipts
   carry the same marginless hard-red pins as contract receipts
   (``lost_acks`` / ``duplicate_acks`` / ``linearizable``);
+- QUORUM ACKS (PR 18) are incomparable config: a receipt whose
+  effective ``ack_quorum`` differs (the ``repl.quorum.ack_quorum`` /
+  ``config.ack_quorum`` field; missing = 1, the shipped primary-only
+  default) never throughput-gates in EITHER direction — a
+  quorum-gated ack waits on follower durability the primary-only ack
+  never pays, and comparing the other way would launder the wait as a
+  win.  Partition-drill receipts (``tools/partition_drill.py``,
+  metric ``partition_drill``) carry the contract hard-red pins plus
+  two of their own, ``fenced_acks_merged > 0`` and
+  ``diverged_followers_unrepaired > 0`` — each a zero-tolerance
+  split-brain/divergence verdict, marginless;
 - a PREP-PLACEMENT change is incomparable config (PR 17): rows whose
   ``config.prep_impl`` or ``config.write_combine`` differ never
   throughput-gate against each other — host prep serializes
@@ -231,10 +242,25 @@ def _replicated(r: dict) -> bool:
     unreplicated fact (replication is OFF by default), so the whole
     committed trajectory keeps comparing."""
     if isinstance(r.get("repl"), dict) \
-            or r.get("metric") == "failover_drill":
+            or r.get("metric") in ("failover_drill",
+                                   "partition_drill"):
         return True
     return bool(r.get("replicas")
                 or (r.get("config") or {}).get("replicas"))
+
+
+def _quorum_cfg(r: dict) -> int:
+    """The receipt's effective ``ack_quorum`` (PR 18).  Missing
+    everywhere = 1, the shipped primary-only default — so the whole
+    committed trajectory keeps comparing.  Quorum-gated rounds wait
+    on follower durability per ack; they never throughput-gate
+    against primary-only rounds in either direction."""
+    q = (r.get("repl") or {}).get("quorum")
+    if isinstance(q, dict) and q.get("ack_quorum"):
+        return int(q["ack_quorum"])
+    return int(r.get("ack_quorum")
+               or (r.get("config") or {}).get("ack_quorum")
+               or (r.get("serve") or {}).get("ack_quorum") or 1)
 
 
 def _comparable(cand: dict, r: dict, metric: str) -> bool:
@@ -250,6 +276,11 @@ def _comparable(cand: dict, r: dict, metric: str) -> bool:
     # process — its walls and throughputs never gate against
     # unreplicated rounds (and vice versa)
     if _replicated(cand) != _replicated(r):
+        return False
+    # quorum-ack wall (PR 18): differing effective ack_quorum never
+    # gates in either direction — the K>1 ack pays a follower-
+    # durability wait the primary-only ack does not
+    if _quorum_cfg(cand) != _quorum_cfg(r):
         return False
     if metric.startswith("serve_"):
         # per-class p99 gates only between rounds aiming at the SAME
@@ -433,9 +464,16 @@ def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
     # `duplicate_acks > 0`, `lost_acks > 0` or `linearizable == false`
     # is a hard red with no margin: each is a count/verdict of a
     # correctness hazard, not a wall.
-    if cand.get("metric") in ("contract_drill", "failover_drill") \
-            or "duplicate_acks" in cand or "linearizable" in cand:
-        for name in ("duplicate_acks", "lost_acks"):
+    if cand.get("metric") in ("contract_drill", "failover_drill",
+                              "partition_drill") \
+            or "duplicate_acks" in cand or "linearizable" in cand \
+            or "fenced_acks_merged" in cand:
+        # partition-drill pins (PR 18) ride the same marginless rule:
+        # a merged fenced ack or an unrepaired diverged follower is a
+        # split-brain/divergence verdict, not a wall
+        for name in ("duplicate_acks", "lost_acks",
+                     "fenced_acks_merged",
+                     "diverged_followers_unrepaired"):
             val = cand.get(name)
             if val is None:
                 continue
