@@ -185,6 +185,22 @@ def _lint_clean() -> bool | None:
         return None
 
 
+@functools.lru_cache(maxsize=1)
+def _multihost_capable_stamp() -> bool | None:
+    """Can this jaxlib run CPU multiprocess collectives?  Stamped into
+    the JSON ``config`` block so chip-session artifacts are
+    self-describing about which transport a multihost number exercised
+    (emulated host contexts vs a real process-spanning mesh).  Probed
+    once per run via two short-lived subprocesses
+    (``sherman_tpu.multihost.multihost_capable``); None, never a
+    crash, when the probe itself cannot run."""
+    try:
+        from sherman_tpu.multihost import multihost_capable
+        return multihost_capable()[0]
+    except Exception:
+        return None
+
+
 def run(n_keys: int, batch: int, secs: float, theta: float,
         combine_env: str) -> dict:
     import jax
@@ -194,7 +210,7 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
     from sherman_tpu.obs import device as dev_obs
     from sherman_tpu.cluster import Cluster
     from sherman_tpu.config import (DSMConfig, LEAF_CAP, TreeConfig,
-                                    prep_impl, staged_fusion,
+                                    hosts, prep_impl, staged_fusion,
                                     write_combine)
     from sherman_tpu.models import batched
     from sherman_tpu.models.btree import Tree
@@ -1258,6 +1274,15 @@ def run(n_keys: int, batch: int, secs: float, theta: float,
             # device-prep runs don't pay.
             "prep_impl": prep_impl(),
             "write_combine": write_combine(),
+            # multihost service plane (PR 19): how many hosts' front
+            # doors/journals this run spanned (SHERMAN_HOSTS; the
+            # closed-loop bench itself is single-host, so this stamps
+            # the knob for honesty) and whether THIS jaxlib could run
+            # real cross-process collectives.  perfgate treats a
+            # differing host count as INCOMPARABLE (the nodes rule's
+            # pattern): N journal streams ack in parallel.
+            "hosts": hosts(),
+            "multihost_capable": _multihost_capable_stamp(),
         },
         # hot-key tier receipt (models/leaf_cache.py; None = cache off,
         # the shipped default — optional block, schema stays 3).
@@ -1466,6 +1491,24 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import partition_drill
         partition_drill.main(sys.argv[1:])
+        return
+
+    if "--multihost-drill" in sys.argv:
+        # Multihost lane: the pod-scale service plane rehearsed end to
+        # end (per-host chain ownership in one shared directory -> the
+        # routed cross-host front door with owner-journal acks ->
+        # per-host delta checkpoints -> crash with ONE host's journal
+        # tail torn -> union recovery with the merged acked-op ledger
+        # audited -> a follower on host B tailing host A's chain ->
+        # the shared-vs-per-host journal ack-bandwidth A/B), pinning
+        # rpo_ops == 0, lost_acks == 0, linearizable == true and
+        # ack-bandwidth speedup >= 1.5x.  tools/multihost_drill.py
+        # owns the sequence; it prints its own one-line JSON receipt.
+        sys.argv.remove("--multihost-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import multihost_drill
+        multihost_drill.main(sys.argv[1:])
         return
 
     if "--reshard-drill" in sys.argv:

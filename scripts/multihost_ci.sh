@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# Multihost service-plane CI lane: pin the per-host journal/chain
+# ownership + cross-host front door plane (sherman_tpu/multihost.py
+# HostRouter/MultihostService/merge_host_stats + recovery.py per-host
+# namespaces/recover_union + replica.py cross-host tailing).
+#
+# Runs (1) the multihost fast tier — the host knobs, the deterministic
+# key->owner router, split-submit/merge order, scan refusal, chain
+# namespace naming (legacy un-tagged at hosts=1), host-scoped stale
+# sweeps, union-recovery edge cases (torn tail on one host, typed
+# missing links), the cross-host tailer seam, and the perfgate
+# host-count wall + drill pins; (2) a single-host bit-identity pin —
+# a plane built with the knobs at their shipped defaults emits the
+# SAME artifact names and byte-identical journal frames as one built
+# with no knobs at all; and (3) the emulated 2-host drill end to end
+# with its receipt pins asserted and perfgate run on the live receipt.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== multihost fast tier (router, front door, union recovery) =="
+python -m pytest tests/test_multihost_plane.py -q
+python -m pytest \
+    tests/test_recovery.py::test_recovery_plane_crash_rpo_zero \
+    -q
+
+echo "== single-host bit-identity pin (hosts=1 == pre-plane build) =="
+python - <<'EOF'
+import glob
+import os
+import re
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig, TreeConfig
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.recovery import RecoveryPlane
+
+def build(rdir, **plane_kw):
+    cfg = DSMConfig(machine_nr=4, pages_per_node=512, locks_per_node=256,
+                    step_capacity=256, chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=128,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    keys = np.arange(1, 301, dtype=np.uint64) * np.uint64(7919)
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0xABCD))
+    eng.attach_router()
+    plane = RecoveryPlane(cluster, tree, eng, rdir, **plane_kw)
+    plane.checkpoint_base()
+    eng.insert(keys[:64], keys[:64] ^ np.uint64(0x11))
+    assert eng.delete(keys[64:72]).all()
+    jpath = eng.journal.path
+    blob = open(jpath, "rb").read()
+    plane.close()
+    return sorted(os.path.basename(f)
+                  for f in glob.glob(os.path.join(rdir, "*"))), \
+        os.path.basename(jpath), blob
+
+with tempfile.TemporaryDirectory() as da, \
+        tempfile.TemporaryDirectory() as db:
+    # no knobs at all vs the knobs at their shipped defaults
+    names_a, jname_a, jblob_a = build(da)
+    names_b, jname_b, jblob_b = build(db, host_id=0, hosts=1)
+assert jblob_a == jblob_b, "journal frames differ at hosts=1 defaults"
+pat = re.compile(r"^(base\.npz|delta-[0-9a-f]{8}-\d{6}\.npz|"
+                 r"journal-[0-9a-f]{8}-\d{6}\.wal)$")
+for names in (names_a, names_b):
+    assert all(pat.match(n) for n in names), names  # legacy, un-tagged
+    assert not any("-h" in n for n in names), names
+assert [pat.match(n).re for n in names_a] == \
+    [pat.match(n).re for n in names_b]
+print("bit-identity pin: hosts=1 defaults emit legacy names,",
+      f"journal bytes identical ({len(jblob_a)} B)")
+EOF
+
+echo "== multihost drill (2 emulated hosts, union recovery, A/B) =="
+SHERMAN_MULTIHOST_RECEIPT=/tmp/_multihost_ci.json \
+    python bench.py --multihost-drill --keys 3000
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/_multihost_ci.json"))
+assert d["ok"], "drill not ok"
+assert d["hosts"] == 2, d["hosts"]
+assert d["rpo_ops"] == 0, f"acked ops lost in union recovery: {d['rpo_ops']}"
+assert d["lost_acks"] == 0, f"lost acks: {d['lost_acks']}"
+assert d["linearizable"] is True, "history not linearizable"
+assert "-h0-" in d["torn"], "the torn tail was not host 0's segment"
+assert d["union"]["replay"]["deletes"] > 0, "no deletes in replay (mixed)"
+assert d["tail"]["of_host"] == 0 and d["tail"]["applied_records"] > 0, \
+    "cross-host follower never shipped host 0's chain"
+assert d["tail"]["reads_served"] > 0, "no certified replica reads"
+ab = d["ack_bandwidth"]
+assert ab["speedup"] >= 1.5, \
+    f"per-host ack bandwidth {ab['speedup']}x < 1.5x shared"
+assert d["obs"]["multihost.split_submits"] > 0, "no split submits"
+print("multihost drill:", d["hosts"], "hosts, split",
+      d["key_split"], "keys;", d["audit"]["events"], "events audited,",
+      d["audit"]["reads_checked"], "reads checked; ack bandwidth",
+      f"{ab['speedup']}x per-host vs shared",
+      f"({ab['speedup_vs_percommit']}x vs per-commit, published)")
+EOF
+
+echo "== perfgate: committed multihost receipt passes on its pins =="
+python tools/perfgate.py --receipt /tmp/_multihost_ci.json --json
+echo "MULTIHOST-CI PASS"

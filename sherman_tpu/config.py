@@ -320,6 +320,54 @@ def anti_entropy_s() -> float:
     return s
 
 
+def hosts() -> int:
+    """Host-plane width knob (``SHERMAN_HOSTS``): how many hosts the
+    multihost service plane spans — per-host journal/chain ownership,
+    per-host ingress dispatchers, and key routing by owner host
+    (``sherman_tpu/multihost.py``).
+
+    1 is the SHIPPED DEFAULT (standing guardrail): no host plane — one
+    front door, one journal stream, legacy un-tagged chain artifact
+    names, bit-identical to a build without the plane.  ``N > 1``
+    gives every host its own chain namespace (``base-h<i>.npz`` /
+    ``delta-h<i>-...`` / ``journal-h<i>-...``) and one Nth of the key
+    space; on CPU builds without multiprocess collectives the plane
+    runs EMULATED (N host contexts in one process — the protocol/file
+    paths are real, the transport is not)."""
+    import os
+    v = os.environ.get("SHERMAN_HOSTS", "1").strip().lower()
+    if v in ("", "0", "1", "false", "off", "no"):
+        return 1
+    try:
+        n = int(v)
+    except ValueError:
+        raise ConfigError(f"SHERMAN_HOSTS={v!r}: want a host count >= 1")
+    if n < 1:
+        raise ConfigError(f"SHERMAN_HOSTS={n}: want >= 1")
+    return n
+
+
+def host_id() -> int:
+    """This process's host index knob (``SHERMAN_HOST_ID``): which
+    host of the ``SHERMAN_HOSTS``-wide plane this process IS on a real
+    pod (one process per host, each owning its chain namespace and
+    key range).  0 is the SHIPPED DEFAULT and the only legal value
+    when ``SHERMAN_HOSTS=1``; emulated (single-process) planes ignore
+    it — they construct every host context themselves."""
+    import os
+    v = os.environ.get("SHERMAN_HOST_ID", "0").strip()
+    try:
+        h = int(v) if v else 0
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_HOST_ID={v!r}: want a host index >= 0")
+    n = hosts()
+    if not (0 <= h < n):
+        raise ConfigError(
+            f"SHERMAN_HOST_ID={h}: want in [0, SHERMAN_HOSTS={n})")
+    return h
+
+
 @dataclasses.dataclass(frozen=True)
 class DSMConfig:
     """Cluster + memory-pool shape (reference ``Config.h:13-22``).

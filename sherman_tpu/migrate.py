@@ -134,8 +134,15 @@ class Migrator:
                  target_locks_per_node: int | None = None,
                  batch_pages: int | None = None):
         if cluster.dsm.multihost:
+            # migration's lock-leased batch copies assume one driver
+            # per POOL; the multihost service plane (PR 19) scopes a
+            # migration to one host context at a time (each host's
+            # chain namespace re-bases independently) — driving the
+            # copy loop from N processes at once stays out of scope
             raise MultiprocessUnsupportedError(
-                "online migration is single-process only")
+                "online migration drives one process per pool: run it "
+                "inside a single host context (the multihost service "
+                "plane migrates per-host contexts one at a time)")
         if not 1 <= int(target_nodes) <= C.MAX_MACHINE:
             raise ConfigError(f"target_nodes={target_nodes} out of range")
         self.cluster = cluster

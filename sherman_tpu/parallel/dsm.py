@@ -40,8 +40,7 @@ import numpy as np
 from sherman_tpu import config as CFG
 from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig, PAGE_WORDS
-from sherman_tpu.errors import (ConfigError, MultiprocessUnsupportedError,
-                                ProtocolError)
+from sherman_tpu.errors import ConfigError, ProtocolError
 from sherman_tpu.ops import bits
 from sherman_tpu.parallel import transport
 from sherman_tpu.parallel.mesh import AXIS, make_mesh, node_sharding
@@ -540,11 +539,12 @@ class DSM(_HostOps):
         self._heap_dirty_host: set[int] = set()
         self._heap_write = None
         if cfg.heap_pages_per_node > 0:
-            if self.multihost:
-                raise MultiprocessUnsupportedError(
-                    "the value heap is single-process only (like delta "
-                    "checkpoints); unset heap_pages_per_node on "
-                    "multihost meshes")
+            # multihost allocation rides the same make_array_from_
+            # callback path as the pool (PR 19): ownership is row-
+            # range-based — each process's allocator hands out slabs
+            # from its OWN nodes' heap rows only (global-row handles
+            # stay valid everywhere; only allocation is local), so no
+            # cross-host allocator coordination exists to get wrong.
             self.heap = _zeros((N * cfg.heap_pages_per_node, PAGE_WORDS),
                                jnp.int32)
         # Dirty-page tracking (the recovery plane's delta-checkpoint
@@ -689,17 +689,25 @@ class DSM(_HostOps):
     _POOL_WRITE_OPS = (OP_WRITE, OP_WRITE_WORD, OP_CAS, OP_FAA,
                        OP_MASKED_CAS, OP_MASKED_FAA)
 
+    def local_row_range(self) -> tuple[int, int]:
+        """``[lo, hi)`` global pool rows owned by THIS process — the
+        row-range ownership basis of the multihost service plane
+        (PR 19).  Single-process: the whole pool.  Global-row
+        addressing means a reshard never rewrites a handle; ownership
+        is just which process's dirty tracking / delta artifacts a row
+        lands in."""
+        P = self.cfg.pages_per_node
+        return (self.local_nodes.start * P, self.local_nodes.stop * P)
+
     def _mark_dirty_from_reqs(self, reqs) -> None:
         """One address-set union per host step: every pool-space request
         that CAN mutate its page marks that page dirty (CAS losers
         over-mark — a harmless extra delta row, never a missed one).
         Pure numpy (no device trip); out-of-range addresses are the
         requests _apply refuses with ok=0 — skipped here too.
-        Multihost: deltas are unsupported there (dirty_rows raises, the
-        collective checkpoint never clears) — don't grow an
-        unconsumable set on a long-running server."""
-        if self.multihost:
-            return
+        Multihost: only LOCALLY-OWNED rows are tracked (row-range
+        ownership, PR 19) — a remote-node write is the remote process's
+        to track, from its own copy of the same collective step."""
         op = np.asarray(reqs["op"]).ravel()
         wr = np.isin(op, self._POOL_WRITE_OPS) \
             & (np.asarray(reqs["space"]).ravel() == SPACE_POOL)
@@ -711,30 +719,79 @@ class DSM(_HostOps):
         page = a & CFG.ADDR_PAGE_MASK
         ok = (node < self.cfg.machine_nr) & (page < self.cfg.pages_per_node)
         rows = node[ok] * self.cfg.pages_per_node + page[ok]
+        if self.multihost:
+            lo, hi = self.local_row_range()
+            rows = rows[(rows >= lo) & (rows < hi)]
         self._dirty_host.update(int(r) for r in np.unique(rows))
 
     def mark_dirty_rows(self, rows) -> None:
         """Explicitly mark global pool rows dirty (direct pool installs
         — bulk_load — whose writes bypass the step/request path).
-        No-op on multihost (deltas unsupported: nothing ever consumes
-        or clears the set there)."""
+        Multihost: rows outside this process's ownership range are
+        dropped (the owner marks them from its own call)."""
+        rows = np.asarray(rows, np.int64).ravel()
         if self.multihost:
-            return
-        self._dirty_host.update(int(r) for r in np.asarray(rows).ravel())
+            lo, hi = self.local_row_range()
+            rows = rows[(rows >= lo) & (rows < hi)]
+        self._dirty_host.update(int(r) for r in rows)
 
     def dirty_rows(self) -> np.ndarray:
         """Sorted global pool rows written since the last clear: the
         device mask (engine write programs) united with the host set
-        (DSM.step boundary + direct installs).  Single-process only —
-        multihost deltas are unsupported (full per-host checkpoints)."""
+        (DSM.step boundary + direct installs).  Multihost: THIS
+        process's owned rows only — the device mask is read from the
+        addressable shards (collective-free; each shard's mesh
+        position gives its global row offset), and the host set was
+        ownership-filtered at mark time.  The union of every host's
+        return IS the cluster's dirty set, disjoint by construction —
+        the per-host delta artifacts the union recovery replays."""
         if self.multihost:
-            raise MultiprocessUnsupportedError("dirty_rows is single-process only")
+            P = self.cfg.pages_per_node
+            parts = [self._dirty_host]
+            for s in self.dirty.addressable_shards:
+                off = s.index[0].start or 0
+                loc = np.nonzero(np.asarray(s.data))[0]
+                parts.append(set((loc + off).tolist()))
+            allr = set().union(*parts)
+            return np.array(sorted(allr), np.int64)
         dev = np.nonzero(np.asarray(self.dirty))[0].astype(np.int64)
         if not self._dirty_host:
             return dev
         host = np.fromiter(self._dirty_host, np.int64,
                            len(self._dirty_host))
         return np.union1d(dev, host)
+
+    def read_rows_local(self, rows, region: str = "pool") -> np.ndarray:
+        """Gather pool/heap rows host-side from this process's
+        ADDRESSABLE shards only — the collective-free gather the
+        per-host delta save needs on a process-spanning mesh (a global
+        fancy-index there would be a cross-host collective).  ``rows``
+        must lie in :meth:`local_row_range` (scaled to the heap's rows
+        for ``region="heap"``); out-of-range rows raise."""
+        import jax.numpy as _jnp
+        arr = self.heap if region == "heap" else self.pool
+        if arr is None:
+            raise ConfigError("no value heap configured")
+        rows = np.asarray(rows, np.int64).ravel()
+        if rows.size == 0:
+            return np.zeros((0, arr.shape[1]), np.int32)
+        if not self.multihost:
+            return np.asarray(arr[_jnp.asarray(rows)])
+        out = np.zeros((rows.size, arr.shape[1]), np.int32)
+        seen = np.zeros(rows.size, bool)
+        for s in arr.addressable_shards:
+            off = s.index[0].start or 0
+            n = s.data.shape[0]
+            sel = (rows >= off) & (rows < off + n)
+            if sel.any():
+                out[sel] = np.asarray(s.data)[rows[sel] - off]
+                seen |= sel
+        if not seen.all():
+            raise ConfigError(
+                f"read_rows_local: {int((~seen).sum())} row(s) outside "
+                "this process's addressable shards — gather them on "
+                "their owner host")
+        return out
 
     # -- value-heap region (the second DSM region) ---------------------------
     # Word-cell writes + page reads over ``self.heap``.  The slab/handle
@@ -818,10 +875,8 @@ class DSM(_HostOps):
         """Register a callable handed the dirty rows at every
         :meth:`clear_dirty` (BEFORE the reset) — the second-consumer
         contract for the dirty tracking (see ``_dirty_sinks``).
-        Single-process only (dirty tracking itself is)."""
-        if self.multihost:
-            raise MultiprocessUnsupportedError(
-                "dirty sinks are single-process only")
+        Multihost: the sink sees this process's OWNED rows only
+        (:meth:`dirty_rows`' row-range contract)."""
         self._dirty_sinks.append(fn)
 
     def remove_dirty_sink(self, fn) -> None:
@@ -832,7 +887,7 @@ class DSM(_HostOps):
         """Reset both dirty tiers (a checkpoint artifact captured them).
         Registered dirty sinks see the rows first — a clear must not
         hide writes from a concurrent consumer (migration re-copy)."""
-        if self._dirty_sinks and not self.multihost:
+        if self._dirty_sinks:
             rows = self.dirty_rows()
             if rows.size:
                 for fn in list(self._dirty_sinks):

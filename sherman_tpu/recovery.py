@@ -36,6 +36,29 @@ of a superseded chain can never be mistaken for the live one: after a
 crash + recover, the plane re-bases (new cid) and stale files are both
 ignored by discovery and swept.
 
+**Per-host chain ownership (the multihost service plane, PR 19).**
+With ``hosts > 1`` each host owns ONE journal stream and its own chain
+namespace in the shared directory — every artifact name carries the
+owner's host tag::
+
+    base-h<host>.npz
+    delta-h<host>-<cid>-000001.npz ...
+    journal-h<host>-<cid>-000001.wal ...
+
+so N hosts append/fsync/rotate/sweep fully independently (N fsync
+streams instead of one — the ack-bandwidth multiplier the drill
+measures), and recovery becomes :meth:`RecoveryPlane.recover_union`:
+the union of per-host chains, each restored + replayed independently in
+its own (host, cid, seq) order.  Cross-host replay order is immaterial
+by construction — the front door routes every key to exactly one owner
+host, so no two hosts' journals ever carry records for the same key.
+Each host's epoch/nonce machinery is likewise independent: a torn tail
+or re-based chain on one host never blocks another host's replay, and a
+host's stale-cid sweep touches only its OWN ``-h<host>-`` artifacts.
+``hosts == 1`` (the shipped default) keeps the legacy un-tagged names
+byte for byte — a single-host deployment's artifacts are bit-identical
+to a build without the plane.
+
 The crash contract, window by window:
 
 - crash before a journal append completes: the op was never acked; the
@@ -89,6 +112,20 @@ def _cid_of(epoch) -> str:
     return f"{int(np.asarray(epoch).ravel()[0]) & 0xFFFFFFFF:08x}"
 
 
+def _base_name(host_id: int | None) -> str:
+    """Base-artifact filename of one host's chain.  ``None`` = the
+    legacy single-host namespace (un-tagged names, bit-identical to
+    pre-multihost builds)."""
+    return "base.npz" if host_id is None else f"base-h{int(host_id)}.npz"
+
+
+def _host_tag(host_id: int | None) -> str:
+    """The artifact-name infix of one host's chain namespace: deltas
+    and journals are ``delta<tag>-<cid>-k.npz`` / ``journal<tag>-<cid>-
+    k.wal`` with ``tag = "-h<id>"`` (empty for the legacy namespace)."""
+    return "" if host_id is None else f"-h{int(host_id)}"
+
+
 class RecoveryPlane:
     """Durability coordinator over one (cluster, tree, engine) triple.
 
@@ -102,9 +139,28 @@ class RecoveryPlane:
     def __init__(self, cluster, tree, eng, directory: str,
                  journal_sync: bool = True,
                  group_commit_ms: float = 0.0,
-                 ack_carry: int = 65536):
-        if cluster.dsm.multihost:
-            raise MultiprocessUnsupportedError("RecoveryPlane is single-process only")
+                 ack_carry: int = 65536,
+                 host_id: int = 0, hosts: int = 1):
+        if cluster.dsm.multihost and int(hosts) <= 1:
+            # a process-spanning mesh with NO host plane configured has
+            # no per-host chain namespace to own — the pre-PR-19 wall.
+            # The multihost service plane (sherman_tpu/multihost.py)
+            # constructs one plane per host with hosts > 1 instead.
+            raise MultiprocessUnsupportedError(
+                "RecoveryPlane on a multihost mesh needs per-host chain "
+                "ownership: pass hosts=<N>, host_id=<this host> (the "
+                "multihost service plane does; see sherman_tpu/"
+                "multihost.py)")
+        if not (0 <= int(host_id) < int(hosts)):
+            raise StateError(
+                f"host_id={host_id} outside [0, hosts={hosts})")
+        #: this plane's position in the host plane: ``hosts == 1`` is
+        #: the shipped default (legacy un-tagged artifact names, bit-
+        #: identical to pre-multihost builds); ``hosts > 1`` scopes
+        #: every artifact + sweep to the ``-h<host_id>-`` namespace
+        self.hosts = int(hosts)
+        self.host_id = int(host_id)
+        self._htag: int | None = self.host_id if self.hosts > 1 else None
         #: exactly-once ack entries carried across journal rotations
         #: (most-recent wins; bounds the re-forwarded window)
         self.ack_carry = int(ack_carry)
@@ -118,7 +174,7 @@ class RecoveryPlane:
         # concurrent ops coalesce into one fsync per window
         self.group_commit_ms = float(group_commit_ms)
         os.makedirs(directory, exist_ok=True)
-        self.base_path = os.path.join(directory, "base.npz")
+        self.base_path = os.path.join(directory, _base_name(self._htag))
         self.cid: str | None = None
         self.delta_paths: list[str] = []
         self._tip_epoch = None
@@ -156,19 +212,28 @@ class RecoveryPlane:
     # -- artifact naming ------------------------------------------------------
 
     def _delta_path(self, k: int) -> str:
-        return os.path.join(self.dir, f"delta-{self.cid}-{k:06d}.npz")
+        return os.path.join(
+            self.dir, f"delta{_host_tag(self._htag)}-{self.cid}-{k:06d}.npz")
 
     def _journal_path(self, k: int) -> str:
-        return os.path.join(self.dir, f"journal-{self.cid}-{k:06d}.wal")
+        return os.path.join(
+            self.dir,
+            f"journal{_host_tag(self._htag)}-{self.cid}-{k:06d}.wal")
 
     @staticmethod
-    def _discover(directory: str):
+    def _discover(directory: str, host_id: int | None = None):
         """-> (cid, delta_paths, journal_paths) of the on-disk chain
-        anchored at base.npz; stale-cid artifacts are ignored."""
-        base = os.path.join(directory, "base.npz")
+        anchored at this namespace's base; stale-cid artifacts are
+        ignored.  ``host_id=None`` (the default) discovers the legacy
+        un-tagged chain; an integer discovers that host's ``-h<id>-``
+        chain only — one host's artifacts are invisible to another
+        host's discovery by name."""
+        tag = _host_tag(host_id)
+        base = os.path.join(directory, _base_name(host_id))
         if not os.path.exists(base):
             raise FileNotFoundError(
-                f"{directory}: no base.npz — nothing to recover")
+                f"{directory}: no {_base_name(host_id)} — nothing to "
+                "recover")
         epoch = CK._load_arrays(base, keys=("epoch",)).get("epoch")
         if epoch is None:
             raise CK.CheckpointCorruptError(
@@ -176,18 +241,27 @@ class RecoveryPlane:
                 "artifact) — cannot anchor a chain")
         cid = _cid_of(epoch)
         deltas = sorted(glob.glob(
-            os.path.join(directory, f"delta-{cid}-*.npz")))
+            os.path.join(directory, f"delta{tag}-{cid}-*.npz")))
         journals = sorted(glob.glob(
-            os.path.join(directory, f"journal-{cid}-*.wal")))
+            os.path.join(directory, f"journal{tag}-{cid}-*.wal")))
         return cid, deltas, journals
 
     def _sweep_stale(self) -> int:
         """Remove artifacts whose cid is not the live chain's (a
-        superseded chain after a re-base)."""
+        superseded chain after a re-base).  Host-scoped: with
+        ``hosts > 1`` only THIS host's ``-h<id>-`` namespace is swept —
+        another host's live chain (same directory, different tag, its
+        own cids) is never this host's to judge."""
+        tag = _host_tag(self._htag)
         n = 0
-        for f in glob.glob(os.path.join(self.dir, "delta-*.npz")) \
-                + glob.glob(os.path.join(self.dir, "journal-*.wal")):
+        for f in glob.glob(os.path.join(self.dir, f"delta{tag}-*.npz")) \
+                + glob.glob(os.path.join(self.dir,
+                                         f"journal{tag}-*.wal")):
             name = os.path.basename(f)
+            if self._htag is None and name.split("-")[1].startswith("h"):
+                # legacy sweep never touches host-tagged chains (a cid
+                # is 8 hex digits — it can never start with 'h')
+                continue
             if self.cid is not None and f"-{self.cid}-" in name:
                 continue
             try:
@@ -226,15 +300,9 @@ class RecoveryPlane:
         if old is not None:
             old.close()
             try:
-                carry: dict = {}
-                for kind, _keys, aux in J.read_records(old.path):
-                    if kind == J.J_ACK:
-                        # star-unpack: provenance-bearing entries (heap
-                        # writes, PR 16) are 5-tuples and carry forward
-                        # whole — re-encoding preserves the handles
-                        for entry in aux:
-                            rid, tenant = entry[0], entry[1]
-                            carry[(tenant, rid)] = entry
+                # provenance-bearing entries (heap writes, PR 16)
+                # carry forward whole — re-encoding preserves handles
+                carry = J.read_acks(old.path)
                 acks = list(carry.values())[-self.ack_carry:] \
                     if self.ack_carry > 0 else []
                 if acks:
@@ -247,8 +315,9 @@ class RecoveryPlane:
         """Delete every journal segment other than the live one —
         only once the chain artifact capturing their ops is DURABLE
         (after a base/delta save, never at rotation time)."""
-        for f in glob.glob(os.path.join(self.dir,
-                                        f"journal-{self.cid}-*.wal")):
+        for f in glob.glob(os.path.join(
+                self.dir,
+                f"journal{_host_tag(self._htag)}-{self.cid}-*.wal")):
             if f != self._journal_path(self._segment):
                 try:
                     os.unlink(f)
@@ -338,22 +407,26 @@ class RecoveryPlane:
     def recover(cls, directory: str, mesh=None, batch_per_node: int = 512,
                 tcfg=None, journal_sync: bool = True,
                 attach_router: bool = True,
-                group_commit_ms: float = 0.0):
+                group_commit_ms: float = 0.0,
+                host_id: int = 0, hosts: int = 1):
         """Rebuild a serving engine from the on-disk chain + journal.
 
         restore(base + deltas) -> replay journal segments in order ->
         re-base (fresh chain capturing the replayed state).  Returns
         (plane, cluster, tree, eng, receipt) with the receipt carrying
         the per-phase wall times and replay counts — the drill turns
-        these into the published RTO.
+        these into the published RTO.  With ``hosts > 1`` this is ONE
+        host's half of :meth:`recover_union` — it restores/replays/
+        re-bases the ``-h<host_id>-`` chain namespace only.
         """
         from sherman_tpu.models.batched import BatchedEngine
         from sherman_tpu.models.btree import Tree
 
+        htag = int(host_id) if int(hosts) > 1 else None
         t0 = time.perf_counter()
-        cid, deltas, journals = cls._discover(directory)
-        cluster = CK.restore_chain(os.path.join(directory, "base.npz"),
-                                   deltas, mesh=mesh)
+        cid, deltas, journals = cls._discover(directory, host_id=htag)
+        cluster = CK.restore_chain(
+            os.path.join(directory, _base_name(htag)), deltas, mesh=mesh)
         t_restore = time.perf_counter()
         tree = Tree(cluster)
         eng = BatchedEngine(tree, batch_per_node=batch_per_node, tcfg=tcfg)
@@ -382,7 +455,8 @@ class RecoveryPlane:
         t_replay = time.perf_counter()
         plane = cls(cluster, tree, eng, directory,
                     journal_sync=journal_sync,
-                    group_commit_ms=group_commit_ms)
+                    group_commit_ms=group_commit_ms,
+                    host_id=host_id, hosts=hosts)
         for rid, tenant, op, ok, *prov in acks:
             plane.dedup_window[(tenant, rid)] = (op, ok, *prov)
         plane.checkpoint_base()  # re-base: fresh chain, stale cid swept
@@ -390,11 +464,15 @@ class RecoveryPlane:
         _OBS_RECOVERS.inc()
         obs.record_event(
             "recovery.recover", cid=cid, deltas=len(deltas),
-            segments=replay_stats["segments"],
+            host=int(host_id), segments=replay_stats["segments"],
             replayed_records=replay_stats["records"],
             total_ms=round((t_end - t0) * 1e3, 1))
+        chain_info = {"cid": cid, "deltas": len(deltas)}
+        if int(hosts) > 1:
+            # hosts=1 receipts stay byte-identical to pre-plane builds
+            chain_info["host"] = int(host_id)
         receipt = {
-            "chain": {"cid": cid, "deltas": len(deltas)},
+            "chain": chain_info,
             "restore_ms": round((t_restore - t0) * 1e3, 1),
             "replay_ms": round((t_replay - t_restore) * 1e3, 1),
             "rebase_ms": round((t_end - t_replay) * 1e3, 1),
@@ -402,6 +480,61 @@ class RecoveryPlane:
             "replay": replay_stats,
         }
         return plane, cluster, tree, eng, receipt
+
+    @classmethod
+    def recover_union(cls, directory: str, hosts: int, mesh=None,
+                      batch_per_node: int = 512, tcfg=None,
+                      journal_sync: bool = True,
+                      attach_router: bool = True,
+                      group_commit_ms: float = 0.0):
+        """Union recovery over every host's chain in one directory —
+        the multihost service plane's crash exit.  Each host's chain is
+        restored + replayed INDEPENDENTLY in its own (cid, seq) order
+        (keys are partitioned by owner host, so no cross-host record
+        ordering exists to get wrong); a torn tail on one host's live
+        segment truncates only that host's replay, exactly as the
+        single-chain contract, and never blocks another host's.
+
+        ALL-OR-TYPED: a host whose chain is missing (no base) or
+        corrupt (a skipped/missing delta link, a mid-file journal CRC
+        failure) raises the underlying typed error
+        (:class:`FileNotFoundError` /
+        :class:`~sherman_tpu.utils.checkpoint.CheckpointCorruptError` /
+        :class:`~sherman_tpu.utils.journal.JournalCorruptError`) —
+        never a silently partial union with one host's acked ops gone.
+
+        -> (contexts, receipt): ``contexts[h]`` is host ``h``'s
+        (plane, cluster, tree, eng, receipt) exactly as
+        :meth:`recover` returns; ``receipt`` carries the per-host
+        chains + summed replay counts."""
+        if int(hosts) < 2:
+            raise StateError(
+                f"recover_union wants hosts >= 2 (got {hosts}); a "
+                "single-host directory is recover()'s job")
+        t0 = time.perf_counter()
+        contexts = []
+        for h in range(int(hosts)):
+            contexts.append(cls.recover(
+                directory, mesh=mesh, batch_per_node=batch_per_node,
+                tcfg=tcfg, journal_sync=journal_sync,
+                attach_router=attach_router,
+                group_commit_ms=group_commit_ms,
+                host_id=h, hosts=hosts))
+        replay = {}
+        for ctx in contexts:
+            for k, v in ctx[4]["replay"].items():
+                replay[k] = replay.get(k, 0) + v
+        receipt = {
+            "hosts": int(hosts),
+            "chains": [ctx[4]["chain"] for ctx in contexts],
+            "replay": replay,
+            "per_host_ms": [ctx[4]["total_ms"] for ctx in contexts],
+            "total_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        }
+        obs.record_event("recovery.recover_union", hosts=int(hosts),
+                         replayed_records=replay.get("records", 0),
+                         total_ms=receipt["total_ms"])
+        return contexts, receipt
 
     # -- targeted repair (degraded mode's real exit) --------------------------
 

@@ -189,9 +189,16 @@ class JournalTailer:
     last call and advances across rotations.  See the module
     docstring for the torn-tail / sweep / re-base contract."""
 
-    def __init__(self, directory: str, cid: str):
+    def __init__(self, directory: str, cid: str,
+                 host_id: int | None = None):
         self.dir = directory
         self.cid = cid
+        #: chain namespace to tail: ``None`` = the legacy un-tagged
+        #: single-host chain; an integer tails that host's ``-h<id>-``
+        #: chain — the cross-host replication seam (PR 19): a follower
+        #: on host B points this at host A's namespace in the shared
+        #: directory and ships A's stream through the same core
+        self.host_id = host_id
         self._cur: str | None = None   # current segment path
         self._off = 0                  # consumed bytes (past magic)
         self._fmt = 2
@@ -236,7 +243,8 @@ class JournalTailer:
 
     def _segments(self) -> list[str]:
         from sherman_tpu.recovery import RecoveryPlane
-        cid, _deltas, journals = RecoveryPlane._discover(self.dir)
+        cid, _deltas, journals = RecoveryPlane._discover(
+            self.dir, host_id=self.host_id)
         if cid != self.cid:
             raise _ResyncRequired(
                 f"chain re-based ({self.cid} -> {cid})")
@@ -514,9 +522,12 @@ class Follower:
         from sherman_tpu.utils import checkpoint as CK
 
         g = self.group
-        cid, deltas, _journals = RecoveryPlane._discover(g.primary_dir)
+        cid, deltas, _journals = RecoveryPlane._discover(
+            g.primary_dir, host_id=g.primary_host)
+        from sherman_tpu.recovery import _base_name
         cluster = CK.restore_chain(
-            os.path.join(g.primary_dir, "base.npz"), deltas)
+            os.path.join(g.primary_dir, _base_name(g.primary_host)),
+            deltas)
         tree = Tree(cluster)
         eng = BatchedEngine(tree, batch_per_node=g.batch_per_node,
                             tcfg=g.tcfg)
@@ -532,14 +543,16 @@ class Follower:
         self.seq = 0
         self.window.clear()
         self.caught_up = False
-        self.tailer = JournalTailer(g.primary_dir, cid)
+        self.tailer = JournalTailer(g.primary_dir, cid,
+                                    host_id=g.primary_host)
         g._arm_tailer(self)
         # a checkpoint that lands between the restore above and the
         # tailer's anchor would sweep records into a delta we did not
         # restore while the tailer anchors past them — re-discover and
         # start over if the chain moved (bounded: one loop per
         # checkpoint, and checkpoints are seconds apart)
-        cid2, deltas2, _ = RecoveryPlane._discover(g.primary_dir)
+        cid2, deltas2, _ = RecoveryPlane._discover(
+            g.primary_dir, host_id=g.primary_host)
         if cid2 != cid or len(deltas2) != len(deltas):
             self._bootstrap()
             return
@@ -705,6 +718,12 @@ class ReplicaGroup:
                              "plane.checkpoint_base() first")
         self.plane = plane
         self.primary_dir = plane.dir
+        #: chain namespace the followers tail (the primary plane's own
+        #: host tag): ``None`` on a single-host plane; on a multihost
+        #: plane this is the owner's ``-h<id>-`` namespace, so a group
+        #: constructed against host A's plane but PUMPED from host B's
+        #: context ships A's stream — the cross-host seam (PR 19)
+        self.primary_host = plane._htag
         self.batch_per_node = int(batch_per_node)
         self.tcfg = tcfg
         self.cache_slots = cache_slots

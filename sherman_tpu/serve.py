@@ -603,9 +603,16 @@ class ShermanServer:
     """
 
     def __init__(self, eng, config: ServeConfig | None = None, *,
-                 journal=None, value_heap=None, auditor=None):
+                 journal=None, value_heap=None, auditor=None,
+                 host_id: int | None = None):
         self.eng = eng
         self.cfg = config or ServeConfig.from_env()
+        #: this server's position in the multihost service plane
+        #: (PR 19): its stats/receipts carry the host tag so the merged
+        #: logical-SLO view (``multihost.merge_host_stats``) can
+        #: attribute; ``None`` (the default) = no plane — stats stay
+        #: byte-identical to pre-plane builds
+        self.host_id = host_id
         #: optional sampling history auditor (sherman_tpu/audit.py):
         #: fed on the completion paths, checked in the background
         self.auditor = auditor
@@ -1992,6 +1999,10 @@ class ShermanServer:
                                     if js["fsyncs"] else None)
             out["journal"] = js
         out["write_lane"] = self.cfg.write_lane
+        if self.host_id is not None:
+            # host attribution only under a multihost plane — hosts=1
+            # receipts stay byte-identical to pre-plane builds
+            out["host_id"] = int(self.host_id)
         if self.leaf_cache is not None:
             out["cache"] = {**self.leaf_cache.stats(),
                             "sketch": self.leaf_cache.sketch_stats()}

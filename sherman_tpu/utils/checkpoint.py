@@ -35,7 +35,7 @@ from sherman_tpu import config as _C
 from sherman_tpu import obs
 from sherman_tpu.config import DSMConfig
 from sherman_tpu.errors import (CheckpointFormatError, ConfigError,
-                                MultiprocessUnsupportedError, ShermanError)
+                                ShermanError)
 
 _CFG_FIELDS = ("machine_nr", "pages_per_node", "locks_per_node",
                "step_capacity", "host_step_capacity", "chunk_pages",
@@ -515,21 +515,25 @@ def _restore_multihost(path: str, mesh, keeper, clear_locks: bool):
 # the full (tiny) locks/counters/manifest state, chained by the same
 # (nonce, seq, crc) epoch machinery the multihost save uses: each delta
 # records its parent's epoch, and restore refuses out-of-order or
-# mixed-chain links.  Single-process meshes only (the chaos/drill tier);
-# multihost deployments checkpoint full per-host shards.
+# mixed-chain links.  Multihost meshes save per-host row-range deltas
+# (PR 19) — each process's chain covers the rows it owns.
 # ---------------------------------------------------------------------------
 
 def checkpoint_delta(cluster, path: str, parent_epoch) -> dict:
     """Save a delta artifact chained onto ``parent_epoch`` (the epoch
     returned by the previous :func:`checkpoint` / :func:`checkpoint_delta`
     of this chain).  Clears the DSM's dirty tracking on success.
-    Returns {"pages", "bytes", "epoch"}."""
+    Returns {"pages", "bytes", "epoch"}.
+
+    Multihost meshes (PR 19): each process saves a delta of its OWN
+    row range only — ``dirty_rows()`` is ownership-filtered and the
+    page gather reads this process's addressable shards
+    (``read_rows_local``, collective-free), so N hosts write N
+    disjoint delta streams concurrently.  Restore is per-host too:
+    each host's chain replays onto the rows it owns
+    (``RecoveryPlane.recover_union``'s contract)."""
     if not path.endswith(".npz"):
         path += ".npz"
-    if cluster.keeper.is_multihost or cluster.dsm.multihost:
-        raise MultiprocessUnsupportedError(
-            "delta checkpoints are single-process only; multihost "
-            "deployments take full per-host checkpoints")
     if parent_epoch is None:
         raise ConfigError(
             "checkpoint_delta needs the parent artifact's epoch "
@@ -543,17 +547,33 @@ def checkpoint_delta(cluster, path: str, parent_epoch) -> dict:
     # gather the dirty pages DEVICE-side: the d2h transfer is then
     # O(dirty pages) like the artifact, not O(pool) — at the 100 M-key
     # config a full-pool materialization would cost the whole 4.3 GB
-    # tunnel transfer per "cheap frequent delta"
-    pages = (np.asarray(dsm.pool[jnp.asarray(rows)]) if rows.size
-             else np.zeros((0, _C.PAGE_WORDS), np.int32))
+    # tunnel transfer per "cheap frequent delta".  Multihost: the
+    # owned-shard gather (a global fancy-index would be a cross-host
+    # collective inside a per-host save).
+    if dsm.multihost:
+        pages = dsm.read_rows_local(rows)
+    else:
+        pages = (np.asarray(dsm.pool[jnp.asarray(rows)]) if rows.size
+                 else np.zeros((0, _C.PAGE_WORDS), np.int32))
+    if dsm.multihost:
+        # this process's lock/counter shards only (the full arrays
+        # are not addressable here; the owner rows are what this
+        # host's chain replays onto anyway)
+        locks = np.concatenate([np.asarray(s.data) for s in
+                                dsm.locks.addressable_shards])
+        counters = np.concatenate([np.asarray(s.data) for s in
+                                   dsm.counters.addressable_shards])
+    else:
+        locks = np.asarray(dsm.locks)
+        counters = np.asarray(dsm.counters)
     arrays = dict(
         delta=np.asarray([1], np.int64),
         parent_epoch=np.asarray(parent_epoch, np.int32).ravel(),
         epoch=epoch,
         delta_rows=rows.astype(np.int64),
         delta_pages=pages,
-        locks=np.asarray(dsm.locks),
-        counters=np.asarray(dsm.counters),
+        locks=locks,
+        counters=counters,
         **man,
     )
     # value-heap dirty rows ride the same link (optional arrays —
@@ -561,9 +581,12 @@ def checkpoint_delta(cluster, path: str, parent_epoch) -> dict:
     if dsm.heap is not None:
         hrows = dsm.heap_dirty_rows()
         arrays["heap_rows"] = hrows.astype(np.int64)
-        arrays["heap_pages"] = (
-            np.asarray(dsm.heap[jnp.asarray(hrows)]) if hrows.size
-            else np.zeros((0, _C.PAGE_WORDS), np.int32))
+        if dsm.multihost:
+            arrays["heap_pages"] = dsm.read_rows_local(hrows, "heap")
+        else:
+            arrays["heap_pages"] = (
+                np.asarray(dsm.heap[jnp.asarray(hrows)]) if hrows.size
+                else np.zeros((0, _C.PAGE_WORDS), np.int32))
     arrays["integrity"] = _integrity(arrays)
     _savez_atomic(path, 0, **arrays)
     dsm.clear_dirty()

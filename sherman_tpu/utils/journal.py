@@ -673,6 +673,26 @@ def read_records(path: str, truncate_torn: bool = False,
     return out
 
 
+def read_acks(path: str) -> dict:
+    """Reconstruct one segment's exactly-once window:
+    ``{(tenant, rid): entry}`` over every J_ACK record, in ack order
+    (later entries override earlier — the front door's own last-writer
+    window semantics).  Entries are the raw ack tuples
+    ``(rid, tenant, op, ok[, handles])`` — provenance-bearing heap
+    entries (5-tuples, PR 16) carry through whole so re-encoding
+    preserves the handles.  Shared by journal rotation's ack
+    carry-forward (``RecoveryPlane._rotate_journal``) and the
+    multihost drill's merged acked-op ledger (one call per host
+    segment, dict-union across hosts — disjoint by the router's
+    key-partition, PR 19)."""
+    window: dict = {}
+    for kind, _keys, aux in read_records(path):
+        if kind == J_ACK:
+            for entry in aux:
+                window[(entry[1], entry[0])] = entry
+    return window
+
+
 def crc_of_range(path: str, start: int, end: int) -> int:
     """CRC32 of the raw segment bytes ``[start, end)`` — the anti-
     entropy audit's ground truth.  A follower's tailer accumulates the

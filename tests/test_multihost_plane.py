@@ -1,0 +1,485 @@
+"""Multihost service plane tests (PR 19): host-count knobs, the
+key -> owner-host router, the cross-host front door's split/merge,
+per-host chain namespaces (bit-identical legacy names at hosts=1,
+host-scoped stale sweeps), union recovery's edge cases (one torn tail
+never blocks another host's replay; a missing chain link fails typed,
+never a silent partial), the cross-host journal tailing seam, and the
+perfgate host-count comparability wall."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import ConfigError, DSMConfig, TreeConfig
+from sherman_tpu.errors import StateError
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.multihost import (HostRouter, MultihostService,
+                                   merge_host_stats, plane_from_env)
+from sherman_tpu.recovery import RecoveryPlane
+from sherman_tpu.utils import checkpoint as CK
+from sherman_tpu.utils import journal as J
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+def test_hosts_knobs(monkeypatch):
+    from sherman_tpu import config as C
+
+    for off in (None, "", "0", "1", "off", "no", "false"):
+        if off is None:
+            monkeypatch.delenv("SHERMAN_HOSTS", raising=False)
+        else:
+            monkeypatch.setenv("SHERMAN_HOSTS", off)
+        assert C.hosts() == 1
+    monkeypatch.setenv("SHERMAN_HOSTS", "4")
+    assert C.hosts() == 4
+    monkeypatch.setenv("SHERMAN_HOSTS", "pod")
+    with pytest.raises(ConfigError):
+        C.hosts()
+    monkeypatch.setenv("SHERMAN_HOSTS", "-2")
+    with pytest.raises(ConfigError):
+        C.hosts()
+
+    monkeypatch.setenv("SHERMAN_HOSTS", "2")
+    monkeypatch.delenv("SHERMAN_HOST_ID", raising=False)
+    assert C.host_id() == 0
+    monkeypatch.setenv("SHERMAN_HOST_ID", "1")
+    assert C.host_id() == 1
+    assert plane_from_env() == (2, 1)
+    monkeypatch.setenv("SHERMAN_HOST_ID", "2")  # outside [0, hosts)
+    with pytest.raises(ConfigError):
+        C.host_id()
+    monkeypatch.setenv("SHERMAN_HOST_ID", "east")
+    with pytest.raises(ConfigError):
+        C.host_id()
+    # host_id=1 is only legal under a configured plane
+    monkeypatch.setenv("SHERMAN_HOSTS", "1")
+    monkeypatch.setenv("SHERMAN_HOST_ID", "1")
+    with pytest.raises(ConfigError):
+        C.host_id()
+
+
+# ---------------------------------------------------------------------------
+# HostRouter
+# ---------------------------------------------------------------------------
+
+def test_host_router_deterministic_split():
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.integers(1, 1 << 60, 5000, dtype=np.uint64))
+    r = HostRouter(2)
+    own = r.owner(keys)
+    assert own.dtype == np.int32
+    assert ((own >= 0) & (own < 2)).all()
+    # deterministic (a retried rid re-splits identically) and balanced
+    # (mix hash: no owner starves)
+    np.testing.assert_array_equal(own, r.owner(keys))
+    np.testing.assert_array_equal(own, HostRouter(2).owner(keys))
+    counts = np.bincount(own, minlength=2)
+    assert counts.min() > 0.35 * keys.size, counts
+    # split partitions exactly and the idx permutation reassembles
+    vals = keys ^ np.uint64(0xC0FFEE)
+    parts = r.split(keys, vals)
+    got_idx = np.concatenate([idx for _h, idx, _k, _v in parts])
+    assert np.array_equal(np.sort(got_idx), np.arange(keys.size))
+    back = np.zeros_like(keys)
+    for h, idx, k_h, v_h in parts:
+        np.testing.assert_array_equal(r.owner(k_h), h)
+        np.testing.assert_array_equal(v_h, k_h ^ np.uint64(0xC0FFEE))
+        back[idx] = k_h
+    np.testing.assert_array_equal(back, keys)
+    # hosts=1 degenerates to the identity plane
+    assert (HostRouter(1).owner(keys) == 0).all()
+    with pytest.raises(ConfigError):
+        HostRouter(0)
+
+
+# ---------------------------------------------------------------------------
+# Front door: split submit + merge (transport-free fakes)
+# ---------------------------------------------------------------------------
+
+class _FakeFuture:
+    def __init__(self, op, keys, values):
+        self.op, self.keys, self.values = op, keys, values
+        self.deduped = op != "read"
+
+    def done(self):
+        return True
+
+    def result(self, timeout=None):
+        k = np.asarray(self.keys, np.uint64)
+        if self.op == "read":
+            return k ^ np.uint64(0xAB), (k % np.uint64(3)) != 0
+        return np.ones(k.size, bool)
+
+
+class _FakeServer:
+    def __init__(self):
+        self.calls = []
+
+    def submit(self, op, keys=None, values=None, *, tenant="default",
+               rid=None, deadline_ms=None):
+        self.calls.append((op, np.asarray(keys, np.uint64), rid))
+        return _FakeFuture(op, keys, values)
+
+    def stats(self):
+        return {}
+
+
+def test_multihost_service_split_merge_order():
+    rng = np.random.default_rng(11)
+    keys = rng.integers(1, 1 << 60, 257, dtype=np.uint64)
+    servers = [_FakeServer(), _FakeServer()]
+    svc = MultihostService(servers)
+    f = svc.submit("read", keys, rid=42)
+    vals, found = f.result(timeout=5)
+    # merged result is in ORIGINAL batch order despite the split
+    np.testing.assert_array_equal(vals, keys ^ np.uint64(0xAB))
+    np.testing.assert_array_equal(found, (keys % np.uint64(3)) != 0)
+    # each server saw only its owned keys, same rid (exactly-once
+    # composes through the deterministic split)
+    own = svc.router.owner(keys)
+    for h, srv in enumerate(servers):
+        op, k_h, rid = srv.calls[0]
+        np.testing.assert_array_equal(np.sort(k_h),
+                                      np.sort(keys[own == h]))
+        assert rid == 42
+    ok = svc.submit("insert", keys, keys).result(timeout=5)
+    assert ok.shape == keys.shape and ok.all()
+    assert svc.submit("insert", keys, keys, rid=7).deduped
+    # scans do not split over a hash partition: refused typed
+    with pytest.raises(ConfigError):
+        svc.submit("scan", keys[:4])
+    # router/server width mismatch is a construction error
+    with pytest.raises(ConfigError):
+        MultihostService(servers, router=HostRouter(3))
+    with pytest.raises(ConfigError):
+        MultihostService([])
+    # frontier tokens need the planes wired in
+    with pytest.raises(StateError):
+        svc.journal_frontiers()
+    # hosts=1 delegates straight through — zero added surface
+    lone = _FakeServer()
+    f1 = MultihostService([lone]).submit("read", keys[:8])
+    assert isinstance(f1, _FakeFuture) and len(lone.calls) == 1
+
+
+def test_merge_host_stats_one_logical_plane():
+    a = {"admitted_ops": 10, "served_ops": 9, "acked_writes": 6,
+         "rejects": {"overload": 1, "degraded": 0}, "dispatch_errors": 0,
+         "retraces": 1, "controller": {"settled_width": 256},
+         "window": {"read": {"ops_s": 100.0, "p50_ms": 1.0,
+                             "p99_ms": 5.0, "window_ops": 10,
+                             "ops_total": 20}},
+         "contract": {"dedup_hits": 2},
+         "journal": {"fsyncs": 3, "appends": 6}}
+    b = {"admitted_ops": 20, "served_ops": 18, "acked_writes": 4,
+         "rejects": {"overload": 0, "degraded": 2}, "dispatch_errors": 1,
+         "retraces": 0, "controller": {"cap_width": 1024},
+         "window": {"read": {"ops_s": 50.0, "p50_ms": 2.0,
+                             "p99_ms": 9.0, "window_ops": 5,
+                             "ops_total": 7}},
+         "contract": {"dedup_hits": 1},
+         "journal": {"fsyncs": 2, "appends": 4}}
+    m = merge_host_stats([a, b])
+    assert m["hosts"] == 2 and m["admitted_ops"] == 30
+    assert m["acked_writes"] == 10 and m["retraces"] == 1
+    assert m["rejects"] == {"overload": 1, "degraded": 2}
+    assert m["widths"] == [256, 1024]  # settled, cap fallback
+    # throughput sums; tail promises take the WORST host
+    w = m["window"]["read"]
+    assert w["ops_s"] == 150.0 and w["p99_ms"] == 9.0
+    assert w["window_ops"] == 15 and w["ops_total"] == 27
+    assert m["contract"]["dedup_hits"] == 3
+    # coalescing re-derives from the SUMMED acks/fsyncs
+    assert m["journal"] == {"fsyncs": 5, "appends": 10,
+                            "acks_per_fsync": 2.0}
+    with pytest.raises(ConfigError):
+        merge_host_stats([])
+
+
+# ---------------------------------------------------------------------------
+# Per-host chain namespaces
+# ---------------------------------------------------------------------------
+
+def _small_cluster(pages=512, batch=128):
+    cfg = DSMConfig(machine_nr=4, pages_per_node=pages, locks_per_node=256,
+                    step_capacity=256, chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=batch,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    return cluster, tree, eng
+
+
+def _load(tree, eng, keys, salt=0xABCD):
+    vals = keys ^ np.uint64(salt)
+    batched.bulk_load(tree, keys, vals)
+    eng.attach_router()
+    return vals
+
+
+def _keyset(n=600, seed=5):
+    rng = np.random.default_rng(seed)
+    return np.unique(rng.integers(1, 1 << 56, int(n * 1.2),
+                                  dtype=np.uint64))[:n]
+
+
+def test_hosts1_legacy_names_and_sweep_skip(eight_devices, tmp_path):
+    """The shipped default (hosts=1) writes the PRE-multihost artifact
+    names — bit-identity with builds that predate the plane — and its
+    stale sweep never judges a host-tagged chain sharing the
+    directory."""
+    cluster, tree, eng = _small_cluster()
+    keys = _keyset(200, seed=3)
+    _load(tree, eng, keys)
+    rdir = str(tmp_path / "r")
+    plane = RecoveryPlane(cluster, tree, eng, rdir)
+    assert plane._htag is None
+    plane.checkpoint_base()
+    eng.insert(keys[:32], keys[:32])
+    d = plane.checkpoint_delta()
+    assert d["pages"] > 0
+    names = sorted(os.listdir(rdir))
+    assert "base.npz" in names
+    assert any(n.startswith(f"delta-{plane.cid}-") for n in names)
+    assert any(n.startswith(f"journal-{plane.cid}-") for n in names)
+    assert not any("-h" in n for n in names)  # un-tagged, bit-identical
+    # recover() receipt carries no "host" key at hosts=1 either
+    # (the chain dict stays byte-identical to pre-plane builds)
+    # a foreign host's chain + a stale legacy artifact share the dir:
+    # the legacy sweep removes only the stale LEGACY artifact
+    foreign = ["base-h1.npz", "delta-h1-deadbeef-000000.npz",
+               "journal-h1-deadbeef-000000.wal"]
+    for n in foreign:
+        open(os.path.join(rdir, n), "wb").write(b"x")
+    open(os.path.join(rdir, "delta-0badcafe-000000.npz"),
+         "wb").write(b"x")
+    swept = plane._sweep_stale()
+    assert swept == 1
+    left = set(os.listdir(rdir))
+    assert set(foreign) <= left
+    assert "delta-0badcafe-000000.npz" not in left
+    plane.close()
+
+
+# ---------------------------------------------------------------------------
+# Union recovery edge cases + the cross-host plane lifecycle
+# ---------------------------------------------------------------------------
+
+def test_union_recovery_torn_tail_one_host(eight_devices, tmp_path):
+    """The plane lifecycle on one shared directory, end to end.  Host
+    0 crashes with a TORN live-segment tail; host 1's chain is clean.
+    recover_union replays both convergently: host 0's torn
+    (never-acked) record is truncated, every acked op on BOTH hosts
+    survives (RPO 0), and the torn tail never blocks host 1.  Then,
+    on the recovered planes: re-basing host 0 sweeps ONLY the
+    ``-h0-`` namespace (host 1's live chain survives byte-for-byte),
+    and a cross-host tailer/replica group ships host 0's chain while
+    host 1's interleaved segments stay invisible by name."""
+    rdir = str(tmp_path / "r")
+    keys = _keyset(420, seed=17)
+    own = HostRouter(2).owner(keys)
+    hk = [keys[own == 0], keys[own == 1]]
+    jpaths = []
+    for h in (0, 1):
+        cluster, tree, eng = _small_cluster()
+        _load(tree, eng, hk[h])
+        plane = RecoveryPlane(cluster, tree, eng, rdir,
+                              host_id=h, hosts=2)
+        assert plane._htag == h
+        plane.checkpoint_base()
+        # acked traffic: pre-delta writes (land via the chain link),
+        # a delta, then journal-only writes AND deletes (land via
+        # replay of the live segment)
+        eng.insert(hk[h][:48], hk[h][:48] ^ np.uint64(0x11))
+        assert plane.checkpoint_delta()["pages"] > 0
+        eng.insert(hk[h][56:104], hk[h][56:104] ^ np.uint64(0x22))
+        assert eng.delete(hk[h][48:56]).all()
+        jpaths.append(eng.journal.path)
+        plane.close()
+        del cluster, tree, eng
+    names = sorted(os.listdir(rdir))
+    for h in (0, 1):  # per-host namespaces, side by side in one dir
+        assert f"base-h{h}.npz" in names
+        assert any(n.startswith(f"delta-h{h}-") for n in names)
+        assert any(n.startswith(f"journal-h{h}-") for n in names)
+    # crash mid-append on host 0 ONLY: torn half-record, never acked
+    rec = J.encode_record(J.J_UPSERT, np.asarray([12345], np.uint64),
+                          np.asarray([1], np.uint64))
+    with open(jpaths[0], "ab") as f:
+        f.write(rec[: len(rec) // 2])
+    assert "-h0-" in os.path.basename(jpaths[0])
+
+    ctxs, receipt = RecoveryPlane.recover_union(
+        rdir, hosts=2, batch_per_node=128,
+        tcfg=TreeConfig(sibling_chase_budget=1))
+    assert receipt["hosts"] == 2 and len(receipt["chains"]) == 2
+    assert [c["host"] for c in receipt["chains"]] == [0, 1]
+    assert receipt["replay"]["records"] >= 4
+    assert receipt["replay"]["deletes"] >= 2
+    for h in (0, 1):
+        eng = ctxs[h][3]
+        got, found = eng.search(hk[h][:104])
+        assert found[:48].all() and not found[48:56].any() \
+            and found[56:104].all(), f"host {h}"
+        np.testing.assert_array_equal(
+            got[:48], hk[h][:48] ^ np.uint64(0x11))
+        np.testing.assert_array_equal(
+            got[56:104], hk[h][56:104] ^ np.uint64(0x22))
+        # untouched keys intact (no cross-host bleed in the union)
+        got, found = eng.search(hk[h][104:])
+        assert found.all()
+        np.testing.assert_array_equal(got, hk[h][104:] ^ np.uint64(0xABCD))
+        from sherman_tpu.models.validate import check_structure_device
+        check_structure_device(ctxs[h][2])
+    # the torn (unacknowledged) record must NOT have replayed anywhere
+    for h in (0, 1):
+        _, f0 = ctxs[h][3].search(np.asarray([12345], np.uint64))
+        assert not f0.any()
+
+    # -- host-scoped sweep: host 0 re-bases; its old cid's artifacts
+    # are stale and swept, the peer's live chain survives verbatim
+    h1_files = {n: open(os.path.join(rdir, n), "rb").read()
+                for n in os.listdir(rdir) if "-h1" in n}
+    old_cid0 = ctxs[0][0].cid
+    ctxs[0][0].checkpoint_base()
+    left = sorted(os.listdir(rdir))
+    assert not any(f"-h0-{old_cid0}-" in n for n in left)
+    for n, blob in h1_files.items():
+        assert open(os.path.join(rdir, n), "rb").read() == blob
+    # discovery is namespace-blind to the peer by NAME
+    _cid, _deltas, journals = RecoveryPlane._discover(rdir, host_id=1)
+    assert journals
+    assert all("-h1-" in os.path.basename(p) for p in _deltas + journals)
+
+    # -- cross-host replication seam: a tailer/replica group on host
+    # 0's chain ships host 0's writes only
+    from sherman_tpu.replica import JournalTailer, ReplicaGroup
+    tailer = JournalTailer(rdir, ctxs[0][0].cid, host_id=0)
+    k0, k1 = hk[0][104:144], hk[1][104:144]
+    ctxs[0][3].insert(k0, k0 ^ np.uint64(0x77))
+    ctxs[1][3].insert(k1, k1 ^ np.uint64(0x88))
+    recs = tailer.poll()
+    assert recs, "host 0's journaled write must ship"
+    shipped = np.concatenate([np.asarray(r[1], np.uint64) for r in recs])
+    assert set(shipped.tolist()) <= set(k0.tolist())
+    assert not set(shipped.tolist()) & set(k1.tolist())
+    # ReplicaGroup inherits the namespace from the plane (primary_host)
+    group = ReplicaGroup(ctxs[0][0], 1, cache_slots=1024)
+    assert group.primary_host == 0
+    ctxs[0][3].insert(k0[:8], k0[:8] ^ np.uint64(0x99))
+    group.pump()
+    assert group.stats()["applied_records"] > 0
+    got, found = group.followers[0].eng.search(k0[:8])
+    assert found.all()
+    np.testing.assert_array_equal(got, k0[:8] ^ np.uint64(0x99))
+    group.close()
+    for ctx in ctxs:  # close the recovered planes (journal fds)
+        ctx[0].close()
+
+
+def test_union_recovery_missing_link_typed(eight_devices, tmp_path):
+    """ALL-OR-TYPED: a missing per-host delta (a skipped chain link)
+    or a missing base fails the WHOLE union with the underlying typed
+    error — never a silently partial restore serving one host's acked
+    ops as gone."""
+    rdir = str(tmp_path / "r")
+    keys = _keyset(240, seed=23)
+    own = HostRouter(2).owner(keys)
+    for h in (0, 1):
+        cluster, tree, eng = _small_cluster()
+        kh = keys[own == h]
+        _load(tree, eng, kh)
+        plane = RecoveryPlane(cluster, tree, eng, rdir,
+                              host_id=h, hosts=2)
+        plane.checkpoint_base()
+        eng.insert(kh[:16], kh[:16] ^ np.uint64(0x1))
+        plane.checkpoint_delta()
+        eng.insert(kh[16:32], kh[16:32] ^ np.uint64(0x2))
+        plane.checkpoint_delta()
+        plane.close()
+        del cluster, tree, eng
+    # drop host 0's FIRST delta: the second link's parent pairing breaks
+    cid0, deltas0, _ = RecoveryPlane._discover(rdir, host_id=0)
+    assert len(deltas0) == 2
+    os.unlink(deltas0[0])
+    with pytest.raises(CK.CheckpointCorruptError):
+        RecoveryPlane.recover_union(rdir, hosts=2, batch_per_node=128)
+    # a host with NO chain at all is typed too
+    os.unlink(os.path.join(rdir, "base-h0.npz"))
+    with pytest.raises(FileNotFoundError):
+        RecoveryPlane.recover_union(rdir, hosts=2, batch_per_node=128)
+    # and a single-host directory is recover()'s job, stated typed
+    with pytest.raises(StateError):
+        RecoveryPlane.recover_union(rdir, hosts=1)
+
+
+# ---------------------------------------------------------------------------
+# perfgate: host-count comparability wall + multihost drill pins
+# ---------------------------------------------------------------------------
+
+def _receipt(**cfg):
+    r = {"keys": 10_000_000, "batch": 4_194_304, "value": 30e6,
+         "sustained_ops_s": 33e6, "sus_dev_ms_per_step": 70.0}
+    if cfg:
+        r["config"] = cfg
+    return r
+
+
+def test_perfgate_hosts_wall_both_directions():
+    import perfgate
+
+    # absent field = the pre-multihost fact: everything ran at hosts=1
+    assert perfgate._hosts_cfg({}) == 1
+    assert perfgate._hosts_cfg({"hosts": 2}) == 2  # drill receipts
+    assert perfgate._hosts_cfg({"config": {"hosts": 3}}) == 3
+
+    legacy = _receipt()                       # pre-field round
+    one = _receipt(hosts=1)
+    two = _receipt(hosts=2)
+    assert perfgate._comparable(one, legacy, "sustained_ops_s")
+    assert perfgate._comparable(legacy, one, "sustained_ops_s")
+    # differing host counts never gate, in EITHER direction: a 2-host
+    # aggregate row must not ratchet the single-host trajectory (nor
+    # be failed by it)
+    for a, b in ((two, legacy), (legacy, two), (two, one), (one, two)):
+        assert not perfgate._comparable(a, b, "sustained_ops_s")
+        assert not perfgate._comparable(a, b, "value")
+    rounds = [dict(legacy, _round=21), dict(one, _round=22)]
+    res = perfgate.gate(dict(two), rounds)
+    assert not res["ok"] and "no comparable metric" in res["error"]
+    res = perfgate.gate(dict(one), rounds)
+    assert res["ok"] and "sustained_ops_s" in res["gated_metrics"]
+
+
+def test_perfgate_multihost_drill_hard_pins():
+    """multihost_drill receipts ride the contract hard-pin rail:
+    rpo_ops > 0 (an acked op gone after union recovery) or
+    lost_acks > 0 or linearizable == false is a hard red; a green
+    receipt passes on its pins alone and is NEVER throughput-gated
+    against hosts=1 rounds."""
+    import perfgate
+
+    closed = {"keys": 200_000, "batch": 4096, "value": 1_000_000,
+              "sustained_ops_s": 2_000_000,
+              "sus_dev_ms_per_step": 10.0, "_round": 5}
+    good = {"metric": "multihost_drill", "hosts": 2, "rpo_ops": 0,
+            "lost_acks": 0, "linearizable": True,
+            "ack_bandwidth": {"speedup": 12.5}}
+    res = perfgate.gate(dict(good), [closed])
+    assert res["ok"] and "error" not in res, res
+    assert res["metrics"]["contract.rpo_ops"]["ok"]
+    assert res["metrics"]["contract.linearizable"]["ok"]
+    for bad in ({"rpo_ops": 1}, {"lost_acks": 2},
+                {"linearizable": False}):
+        res = perfgate.gate(dict(good, **bad), [closed])
+        assert not res["ok"], bad

@@ -1,0 +1,563 @@
+#!/usr/bin/env python
+"""Multihost drill: per-host chain ownership, the cross-host front
+door, union recovery, and the cross-host replication seam.
+
+The sixth end-to-end rehearsal (chaos = detection, recovery =
+durability, reshard = capacity, contract = the front door, failover =
+replication) — this one pins the MULTIHOST SERVICE PLANE
+(``sherman_tpu/multihost.py``):
+
+  phase 1  build TWO emulated host contexts in one process: each host
+           its own cluster/tree/engine, its own recovery plane in ONE
+           shared directory (chain namespaces ``-h0-`` / ``-h1-``),
+           its own front door — behind one ``MultihostService`` whose
+           ``HostRouter`` partitions the key space.  Bulk values land
+           on their owner host only.
+  traffic  open-loop writers + a deleter (exactly-once rids) + readers
+           hammer the ROUTED front door: every batch splits by owner,
+           each sub-batch is acked by the OWNER's journal only, and
+           the merged future reassembles batch order.  A per-host
+           delta checkpoint runs mid-stream on BOTH chains.
+  crash    both front doors are killed mid-traffic (no drain) and
+           host 0's live journal tail is TORN (half a frame appended)
+           — host 1's chain stays clean: the drill's core claim is
+           that one host's torn tail never blocks the other's replay.
+  recover  ``RecoveryPlane.recover_union``: every host's chain is
+           restored + replayed independently; the merged acked-op
+           ledger (inserts AND deletes, both hosts) is then audited
+           against the recovered engines — ``rpo_ops == 0`` and
+           ``lost_acks == 0``, plus an untouched-key probe.
+  tail     the cross-host replication seam: a follower group attached
+           to host 0's recovered plane ships host 0's ``-h0-`` chain
+           out of the SHARED directory (host 1's files interleaved
+           beside it must be ignored), applies a fresh acked round,
+           converges, and serves certified replica reads.  The full
+           client history — both hosts, both sides of the crash, plus
+           the replica-served reads — checks linearizable offline.
+  a/b      journal ack bandwidth: the hosts' concurrent write streams
+           through ONE shared journal vs one journal EACH, both under
+           the shipped front-door discipline (group commit).  The
+           shared stream must coalesce the hosts' acks through the
+           bounded-latency commit window; per-host ownership makes
+           every stream a lone writer, which skips the window by
+           design and acks at raw fsync speed.  Per-host chains must
+           clear >= 1.5x aggregate acks/s (the window-less contended
+           stream is published too, never gated — on one shared
+           device its fsyncs semi-serialize in the filesystem
+           journal, an emulation artifact real per-host disks do not
+           have).
+
+Runs on the CPU mesh anywhere (``bench.py --multihost-drill`` forwards
+here; ``scripts/multihost_ci.sh`` pins it in CI).  Prints ONE JSON
+line ``{"metric": "multihost_drill", "ok": true, "rpo_ops": 0,
+"lost_acks": 0, "linearizable": true, "ack_bandwidth": {...}, ...}``
+and mirrors it to ``SHERMAN_MULTIHOST_RECEIPT`` when set.  perfgate
+treats the committed receipt as a robustness artifact: never
+throughput-gated against hosts=1 rounds (the ``hosts`` comparability
+wall), but ``rpo_ops > 0`` / ``lost_acks > 0`` / ``linearizable ==
+false`` is a marginless hard red.  Env knobs: SHERMAN_DRILL_KEYS
+(default 4000), SHERMAN_CHAOS_SEED, SHERMAN_DRILL_SECS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from common import build_cluster, pages_for_keys, setup_platform
+
+SALT = 0x30057FEB  # bulk-load value stamp (key ^ SALT)
+
+
+def _chunked_search(eng, keys: np.ndarray, width: int = 512):
+    """Engine point reads in dispatch-sized chunks -> (values, found)."""
+    vs, fs = [], []
+    for i in range(0, keys.size, width):
+        v, f = eng.search(keys[i:i + width])
+        vs.append(np.asarray(v, np.uint64))
+        fs.append(np.asarray(f, bool))
+    return np.concatenate(vs), np.concatenate(fs)
+
+
+def _ack_bandwidth_ab(root: str, n_hosts: int, total: int,
+                      gc_ms: float = 2.0) -> dict:
+    """The perf claim, measured where it lives: ``n_hosts`` concurrent
+    closed-loop write streams (one per host's write lane) acking
+    ``total`` durable appends through ONE shared journal vs one
+    journal EACH, both under the SHIPPED front-door journal discipline
+    (``group_commit_ms`` — the same value this drill's own front doors
+    run).  The mechanism being measured is contention: a single
+    logical journal must coalesce the hosts' concurrent acks through
+    the bounded-latency group-commit window (every group pays up to
+    the window in added ack latency), while per-host ownership makes
+    every stream a LONE writer — which skips the window entirely by
+    design and acks at raw per-op-fsync speed, with the N fsync
+    streams running their disk waits in parallel.
+
+    ``shared_percommit_acks_s`` is published alongside, NEVER gated:
+    the same contended shared stream with the window forced off
+    (``group_commit_ms=0``), where concurrent appends still coalesce
+    implicitly (one leader fsync covers the joiners).  On this
+    emulation both "hosts" share one device, so cross-file fsyncs
+    semi-serialize on the filesystem journal and that pair
+    under-measures the stream-parallelism term a real pod's
+    independent disks provide — it is reported for completeness, not
+    the claim's baseline."""
+    from sherman_tpu.utils import journal as J
+
+    def run(journals, n_thr: int) -> tuple[float, int]:
+        per_thr = total // n_thr
+        barrier = threading.Barrier(n_thr + 1)
+
+        def writer(t: int):
+            jr = journals[t % len(journals)]
+            k = np.asarray([t + 1], np.uint64)
+            v = np.asarray([t + 1], np.uint64)
+            barrier.wait()
+            for i in range(per_thr):
+                jr.append(J.J_UPSERT, k, v, rid=(t << 32) | i)
+
+        ths = [threading.Thread(target=writer, args=(t,), daemon=True)
+               for t in range(n_thr)]
+        for th in ths:
+            th.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for th in ths:
+            th.join(timeout=600)
+        dt = time.perf_counter() - t0
+        fsyncs = sum(jr.fsyncs for jr in journals)
+        for jr in journals:
+            jr.close()
+        return (n_thr * per_thr) / max(dt, 1e-9), fsyncs
+
+    shared, sh_fs = run([J.Journal(
+        os.path.join(root, "ab-shared.wal"), sync=True,
+        group_commit_ms=gc_ms)], n_hosts)
+    percommit, _pc_fs = run([J.Journal(
+        os.path.join(root, "ab-percommit.wal"), sync=True)], n_hosts)
+    perhost, ph_fs = run([J.Journal(
+        os.path.join(root, f"ab-h{t}.wal"), sync=True,
+        group_commit_ms=gc_ms) for t in range(n_hosts)], n_hosts)
+    return {
+        "hosts": n_hosts, "acks_total": total,
+        "group_commit_ms": gc_ms,
+        "shared_acks_s": round(shared, 1),
+        "shared_acks_per_fsync": round(total / max(sh_fs, 1), 2),
+        "shared_percommit_acks_s": round(percommit, 1),
+        "perhost_acks_s": round(perhost, 1),
+        "perhost_acks_per_fsync": round(total / max(ph_fs, 1), 2),
+        "speedup": round(perhost / max(shared, 1e-9), 3),
+        "speedup_vs_percommit": round(
+            perhost / max(percommit, 1e-9), 3),
+    }
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--keys", type=int,
+                   default=int(os.environ.get("SHERMAN_DRILL_KEYS", 4000)))
+    p.add_argument("--hosts", type=int, default=2,
+                   help="emulated host count (>= 2)")
+    p.add_argument("--seed", type=int,
+                   default=int(os.environ.get("SHERMAN_CHAOS_SEED", 7)))
+    p.add_argument("--secs", type=float,
+                   default=float(os.environ.get("SHERMAN_DRILL_SECS", 2.0)))
+    p.add_argument("--dir", default=None,
+                   help="drill directory (default: a tempdir)")
+    a = p.parse_args(argv)
+    assert a.hosts >= 2, "the multihost drill wants >= 2 hosts"
+    # one device per emulated host: per-host engines are single-device
+    # programs (no collective rendezvous to interleave across the
+    # concurrent per-host executors — the failover drill's lesson)
+    setup_platform(1)
+
+    from sherman_tpu import audit as A
+    from sherman_tpu import obs
+    from sherman_tpu.errors import ShermanError
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.validate import check_structure_device
+    from sherman_tpu.multihost import HostRouter, MultihostService
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.replica import ReplicaGroup
+    from sherman_tpu.serve import (RetryingClient, RetryPolicy,
+                                   ServeConfig, ShermanServer)
+    from sherman_tpu.utils import journal as J
+
+    t_start = time.time()
+    H = a.hosts
+    out: dict = {"metric": "multihost_drill", "seed": a.seed, "ok": False,
+                 "hosts": H, "keys": a.keys}
+    root = a.dir or tempfile.mkdtemp(prefix="sherman_multihost_")
+    out["dir"] = root
+    snap0 = obs.snapshot()
+
+    # -- phase 1: N host contexts, one shared chain directory -----------------
+    router = HostRouter(H)
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 56, int(a.keys * 1.05),
+                                  dtype=np.uint64))[:a.keys]
+    vals = keys ^ np.uint64(SALT)
+    own = router.owner(keys)
+    out["key_split"] = [int((own == h).sum()) for h in range(H)]
+    assert all(n > 0 for n in out["key_split"]), "degenerate key split"
+
+    widths = (256, 1024)
+    big = {c: 1e9 for c in ("read", "scan", "insert", "delete")}
+
+    def front_door(engine, host_id: int, calib: np.ndarray):
+        cfg = ServeConfig(widths=widths, p99_targets_ms=dict(big),
+                          write_linger_ms=0.5, write_width=2048,
+                          group_commit_ms=2.0)
+        srv = ShermanServer(engine, cfg, host_id=host_id)
+        absent = np.asarray([1 << 60], np.uint64)
+        # value-preserving calibration against THIS host's owned keys
+        ck = calib[:256]
+        cv, cf = engine.search(ck)
+        srv.start(calib_keys=calib,
+                  calib_writes=(ck[cf], np.asarray(cv)[cf]),
+                  calib_delete_keys=absent)
+        return srv
+
+    ppn = pages_for_keys(a.keys)
+    hosts = []  # [(cluster, tree, eng, plane, srv, my_keys)]
+    for h in range(H):
+        cluster, tree, eng = build_cluster(
+            1, ppn, batch_per_node=512,
+            locks_per_node=1024, chunk_pages=64)
+        my = keys[own == h]
+        batched.bulk_load(tree, my, my ^ np.uint64(SALT))
+        eng.attach_router()
+        check_structure_device(tree)
+        plane = RecoveryPlane(cluster, tree, eng, root,
+                              group_commit_ms=2.0, host_id=h, hosts=H)
+        plane.checkpoint_base()
+        srv = front_door(eng, h, my)
+        hosts.append((cluster, tree, eng, plane, srv, my))
+    svc = MultihostService([hc[4] for hc in hosts], router,
+                           planes=[hc[3] for hc in hosts])
+
+    # -- acked mixed traffic through the routed front door --------------------
+    # writer slices + a delete slice + an immutable tail; every client
+    # batch is random over its slice, so every batch SPLITS across
+    # owner hosts (the whole point of the drill)
+    n_writers, n_readers = 2, 1
+    per = a.keys // (n_writers + 2)
+    del_slice = keys[n_writers * per:(n_writers + 1) * per]
+    imm = keys[(n_writers + 1) * per:]
+    # merged acked-op ledger: key -> (present, value) after the LAST
+    # acked op (slices are disjoint per client thread, so per-key
+    # order is each thread's program order)
+    acked: list[dict] = [dict() for _ in range(n_writers + 1)]
+    unacked: list[dict] = [dict() for _ in range(n_writers + 1)]
+    events: list[list] = [[] for _ in range(n_writers + 1 + n_readers)]
+    stop = threading.Event()
+    gens = [0] * n_writers
+    pol = RetryPolicy(max_attempts=6, hedge_reads=False)
+
+    def writer(w: int, n_reqs: int):
+        my = keys[w * per:(w + 1) * per]
+        cl = RetryingClient(svc, tenant=f"writer{w}", policy=pol,
+                            seed=100 + w + gens[w])
+        ev = events[w]
+        wrng = np.random.default_rng(1000 * w + gens[w])
+        done = 0
+        while not stop.is_set() and (n_reqs == 0 or done < n_reqs):
+            gens[w] += 1
+            done += 1
+            time.sleep(0.005)
+            kreq = np.unique(my[wrng.integers(0, my.size, 48)])
+            vreq = kreq ^ np.uint64(SALT) ^ np.uint64(gens[w] << 8)
+            t_inv = time.perf_counter()
+            try:
+                ok = cl.insert(kreq, vreq)
+            except ShermanError:
+                # in flight at the kill: result unknown, not owed
+                for k, v in zip(kreq.tolist(), vreq.tolist()):
+                    unacked[w].setdefault(k, []).append((True, v))
+                continue
+            t_resp = time.perf_counter()
+            for k, v, o in zip(kreq.tolist(), vreq.tolist(),
+                               ok.tolist()):
+                if o:
+                    acked[w][k] = (True, v)
+                    ev.append((k, A.OP_INSERT, t_inv, t_resp, v, True))
+
+    def deleter(n_reqs: int):
+        cl = RetryingClient(svc, tenant="deleter", policy=pol,
+                            seed=300)
+        ev = events[n_writers]
+        drng = np.random.default_rng(4000)
+        done = 0
+        while not stop.is_set() and (n_reqs == 0 or done < n_reqs):
+            done += 1
+            time.sleep(0.011)
+            kreq = np.unique(
+                del_slice[drng.integers(0, del_slice.size, 24)])
+            t_inv = time.perf_counter()
+            try:
+                found = cl.delete(kreq)
+            except ShermanError:
+                for k in kreq.tolist():
+                    unacked[n_writers].setdefault(k, []).append(
+                        (False, None))
+                continue
+            t_resp = time.perf_counter()
+            for k, f in zip(kreq.tolist(), found.tolist()):
+                # an acked delete leaves the key absent whether or not
+                # this call found it
+                acked[n_writers][k] = (False, None)
+                ev.append((k, A.OP_DELETE, t_inv, t_resp, None,
+                           bool(f)))
+
+    def reader(r: int):
+        cl = RetryingClient(svc, tenant=f"reader{r}", policy=pol,
+                            seed=200 + r, deadline_ms=5000.0)
+        ev = events[n_writers + 1 + r]
+        rrng = np.random.default_rng(50 + r)
+        while not stop.is_set():
+            kreq = np.unique(keys[rrng.integers(0, keys.size, 64)])
+            t_inv = time.perf_counter()
+            try:
+                got, found = cl.read(kreq)
+            except ShermanError:
+                continue
+            t_resp = time.perf_counter()
+            for k, g, f in zip(kreq.tolist(), got.tolist(),
+                               found.tolist()):
+                ev.append((k, A.OP_READ, t_inv, t_resp,
+                           g if f else None, bool(f)))
+            time.sleep(0.001)
+
+    readers = [threading.Thread(target=reader, args=(r,), daemon=True)
+               for r in range(n_readers)]
+    for t in readers:
+        t.start()
+    n_round = max(4, int(a.secs * 5))
+
+    def run_round(n_reqs: int):
+        ws = [threading.Thread(target=writer, args=(w, n_reqs),
+                               daemon=True) for w in range(n_writers)]
+        ws.append(threading.Thread(target=deleter, args=(n_reqs,),
+                                   daemon=True))
+        for t in ws:
+            t.start()
+        return ws
+
+    # round 1: acked load on the base chains
+    for t in run_round(n_round):
+        t.join(timeout=300)
+
+    # per-host delta checkpoints mid-stream: BOTH chains grow a link
+    # (rotation + sweep each scoped to its own -h<i>- namespace)
+    deltas = [hc[3].checkpoint_delta() for hc in hosts]
+    out["delta_pages"] = [int(d["pages"]) for d in deltas]
+
+    # round 2: acked load on the fresh segments
+    for t in run_round(n_round):
+        t.join(timeout=300)
+
+    # round 3: open-ended — the in-flight-at-the-kill load
+    ws = run_round(0)
+    time.sleep(min(0.5, a.secs / 4))
+
+    # -- crash: kill both doors, tear host 0's tail ONLY ----------------------
+    svc_stats = svc.stats()
+    for hc in hosts:
+        hc[4].kill()
+    stop.set()
+    for t in ws + readers:
+        t.join(timeout=120)
+    frontiers = svc.journal_frontiers()
+    out["frontiers"] = [[os.path.basename(p), int(n)]
+                        for p, n in frontiers]
+    torn_path = hosts[0][2].journal.path
+    with open(torn_path, "ab") as f:  # crash mid-append: torn half-frame
+        rec = J.encode_record(J.J_UPSERT,
+                              np.asarray([1 << 40], np.uint64),
+                              np.asarray([7], np.uint64), rid=0xDEAD)
+        f.write(rec[: len(rec) // 2])
+    out["torn"] = os.path.basename(torn_path)
+    assert "-h0-" in out["torn"], "tore the wrong host's tail"
+
+    # -- union recovery: every chain independently, torn tail local -----------
+    ctxs, union = RecoveryPlane.recover_union(root, hosts=H)
+    out["union"] = {"chains": union["chains"],
+                    "replay": union["replay"],
+                    "per_host_ms": union["per_host_ms"],
+                    "total_ms": union["total_ms"]}
+
+    # -- RPO: the merged acked-op ledger against the recovered engines --------
+    merged: dict = {}
+    for d in acked:
+        merged.update(d)
+    assert merged, "drill acked no ops before the kill"
+    assert any(not pres for pres, _ in merged.values()), \
+        "drill acked no deletes (mixed traffic pin)"
+    ak = np.asarray(sorted(merged), np.uint64)
+    a_pres = np.asarray([merged[int(k)][0] for k in ak], bool)
+    a_val = np.asarray([merged[int(k)][1] or 0 for k in ak], np.uint64)
+    a_own = router.owner(ak)
+    rpo = 0
+    post_events = []
+    for h in range(H):
+        sel = a_own == h
+        if not sel.any():
+            continue
+        t_inv = time.perf_counter()
+        got, found = _chunked_search(ctxs[h][3], ak[sel])
+        t_resp = time.perf_counter()
+        rpo += int((found != a_pres[sel]).sum())
+        rpo += int((got[found & a_pres[sel]]
+                    != a_val[sel][found & a_pres[sel]]).sum())
+        post_events += [(int(k), A.OP_READ, t_inv, t_resp,
+                         int(g) if f else None, bool(f))
+                        for k, g, f in zip(ak[sel].tolist(),
+                                           got.tolist(),
+                                           found.tolist())]
+    out["rpo_ops"] = rpo
+    assert rpo == 0, f"{rpo} acked ops lost across union recovery"
+    # untouched-key probe: bulk values still served verbatim
+    lost = rpo
+    probe = keys[~np.isin(keys, ak)][:: max(1, a.keys // 512)]
+    p_own = router.owner(probe)
+    for h in range(H):
+        pk = probe[p_own == h]
+        if not pk.size:
+            continue
+        got, found = _chunked_search(ctxs[h][3], pk)
+        lost += int((~found).sum()) + int(
+            (got[found] != (pk ^ np.uint64(SALT))[found]).sum())
+    out["lost_acks"] = lost
+    assert lost == 0, f"{lost} acked/bulk ops lost across recovery"
+
+    # -- cross-host replication seam: tail -h0- out of the shared dir ---------
+    # the follower group attaches to host 0's recovered plane; its
+    # tailer ships the -h0- chain while host 1's base/delta/journal
+    # files sit interleaved in the SAME directory — picking up any of
+    # them would corrupt the bootstrap, so convergence IS the pin.
+    plane0, cl0, tree0, eng0, _r0 = ctxs[0]
+    group = ReplicaGroup(plane0, 1, cache_slots=4096)
+    h0keys = keys[own == 0]
+    srv0 = front_door(eng0, 0, h0keys)
+    tail_acked: dict = {}
+    wcl = RetryingClient(srv0, tenant="tailwriter", policy=pol,
+                         seed=900)
+    wrng = np.random.default_rng(42)
+    for _ in range(max(4, n_round // 2)):
+        kreq = np.unique(h0keys[wrng.integers(0, h0keys.size, 48)])
+        vreq = kreq ^ np.uint64(SALT) ^ np.uint64(0x9999 << 16)
+        t_inv = time.perf_counter()
+        ok = wcl.insert(kreq, vreq)
+        t_resp = time.perf_counter()
+        for k, v, o in zip(kreq.tolist(), vreq.tolist(), ok.tolist()):
+            if o:
+                tail_acked[k] = v
+                post_events.append((k, A.OP_INSERT, t_inv, t_resp, v,
+                                    True))
+    lag_ms = group.measure_lag()
+    fol = group.followers[0]
+    tk = np.asarray(sorted(tail_acked), np.uint64)
+    tv = np.asarray([tail_acked[int(k)] for k in tk], np.uint64)
+    got, found = _chunked_search(fol.eng, tk)
+    diverged = int((~found).sum()) + int((got[found] != tv[found]).sum())
+    assert diverged == 0, \
+        f"cross-host follower diverged on {diverged} acked keys"
+    # certified replica reads over host 0's immutable slice
+    imm0 = imm[router.owner(imm) == 0]
+    fol.admit(imm0)
+    t_inv = time.perf_counter()
+    got, found = group.read(imm0[:256])
+    t_resp = time.perf_counter()
+    post_events += [(int(k), A.OP_READ, t_inv, t_resp,
+                     int(g) if f else None, bool(f))
+                    for k, g, f in zip(imm0[:256].tolist(),
+                                       np.asarray(got).tolist(),
+                                       np.asarray(found).tolist())]
+    st = group.stats()
+    out["tail"] = {
+        "of_host": 0, "applied_records": st["applied_records"],
+        "applied_rows": st["applied_rows"], "lag_ms": round(lag_ms, 2),
+        "reads_served": st["reads_served"],
+        "reads_forwarded": st["reads_forwarded"],
+        "converged_keys": int(tk.size),
+    }
+    assert st["applied_records"] > 0, "the cross-host tail shipped nothing"
+    assert st["reads_served"] > 0, "no replica-served reads"
+    srv0.drain()
+    group.close()
+
+    # -- offline linearizability over the WHOLE routed history ----------------
+    all_events = [e for ev in events for e in ev] + post_events
+    initial = {int(k): (True, int(v)) for k, v in zip(keys, vals)}
+    open_w: dict = {}
+    for d in unacked:
+        for k, outs in d.items():
+            open_w.setdefault(k, []).extend(outs)
+    verdict = A.check_events(all_events, initial=initial,
+                             open_writes=open_w)
+    out["audit"] = {
+        "events": verdict["events"],
+        "keys": verdict["keys"],
+        "reads_checked": verdict["reads"],
+        "violations": len(verdict["violations"]),
+        "linearizable": bool(verdict["linearizable"]),
+    }
+    out["linearizable"] = bool(verdict["linearizable"])
+    if verdict["violations"]:
+        out["audit"]["first_violations"] = verdict["violations"][:3]
+    assert verdict["linearizable"], \
+        f"history not linearizable: {verdict['violations'][:3]}"
+    assert verdict["reads"] > 0, "audit checked no reads"
+    jsonl = os.path.join(root, "history.jsonl")
+    A.dump_jsonl(all_events, jsonl)
+    out["history_jsonl"] = jsonl
+
+    # -- the service-plane receipt --------------------------------------------
+    out["service"] = {
+        "admitted_ops": svc_stats["admitted_ops"],
+        "served_ops": svc_stats["served_ops"],
+        "acked_writes": svc_stats["acked_writes"],
+        "widths": svc_stats["widths"],
+        "contract": svc_stats["contract"],
+    }
+    if "journal" in svc_stats:
+        out["service"]["journal"] = svc_stats["journal"]
+    assert svc_stats["acked_writes"] > 0
+
+    # -- journal ack bandwidth: shared stream vs per-host streams -------------
+    out["ack_bandwidth"] = _ack_bandwidth_ab(root, n_hosts=H,
+                                             total=1000)
+    assert out["ack_bandwidth"]["speedup"] >= 1.5, (
+        "per-host journal streams cleared only "
+        f"{out['ack_bandwidth']['speedup']}x the shared stream "
+        "(want >= 1.5x)")
+
+    for _pl, _cl, _tr, _en, _rc in ctxs:
+        _pl.close()
+    d = obs.delta(snap0, obs.snapshot())
+    out["obs"] = {k: round(float(d[k]), 2) for k in sorted(d)
+                  if k.startswith("multihost.")
+                  or k in ("recovery.replayed_records",)}
+    assert d.get("multihost.split_submits", 0) > 0
+    out["elapsed_s"] = round(time.time() - t_start, 1)
+    out["ok"] = True
+    line = json.dumps(out)
+    print(line)
+    receipt = os.environ.get("SHERMAN_MULTIHOST_RECEIPT")
+    if receipt:
+        with open(receipt, "w") as f:
+            f.write(line + "\n")
+    print("MULTIHOST-DRILL PASS", file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    main()

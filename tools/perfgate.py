@@ -86,6 +86,16 @@ Comparability rules (the trajectory's own lessons):
   two of their own, ``fenced_acks_merged > 0`` and
   ``diverged_followers_unrepaired > 0`` — each a zero-tolerance
   split-brain/divergence verdict, marginless;
+- a HOST-COUNT change is incomparable config (PR 19): rows whose
+  ``config.hosts`` differ (missing = 1, the pre-multihost fact) never
+  throughput-gate in either direction — N per-host journal streams
+  fsync in parallel and N front doors admit independently, so a
+  multihost number is a different service plane, not a faster one.
+  Multihost-drill receipts (``tools/multihost_drill.py``, metric
+  ``multihost_drill``) carry the contract hard-red pins plus
+  ``rpo_ops > 0`` — an acked op missing after union recovery is lost
+  durability, marginless; the drill's ack-bandwidth speedup is
+  published in the receipt, never gated here against hosts=1 rounds;
 - a PREP-PLACEMENT change is incomparable config (PR 17): rows whose
   ``config.prep_impl`` or ``config.write_combine`` differ never
   throughput-gate against each other — host prep serializes
@@ -263,6 +273,18 @@ def _quorum_cfg(r: dict) -> int:
                or (r.get("serve") or {}).get("ack_quorum") or 1)
 
 
+def _hosts_cfg(r: dict) -> int:
+    """The receipt's host count (config.hosts, PR 19).  Absent
+    everywhere = 1, the pre-multihost fact: every committed round ran
+    one host's front door and one journal stream — so the whole
+    committed trajectory keeps comparing.  A multihost round fsyncs N
+    journal streams in parallel and admits through N width
+    controllers; its numbers never gate against single-host rounds in
+    either direction (the PR 12 ``nodes`` rule's pattern)."""
+    return int((r.get("config") or {}).get("hosts")
+               or r.get("hosts") or 1)
+
+
 def _comparable(cand: dict, r: dict, metric: str) -> bool:
     if r.get("keys") != cand.get("keys") \
             or r.get("batch") != cand.get("batch"):
@@ -293,6 +315,14 @@ def _comparable(cand: dict, r: dict, metric: str) -> bool:
     # receipt without the field ran machine_nr=1 (the pre-field
     # bench.py hardcoded it).
     if (r.get("nodes") or 1) != (cand.get("nodes") or 1):
+        return False
+    # host-count rule (PR 19): differing host counts never compare —
+    # N per-host journal streams ack in parallel and N front doors
+    # admit independently, so a multihost number is a different
+    # service plane, not a faster one.  Missing field = hosts=1 (the
+    # pre-multihost fact), so the committed trajectory keeps
+    # comparing.
+    if _hosts_cfg(r) != _hosts_cfg(cand):
         return False
     # value-config rule (PR 14): rows with differing value_bytes /
     # value_dist / value_heap never gate against each other — an
@@ -465,13 +495,15 @@ def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
     # is a hard red with no margin: each is a count/verdict of a
     # correctness hazard, not a wall.
     if cand.get("metric") in ("contract_drill", "failover_drill",
-                              "partition_drill") \
+                              "partition_drill", "multihost_drill") \
             or "duplicate_acks" in cand or "linearizable" in cand \
             or "fenced_acks_merged" in cand:
         # partition-drill pins (PR 18) ride the same marginless rule:
         # a merged fenced ack or an unrepaired diverged follower is a
-        # split-brain/divergence verdict, not a wall
-        for name in ("duplicate_acks", "lost_acks",
+        # split-brain/divergence verdict, not a wall; the multihost
+        # drill (PR 19) adds rpo_ops — an acked op missing after
+        # union recovery is lost durability, not a slow number
+        for name in ("duplicate_acks", "lost_acks", "rpo_ops",
                      "fenced_acks_merged",
                      "diverged_followers_unrepaired"):
             val = cand.get(name)
