@@ -115,7 +115,11 @@ journal-shipped followers + lease-epoch promotion + replica-served
 reads; kill the primary under acked traffic -> promote the highest-
 watermark follower -> lost_acks == 0, duplicate_acks == 0,
 linearizable == true) — see README "Replication & failover";
-``bench.py --serve`` runs the serving
+``bench.py --hostfail-drill`` runs the host-loss drill
+(tools/hostfail_drill.py: cross-host lease expiry under traffic ->
+chain adoption by the surviving host -> zombie-host acks fenced, never
+merged -> retried rids re-acked through the adopter) — see README
+"Host failure"; ``bench.py --serve`` runs the serving
 front door's OPEN-loop bench (tools/serve_bench.py: multi-tenant paced
 clients through sherman_tpu/serve.py — SLO-adaptive step width,
 fair-share admission + typed backpressure, journaled write acks, and
@@ -1509,6 +1513,27 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import multihost_drill
         multihost_drill.main(sys.argv[1:])
+        return
+
+    if "--hostfail-drill" in sys.argv:
+        # Host-loss lane: the host-failure tolerance plane rehearsed
+        # end to end (cross-host lease table with durable heartbeat
+        # records -> host 0 freezes mid-traffic, its lease expires
+        # under load -> host 1 adopts the dead chain: fence point,
+        # journaled ownership map, dedup window re-seeded into a
+        # fresh door, routing overlay published -> the zombie host
+        # revives and its stale acks land PAST the fence, provably
+        # never merged, typed-refused once healed -> retried rids
+        # re-ack original results through the adopter), pinning
+        # lost_acks == 0, duplicate_acks == 0, linearizable == true,
+        # fenced_acks_merged == 0, unadopted_dead_hosts == 0 and the
+        # published availability gap.  tools/hostfail_drill.py owns
+        # the sequence; it prints its own one-line JSON receipt.
+        sys.argv.remove("--hostfail-drill")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import hostfail_drill
+        hostfail_drill.main(sys.argv[1:])
         return
 
     if "--reshard-drill" in sys.argv:

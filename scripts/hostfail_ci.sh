@@ -1,0 +1,120 @@
+#!/usr/bin/env bash
+# Host-loss tolerance CI lane: pin the cross-host lease table +
+# zombie-host fencing + chain adoption plane (sherman_tpu/hostlease.py
+# HostLeaseTable/HostFence/OwnershipLog/HostFailover + chaos.py
+# HostChaos + multihost.py overlay routing/fan-out scans).
+#
+# Runs (1) the hostfail fast tier — the lease knobs, the durable
+# heartbeat/expiry/epoch protocol (CRC-framed records, typed
+# corruption), the ownership log's begin/done folding and torn-tail
+# tolerance, the host chaos grammar, the journal-gate host fence with
+# the zombie fenced-suffix walk, detection + adoption + crash-resumed
+# adoption, and the perfgate hostfail pins; (2) a single-host
+# NO-LEASE-PLANE pin — at hosts=1 the lease table refuses to build, no
+# hostlease-*/ownership.* files appear, and the journal bytes stay
+# byte-identical to a pre-plane build; and (3) the emulated 2-host
+# drill end to end (freeze -> lease expiry under traffic -> adoption
+# -> zombie fencing) with its receipt pins asserted and perfgate run
+# on the live receipt.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== hostfail fast tier (lease table, fence, adoption, resume) =="
+python -m pytest tests/test_hostfail.py -q -m 'not slow'
+python -m pytest tests/test_multihost_plane.py -q
+
+echo "== single-host pin (hosts=1: no lease plane, bytes identical) =="
+python - <<'EOF'
+import glob
+import os
+import re
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import DSMConfig, TreeConfig
+from sherman_tpu.errors import StateError
+from sherman_tpu.hostlease import HostLeaseTable
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.recovery import RecoveryPlane
+
+def build(rdir, **plane_kw):
+    cfg = DSMConfig(machine_nr=4, pages_per_node=512, locks_per_node=256,
+                    step_capacity=256, chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=128,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    keys = np.arange(1, 301, dtype=np.uint64) * np.uint64(7919)
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0xABCD))
+    eng.attach_router()
+    plane = RecoveryPlane(cluster, tree, eng, rdir, **plane_kw)
+    plane.checkpoint_base()
+    eng.insert(keys[:64], keys[:64] ^ np.uint64(0x11))
+    assert eng.delete(keys[64:72]).all()
+    jpath = eng.journal.path
+    blob = open(jpath, "rb").read()
+    plane.close()
+    return sorted(os.path.basename(f)
+                  for f in glob.glob(os.path.join(rdir, "*"))), blob
+
+with tempfile.TemporaryDirectory() as da, \
+        tempfile.TemporaryDirectory() as db:
+    # a hosts=1 directory must never grow a lease plane: the table
+    # refuses construction typed, and the artifact set + journal
+    # bytes are identical to a build that never imported hostlease
+    try:
+        HostLeaseTable(da, 1)
+        raise SystemExit("hosts=1 lease table did not refuse")
+    except StateError:
+        pass
+    names_a, jblob_a = build(da)
+    names_b, jblob_b = build(db, host_id=0, hosts=1)
+assert jblob_a == jblob_b, "journal frames differ at hosts=1 defaults"
+pat = re.compile(r"^(base\.npz|delta-[0-9a-f]{8}-\d{6}\.npz|"
+                 r"journal-[0-9a-f]{8}-\d{6}\.wal)$")
+for names in (names_a, names_b):
+    assert all(pat.match(n) for n in names), names  # legacy, un-tagged
+    assert not any("-h" in n for n in names), names
+    assert not any(n.startswith(("hostlease-", "ownership."))
+                   for n in names), names
+print("single-host pin: no lease/ownership artifacts at hosts=1,",
+      f"journal bytes identical ({len(jblob_a)} B)")
+EOF
+
+echo "== hostfail drill (freeze -> expire -> adopt -> zombie fence) =="
+SHERMAN_HOSTFAIL_RECEIPT=/tmp/_hostfail_ci.json \
+    python bench.py --hostfail-drill --keys 3000
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/_hostfail_ci.json"))
+assert d["ok"], "drill not ok"
+assert d["hosts"] == 2, d["hosts"]
+assert d["lost_acks"] == 0, f"lost acks: {d['lost_acks']}"
+assert d["duplicate_acks"] == 0, f"duplicate acks: {d['duplicate_acks']}"
+assert d["linearizable"] is True, "history not linearizable"
+assert d["fenced_acks_merged"] == 0, \
+    f"zombie acks merged: {d['fenced_acks_merged']}"
+assert d["unadopted_dead_hosts"] == 0, "a dead host was never adopted"
+assert d["fenced_suffix_frames"] >= 1, "no zombie acks landed past fence"
+assert d["zombie_typed_rejections"] >= 1, "no typed zombie rejection"
+assert d["adoption"]["seeded"] > 0, "dedup window not re-seeded"
+assert d["availability_gap_ms"] > 0, "no availability gap published"
+assert d["obs"]["hostfail.adoptions"] == 1, "no adoption recorded"
+print("hostfail drill:", d["hosts"], "hosts;",
+      "adoption", f"{d['adoption']['adoption_ms']}ms,",
+      "availability gap", f"{d['availability_gap_ms']}ms;",
+      d["fenced_suffix_frames"], "fenced zombie frames, 0 merged;",
+      d["audit"]["events"], "events audited,",
+      d["audit"]["reads_checked"], "reads checked")
+EOF
+
+echo "== perfgate: committed hostfail receipt passes on its pins =="
+python tools/perfgate.py --receipt /tmp/_hostfail_ci.json --json
+echo "HOSTFAIL-CI PASS"

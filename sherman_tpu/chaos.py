@@ -91,6 +91,7 @@ KINDS = ("torn_page", "flip_entry_ver", "wedge_lock", "drop_cas",
          "stale_read")
 REPL_KINDS = ("repl_drop", "repl_delay", "repl_reorder",
               "repl_partition", "repl_slow")
+HOST_KINDS = ("host_crash", "host_freeze", "host_zombie")
 
 # a lease word no live client can own: unregistered owner tag + an
 # epoch far from any real client's generation
@@ -102,6 +103,8 @@ _OBS_TOTAL = obs.counter("chaos.faults_injected")
 _OBS_REPL = {k: obs.counter(f"chaos.{k}") for k in REPL_KINDS}
 _OBS_REPL_TOTAL = obs.counter("chaos.repl_faults_injected")
 _OBS_REPL_DETECTED = obs.counter("chaos.repl_detected")
+_OBS_HOST = {k: obs.counter(f"chaos.{k}") for k in HOST_KINDS}
+_OBS_HOST_TOTAL = obs.counter("chaos.host_faults_injected")
 
 
 @dataclasses.dataclass
@@ -343,26 +346,238 @@ class ReplChaos:
                  "fired": f.fired} for f in self.faults]
 
 
+@dataclasses.dataclass
+class HostFault:
+    """One scheduled HOST-granularity fault.  ``at`` is the window
+    start on the host layer's dispatch clock (one tick per
+    ``MultihostService`` dispatch), ``span`` the window length in
+    ticks, ``host`` the victim host index.  ``host_crash`` and
+    ``host_freeze`` make the host unreachable at the dispatch seam and
+    suppress its lease renewals (crash = process gone, freeze = alive
+    but making no progress); ``host_zombie`` keeps the host reachable
+    and acking but freezes its VIEW of its own lease record — the
+    fencing plane's split-brain ingredient."""
+
+    kind: str
+    host: int = 0
+    at: int = 0
+    span: int = 1
+    fired: bool = dataclasses.field(default=False, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in HOST_KINDS:
+            raise ConfigError(f"unknown host fault kind {self.kind!r}; "
+                              f"want one of {HOST_KINDS}")
+        if self.span < 1:
+            raise ConfigError(f"host fault span {self.span}: want >= 1")
+        if self.host < 0:
+            raise ConfigError(f"host fault host {self.host}: want >= 0")
+
+
+class HostChaos:
+    """The host-granularity fault layer a :class:`FaultPlan` exposes.
+
+    Attached to a ``MultihostService`` (``service.attach_chaos``),
+    which asks :meth:`on_dispatch` before routing any sub-batch to a
+    host; the host lease table asks :meth:`allow_renew` before each
+    heartbeat and routes a zombified host's self-reads through
+    :meth:`lease_view`.  Scheduled windows ride a dispatch clock (one
+    tick per service dispatch); drills drive failures by hand with
+    :meth:`crash`/:meth:`freeze`/:meth:`revive`/:meth:`heal` — the
+    two compose, like :class:`ReplChaos`'s holds."""
+
+    def __init__(self, faults, seed: int = 0):
+        self.faults = [f if isinstance(f, HostFault) else HostFault(**f)
+                       for f in faults]
+        self.seed = int(seed)
+        self._clock = 0              # host time: one tick per dispatch
+        self._crashed: set[int] = set()
+        self._frozen: set[int] = set()
+        self._zombie: set[int] = set()
+        #: per-host frozen lease-record snapshots (zombie view)
+        self._lease_frozen: dict[int, dict | None] = {}
+        self.injected = 0
+
+    # -- scheduling -----------------------------------------------------------
+
+    def _active(self, t: int, host: int) -> list[HostFault]:
+        return [f for f in self.faults
+                if f.host == host and f.at <= t < f.at + f.span]
+
+    def _fire(self, f: HostFault, t: int) -> None:
+        if f.fired:
+            return
+        f.fired = True
+        self.injected += 1
+        _OBS_HOST_TOTAL.inc()
+        _OBS_HOST[f.kind].inc()
+        obs.record_event("chaos.host_inject", fault=f.kind,
+                         host=int(f.host), at=t, span=int(f.span))
+
+    def _manual_inject(self, kind: str, host: int) -> None:
+        self.injected += 1
+        _OBS_HOST_TOTAL.inc()
+        _OBS_HOST[kind].inc()
+        obs.record_event("chaos.host_inject", fault=kind,
+                         host=int(host), at=self._clock, span=-1)
+
+    def _state(self, host: int, t: int, tick_fire: bool) -> str:
+        """Composed manual + scheduled state: ``"up"`` / ``"crash"`` /
+        ``"freeze"`` / ``"zombie"`` (crash dominates freeze dominates
+        zombie)."""
+        host = int(host)
+        kinds = set()
+        if host in self._crashed:
+            kinds.add("host_crash")
+        if host in self._frozen:
+            kinds.add("host_freeze")
+        if host in self._zombie:
+            kinds.add("host_zombie")
+        for f in self._active(t, host):
+            if tick_fire:
+                self._fire(f, t)
+            kinds.add(f.kind)
+        if "host_crash" in kinds:
+            return "crash"
+        if "host_freeze" in kinds:
+            return "freeze"
+        if "host_zombie" in kinds:
+            return "zombie"
+        return "up"
+
+    # -- the dispatch hook (service routing seam) -----------------------------
+
+    def on_dispatch(self, host: int) -> dict | None:
+        """Directive for routing one sub-batch to ``host`` at this
+        dispatch tick, or None when the host is healthy (the zero-cost
+        common case).  ``{"down": True}`` means the host is
+        unreachable (crashed or frozen) — the service must refuse
+        typed rather than strand a sub-future.  A zombie host is NOT
+        down: it accepts and acks (that's the hazard the fence
+        catches)."""
+        t = self._clock
+        self._clock += 1
+        state = self._state(host, t, tick_fire=True)
+        if state == "up":
+            return None
+        return {"down": state in ("crash", "freeze"), "state": state}
+
+    # -- the lease-renewal seam -----------------------------------------------
+
+    def allow_renew(self, host: int) -> bool:
+        """May ``host`` heartbeat its lease record right now?  False
+        while crashed, frozen OR zombified — a zombie's renewals are
+        suppressed too (its lease legitimately expired; letting it
+        re-stamp the record would resurrect the lease the adopter is
+        about to bump)."""
+        return self._state(int(host), self._clock,
+                           tick_fire=False) == "up"
+
+    def lease_view(self, host: int, record: dict | None):
+        """``host``'s lease record as ITS OWN fence sees it.  While the
+        host is frozen or zombified the view is pinned at the first
+        observation — the host cannot watch its epoch get bumped, so
+        it keeps acking; heal/revive restores the live record and the
+        fence fires on the next append."""
+        host = int(host)
+        state = self._state(host, self._clock, tick_fire=False)
+        if state in ("freeze", "zombie"):
+            if host not in self._lease_frozen:
+                self._lease_frozen[host] = None if record is None \
+                    else dict(record)
+            return self._lease_frozen[host]
+        self._lease_frozen.pop(host, None)
+        return record
+
+    # -- manual failure control (drills) --------------------------------------
+
+    def crash(self, host: int) -> None:
+        """Kill ``host`` by hand: unreachable at the dispatch seam,
+        renewals suppressed, until :meth:`revive`/:meth:`heal`."""
+        self._crashed.add(int(host))
+        self._manual_inject("host_crash", host)
+
+    def freeze(self, host: int) -> None:
+        """Freeze ``host`` by hand: alive but making no progress —
+        dispatch refused, renewals suppressed, lease view pinned."""
+        self._frozen.add(int(host))
+        self._manual_inject("host_freeze", host)
+
+    def revive(self, host: int, zombie: bool = True) -> None:
+        """Bring a crashed/frozen host back.  ``zombie=True`` (the
+        interesting case) revives it with its lease view still pinned
+        at the pre-failure snapshot: it dispatches and acks as if it
+        still owned its epoch — the fenced-suffix scenario.
+        ``zombie=False`` is a clean restart (live view)."""
+        host = int(host)
+        self._crashed.discard(host)
+        self._frozen.discard(host)
+        if zombie:
+            self._zombie.add(host)
+            self._manual_inject("host_zombie", host)
+        else:
+            self._zombie.discard(host)
+            self._lease_frozen.pop(host, None)
+
+    def heal(self, host: int | None = None) -> None:
+        """End every manual failure (or just ``host``'s): the next
+        lease-view read sees the live record, so a fenced host's next
+        append raises typed."""
+        if host is None:
+            self._crashed.clear()
+            self._frozen.clear()
+            self._zombie.clear()
+            self._lease_frozen.clear()
+        else:
+            host = int(host)
+            self._crashed.discard(host)
+            self._frozen.discard(host)
+            self._zombie.discard(host)
+            self._lease_frozen.pop(host, None)
+        obs.record_event("chaos.host_heal", at=self._clock,
+                         host=-1 if host is None else host)
+
+    @property
+    def exhausted(self) -> bool:
+        """Every scheduled window has passed and no manual failure is
+        open."""
+        return (not self._crashed and not self._frozen
+                and not self._zombie and all(
+                    f.at + f.span <= self._clock for f in self.faults))
+
+    def describe(self) -> list[dict]:
+        return [{"kind": f.kind, "host": f.host, "at": f.at,
+                 "span": f.span, "fired": f.fired} for f in self.faults]
+
+
 class FaultPlan:
     """A deterministic schedule of data-plane faults over one DSM.
     ``repl_*`` kinds in the same grammar are split out into the
-    replication layer (:meth:`repl_layer`) instead of the DSM hook."""
+    replication layer (:meth:`repl_layer`), ``host_*`` kinds into the
+    host layer (:meth:`host_layer`), instead of the DSM hook."""
 
     def __init__(self, faults, seed: int = 0):
         self.faults = []
         repl = []
+        host = []
         for f in faults:
             if isinstance(f, ReplFault):
                 repl.append(f)
+            elif isinstance(f, HostFault):
+                host.append(f)
             elif isinstance(f, Fault):
                 self.faults.append(f)
             elif isinstance(f, dict) and f.get("kind") in REPL_KINDS:
                 repl.append(ReplFault(**f))
+            elif isinstance(f, dict) and f.get("kind") in HOST_KINDS:
+                host.append(HostFault(**f))
             else:
                 self.faults.append(Fault(**f))
         self.seed = int(seed)
         self.repl_faults = repl
+        self.host_faults = host
         self._repl_layer: ReplChaos | None = None
+        self._host_layer: HostChaos | None = None
         self._rng = np.random.default_rng(self.seed)
         self._steps = 0
         self._undo: list = []       # (space, row, col, old_value)
@@ -377,6 +592,15 @@ class FaultPlan:
             self._repl_layer = ReplChaos(self.repl_faults,
                                          seed=self.seed)
         return self._repl_layer
+
+    def host_layer(self) -> "HostChaos | None":
+        """The plan's host fault layer (None when the plan has no
+        ``host_*`` faults); built once, shared by every caller so the
+        dispatch clock is service-global."""
+        if self._host_layer is None and self.host_faults:
+            self._host_layer = HostChaos(self.host_faults,
+                                         seed=self.seed)
+        return self._host_layer
 
     # -- construction ---------------------------------------------------------
 
@@ -645,4 +869,10 @@ class FaultPlan:
             out.extend({"kind": f.kind, "poll": f.poll, "span": f.span,
                         "follower": f.follower, "scope": f.scope,
                         "fired": f.fired} for f in self.repl_faults)
+        if self._host_layer is not None:
+            out.extend(self._host_layer.describe())
+        else:
+            out.extend({"kind": f.kind, "host": f.host, "at": f.at,
+                        "span": f.span, "fired": f.fired}
+                       for f in self.host_faults)
         return out

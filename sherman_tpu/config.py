@@ -368,6 +368,52 @@ def host_id() -> int:
     return h
 
 
+def host_lease_s() -> float:
+    """Host-lease expiry knob (``SHERMAN_HOST_LEASE_S``): how long a
+    host's durable heartbeat record in the shared chain directory
+    (``sherman_tpu/hostlease.py``) stays live without a renewal before
+    liveness probes judge the host DEAD — the cross-host twin of the
+    client lease table's expiry discipline.  Expiry alone changes
+    nothing durable; it licenses a surviving host to bump the dead
+    host's lease epoch (the fence point) and adopt its chain
+    namespace.  Too short risks adopting a merely-slow host (its
+    post-adoption writes then fence typed — safe, but an availability
+    blip); too long stretches the unserved window for the dead host's
+    keys."""
+    import os
+    v = os.environ.get("SHERMAN_HOST_LEASE_S", "2").strip()
+    try:
+        s = float(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_HOST_LEASE_S={v!r}: want a float of seconds")
+    if s <= 0:
+        raise ConfigError(f"SHERMAN_HOST_LEASE_S={s}: want > 0")
+    return s
+
+
+def host_probe_s() -> float:
+    """Host liveness-probe cadence knob (``SHERMAN_HOST_PROBE_S``):
+    seconds between background sweeps of the host lease table
+    (``HostFailover.start``) looking for expired peers.  0 disables
+    the background prober (the SHIPPED DEFAULT — drills and operators
+    call ``detect()`` explicitly); a positive cadence should be well
+    under ``SHERMAN_HOST_LEASE_S`` so expiry is noticed within one
+    lease window."""
+    import os
+    v = os.environ.get("SHERMAN_HOST_PROBE_S", "0").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return 0.0
+    try:
+        s = float(v)
+    except ValueError:
+        raise ConfigError(
+            f"SHERMAN_HOST_PROBE_S={v!r}: want a float of seconds")
+    if s < 0:
+        raise ConfigError(f"SHERMAN_HOST_PROBE_S={s}: want >= 0")
+    return s
+
+
 @dataclasses.dataclass(frozen=True)
 class DSMConfig:
     """Cluster + memory-pool shape (reference ``Config.h:13-22``).

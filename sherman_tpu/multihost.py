@@ -58,6 +58,15 @@ from sherman_tpu.errors import ConfigError, StateError
 
 _OBS_SPLITS = obs.counter("multihost.split_submits")
 _OBS_ROUTED = obs.counter("multihost.routed_ops")
+_OBS_SCANS = obs.counter("multihost.fanout_scans")
+_OBS_ADOPTIONS = obs.counter("multihost.adoptions")
+
+
+class HostDownError(StateError):
+    """The owner host of (part of) this request is unreachable —
+    crashed or frozen at the dispatch seam.  Typed so clients retry by
+    rid once an adopter serves the namespace (exactly-once re-acks),
+    instead of stranding a half-submitted merged future."""
 
 #: cached :func:`multihost_capable` probe result —
 #: ``[(ok: bool, reason: str)]`` once probed, shared with conftest
@@ -146,14 +155,44 @@ class HostRouter:
     routing (pool placement and service ownership are different
     axes: any host can read any page; only the owner journals the
     write).
+
+    **Adoption overlay** (PR 20): :meth:`owner` is namespace IDENTITY
+    and never changes — a dead host's keys still belong to ITS chain
+    namespace.  The overlay answers a different question — which
+    host's PROCESS currently serves that namespace
+    (:meth:`route`): after host-loss failover, ``overlay[dead] =
+    adopter``.  The map itself is durably journaled by the failover
+    plane (``hostlease.OwnershipLog``); this is the in-memory routing
+    view the service publishes.
     """
 
-    __slots__ = ("hosts",)
+    __slots__ = ("hosts", "overlay")
 
     def __init__(self, hosts: int):
         if int(hosts) < 1:
             raise ConfigError(f"HostRouter wants hosts >= 1 (got {hosts})")
         self.hosts = int(hosts)
+        #: namespace -> serving host (absent = serves itself)
+        self.overlay: dict[int, int] = {}
+
+    def route(self, host: int) -> int:
+        """Which host's process serves ``host``'s namespace right now
+        (identity until an adoption installs an overlay entry)."""
+        return self.overlay.get(int(host), int(host))
+
+    def adopt(self, dead: int, adopter: int) -> None:
+        """Install one adoption: ``dead``'s namespace is now served by
+        ``adopter``'s process.  Ownership (:meth:`owner`) is
+        unchanged — the adopted front door runs over the DEAD
+        namespace's recovered engine, not the adopter's own."""
+        dead, adopter = int(dead), int(adopter)
+        if not (0 <= dead < self.hosts and 0 <= adopter < self.hosts):
+            raise ConfigError(
+                f"adopt({dead} -> {adopter}): hosts outside "
+                f"[0, {self.hosts})")
+        if dead == adopter:
+            raise ConfigError(f"host {dead} cannot adopt itself")
+        self.overlay[dead] = adopter
 
     def owner(self, keys) -> np.ndarray:
         """Owner host per key -> int32 [n] in [0, hosts)."""
@@ -259,6 +298,41 @@ class _MergedFuture:
         return ok
 
 
+class _MergedScan:
+    """Future over one fan-out scan: every host runs the SAME range
+    set over its own shard (a hash partition scatters any range's keys
+    across all hosts), and each range's per-host results concatenate
+    and re-sort by key — ``range_query_many``'s per-range order,
+    restored plane-wide.  Duck-types the ``ServeFuture`` surface."""
+
+    __slots__ = ("tenant", "n_ranges", "parts")
+
+    def __init__(self, tenant: str, n_ranges: int, parts: list):
+        self.tenant = tenant
+        self.n_ranges = int(n_ranges)
+        #: [(host, sub_future)] — every host contributes to every range
+        self.parts = parts
+
+    def done(self) -> bool:
+        return all(f.done() for _h, f in self.parts)
+
+    @property
+    def deduped(self) -> bool:
+        return False            # scans never ride the write contract
+
+    def result(self, timeout: float | None = None):
+        per_host = [f.result(timeout) for _h, f in self.parts]
+        out = []
+        for r in range(self.n_ranges):
+            ks = np.concatenate([np.asarray(ph[r][0], np.uint64)
+                                 for ph in per_host])
+            vs = np.concatenate([np.asarray(ph[r][1], np.uint64)
+                                 for ph in per_host])
+            order = np.argsort(ks, kind="stable")
+            out.append((ks[order], vs[order]))
+        return out
+
+
 class MultihostService:
     """One logical front door over N per-host servers.
 
@@ -266,10 +340,13 @@ class MultihostService:
     (:meth:`HostRouter.split`); each sub-batch is admitted by the
     owner's own ``WidthController``/tenant gates and — for writes —
     acked only after the OWNER's journal fsync covers it.  The merged
-    future resolves in the original batch order.  Scans are refused
-    typed: a hash partition has no contiguous key ranges to scan
-    per-host (range ownership is the documented non-goal of the mix
-    router; scan workloads stay on single-host planes).
+    future resolves in the original batch order.  Scans FAN OUT: a
+    hash partition scatters every range's keys across all hosts, so
+    each host runs the whole range set over its shard and the merged
+    future re-sorts each range plane-wide (YCSB-E runs through the
+    merged door).  The one typed refusal left is a scan carrying a
+    resume ``cursor``: a cursor token is positional within ONE host's
+    range walk and does not compose over a hash partition.
 
     The service itself holds NO pool state — it is a routing table
     plus futures glue, exactly the piece a real pod runs on every
@@ -291,24 +368,58 @@ class MultihostService:
         #: frontier tokens through the service handle; optional — the
         #: front door itself never touches the chain
         self.planes = list(planes) if planes is not None else None
+        self._chaos = None      # HostChaos at the dispatch seam
+        self.adoptions = 0
+
+    def attach_chaos(self, host_chaos) -> None:
+        """Install a ``chaos.HostChaos`` layer at the dispatch seam:
+        every sub-batch's serving host is checked before routing —
+        crashed/frozen hosts refuse typed (:class:`HostDownError`)."""
+        self._chaos = host_chaos
+
+    def _check_dispatch(self, owners) -> None:
+        """Ask the chaos layer about EVERY serving host of this
+        request BEFORE submitting any part — a typed refusal must not
+        strand sub-futures already admitted on live hosts."""
+        if self._chaos is None:
+            return
+        for h in owners:
+            serving = self.router.route(h)
+            d = self._chaos.on_dispatch(serving)
+            if d is not None and d.get("down"):
+                raise HostDownError(
+                    f"host {serving} (serving namespace {h}) is "
+                    f"unreachable ({d.get('state')}); retry by rid "
+                    "once the namespace is adopted")
 
     def submit(self, op: str, keys=None, values=None, *,
-               tenant: str = "default", rid=None,
-               deadline_ms: float | None = None):
+               tenant: str = "default", ranges=None, cursor=None,
+               rid=None, deadline_ms: float | None = None):
         """Split-admit one request across owner hosts -> a merged
         future (original batch order).  Single-host planes delegate
         straight through — zero added surface at hosts=1."""
-        if op == "scan":
+        if cursor is not None:
             raise ConfigError(
-                "scans do not split over a hash-partitioned host plane "
-                "(no contiguous per-host key ranges); submit scans to "
-                "a single-host front door")
+                "scan cursors do not resume over a hash-partitioned "
+                "host plane (a resume token is positional within one "
+                "host's range walk); re-submit the full ranges, or "
+                "resume on a single-host front door")
         if self.hosts == 1:
             return self.servers[0].submit(
-                op, keys, values, tenant=tenant, rid=rid,
-                deadline_ms=deadline_ms)
+                op, keys, values, tenant=tenant, ranges=ranges,
+                rid=rid, deadline_ms=deadline_ms)
+        if op == "scan":
+            if not ranges:
+                raise ConfigError("scan submit needs ranges")
+            self._check_dispatch(range(self.hosts))
+            _OBS_SCANS.inc()
+            parts = [(h, self.servers[h].submit(
+                "scan", tenant=tenant, ranges=ranges,
+                deadline_ms=deadline_ms)) for h in range(self.hosts)]
+            return _MergedScan(tenant, len(ranges), parts)
         keys = np.ascontiguousarray(keys, np.uint64)
         parts_in = self.router.split(keys, values)
+        self._check_dispatch([h for h, _i, _k, _v in parts_in])
         _OBS_SPLITS.inc()
         _OBS_ROUTED.inc(int(keys.size))
         parts = []
@@ -318,6 +429,26 @@ class MultihostService:
                 deadline_ms=deadline_ms)
             parts.append((h, idx, f))
         return _MergedFuture(op, tenant, int(keys.size), rid, parts)
+
+    def adopt(self, dead: int, server, *, plane=None,
+              adopter: int | None = None) -> None:
+        """Swap ``dead``'s front door for the ADOPTED one (a fresh
+        server over the dead namespace's recovered engine, run by the
+        adopter's process) and install the router overlay.  Called by
+        ``hostlease.HostFailover.adopt`` after the done frame is
+        durable — the service's in-memory view follows the journaled
+        ownership map, never leads it."""
+        dead = int(dead)
+        if not (0 <= dead < self.hosts):
+            raise ConfigError(f"adopt: host {dead} outside "
+                              f"[0, {self.hosts})")
+        self.servers[dead] = server
+        if self.planes is not None and plane is not None:
+            self.planes[dead] = plane
+        if adopter is not None:
+            self.router.adopt(dead, adopter)
+        self.adoptions += 1
+        _OBS_ADOPTIONS.inc()
 
     def journal_frontiers(self) -> list[tuple[str, int]]:
         """Per-host durable journal frontier tokens, host order —
@@ -331,8 +462,15 @@ class MultihostService:
 
     def stats(self) -> dict:
         """One logical SLO plane over the per-host receipts
-        (:func:`merge_host_stats`)."""
-        return merge_host_stats([s.stats() for s in self.servers])
+        (:func:`merge_host_stats`).  Adoption state rides along only
+        once an adoption happened — an unfailed plane's receipt is
+        byte-identical to the pre-failover build's."""
+        out = merge_host_stats([s.stats() for s in self.servers])
+        if self.adoptions:
+            out["adoptions"] = self.adoptions
+            out["overlay"] = {str(d): a for d, a
+                              in sorted(self.router.overlay.items())}
+        return out
 
 
 def merge_host_stats(per_host: list[dict]) -> dict:

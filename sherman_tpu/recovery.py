@@ -169,6 +169,11 @@ class RecoveryPlane:
         self.eng = eng
         self.dir = directory
         self.journal_sync = bool(journal_sync)
+        #: re-base sweep gate: an adopting host recovers a DEAD peer's
+        #: chain with the sweep deferred (``recover(sweep_stale=
+        #: False)``) so the fenced zombie segment stays on disk as
+        #: evidence for the fenced-suffix audit (hostlease.py)
+        self.sweep_stale = True
         # bounded-delay journal group commit (utils/journal.py): acks
         # still gate on a covering fsync (RPO 0 by construction), but
         # concurrent ops coalesce into one fsync per window
@@ -349,7 +354,8 @@ class RecoveryPlane:
         self.cid = _cid_of(epoch)
         self._tip_epoch = epoch
         self.delta_paths = []
-        self._sweep_stale()
+        if self.sweep_stale:
+            self._sweep_stale()
         self._rotate_journal(1)
         # the base save above is already durable: retired segments of
         # this chain (none on a fresh chain) can go now
@@ -408,7 +414,8 @@ class RecoveryPlane:
                 tcfg=None, journal_sync: bool = True,
                 attach_router: bool = True,
                 group_commit_ms: float = 0.0,
-                host_id: int = 0, hosts: int = 1):
+                host_id: int = 0, hosts: int = 1,
+                sweep_stale: bool = True):
         """Rebuild a serving engine from the on-disk chain + journal.
 
         restore(base + deltas) -> replay journal segments in order ->
@@ -418,6 +425,9 @@ class RecoveryPlane:
         these into the published RTO.  With ``hosts > 1`` this is ONE
         host's half of :meth:`recover_union` — it restores/replays/
         re-bases the ``-h<host_id>-`` chain namespace only.
+        ``sweep_stale=False`` defers the re-base's stale-chain sweep:
+        host adoption keeps the dead host's old segments on disk so
+        the fenced zombie suffix stays auditable (hostlease.py).
         """
         from sherman_tpu.models.batched import BatchedEngine
         from sherman_tpu.models.btree import Tree
@@ -457,6 +467,7 @@ class RecoveryPlane:
                     journal_sync=journal_sync,
                     group_commit_ms=group_commit_ms,
                     host_id=host_id, hosts=hosts)
+        plane.sweep_stale = bool(sweep_stale)
         for rid, tenant, op, ok, *prov in acks:
             plane.dedup_window[(tenant, rid)] = (op, ok, *prov)
         plane.checkpoint_base()  # re-base: fresh chain, stale cid swept

@@ -712,6 +712,62 @@ def crc_of_range(path: str, start: int, end: int) -> int:
     return crc
 
 
+def frame_blob(payload: bytes) -> bytes:
+    """One journal-framed blob: ``[u32 len | u32 crc32 | payload]`` —
+    the same ``_HDR`` frame every segment record rides, reused by the
+    host-failure plane's durable control records (lease heartbeats and
+    the ownership map, ``sherman_tpu/hostlease.py``) so their
+    corruption discipline is the journal's own."""
+    payload = bytes(payload)
+    if len(payload) > MAX_PAYLOAD:
+        raise ConfigError(f"frame payload {len(payload)} B > MAX_PAYLOAD")
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def unframe_blob(blob: bytes) -> bytes:
+    """Decode exactly one :func:`frame_blob` frame -> payload.  Raises
+    :class:`JournalCorruptError` on a short header, a length that
+    disagrees with the blob, or a CRC mismatch — torn and corrupt
+    records are the same typed refusal."""
+    if len(blob) < _HDR.size:
+        raise JournalCorruptError(
+            f"framed blob of {len(blob)} B is shorter than the header")
+    length, crc = _HDR.unpack_from(blob, 0)
+    end = _HDR.size + length
+    if length > MAX_PAYLOAD or end > len(blob):
+        raise JournalCorruptError(
+            f"framed blob claims {length} B payload with "
+            f"{len(blob) - _HDR.size} B present — torn record")
+    payload = blob[_HDR.size:end]
+    if zlib.crc32(payload) != crc:
+        raise JournalCorruptError("framed blob CRC mismatch — content "
+                                  "corruption, refusing to decode")
+    return payload
+
+
+def iter_frames(blob: bytes):
+    """Walk consecutive :func:`frame_blob` frames -> (payloads, clean):
+    every CRC-valid complete frame from the front, stopping at the
+    first torn/invalid frame; ``clean`` is True when the walk consumed
+    the whole blob.  The append-only control-log reader (ownership map
+    adoptions survive an adopter crash mid-append by truncating at the
+    last clean frame, exactly the journal's torn-tail rule)."""
+    out = []
+    pos = 0
+    size = len(blob)
+    while pos + _HDR.size <= size:
+        length, crc = _HDR.unpack_from(blob, pos)
+        end = pos + _HDR.size + length
+        if length > MAX_PAYLOAD or end > size:
+            break
+        payload = blob[pos + _HDR.size:end]
+        if zlib.crc32(payload) != crc:
+            break
+        out.append(payload)
+        pos = end
+    return out, pos == size
+
+
 def _truncate(path: str, off: int, size: int, do_truncate: bool) -> None:
     _OBS_TORN.inc()
     obs.record_event("journal.torn_tail", path=path, at_byte=off,

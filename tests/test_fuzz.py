@@ -908,3 +908,121 @@ def test_fuzz_partition_storm(eight_devices, tmp_path):
         assert group.wait_quorum(1, timeout_s=30.0)["covered"] >= 1
         group.stop()
         plane.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_union_multi_failure(eight_devices, tmp_path, seed):
+    """Multi-failure union fuzz (hosts=3): random per-host traffic,
+    then a crash image with torn live-segment tails on TWO hosts at
+    once — recover_union truncates each torn host INDEPENDENTLY (the
+    single-chain contract, per host) and every acked op on all three
+    hosts survives.  The same image with one CORRUPT mid-chain link
+    added (a flipped journal payload byte with records following, or
+    a deleted delta link) raises the typed error for the WHOLE union —
+    the clean-truncate / typed-refusal boundary is per-FAILURE-KIND,
+    never a silently partial union."""
+    import os
+    import shutil
+
+    from sherman_tpu import obs
+    from sherman_tpu.config import TreeConfig
+    from sherman_tpu.models.btree import Tree as _Tree
+    from sherman_tpu.multihost import HostRouter
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.utils import checkpoint as CK
+    from sherman_tpu.utils import journal as J
+
+    rng = np.random.default_rng(9000 + seed)
+    H = 3
+    rdir = str(tmp_path / "r")
+    keys = np.unique(rng.integers(1, 1 << 56, 900,
+                                  dtype=np.uint64))[:600]
+    own = HostRouter(H).owner(keys)
+    hk = [keys[own == h] for h in range(H)]
+    models = []
+    jinfo = []
+    for h in range(H):
+        cfg = DSMConfig(machine_nr=4, pages_per_node=512,
+                        locks_per_node=256, step_capacity=256,
+                        chunk_pages=64)
+        cluster = Cluster(cfg)
+        tree = _Tree(cluster)
+        eng = batched.BatchedEngine(
+            tree, batch_per_node=128,
+            tcfg=TreeConfig(sibling_chase_budget=1))
+        batched.bulk_load(tree, hk[h], hk[h] ^ np.uint64(0xABCD))
+        eng.attach_router()
+        plane = RecoveryPlane(cluster, tree, eng, rdir,
+                              host_id=h, hosts=H)
+        plane.checkpoint_base()
+        model = {int(k): int(k ^ np.uint64(0xABCD)) for k in hk[h]}
+        # journaled traffic: writes, a mid-chain delta, more writes
+        # and deletes — so every host's chain has base+delta+journal
+        for r in range(3):
+            idx = rng.integers(0, len(hk[h]), 24)
+            ks = hk[h][idx]
+            vs = ks ^ np.uint64(0x31 + r)
+            eng.insert(ks, vs)
+            for k, v in zip(ks.tolist(), vs.tolist()):
+                model[k] = v
+            if r < 2:  # two links, so a deleted FIRST delta is a gap
+                assert plane.checkpoint_delta()["pages"] > 0
+        dk = np.unique(hk[h][rng.integers(0, len(hk[h]), 6)])
+        assert eng.delete(dk).all()
+        for k in dk.tolist():
+            model.pop(k, None)
+        models.append(model)
+        jp = eng.journal.path
+        plane.close()
+        jinfo.append((jp, os.path.getsize(jp)))
+        del cluster, tree, eng
+    # crash image: torn half-records on hosts 0 AND 1 simultaneously
+    torn_key = np.asarray([99991 + seed], np.uint64)
+    for h in (0, 1):
+        rec = J.encode_record(J.J_UPSERT, torn_key,
+                              np.asarray([1], np.uint64))
+        cut = int(rng.integers(1, len(rec)))
+        with open(jinfo[h][0], "ab") as f:
+            f.write(rec[:cut])
+        assert os.path.getsize(jinfo[h][0]) > jinfo[h][1]
+    bad = str(tmp_path / "bad")
+    shutil.copytree(rdir, bad)
+
+    snap0 = obs.snapshot()
+    ctxs, receipt = RecoveryPlane.recover_union(
+        rdir, hosts=H, batch_per_node=128,
+        tcfg=TreeConfig(sibling_chase_budget=1))
+    assert receipt["hosts"] == H
+    # BOTH torn tails truncated, independently, exactly once each
+    d = obs.delta(snap0, obs.snapshot())
+    assert d.get("journal.truncated_tails", 0) == 2, (seed, d)
+    for h in range(H):
+        eng = ctxs[h][3]
+        ak = np.fromiter(models[h].keys(), np.uint64)
+        av = np.fromiter(models[h].values(), np.uint64)
+        got, found = eng.search(ak)
+        assert found.all(), f"seed {seed} host {h}: acked keys lost"
+        np.testing.assert_array_equal(got, av,
+                                      err_msg=f"seed {seed} host {h}")
+        _g, ft = eng.search(torn_key)
+        assert not ft.any(), "torn (unacked) record replayed"
+        ctxs[h][0].close()
+    del ctxs
+
+    # same image + one corrupt mid-chain link on host 2: typed, whole
+    # union — even though hosts 0/1's torn tails truncate cleanly
+    if seed % 2 == 0:
+        jp2 = RecoveryPlane._discover(bad, host_id=2)[2][-1]
+        blob = bytearray(open(jp2, "rb").read())
+        assert blob[:8] == J.MAGIC
+        ln0 = J._HDR.unpack_from(blob, 8)[0]
+        blob[8 + J._HDR.size + int(rng.integers(0, ln0))] ^= 0xFF
+        open(jp2, "wb").write(bytes(blob))
+        want = J.JournalCorruptError
+    else:
+        os.unlink(RecoveryPlane._discover(bad, host_id=2)[1][0])
+        want = CK.CheckpointCorruptError
+    with pytest.raises(want):
+        RecoveryPlane.recover_union(bad, hosts=H, batch_per_node=128,
+                                    tcfg=TreeConfig(
+                                        sibling_chase_budget=1))

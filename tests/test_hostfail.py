@@ -1,0 +1,569 @@
+"""Host-failure plane tests (PR 20): the cross-host lease table's
+durable heartbeat/expiry/epoch discipline, the host-granularity
+journal fence (zombie appends refused typed, fenced suffix counted),
+the epoch-versioned ownership log (crash-mid-adoption resumable), the
+host chaos grammar, end-to-end chain adoption through the routed
+front door (exactly-once re-acks through the adopter, fan-out scans),
+and the perfgate hostfail pins."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sherman_tpu import obs
+from sherman_tpu.chaos import FaultPlan, HostChaos, HostFault
+from sherman_tpu.cluster import Cluster
+from sherman_tpu.config import ConfigError, DSMConfig, TreeConfig
+from sherman_tpu.errors import StateError
+from sherman_tpu.hostlease import (HostFailover, HostFence,
+                                   HostLeaseCorruptError, HostLeaseTable,
+                                   OwnershipLog, StaleHostError,
+                                   count_fenced_suffix)
+from sherman_tpu.models import batched
+from sherman_tpu.models.btree import Tree
+from sherman_tpu.multihost import (HostDownError, HostRouter,
+                                   MultihostService)
+from sherman_tpu.recovery import RecoveryPlane
+from sherman_tpu.utils import journal as J
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+def test_host_lease_knobs(monkeypatch):
+    from sherman_tpu import config as C
+
+    monkeypatch.delenv("SHERMAN_HOST_LEASE_S", raising=False)
+    assert C.host_lease_s() == 2.0
+    monkeypatch.setenv("SHERMAN_HOST_LEASE_S", "0.25")
+    assert C.host_lease_s() == 0.25
+    for bad in ("0", "-1", "pod"):
+        monkeypatch.setenv("SHERMAN_HOST_LEASE_S", bad)
+        with pytest.raises(ConfigError):
+            C.host_lease_s()
+
+    monkeypatch.delenv("SHERMAN_HOST_PROBE_S", raising=False)
+    assert C.host_probe_s() == 0.0  # shipped default: prober OFF
+    for off in ("", "0", "off", "no", "false"):
+        monkeypatch.setenv("SHERMAN_HOST_PROBE_S", off)
+        assert C.host_probe_s() == 0.0
+    monkeypatch.setenv("SHERMAN_HOST_PROBE_S", "1.5")
+    assert C.host_probe_s() == 1.5
+    for bad in ("-1", "often"):
+        monkeypatch.setenv("SHERMAN_HOST_PROBE_S", bad)
+        with pytest.raises(ConfigError):
+            C.host_probe_s()
+
+
+# ---------------------------------------------------------------------------
+# The lease table (pure file protocol — no engines)
+# ---------------------------------------------------------------------------
+
+def test_host_lease_table_protocol(tmp_path):
+    root = str(tmp_path / "r")
+    # hosts=1 refuses construction: the bit-identity pin's first line
+    # of defense (no lease files, no collector, on single-host planes)
+    with pytest.raises(StateError):
+        HostLeaseTable(root, 1)
+    tab = HostLeaseTable(root, 2, lease_s=0.2)
+    assert tab.read(0) is None and tab.probe(0) == "absent"
+
+    # register starts generation 1 and heartbeats durably
+    assert tab.register(0, hwm=("journal-h0-abc-000001.wal", 128)) == 1
+    rec = tab.read(0)
+    assert rec["host_id"] == 0 and rec["epoch"] == 1
+    assert rec["hwm"] == ["journal-h0-abc-000001.wal", 128]
+    assert tab.probe(0) == "live" and tab.is_live(0, 1)
+    assert not tab.is_live(0, 2)
+    # the record file is journal-CRC-framed and atomic-renamed
+    names = os.listdir(root)
+    assert "hostlease-h0.rec" in names
+    assert not any(n.endswith(".tmp") for n in names)
+    blob = open(os.path.join(root, "hostlease-h0.rec"), "rb").read()
+    assert json.loads(J.unframe_blob(blob))["epoch"] == 1
+
+    # age-based expiry (the client lease table's discipline, durable):
+    # expiry is a VERDICT, not a state change — the record is untouched
+    assert tab.probe(0, now=rec["timestamp"] + 0.1) == "live"
+    assert tab.probe(0, now=rec["timestamp"] + 0.3) == "expired"
+    assert tab.is_live(0, 1), "expiry alone must not fence"
+
+    # renew re-stamps; a renewal against a lost epoch is refused (a
+    # fenced host must not resurrect its lease)
+    t0 = tab.read(0)["timestamp"]
+    assert tab.renew(0, 1)
+    assert tab.read(0)["timestamp"] >= t0
+    assert not tab.renew(0, 99)
+
+    # expire() is the fence point: durable epoch bump + adopter stamp
+    assert tab.expire(0, adopter=1) == 2
+    rec = tab.read(0)
+    assert rec["epoch"] == 2 and rec["adopter"] == 1
+    assert not tab.is_live(0, 1) and tab.is_live(0, 2)
+    assert not tab.renew(0, 1), "old-epoch heartbeat refused"
+    # a restarting host re-registers into its CURRENT generation
+    assert tab.register(0) == 2
+    assert tab.epochs() == {0: 2}
+
+    # a corrupt record is a typed refusal, never a parsed heartbeat
+    tab.register(1)
+    p1 = os.path.join(root, "hostlease-h1.rec")
+    raw = bytearray(open(p1, "rb").read())
+    raw[-1] ^= 0xFF
+    open(p1, "wb").write(bytes(raw))
+    with pytest.raises(HostLeaseCorruptError):
+        tab.read(1)
+
+    # the hostfail pull collector registered on table construction
+    snap = obs.snapshot()
+    assert snap.get("hostfail.leases_renewed", 0) >= 2
+    assert snap.get("hostfail.expirations", 0) >= 1
+
+
+def test_host_lease_chaos_renewal_seam(tmp_path):
+    """The lease-renewal seam: a crashed/frozen/zombified host's
+    heartbeats are suppressed, so its lease expires under traffic."""
+    hc = HostChaos([])
+    tab = HostLeaseTable(str(tmp_path / "r"), 2, lease_s=5.0, chaos=hc)
+    tab.register(0)
+    assert tab.renew(0, 1)
+    hc.freeze(0)
+    assert not tab.renew(0, 1)
+    assert tab.renew(1, 1, force=True), "peer renewals unaffected"
+    hc.revive(0, zombie=True)
+    assert not tab.renew(0, 1), "zombie renewals suppressed too"
+    hc.heal()
+    assert tab.renew(0, 1)
+
+
+# ---------------------------------------------------------------------------
+# The ownership log
+# ---------------------------------------------------------------------------
+
+def test_ownership_log_fold_and_torn_tail(tmp_path):
+    log = OwnershipLog(str(tmp_path))
+    st = log.load()
+    assert st == {"version": 0, "overlay": {}, "pending": [],
+                  "records": []}
+    log.append({"version": 1, "dead": 0, "adopter": 1, "epoch": 2,
+                "state": "begin"})
+    st = log.load()
+    assert st["pending"] == [(0, 1, 2)] and st["overlay"] == {}
+    log.append({"version": 1, "dead": 0, "adopter": 1, "epoch": 2,
+                "state": "done"})
+    st = log.load()
+    assert st["overlay"] == {0: 1} and st["pending"] == []
+    # a later adoption of the same namespace supersedes (latest wins)
+    log.append({"version": 2, "dead": 0, "adopter": 2, "epoch": 3,
+                "state": "begin"})
+    log.append({"version": 2, "dead": 0, "adopter": 2, "epoch": 3,
+                "state": "done"})
+    assert log.load()["overlay"] == {0: 2}
+    # a torn trailing frame (adopter crashed mid-append) is ignored —
+    # the journal's own torn-tail rule on the map log
+    good = open(log.path, "rb").read()
+    frame = J.frame_blob(json.dumps({"version": 3, "dead": 1,
+                                     "adopter": 0, "epoch": 9,
+                                     "state": "begin"}).encode())
+    open(log.path, "ab").write(frame[: len(frame) // 2])
+    st = log.load()
+    assert st["overlay"] == {0: 2} and st["version"] == 2
+    open(log.path, "wb").write(good)
+    assert log.load()["version"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Host chaos grammar
+# ---------------------------------------------------------------------------
+
+def test_host_chaos_grammar_and_layers():
+    with pytest.raises(ConfigError):
+        HostFault(kind="host_melt")
+    with pytest.raises(ConfigError):
+        HostFault(kind="host_crash", span=0)
+    with pytest.raises(ConfigError):
+        HostFault(kind="host_crash", host=-1)
+    # FaultPlan routes host_* kinds into the host layer, exactly like
+    # repl_* into the repl layer — one grammar, three planes
+    plan = FaultPlan([
+        {"kind": "torn_page", "step": 1},
+        {"kind": "repl_drop", "poll": 0},
+        {"kind": "host_freeze", "host": 1, "at": 2, "span": 2},
+    ])
+    assert len(plan.faults) == 1 and len(plan.repl_faults) == 1
+    assert len(plan.host_faults) == 1
+    hc = plan.host_layer()
+    assert hc is plan.host_layer(), "layer built once, clock global"
+    assert any(d["kind"] == "host_freeze" for d in plan.describe())
+    # scheduled window [2, 4) on the dispatch clock, host 1 only
+    assert hc.on_dispatch(1) is None          # t=0
+    assert hc.on_dispatch(1) is None          # t=1
+    assert hc.on_dispatch(0) is None          # t=2: wrong host
+    assert not hc.allow_renew(1)              # t=3: in window, no tick
+    d = hc.on_dispatch(1)                     # t=3: in window
+    assert d == {"down": True, "state": "freeze"}
+    assert hc.on_dispatch(1) is None          # t=4: window passed
+    assert hc.allow_renew(1)
+    assert hc.exhausted
+    assert FaultPlan([{"kind": "torn_page"}]).host_layer() is None
+
+
+def test_host_chaos_manual_and_zombie_view():
+    hc = HostChaos([])
+    rec1 = {"host_id": 0, "epoch": 1, "timestamp": 1.0}
+    rec2 = {"host_id": 0, "epoch": 2, "timestamp": 2.0}
+    assert hc.lease_view(0, rec1) is rec1, "healthy host sees live"
+    hc.crash(0)
+    assert hc.on_dispatch(0) == {"down": True, "state": "crash"}
+    assert hc.on_dispatch(1) is None
+    assert not hc.allow_renew(0)
+    # revive as a ZOMBIE: reachable again, but its lease view pins at
+    # the first observation — it cannot watch its epoch get bumped
+    hc.revive(0, zombie=True)
+    assert hc.on_dispatch(0) == {"down": False, "state": "zombie"}
+    assert hc.lease_view(0, rec1) == rec1     # snapshot captured
+    assert hc.lease_view(0, rec2) == rec1     # bump invisible
+    assert not hc.allow_renew(0)
+    hc.heal()
+    assert hc.lease_view(0, rec2) is rec2     # live again: fence fires
+    assert hc.on_dispatch(0) is None and hc.exhausted
+    # clean restart drops the pinned view immediately
+    hc.freeze(1)
+    assert hc.lease_view(1, rec1) == rec1
+    hc.revive(1, zombie=False)
+    assert hc.lease_view(1, rec2) is rec2
+
+
+# ---------------------------------------------------------------------------
+# The host fence at the journal durability gate
+# ---------------------------------------------------------------------------
+
+def _small_cluster(pages=512, batch=128):
+    cfg = DSMConfig(machine_nr=4, pages_per_node=pages,
+                    locks_per_node=256, step_capacity=256,
+                    chunk_pages=64)
+    cluster = Cluster(cfg)
+    tree = Tree(cluster)
+    eng = batched.BatchedEngine(tree, batch_per_node=batch,
+                                tcfg=TreeConfig(sibling_chase_budget=1))
+    return cluster, tree, eng
+
+
+def _keyset(n=600, seed=5):
+    rng = np.random.default_rng(seed)
+    return np.unique(rng.integers(1, 1 << 56, int(n * 1.2),
+                                  dtype=np.uint64))[:n]
+
+
+def test_host_fence_zombie_suffix(eight_devices, tmp_path):
+    """The full zombie arc at the journal gate: live appends pass ->
+    freeze pins the host's lease view (in-flight append captures it)
+    -> the adopter bumps the epoch -> the zombie keeps appending past
+    the fence point (frames land, durably — the split-brain hazard) ->
+    heal surfaces the bump and the next append raises typed -> the
+    fenced suffix is exactly the zombie's frames, torn bytes
+    excluded."""
+    root = str(tmp_path / "r")
+    cluster, tree, eng = _small_cluster()
+    keys = _keyset(160, seed=3)
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0xABCD))
+    eng.attach_router()
+    plane = RecoveryPlane(cluster, tree, eng, root, host_id=0, hosts=2)
+    plane.checkpoint_base()
+
+    hc = HostChaos([])
+    tab = HostLeaseTable(root, 2, lease_s=60.0, chaos=hc)
+    epoch = tab.register(0)
+    snap0 = obs.snapshot()
+    fence = HostFence(tab, 0, epoch)
+    fence.install(eng)
+    k = np.asarray([keys[0]], np.uint64)
+    v = np.asarray([1], np.uint64)
+    eng.journal.append(J.J_UPSERT, k, v)      # live: passes
+    # rotation hands the fresh segment through the wrapped attach too
+    plane._rotate_journal(2)
+    eng.journal.append(J.J_UPSERT, k, v)
+
+    hc.freeze(0)
+    eng.journal.append(J.J_UPSERT, k, v)      # in-flight: pins the view
+    inner = getattr(eng.journal, "_inner", eng.journal)
+    fence_pt = (inner.path, os.path.getsize(inner.path))
+    new_epoch = tab.expire(0, adopter=1)      # the adopter's bump
+    assert new_epoch == epoch + 1
+
+    hc.revive(0, zombie=True)                 # frozen view: keeps acking
+    eng.journal.append(J.J_UPSERT, k, np.asarray([2], np.uint64))
+    eng.journal.append(J.J_UPSERT, k, np.asarray([3], np.uint64))
+    assert count_fenced_suffix(fence_pt) == 2
+    # a torn in-flight append past the suffix is NOT counted (unacked)
+    with open(inner.path, "ab") as f:
+        rec = J.encode_record(J.J_UPSERT, k, v)
+        f.write(rec[: len(rec) // 2])
+    assert count_fenced_suffix(fence_pt) == 2
+
+    hc.heal()                                 # the bump becomes visible
+    with pytest.raises(StaleHostError):
+        eng.journal.append(J.J_UPSERT, k, v)
+    with pytest.raises(StaleHostError):
+        eng.journal.append_acks([(7, "t", 1, True)])
+    assert fence.fenced == 2
+    d = obs.delta(snap0, obs.snapshot())
+    assert d.get("hostfail.fenced_host_acks", 0) == 2
+    kinds = [e["kind"] for e in obs.get_recorder().events()]
+    assert "host.zombie_fenced" in kinds
+    assert count_fenced_suffix(None) == 0
+    plane.close()
+
+
+# ---------------------------------------------------------------------------
+# Detection + adoption + resume (real chains, no front doors)
+# ---------------------------------------------------------------------------
+
+def _seed_host_chain(root, host_id, hosts, keys, rids=()):
+    """One host's chain in the shared directory: base + a few
+    journaled writes (+ J_ACK entries for ``rids``), closed."""
+    cluster, tree, eng = _small_cluster()
+    batched.bulk_load(tree, keys, keys ^ np.uint64(0xABCD))
+    eng.attach_router()
+    plane = RecoveryPlane(cluster, tree, eng, root,
+                          host_id=host_id, hosts=hosts)
+    plane.checkpoint_base()
+    eng.insert(keys[:24], keys[:24] ^ np.uint64(0x11))
+    for rid in rids:
+        eng.journal.append_acks([(rid, "default", 1, True)])
+    path = eng.journal.path
+    plane.close()
+    del cluster, tree, eng
+    return path
+
+
+def test_host_failover_detect_adopt_resume(eight_devices, tmp_path):
+    root = str(tmp_path / "r")
+    keys = _keyset(300, seed=11)
+    own = HostRouter(2).owner(keys)
+    hk = [keys[own == 0], keys[own == 1]]
+    jpath0 = _seed_host_chain(root, 0, 2, hk[0], rids=(41, 42))
+    _seed_host_chain(root, 1, 2, hk[1])
+
+    tab = HostLeaseTable(root, 2, lease_s=0.15)
+    tab.register(0)
+    tab.register(1)
+    fo = HostFailover(root, tab, 2,
+                      recover_kw={"batch_per_node": 128,
+                                  "tcfg": TreeConfig(
+                                      sibling_chase_budget=1)})
+    assert fo.detect() == [] and fo.unadopted_dead_hosts() == 0
+    # host 0 stops heartbeating; host 1 keeps renewing
+    deadline = time.time() + 5.0
+    while fo.detect() != [0] and time.time() < deadline:
+        tab.renew(1, 1)
+        time.sleep(0.03)
+    assert fo.detect() == [0] and fo.unadopted_dead_hosts() == 1
+    kinds = [e["kind"] for e in obs.get_recorder().events()]
+    assert "host.lease_expired" in kinds
+
+    # torn tail on the dead host's live segment: truncated by the
+    # adoption's replay, exactly the single-chain contract
+    rec = J.encode_record(J.J_UPSERT, np.asarray([12345], np.uint64),
+                          np.asarray([1], np.uint64))
+    open(jpath0, "ab").write(rec[: len(rec) // 2])
+
+    r = fo.adopt(0, 1)
+    assert r["dead"] == 0 and r["adopter"] == 1 and r["epoch"] == 2
+    assert r["fence"] is not None and r["adoption_ms"] > 0
+    plane0, _cl0, _tr0, eng0 = r["context"]
+    # the recovered engine serves the dead host's acked writes
+    got, found = eng0.search(hk[0][:24])
+    assert found.all()
+    np.testing.assert_array_equal(got, hk[0][:24] ^ np.uint64(0x11))
+    _g, f12345 = eng0.search(np.asarray([12345], np.uint64))
+    assert not f12345.any(), "torn (unacked) record must not replay"
+    # the dead window rode the replay into the plane (door-less adopt
+    # leaves seeding to the caller)
+    assert ("default", 41) in plane0.dedup_window
+    # ownership map durable; lease epochs bumped; nothing left dead
+    st = fo.log.load()
+    assert st["overlay"] == {0: 1} and st["pending"] == []
+    assert tab.epochs()[0] == 2
+    tab.renew(1, 1)  # host 1's own heartbeat lapsed during the adopt
+    assert fo.unadopted_dead_hosts() == 0, \
+        "an adopted host must not re-detect as dead"
+    kinds = [e["kind"] for e in obs.get_recorder().events()]
+    assert "host.adopt_begin" in kinds and "host.adopt_done" in kinds
+    # adopter crashed mid-adoption on the OTHER host: a begin frame
+    # with no done — resume() completes it from the journaled map
+    tab2 = HostLeaseTable(root, 2, lease_s=60.0)
+    fo2 = HostFailover(root, tab2, 2, recover_kw=fo.recover_kw)
+    epoch1_new = int(tab2.read(1)["epoch"]) + 1
+    fo2.log.append({"version": st["version"] + 1, "dead": 1,
+                    "adopter": 0, "epoch": epoch1_new, "state": "begin"})
+    tab2.expire(1, adopter=0)
+    assert fo2.log.load()["pending"] == [(1, 0, epoch1_new)]
+    done = fo2.resume()
+    assert len(done) == 1 and done[0]["dead"] == 1
+    st2 = fo2.log.load()
+    assert st2["overlay"] == {0: 1, 1: 0} and st2["pending"] == []
+    # resumed context serves host 1's chain
+    eng1 = done[0]["context"][-1]
+    _g, f1 = eng1.search(hk[1][:24])
+    assert f1.all()
+    snap = obs.snapshot()
+    assert snap.get("hostfail.adoptions", 0) >= 2
+    assert snap.get("hostfail.adoption_ms", 0) > 0
+    plane0.close()
+    done[0]["context"][0].close()
+
+
+# ---------------------------------------------------------------------------
+# The routed front door under host loss (end to end, with servers)
+# ---------------------------------------------------------------------------
+
+def _front_door(eng, host_id, calib):
+    from sherman_tpu.serve import ServeConfig, ShermanServer
+    cfg = ServeConfig(widths=(128, 512),
+                      p99_targets_ms={c: 1e9 for c in
+                                      ("read", "scan", "insert",
+                                       "delete")},
+                      write_linger_ms=0.5)
+    srv = ShermanServer(eng, cfg, host_id=host_id)
+    ck = calib[:64]
+    cv, cf = eng.search(ck)
+    srv.start(calib_keys=calib,
+              calib_writes=(ck[cf], np.asarray(cv)[cf]),
+              calib_delete_keys=np.asarray([1 << 60], np.uint64))
+    return srv
+
+
+@pytest.mark.slow
+def test_adoption_through_routed_door(eight_devices, tmp_path):
+    """Freeze -> expire -> adopt -> serve: the routed front door keeps
+    the dead host's keyspace available through the adopter, retried
+    rids re-ack their ORIGINAL results through the re-seeded window,
+    and fan-out scans run through the merged door before and after."""
+    root = str(tmp_path / "r")
+    keys = _keyset(360, seed=29)
+    router = HostRouter(2)
+    own = router.owner(keys)
+    hk = [keys[own == 0], keys[own == 1]]
+    hc = HostChaos([])
+    tab = HostLeaseTable(root, 2, lease_s=0.2, chaos=hc)
+    tcfg = TreeConfig(sibling_chase_budget=1)
+
+    hosts = []
+    for h in (0, 1):
+        cluster, tree, eng = _small_cluster()
+        batched.bulk_load(tree, hk[h], hk[h] ^ np.uint64(0xABCD))
+        eng.attach_router()
+        plane = RecoveryPlane(cluster, tree, eng, root,
+                              host_id=h, hosts=2)
+        plane.checkpoint_base()
+        epoch = tab.register(h)
+        HostFence(tab, h, epoch).install(eng)
+        srv = _front_door(eng, h, hk[h])
+        hosts.append((cluster, tree, eng, plane, srv))
+    svc = MultihostService([hc_[4] for hc_ in hosts], router,
+                           planes=[hc_[3] for hc_ in hosts])
+    svc.attach_chaos(hc)
+
+    # acked exactly-once writes through the routed door (split batch)
+    wk = keys[:64]
+    wv = wk ^ np.uint64(0x5151)
+    ok = svc.submit("insert", wk, wv, rid=7001).result(timeout=30)
+    assert ok.all()
+    # fan-out scan pre-failure: both shards merged in key order
+    lo = int(keys.min())
+    hi = int(keys[:80].max()) + 1
+    scans = svc.submit("scan", ranges=[(lo, hi)]).result(timeout=30)
+    sk, _sv = scans[0]
+    in_range = np.sort(keys[(keys >= lo) & (keys < hi)])
+    np.testing.assert_array_equal(sk, in_range)
+
+    # host 0 freezes under traffic: dispatch refused typed, renewals
+    # suppressed, lease expires
+    hc.freeze(0)
+    with pytest.raises(HostDownError):
+        svc.submit("read", wk)
+    with pytest.raises(HostDownError):
+        svc.submit("scan", ranges=[(lo, hi)])
+    fo = HostFailover(root, tab, 2,
+                      recover_kw={"batch_per_node": 128, "tcfg": tcfg})
+    deadline = time.time() + 5.0
+    while fo.detect() != [0] and time.time() < deadline:
+        tab.renew(1, tab.read(1)["epoch"])
+        time.sleep(0.03)
+    assert fo.detect() == [0]
+
+    # host 1 adopts: recover the -h0- chain, re-seed the window, swap
+    # the service's door, publish the overlay
+    def door(plane, cluster, tree, eng):
+        return _front_door(eng, 1, hk[0])
+
+    r = fo.adopt(0, 1, door_factory=door, service=svc)
+    assert r["seeded"] > 0, "dead window must re-seed into the door"
+    assert svc.router.overlay == {0: 1}
+    assert svc.router.owner(hk[0][:4]).tolist() == [0] * 4, \
+        "ownership (namespace identity) never remapped"
+    hc.heal(0)  # transport view: the frozen PROCESS no longer routes
+
+    # the dead keyspace serves through the adopter, values intact
+    got, found = svc.submit("read", wk).result(timeout=30)
+    assert found.all()
+    np.testing.assert_array_equal(got, wv)
+    # a retried rid re-acks the ORIGINAL result through the adopter's
+    # re-seeded window — exactly-once across host death
+    f = svc.submit("insert", wk, wv, rid=7001)
+    assert f.result(timeout=30).all() and f.deduped
+    # fresh writes land; fan-out scans run post-adoption too
+    nk = keys[64:96]
+    assert svc.submit("insert", nk, nk, rid=7002).result(timeout=30).all()
+    scans = svc.submit("scan", ranges=[(lo, hi)]).result(timeout=30)
+    np.testing.assert_array_equal(scans[0][0], in_range)
+    st = svc.stats()
+    assert st["adoptions"] == 1 and st["overlay"] == {"0": 1}
+
+    r["server"].stop()
+    for _cl, _tr, _en, pl, srv in hosts:
+        try:
+            srv.kill()
+        except Exception:  # noqa: BLE001 — frozen host's door may be dead
+            pass
+        pl.close()
+    r["context"][0].close()
+
+
+# ---------------------------------------------------------------------------
+# perfgate: hostfail pins
+# ---------------------------------------------------------------------------
+
+def test_perfgate_hostfail_hard_pins():
+    """hostfail_drill receipts ride the never-throughput-gated drill
+    rail; fenced_acks_merged and unadopted_dead_hosts are marginless
+    zero-pins, both directions."""
+    import perfgate
+
+    closed = {"keys": 200_000, "batch": 4096, "value": 1_000_000,
+              "sustained_ops_s": 2_000_000,
+              "sus_dev_ms_per_step": 10.0, "_round": 5}
+    good = {"metric": "hostfail_drill", "hosts": 2, "lost_acks": 0,
+            "duplicate_acks": 0, "linearizable": True,
+            "fenced_acks_merged": 0, "unadopted_dead_hosts": 0}
+    res = perfgate.gate(dict(good), [closed])
+    assert res["ok"] and "error" not in res, res
+    assert res["metrics"]["contract.fenced_acks_merged"]["ok"]
+    assert res["metrics"]["contract.unadopted_dead_hosts"]["ok"]
+    for bad in ({"fenced_acks_merged": 1}, {"unadopted_dead_hosts": 1},
+                {"lost_acks": 1}, {"linearizable": False}):
+        res = perfgate.gate(dict(good, **bad), [closed])
+        assert not res["ok"], bad
+    # the zero-pin rail also catches a NON-drill receipt carrying the
+    # field (both directions: presence pins, absence never does)
+    res = perfgate.gate({"unadopted_dead_hosts": 2}, [closed])
+    assert not res["ok"]

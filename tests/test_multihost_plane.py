@@ -107,14 +107,24 @@ def test_host_router_deterministic_split():
 # ---------------------------------------------------------------------------
 
 class _FakeFuture:
-    def __init__(self, op, keys, values):
+    def __init__(self, op, keys, values, ranges=None, host=0):
         self.op, self.keys, self.values = op, keys, values
+        self.ranges, self.host = ranges, host
         self.deduped = op != "read"
 
     def done(self):
         return True
 
     def result(self, timeout=None):
+        if self.op == "scan":
+            # host h's shard of each range: keys congruent to h mod 2
+            # (so a 2-host merge must interleave to restore key order)
+            out = []
+            for lo, hi in self.ranges:
+                ks = np.arange(int(lo) + self.host, int(hi), 2,
+                               dtype=np.uint64)
+                out.append((ks, ks ^ np.uint64(0xAB)))
+            return out
         k = np.asarray(self.keys, np.uint64)
         if self.op == "read":
             return k ^ np.uint64(0xAB), (k % np.uint64(3)) != 0
@@ -122,13 +132,16 @@ class _FakeFuture:
 
 
 class _FakeServer:
-    def __init__(self):
+    def __init__(self, host=0):
+        self.host = host
         self.calls = []
 
     def submit(self, op, keys=None, values=None, *, tenant="default",
-               rid=None, deadline_ms=None):
-        self.calls.append((op, np.asarray(keys, np.uint64), rid))
-        return _FakeFuture(op, keys, values)
+               ranges=None, rid=None, deadline_ms=None):
+        self.calls.append((op, None if keys is None
+                           else np.asarray(keys, np.uint64), rid))
+        return _FakeFuture(op, keys, values, ranges=ranges,
+                           host=self.host)
 
     def stats(self):
         return {}
@@ -137,7 +150,7 @@ class _FakeServer:
 def test_multihost_service_split_merge_order():
     rng = np.random.default_rng(11)
     keys = rng.integers(1, 1 << 60, 257, dtype=np.uint64)
-    servers = [_FakeServer(), _FakeServer()]
+    servers = [_FakeServer(0), _FakeServer(1)]
     svc = MultihostService(servers)
     f = svc.submit("read", keys, rid=42)
     vals, found = f.result(timeout=5)
@@ -155,9 +168,22 @@ def test_multihost_service_split_merge_order():
     ok = svc.submit("insert", keys, keys).result(timeout=5)
     assert ok.shape == keys.shape and ok.all()
     assert svc.submit("insert", keys, keys, rid=7).deduped
-    # scans do not split over a hash partition: refused typed
+    # scans FAN OUT: every host runs the range set over its shard and
+    # the merged future restores plane-wide key order per range
+    fs = svc.submit("scan", ranges=[(10, 20), (100, 105)])
+    scans = fs.result(timeout=5)
+    assert len(scans) == 2
+    for (lo, hi), (ks, vs) in zip([(10, 20), (100, 105)], scans):
+        np.testing.assert_array_equal(
+            ks, np.arange(lo, hi, dtype=np.uint64))
+        np.testing.assert_array_equal(vs, ks ^ np.uint64(0xAB))
+    assert not fs.deduped  # scans never ride the write contract
+    # the one typed refusal left: a resume cursor (positional within
+    # ONE host's range walk — does not compose over a hash partition)
     with pytest.raises(ConfigError):
-        svc.submit("scan", keys[:4])
+        svc.submit("scan", ranges=[(10, 20)], cursor=b"tok")
+    with pytest.raises(ConfigError):
+        svc.submit("scan")  # still needs ranges
     # router/server width mismatch is a construction error
     with pytest.raises(ConfigError):
         MultihostService(servers, router=HostRouter(3))

@@ -1,0 +1,543 @@
+#!/usr/bin/env python
+"""Host-failure drill: lease expiry under traffic, zombie-host
+fencing, and chain adoption by the surviving host.
+
+The seventh end-to-end rehearsal (chaos = detection, recovery =
+durability, reshard = capacity, contract = the front door, failover =
+replication, multihost = the service plane) — this one pins the
+HOST-LOSS TOLERANCE plane (``sherman_tpu/hostlease.py``):
+
+  phase 1  TWO emulated host contexts in one process behind one
+           ``MultihostService`` (per-host chains ``-h0-``/``-h1-`` in
+           one shared directory), plus the cross-host LEASE TABLE:
+           each host registers a durable heartbeat record and a
+           renewer thread re-stamps it; every engine's journal gate is
+           wrapped by a ``HostFence`` bound to the host's lease epoch.
+  traffic  open-loop writers + a deleter (exactly-once rids) +
+           readers hammer the routed front door; one probe rid's
+           acked result is remembered for the post-adoption re-ack
+           pin.
+  freeze   host 0 freezes mid-traffic (``HostChaos``): the dispatch
+           seam refuses its sub-batches typed (``HostDownError``),
+           its renewals are suppressed, and ONE in-flight append
+           pins its lease view — the frozen host cannot watch its
+           own epoch get bumped.  Its lease expires UNDER TRAFFIC.
+  adopt    host 1 adopts: fence point captured (last clean frame
+           boundary — the torn half-frame appended at the freeze is
+           about to be truncated), ``begin`` journaled, epoch bumped
+           durably, host 0's chain recovered (torn tail truncated,
+           stale sweep deferred), dedup window re-seeded into a fresh
+           front door, ownership overlay published, ``done``
+           journaled.  The availability gap (freeze -> first
+           successful routed op on the dead keyspace) is published.
+  zombie   host 0 revives as a ZOMBIE: its pinned lease view still
+           says epoch 1, so its stale acks keep landing durably —
+           PAST the fence point, where ``count_fenced_suffix`` counts
+           them and the read-back audit proves none ever merged.  On
+           heal the bump becomes visible and the next append raises
+           the typed ``StaleHostError``.
+  audit    retried probe rid re-acks its ORIGINAL result through the
+           adopter's re-seeded window; the merged acked-op ledger
+           reads back through the adopted door (``lost_acks == 0``);
+           the whole routed history checks linearizable offline.
+
+Runs on the CPU mesh anywhere (``bench.py --hostfail-drill`` forwards
+here; ``scripts/hostfail_ci.sh`` pins it in CI).  Prints ONE JSON line
+``{"metric": "hostfail_drill", "ok": true, "lost_acks": 0,
+"duplicate_acks": 0, "linearizable": true, "fenced_acks_merged": 0,
+"unadopted_dead_hosts": 0, "availability_gap_ms": ..., ...}`` and
+mirrors it to ``SHERMAN_HOSTFAIL_RECEIPT`` when set.  perfgate treats
+the committed receipt as a robustness artifact: never
+throughput-gated, but ``lost_acks``/``duplicate_acks``/
+``fenced_acks_merged``/``unadopted_dead_hosts`` nonzero or
+``linearizable == false`` is a marginless hard red.  Env knobs:
+SHERMAN_DRILL_KEYS (default 4000), SHERMAN_CHAOS_SEED,
+SHERMAN_DRILL_SECS, SHERMAN_HOST_LEASE_S (drill default 0.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from common import build_cluster, pages_for_keys, setup_platform
+
+SALT = 0x30057FEB  # bulk-load value stamp (key ^ SALT)
+PROBE_RID = 0x51C0FFEE  # the exactly-once re-ack probe
+
+
+def _chunked_svc_read(svc, keys: np.ndarray, width: int = 512):
+    """Routed point reads in dispatch-sized chunks -> (values, found)."""
+    vs, fs = [], []
+    for i in range(0, keys.size, width):
+        v, f = svc.submit("read", keys[i:i + width]).result(timeout=120)
+        vs.append(np.asarray(v, np.uint64))
+        fs.append(np.asarray(f, bool))
+    return np.concatenate(vs), np.concatenate(fs)
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--keys", type=int,
+                   default=int(os.environ.get("SHERMAN_DRILL_KEYS", 4000)))
+    p.add_argument("--seed", type=int,
+                   default=int(os.environ.get("SHERMAN_CHAOS_SEED", 7)))
+    p.add_argument("--secs", type=float,
+                   default=float(os.environ.get("SHERMAN_DRILL_SECS", 2.0)))
+    p.add_argument("--lease-s", type=float,
+                   default=float(os.environ.get("SHERMAN_HOST_LEASE_S",
+                                                0.5)))
+    p.add_argument("--dir", default=None,
+                   help="drill directory (default: a tempdir)")
+    a = p.parse_args(argv)
+    # one device per emulated host (the failover drill's lesson)
+    setup_platform(1)
+
+    from sherman_tpu import audit as A
+    from sherman_tpu import obs
+    from sherman_tpu.chaos import HostChaos
+    from sherman_tpu.errors import ShermanError
+    from sherman_tpu.hostlease import (HostFailover, HostFence,
+                                       HostLeaseTable, StaleHostError,
+                                       count_fenced_suffix)
+    from sherman_tpu.models import batched
+    from sherman_tpu.models.validate import check_structure_device
+    from sherman_tpu.multihost import HostRouter, MultihostService
+    from sherman_tpu.recovery import RecoveryPlane
+    from sherman_tpu.serve import (RetryingClient, RetryPolicy,
+                                   ServeConfig, ShermanServer)
+    from sherman_tpu.utils import journal as J
+
+    t_start = time.time()
+    H = 2
+    out: dict = {"metric": "hostfail_drill", "seed": a.seed, "ok": False,
+                 "hosts": H, "keys": a.keys, "lease_s": a.lease_s}
+    root = a.dir or tempfile.mkdtemp(prefix="sherman_hostfail_")
+    out["dir"] = root
+    snap0 = obs.snapshot()
+
+    # -- phase 1: two host contexts + the lease table -------------------------
+    router = HostRouter(H)
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 56, int(a.keys * 1.05),
+                                  dtype=np.uint64))[:a.keys]
+    vals = keys ^ np.uint64(SALT)
+    own = router.owner(keys)
+    out["key_split"] = [int((own == h).sum()) for h in range(H)]
+    assert all(n > 0 for n in out["key_split"]), "degenerate key split"
+
+    hc = HostChaos([], seed=a.seed)
+    table = HostLeaseTable(root, H, lease_s=a.lease_s, chaos=hc)
+
+    widths = (256, 1024)
+    big = {c: 1e9 for c in ("read", "scan", "insert", "delete")}
+
+    def front_door(engine, host_id: int, calib: np.ndarray):
+        cfg = ServeConfig(widths=widths, p99_targets_ms=dict(big),
+                          write_linger_ms=0.5, write_width=2048,
+                          group_commit_ms=2.0)
+        srv = ShermanServer(engine, cfg, host_id=host_id)
+        ck = calib[:256]
+        cv, cf = engine.search(ck)
+        srv.start(calib_keys=calib,
+                  calib_writes=(ck[cf], np.asarray(cv)[cf]),
+                  calib_delete_keys=np.asarray([1 << 60], np.uint64))
+        return srv
+
+    ppn = pages_for_keys(a.keys)
+    hosts = []  # [(cluster, tree, eng, plane, srv, my_keys)]
+    epochs = {}
+    for h in range(H):
+        cluster, tree, eng = build_cluster(
+            1, ppn, batch_per_node=512,
+            locks_per_node=1024, chunk_pages=64)
+        my = keys[own == h]
+        batched.bulk_load(tree, my, my ^ np.uint64(SALT))
+        eng.attach_router()
+        check_structure_device(tree)
+        plane = RecoveryPlane(cluster, tree, eng, root,
+                              group_commit_ms=2.0, host_id=h, hosts=H)
+        plane.checkpoint_base()
+        epochs[h] = table.register(
+            h, hwm=(eng.journal.path, os.path.getsize(eng.journal.path)))
+        HostFence(table, h, epochs[h], chaos=hc).install(eng)
+        srv = front_door(eng, h, my)
+        hosts.append((cluster, tree, eng, plane, srv, my))
+    svc = MultihostService([x[4] for x in hosts], router,
+                           planes=[x[3] for x in hosts])
+    svc.attach_chaos(hc)
+    failover = HostFailover(root, table, H,
+                            recover_kw={"group_commit_ms": 2.0})
+
+    # the renewer: each host's heartbeat, gated by chaos (a frozen or
+    # zombified host's renewals are suppressed at the seam)
+    stop_renew = threading.Event()
+
+    def renewer():
+        while not stop_renew.is_set():
+            for h in range(H):
+                table.renew(h, epochs[h])
+            time.sleep(a.lease_s / 5.0)
+
+    renew_thr = threading.Thread(target=renewer, daemon=True)
+    renew_thr.start()
+
+    # -- acked mixed traffic through the routed front door --------------------
+    n_writers, n_readers = 2, 1
+    per = a.keys // (n_writers + 2)
+    del_slice = keys[n_writers * per:(n_writers + 1) * per]
+    acked: list[dict] = [dict() for _ in range(n_writers + 1)]
+    unacked: list[dict] = [dict() for _ in range(n_writers + 1)]
+    events: list[list] = [[] for _ in range(n_writers + 1 + n_readers)]
+    stop = threading.Event()
+    gens = [0] * n_writers
+    pol = RetryPolicy(max_attempts=6, hedge_reads=False)
+
+    def writer(w: int, n_reqs: int):
+        my = keys[w * per:(w + 1) * per]
+        cl = RetryingClient(svc, tenant=f"writer{w}", policy=pol,
+                            seed=100 + w + gens[w])
+        ev = events[w]
+        wrng = np.random.default_rng(1000 * w + gens[w])
+        done = 0
+        while not stop.is_set() and (n_reqs == 0 or done < n_reqs):
+            gens[w] += 1
+            done += 1
+            time.sleep(0.005)
+            kreq = np.unique(my[wrng.integers(0, my.size, 48)])
+            vreq = kreq ^ np.uint64(SALT) ^ np.uint64(gens[w] << 8)
+            t_inv = time.perf_counter()
+            try:
+                ok = cl.insert(kreq, vreq)
+            except ShermanError:
+                # in flight across the outage: result unknown, not owed
+                for k, v in zip(kreq.tolist(), vreq.tolist()):
+                    unacked[w].setdefault(k, []).append((True, v))
+                continue
+            t_resp = time.perf_counter()
+            for k, v, o in zip(kreq.tolist(), vreq.tolist(),
+                               ok.tolist()):
+                if o:
+                    acked[w][k] = (True, v)
+                    ev.append((k, A.OP_INSERT, t_inv, t_resp, v, True))
+
+    def deleter(n_reqs: int):
+        cl = RetryingClient(svc, tenant="deleter", policy=pol, seed=300)
+        ev = events[n_writers]
+        drng = np.random.default_rng(4000)
+        done = 0
+        while not stop.is_set() and (n_reqs == 0 or done < n_reqs):
+            done += 1
+            time.sleep(0.011)
+            kreq = np.unique(
+                del_slice[drng.integers(0, del_slice.size, 24)])
+            t_inv = time.perf_counter()
+            try:
+                found = cl.delete(kreq)
+            except ShermanError:
+                for k in kreq.tolist():
+                    unacked[n_writers].setdefault(k, []).append(
+                        (False, None))
+                continue
+            t_resp = time.perf_counter()
+            for k, f in zip(kreq.tolist(), found.tolist()):
+                acked[n_writers][k] = (False, None)
+                ev.append((k, A.OP_DELETE, t_inv, t_resp, None,
+                           bool(f)))
+
+    def reader(r: int):
+        cl = RetryingClient(svc, tenant=f"reader{r}", policy=pol,
+                            seed=200 + r, deadline_ms=5000.0)
+        ev = events[n_writers + 1 + r]
+        rrng = np.random.default_rng(50 + r)
+        while not stop.is_set():
+            kreq = np.unique(keys[rrng.integers(0, keys.size, 64)])
+            t_inv = time.perf_counter()
+            try:
+                got, found = cl.read(kreq)
+            except ShermanError:
+                continue
+            t_resp = time.perf_counter()
+            for k, g, f in zip(kreq.tolist(), got.tolist(),
+                               found.tolist()):
+                ev.append((k, A.OP_READ, t_inv, t_resp,
+                           g if f else None, bool(f)))
+            time.sleep(0.001)
+
+    readers = [threading.Thread(target=reader, args=(r,), daemon=True)
+               for r in range(n_readers)]
+    for t in readers:
+        t.start()
+    n_round = max(4, int(a.secs * 5))
+
+    def run_round(n_reqs: int):
+        ws = [threading.Thread(target=writer, args=(w, n_reqs),
+                               daemon=True) for w in range(n_writers)]
+        ws.append(threading.Thread(target=deleter, args=(n_reqs,),
+                                   daemon=True))
+        for t in ws:
+            t.start()
+        return ws
+
+    # round 1: acked load, both hosts up
+    for t in run_round(n_round):
+        t.join(timeout=300)
+
+    # the exactly-once probe: one acked rid whose result must re-ack
+    # IDENTICALLY through the adopter after host 0 dies
+    prng = np.random.default_rng(77)
+    h0keys = keys[own == 0]
+    imm = keys[(n_writers + 1) * per:]  # no writer/deleter slice
+    h0imm = imm[router.owner(imm) == 0]
+    pk = np.unique(h0imm[prng.integers(0, h0imm.size, 32)])
+    pv = pk ^ np.uint64(SALT) ^ np.uint64(0xBEEF << 16)
+    probe_f = svc.submit("insert", pk, pv, tenant="probe",
+                         rid=PROBE_RID)
+    probe_ok = np.asarray(probe_f.result(timeout=120), bool)
+    assert probe_ok.all()
+    t_inv = time.perf_counter()
+    for k, v in zip(pk.tolist(), pv.tolist()):
+        acked[0][k] = (True, v)
+        events[0].append((k, A.OP_INSERT, t_inv, t_inv, v, True))
+
+    # round 2: open-ended — traffic KEEPS RUNNING through the failure
+    ws = run_round(0)
+    time.sleep(min(0.5, a.secs / 4))
+
+    # -- freeze: host 0 stops responding AND stops heartbeating ---------------
+    t_freeze = time.perf_counter()
+    hc.freeze(0)
+    # the frozen process serves nothing: its door's dispatcher stops
+    # dead (no drain, journal left open — the crash image), queued
+    # requests fail typed and the clients ledger them as unacked
+    hosts[0][4].kill()
+    # one in-flight append inside the frozen host pins its lease view:
+    # the host was mid-write when it froze, and from here on it cannot
+    # watch its own epoch get bumped (PIN key sits outside the client
+    # keyspace — it replays as pre-fence durable state, never read)
+    eng0 = hosts[0][2]
+    eng0.journal.append(J.J_UPSERT, np.asarray([1 << 58], np.uint64),
+                        np.asarray([1], np.uint64))
+    # crash image: torn half-frame (in-flight at the freeze, unacked)
+    rec = J.encode_record(J.J_UPSERT, np.asarray([1 << 40], np.uint64),
+                          np.asarray([7], np.uint64), rid=0xDEAD)
+    with open(hosts[0][2].journal.path, "ab") as f:
+        f.write(rec[: len(rec) // 2])
+
+    # the lease expires UNDER TRAFFIC (the renewer is still stamping
+    # host 1; host 0's renewals are chaos-suppressed)
+    deadline = time.time() + max(20.0, 40 * a.lease_s)
+    while failover.detect() != [0] and time.time() < deadline:
+        time.sleep(a.lease_s / 10.0)
+    assert failover.detect() == [0], "host 0's lease never expired"
+    assert failover.unadopted_dead_hosts() == 1
+    t_expired = time.perf_counter()
+    out["detect_ms"] = round((t_expired - t_freeze) * 1e3, 1)
+
+    # -- adoption: host 1 takes over host 0's namespace -----------------------
+    def door(plane, cluster, tree, eng):
+        return front_door(eng, 1, h0keys)
+
+    r = failover.adopt(0, 1, door_factory=door, service=svc)
+    assert r["seeded"] > 0, "dead dedup window did not re-seed"
+    assert r["fence"] is not None
+    out["adoption"] = {"dead": r["dead"], "adopter": r["adopter"],
+                       "epoch": r["epoch"], "seeded": r["seeded"],
+                       "fence": r["fence"],
+                       "adoption_ms": r["adoption_ms"]}
+    # first successful routed op on the DEAD keyspace closes the gap
+    avail_deadline = time.time() + 60
+    while True:
+        try:
+            g, f = svc.submit("read", pk).result(timeout=30)
+            break
+        except ShermanError:
+            assert time.time() < avail_deadline, "keyspace never returned"
+            time.sleep(0.01)
+    t_avail = time.perf_counter()
+    out["availability_gap_ms"] = round((t_avail - t_freeze) * 1e3, 1)
+    assert np.asarray(f, bool).all()
+    np.testing.assert_array_equal(np.asarray(g, np.uint64), pv)
+
+    # -- zombie: host 0 revives with its PINNED pre-bump lease view -----------
+    hc.revive(0, zombie=True)
+    fenced_pairs = []
+    zrng = np.random.default_rng(a.seed)
+    for i in range(3):
+        zk = np.unique(h0keys[zrng.integers(0, h0keys.size, 8)])
+        zv = zk ^ np.uint64(0xFEFE << 8) ^ np.uint64(i)
+        # a stale ack: the zombie's own durability gate still says
+        # epoch 1, so the append LANDS — past the fence point
+        eng0.journal.append(J.J_UPSERT, zk, zv, rid=0xF0 + i)
+        fenced_pairs += list(zip(zk.tolist(), zv.tolist()))
+    suffix = count_fenced_suffix((os.path.join(root,
+                                               r["fence"]["segment"]),
+                                  r["fence"]["size"]))
+    out["fenced_suffix_frames"] = suffix
+    assert suffix >= 3, f"zombie appends not past the fence: {suffix}"
+    # heal: the epoch bump becomes visible — the NEXT stale ack is a
+    # typed refusal at the durability gate
+    hc.heal()
+    typed = 0
+    try:
+        eng0.journal.append(J.J_UPSERT, np.asarray([h0keys[0]],
+                                                   np.uint64),
+                            np.asarray([0], np.uint64))
+    except StaleHostError:
+        typed = 1
+    out["zombie_typed_rejections"] = typed
+    assert typed == 1, "post-heal zombie append was not typed-fenced"
+
+    # the retried probe rid re-acks its ORIGINAL result through the
+    # adopter's re-seeded window — exactly-once across host death
+    f2 = svc.submit("insert", pk, pv, tenant="probe", rid=PROBE_RID)
+    re_ok = np.asarray(f2.result(timeout=120), bool)
+    dup = 0 if (bool(f2.deduped)
+                and np.array_equal(re_ok, probe_ok)) else 1
+    out["duplicate_acks"] = dup
+    assert dup == 0, "retried rid did not dedup through the adopter"
+
+    # -- stop traffic, audit --------------------------------------------------
+    stop.set()
+    for t in ws + readers:
+        t.join(timeout=120)
+    svc_stats = svc.stats()
+    assert svc_stats["adoptions"] == 1
+    assert svc_stats["overlay"] == {"0": 1}
+
+    # fenced acks provably never merged: read every fenced (key, value)
+    # pair back through the ADOPTED door
+    fa = A.check_fenced_rejected(
+        lambda ks: _chunked_svc_read(svc, ks), fenced_pairs)
+    out["fenced_acks"] = fa["fenced"]
+    out["fenced_acks_merged"] = fa["merged"]
+    assert fa["merged"] == 0, \
+        f"zombie acks merged: {fa['violations'][:3]}"
+
+    # lost acks: the merged acked-op ledger against the adopted plane
+    merged: dict = {}
+    for d in acked:
+        merged.update(d)
+    assert merged, "drill acked no ops"
+    assert any(not pres for pres, _ in merged.values()), \
+        "drill acked no deletes (mixed traffic pin)"
+    open_w: dict = {}
+    for d in unacked:
+        for k, outs in d.items():
+            open_w.setdefault(k, []).extend(outs)
+    ak = np.asarray(sorted(merged), np.uint64)
+    t_inv = time.perf_counter()
+    got, found = _chunked_svc_read(svc, ak)
+    t_resp = time.perf_counter()
+    # an acked op's result must be served — unless a LATER in-flight
+    # (result-unknown) write on the same key could have replaced it:
+    # per key, the observed state must match the last acked outcome
+    # or one of the open-write outcomes (same-thread program order)
+    lost = 0
+    lost_keys = []
+    for k, g, f in zip(ak.tolist(), got.tolist(), found.tolist()):
+        seen = (bool(f), int(g) if f else None)
+        allowed = [merged[k]] + open_w.get(k, [])
+        if not any(pres == seen[0] and (not pres or int(v) == seen[1])
+                   for pres, v in allowed):
+            lost += 1
+            lost_keys.append((k, merged[k], seen))
+    post_events = [(int(k), A.OP_READ, t_inv, t_resp,
+                    int(g) if f else None, bool(f))
+                   for k, g, f in zip(ak.tolist(), got.tolist(),
+                                      found.tolist())]
+    # untouched-key probe: bulk values still served verbatim.  A key
+    # with an in-flight write at the kill is NOT untouched: its
+    # host-1 sub-batch may have applied before the merged future
+    # failed (result unknown, ledgered as an open write for the
+    # audit) — exclude those too
+    touched = set(merged)
+    for d in unacked:
+        touched.update(d)
+    tk = np.asarray(sorted(touched), np.uint64)
+    probe = keys[~np.isin(keys, tk)][:: max(1, a.keys // 512)]
+    got, found = _chunked_svc_read(svc, probe)
+    lost += int((~found).sum()) + int(
+        (got[found] != (probe ^ np.uint64(SALT))[found]).sum())
+    out["lost_acks"] = lost
+    assert lost == 0, \
+        f"{lost} acked/bulk ops lost across adoption: {lost_keys[:3]}"
+
+    # nothing left dead: host 0 is adopted, host 1 is still renewing
+    out["unadopted_dead_hosts"] = failover.unadopted_dead_hosts()
+    assert out["unadopted_dead_hosts"] == 0
+    stop_renew.set()
+    renew_thr.join(timeout=30)
+
+    # offline linearizability over the WHOLE routed history
+    all_events = [e for ev in events for e in ev] + post_events
+    initial = {int(k): (True, int(v)) for k, v in zip(keys, vals)}
+    verdict = A.check_events(all_events, initial=initial,
+                             open_writes=open_w)
+    out["audit"] = {
+        "events": verdict["events"], "keys": verdict["keys"],
+        "reads_checked": verdict["reads"],
+        "violations": len(verdict["violations"]),
+        "linearizable": bool(verdict["linearizable"]),
+    }
+    out["linearizable"] = bool(verdict["linearizable"])
+    if verdict["violations"]:
+        out["audit"]["first_violations"] = verdict["violations"][:3]
+    assert verdict["linearizable"], \
+        f"history not linearizable: {verdict['violations'][:3]}"
+    assert verdict["reads"] > 0, "audit checked no reads"
+    jsonl = os.path.join(root, "history.jsonl")
+    A.dump_jsonl(all_events, jsonl)
+    out["history_jsonl"] = jsonl
+
+    out["service"] = {
+        "admitted_ops": svc_stats["admitted_ops"],
+        "served_ops": svc_stats["served_ops"],
+        "acked_writes": svc_stats["acked_writes"],
+        "adoptions": svc_stats["adoptions"],
+        "overlay": svc_stats["overlay"],
+    }
+    assert svc_stats["acked_writes"] > 0
+
+    # flight-event + collector pins
+    kinds = {e["kind"] for e in obs.get_recorder().events()}
+    for want in ("host.lease_expired", "host.adopt_begin",
+                 "host.adopt_done", "host.zombie_fenced"):
+        assert want in kinds, f"missing flight event {want}"
+    d = obs.delta(snap0, obs.snapshot())
+    out["obs"] = {k: round(float(d[k]), 2) for k in sorted(d)
+                  if k.startswith(("hostfail.", "multihost.adoptions",
+                                   "chaos.host"))}
+    assert d.get("hostfail.expirations", 0) >= 1
+    assert d.get("hostfail.adoptions", 0) == 1
+    assert d.get("hostfail.fenced_host_acks", 0) >= 1
+
+    r["server"].stop()
+    for _cl, _tr, _en, pl, srv, _my in hosts:
+        try:
+            srv.kill()
+        except Exception:
+            pass
+        pl.close()
+    r["context"][0].close()
+    out["elapsed_s"] = round(time.time() - t_start, 1)
+    out["ok"] = True
+    line = json.dumps(out)
+    print(line)
+    receipt = os.environ.get("SHERMAN_HOSTFAIL_RECEIPT")
+    if receipt:
+        with open(receipt, "w") as f:
+            f.write(line + "\n")
+    print("HOSTFAIL-DRILL PASS", file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -495,17 +495,22 @@ def gate(cand: dict, rounds: list[dict], *, spread_mult: float = 2.0,
     # is a hard red with no margin: each is a count/verdict of a
     # correctness hazard, not a wall.
     if cand.get("metric") in ("contract_drill", "failover_drill",
-                              "partition_drill", "multihost_drill") \
+                              "partition_drill", "multihost_drill",
+                              "hostfail_drill") \
             or "duplicate_acks" in cand or "linearizable" in cand \
-            or "fenced_acks_merged" in cand:
+            or "fenced_acks_merged" in cand \
+            or "unadopted_dead_hosts" in cand:
         # partition-drill pins (PR 18) ride the same marginless rule:
         # a merged fenced ack or an unrepaired diverged follower is a
         # split-brain/divergence verdict, not a wall; the multihost
         # drill (PR 19) adds rpo_ops — an acked op missing after
-        # union recovery is lost durability, not a slow number
+        # union recovery is lost durability, not a slow number; the
+        # hostfail drill (PR 20) adds unadopted_dead_hosts — an
+        # expired host nobody adopted is unavailability, not a wall
         for name in ("duplicate_acks", "lost_acks", "rpo_ops",
                      "fenced_acks_merged",
-                     "diverged_followers_unrepaired"):
+                     "diverged_followers_unrepaired",
+                     "unadopted_dead_hosts"):
             val = cand.get(name)
             if val is None:
                 continue
