@@ -379,10 +379,11 @@ class HostChaos:
 
     Attached to a ``MultihostService`` (``service.attach_chaos``),
     which asks :meth:`on_dispatch` before routing any sub-batch to a
-    host; the host lease table asks :meth:`allow_renew` before each
-    heartbeat and routes a zombified host's self-reads through
-    :meth:`lease_view`.  Scheduled windows ride a dispatch clock (one
-    tick per service dispatch); drills drive failures by hand with
+    host and then advances the dispatch clock ONCE per dispatch via
+    :meth:`tick` (never once per host probed — fan-out must not age
+    the schedule); the host lease table asks :meth:`allow_renew`
+    before each heartbeat and routes a zombified host's self-reads
+    through :meth:`lease_view`.  Drills drive failures by hand with
     :meth:`crash`/:meth:`freeze`/:meth:`revive`/:meth:`heal` — the
     two compose, like :class:`ReplChaos`'s holds."""
 
@@ -448,19 +449,28 @@ class HostChaos:
     # -- the dispatch hook (service routing seam) -----------------------------
 
     def on_dispatch(self, host: int) -> dict | None:
-        """Directive for routing one sub-batch to ``host`` at this
-        dispatch tick, or None when the host is healthy (the zero-cost
-        common case).  ``{"down": True}`` means the host is
+        """Directive for routing one sub-batch to ``host`` at the
+        CURRENT dispatch tick, or None when the host is healthy (the
+        zero-cost common case).  ``{"down": True}`` means the host is
         unreachable (crashed or frozen) — the service must refuse
         typed rather than strand a sub-future.  A zombie host is NOT
         down: it accepts and acks (that's the hazard the fence
-        catches)."""
-        t = self._clock
-        self._clock += 1
-        state = self._state(host, t, tick_fire=True)
+        catches).  Pure with respect to the clock: a request probes
+        EVERY serving host at the same tick (a scan probes all of
+        them), and :meth:`tick` advances time once per dispatch."""
+        state = self._state(host, self._clock, tick_fire=True)
         if state == "up":
             return None
         return {"down": state in ("crash", "freeze"), "state": state}
+
+    def tick(self) -> int:
+        """Advance the dispatch clock by ONE — called exactly once
+        per ``MultihostService`` dispatch, after the per-host probes,
+        so scheduled fault windows elapse at the documented
+        one-tick-per-dispatch rate regardless of a request's fan-out.
+        Returns the new clock value."""
+        self._clock += 1
+        return self._clock
 
     # -- the lease-renewal seam -----------------------------------------------
 

@@ -32,11 +32,17 @@ single-host mechanisms into host-granularity failover:
   (``seed_dedup``, re-journaled for second-crash durability), and
   publishes an epoch-versioned ownership map.  The map is an
   APPEND-ONLY CRC-framed log (``ownership.maplog``): adoption writes
-  a ``begin`` frame before touching the dead chain and a ``done``
-  frame after the window re-seed, so an adopter crashing
-  mid-adoption leaves a durable in-flight marker that
-  :meth:`HostFailover.resume` completes — takeover survives the
-  adopter dying too.
+  a ``begin`` frame (carrying the captured fence point) before
+  touching the dead chain and a ``done`` frame after the window
+  re-seed, so an adopter crashing mid-adoption leaves a durable
+  in-flight marker that :meth:`HostFailover.resume` completes —
+  re-asserting the journaled epoch bump (the crash may have landed
+  before :meth:`HostLeaseTable.expire` ran) and reusing the journaled
+  fence (zombie appends between crash and resume stay in the fenced
+  suffix) — takeover survives the adopter dying too.  A restarted
+  previously-adopted host cannot silently rejoin at the fence epoch:
+  ``register`` refuses typed until :meth:`HostFailover.handback`
+  clears the overlay and opens a fresh lease generation.
 
 **Scope honesty.**  Same caveat as the rest of the multihost plane:
 this container's jaxlib has no multiprocess collectives, so hosts are
@@ -88,6 +94,14 @@ def _ensure_collector() -> None:
 class StaleHostError(StateError):
     """This host's lease epoch was bumped by an adopter: the append is
     fenced — a zombie host must not fork its (now adopted) journal."""
+
+
+class HostAdoptedError(StateError):
+    """This host's namespace is currently ADOPTED by a surviving peer:
+    re-registering would rejoin at the fence epoch and dual-write the
+    chain the adopter is serving.  An explicit hand-back
+    (:meth:`HostFailover.handback`) clears the overlay and opens a
+    fresh lease generation first."""
 
 
 class HostLeaseCorruptError(ShermanError, RuntimeError):
@@ -166,8 +180,21 @@ class HostLeaseTable:
         """Join (or re-join) the table: adopt the recorded epoch if a
         record exists (a restarting host continues its own lease
         generation), else start at epoch 1; write a fresh heartbeat.
-        Returns the epoch this host now holds."""
+        Returns the epoch this host now holds.
+
+        A record carrying an ``adopter`` stamp is a namespace someone
+        else is SERVING right now: rejoining at the recorded (fence)
+        epoch would make ``HostFence.check`` pass on the restarted
+        host while the adopter appends to the same chain — a
+        dual-writer.  Refused typed (:class:`HostAdoptedError`) until
+        an explicit hand-back (:meth:`handback`) clears the stamp."""
         rec = self.read(host_id)
+        if rec is not None and "adopter" in rec:
+            raise HostAdoptedError(
+                f"host {int(host_id)}'s namespace is adopted by host "
+                f"{int(rec['adopter'])} (fence epoch {int(rec['epoch'])}); "
+                "re-registering would dual-write the adopted chain — "
+                "hand the namespace back first")
         epoch = int(rec["epoch"]) if rec is not None else 1
         self.renew(host_id, epoch, hwm=hwm, force=True)
         return epoch
@@ -185,6 +212,7 @@ class HostLeaseTable:
                 and not self.chaos.allow_renew(int(host_id)):
             return False
         with self._lock:
+            rec = None
             if not force:
                 rec = self.read(host_id)
                 if rec is not None and int(rec["epoch"]) != int(epoch):
@@ -192,6 +220,10 @@ class HostLeaseTable:
             new = {"host_id": int(host_id), "epoch": int(epoch),
                    "hwm": self._hwm_field(hwm),
                    "timestamp": time.time()}
+            if rec is not None and "adopter" in rec:
+                # the adoption stamp is sticky across heartbeats: only
+                # an explicit hand-back may clear it
+                new["adopter"] = int(rec["adopter"])
             self._write(new)
         _STATS["leases_renewed"] += 1
         return True
@@ -243,6 +275,54 @@ class HostLeaseTable:
             self._write(new)
         _STATS["expirations"] += 1
         return old + 1
+
+    def ensure_epoch(self, host_id: int, epoch: int,
+                     adopter: int | None = None) -> int:
+        """Idempotent fence toward a journaled epoch: durably raise
+        ``host_id``'s lease epoch to AT LEAST ``epoch``.  The resume
+        path's bump — an adopter that crashed between the ``begin``
+        frame and :meth:`expire` left the dead host's epoch one short
+        of the journaled fence, and without the repair the zombie's
+        fence check and renewals would still pass.  A no-op when the
+        recorded epoch already reached ``epoch`` (the bump happened
+        before the crash).  Returns the recorded epoch after."""
+        with self._lock:
+            rec = self.read(host_id)
+            cur = int(rec["epoch"]) if rec is not None else 0
+            if cur >= int(epoch):
+                return cur
+            new = {"host_id": int(host_id), "epoch": int(epoch),
+                   "hwm": rec.get("hwm") if rec is not None else None,
+                   "timestamp": time.time()}
+            if adopter is not None:
+                new["adopter"] = int(adopter)
+            self._write(new)
+        _STATS["expirations"] += 1
+        return int(epoch)
+
+    def handback(self, host_id: int) -> int:
+        """Clear the adopter stamp and bump the epoch — the explicit
+        hand-back that lets a previously-adopted host re-register
+        (:meth:`register` refuses typed while the stamp is set).  The
+        bump opens a FRESH lease generation: every epoch the zombie or
+        the adopter ever fenced against stays behind the new fence.
+        Idempotent when no stamp is set (crash-retry safe); typed when
+        the host never registered.  Returns the epoch a re-register
+        will now join."""
+        with self._lock:
+            rec = self.read(host_id)
+            if rec is None:
+                raise StateError(
+                    f"host {int(host_id)} has no lease record to hand "
+                    "back")
+            if "adopter" not in rec:
+                return int(rec["epoch"])
+            new = {"host_id": int(host_id),
+                   "epoch": int(rec["epoch"]) + 1,
+                   "hwm": rec.get("hwm"),
+                   "timestamp": time.time()}
+            self._write(new)
+            return int(rec["epoch"]) + 1
 
     def epochs(self) -> dict:
         """{host: epoch} over every present record — the receipt
@@ -399,9 +479,13 @@ class OwnershipLog:
 
     def load(self) -> dict:
         """-> ``{"version", "overlay": {dead: adopter}, "pending":
-        [(dead, adopter, epoch), ...], "records"}``.  ``overlay`` is
-        the completed adoptions (latest version per dead host wins);
-        ``pending`` the begun-but-not-done set a resume must finish."""
+        [(dead, adopter, epoch, fence), ...], "records"}``.
+        ``overlay`` is the completed adoptions (latest version per
+        dead host wins); ``pending`` the begun-but-not-done set a
+        resume must finish, each carrying the fence point captured in
+        its ``begin`` frame (``[relpath, size]`` or None) so the
+        resume never recomputes it; a ``handback`` frame clears the
+        host's overlay entry (the namespace serves itself again)."""
         try:
             with open(self.path, "rb") as f:
                 blob = f.read()
@@ -420,7 +504,11 @@ class OwnershipLog:
             elif r["state"] == "done":
                 open_begins.pop(dead, None)
                 overlay[dead] = int(r["adopter"])
-        pending = [(int(r["dead"]), int(r["adopter"]), int(r["epoch"]))
+            elif r["state"] == "handback":
+                open_begins.pop(dead, None)
+                overlay.pop(dead, None)
+        pending = [(int(r["dead"]), int(r["adopter"]), int(r["epoch"]),
+                    r.get("fence"))
                    for r in open_begins.values()]
         return {"version": version, "overlay": overlay,
                 "pending": pending, "records": records}
@@ -550,9 +638,12 @@ class HostFailover:
         Protocol (every step durable before the next):
 
         1. capture the fence point (dead's live-segment size);
-        2. append the ``begin`` frame (crash after this is resumable);
-        3. durably bump dead's lease epoch (:meth:`HostLeaseTable.
-           expire`) — zombie appends from here land past the fence;
+        2. append the ``begin`` frame, the fence point journaled
+           inside it (crash after this is resumable, and the resume
+           reuses THIS fence rather than recomputing a later one);
+        3. durably raise dead's lease epoch to the journaled fence
+           epoch (:meth:`HostLeaseTable.ensure_epoch`) — zombie
+           appends from here land past the fence;
         4. restore-then-replay dead's chain (``RecoveryPlane.recover``
            scoped to one peer, stale sweep deferred so the fenced
            zombie segment stays on disk as evidence);
@@ -568,47 +659,102 @@ class HostFailover:
         under ``"context"`` for the caller to own."""
         st = self.log.load()
         version = st["version"] + 1
-        epoch_new = None
         # resume path re-enters with the begin frame already durable
-        for d, a, e in st["pending"]:
-            if d == int(dead):
-                epoch_new = e
-                break
+        pend = next((p for p in st["pending"] if p[0] == int(dead)),
+                    None)
         return self._run_adoption(int(dead), int(adopter), version,
-                                  epoch_new, door_factory, service)
+                                  pend, door_factory, service)
 
     def resume(self, *, door_factory=None, service=None) -> list[dict]:
         """Finish every begun-but-not-done adoption in the ownership
         log — the adopter-crashed-mid-adoption exit.  Re-running the
         restore-then-replay core is safe: recover() rebuilds from the
-        chain and re-bases; the epoch bump already happened (the
-        begin frame is appended only after the fence capture, and the
-        bump is idempotent in effect — any epoch past the dead host's
-        own fences it)."""
+        chain and re-bases.  The crash may have landed BETWEEN the
+        begin frame and the epoch bump, so the resume re-asserts the
+        journaled epoch (``ensure_epoch`` — a no-op when the bump
+        already happened, a repair when it did not: without it the
+        zombie's fence check and renewals would still pass while the
+        adopter serves the namespace).  The fence point is the one
+        captured in the begin frame, never recomputed — a zombie may
+        have appended between the crash and the resume, and those
+        frames belong to the fenced suffix too."""
         out = []
-        for dead, adopter, epoch in self.log.load()["pending"]:
+        for pend in self.log.load()["pending"]:
             version = self.log.load()["version"] + 1
-            out.append(self._run_adoption(dead, adopter, version, epoch,
-                                          door_factory, service))
+            out.append(self._run_adoption(pend[0], pend[1], version,
+                                          pend, door_factory, service))
         return out
 
+    def handback(self, dead: int, router=None) -> int:
+        """Explicit hand-back: the adopted namespace returns to its
+        (restarted) owner.  Durably appends a ``handback`` frame to
+        the ownership log (clearing the overlay, so ``detect`` can
+        see the host again), clears the lease record's adopter stamp
+        and bumps the epoch (:meth:`HostLeaseTable.handback` — the
+        returning host re-registers into a FRESH generation, so no
+        fence the adopter raised ever passes again), and drops the
+        in-memory router overlay entry when a router is given.  The
+        caller owns rebuilding the host's front door before routing
+        traffic back.  Crash-retry safe: the log frame lands before
+        the lease record changes, and both halves are idempotent.
+        Returns the lease epoch a re-register now joins."""
+        dead = int(dead)
+        st = self.log.load()
+        rec = self.table.read(dead)
+        stamped = rec is not None and "adopter" in rec
+        if dead not in st["overlay"] and not stamped:
+            raise StateError(
+                f"host {dead} is not adopted; nothing to hand back")
+        if dead in st["overlay"]:
+            self.log.append({"version": st["version"] + 1, "dead": dead,
+                             "adopter": int(st["overlay"][dead]),
+                             "epoch": (int(rec["epoch"]) + 1
+                                       if rec is not None else 0),
+                             "state": "handback"})
+        epoch = self.table.handback(dead)
+        self._seen_expired.discard(dead)
+        if router is not None:
+            router.handback(dead)
+        obs.record_event("host.handback", host=dead, epoch=epoch)
+        return epoch
+
+    def _fence_field(self, fence: tuple[str, int] | None):
+        """Fence point -> its begin-frame shape (path made relative to
+        the chain directory, so the log moves with the directory)."""
+        if fence is None:
+            return None
+        path, size = fence
+        return [os.path.relpath(str(path), self.dir), int(size)]
+
+    def _fence_from_field(self, field) -> tuple[str, int] | None:
+        if field is None:
+            return None
+        rel, size = field
+        return (os.path.join(self.dir, str(rel)), int(size))
+
     def _run_adoption(self, dead: int, adopter: int, version: int,
-                      epoch_new: int | None, door_factory,
-                      service) -> dict:
+                      pending, door_factory, service) -> dict:
         from sherman_tpu.recovery import RecoveryPlane
         t0 = time.perf_counter()
-        fence = self.fence_point(dead)
-        if epoch_new is None:
-            # fresh adoption: fence first, then the durable intent
-            # marker, then the epoch bump — a crash between any two
-            # steps leaves either nothing (retry from detect) or a
-            # pending begin frame (resume)
-            epoch_new = (self.table.read(dead) or {"epoch": 0})
-            epoch_new = int(epoch_new["epoch"]) + 1
+        if pending is None:
+            # fresh adoption: capture the fence, journal it inside the
+            # durable intent marker, then bump the epoch — a crash
+            # between any two steps leaves either nothing (retry from
+            # detect) or a pending begin frame (resume)
+            fence = self.fence_point(dead)
+            rec = self.table.read(dead)
+            epoch_new = (int(rec["epoch"]) if rec is not None else 0) + 1
             self.log.append({"version": version, "dead": dead,
                              "adopter": adopter, "epoch": epoch_new,
-                             "state": "begin"})
-            self.table.expire(dead, adopter=adopter)
+                             "state": "begin",
+                             "fence": self._fence_field(fence)})
+        else:
+            _d, _a, epoch_new, fence_field = pending
+            fence = self._fence_from_field(fence_field)
+        # idempotent toward the journaled epoch: on the fresh path
+        # this IS the bump; on resume it repairs the crash window
+        # between the begin frame and the bump
+        self.table.ensure_epoch(dead, epoch_new, adopter=adopter)
         obs.record_event("host.adopt_begin", dead=dead, adopter=adopter,
                          epoch=epoch_new, version=version,
                          fence=None if fence is None else

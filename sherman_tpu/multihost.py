@@ -194,6 +194,14 @@ class HostRouter:
             raise ConfigError(f"host {dead} cannot adopt itself")
         self.overlay[dead] = adopter
 
+    def handback(self, dead: int) -> None:
+        """Drop one adoption overlay entry: ``dead``'s namespace
+        serves itself again.  The routing half of the explicit
+        hand-back (``hostlease.HostFailover.handback``) — the caller
+        re-registers the returning host and rebuilds its door before
+        traffic routes back."""
+        self.overlay.pop(int(dead), None)
+
     def owner(self, keys) -> np.ndarray:
         """Owner host per key -> int32 [n] in [0, hosts)."""
         k = np.ascontiguousarray(keys, np.uint64)
@@ -380,17 +388,23 @@ class MultihostService:
     def _check_dispatch(self, owners) -> None:
         """Ask the chaos layer about EVERY serving host of this
         request BEFORE submitting any part — a typed refusal must not
-        strand sub-futures already admitted on live hosts."""
+        strand sub-futures already admitted on live hosts.  The
+        dispatch clock ticks ONCE per service dispatch (refused or
+        not), never once per host probed, so scheduled fault windows
+        elapse independently of a request's fan-out."""
         if self._chaos is None:
             return
-        for h in owners:
-            serving = self.router.route(h)
-            d = self._chaos.on_dispatch(serving)
-            if d is not None and d.get("down"):
-                raise HostDownError(
-                    f"host {serving} (serving namespace {h}) is "
-                    f"unreachable ({d.get('state')}); retry by rid "
-                    "once the namespace is adopted")
+        try:
+            for h in owners:
+                serving = self.router.route(h)
+                d = self._chaos.on_dispatch(serving)
+                if d is not None and d.get("down"):
+                    raise HostDownError(
+                        f"host {serving} (serving namespace {h}) is "
+                        f"unreachable ({d.get('state')}); retry by rid "
+                        "once the namespace is adopted")
+        finally:
+            self._chaos.tick()
 
     def submit(self, op: str, keys=None, values=None, *,
                tenant: str = "default", ranges=None, cursor=None,

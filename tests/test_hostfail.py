@@ -19,10 +19,10 @@ from sherman_tpu.chaos import FaultPlan, HostChaos, HostFault
 from sherman_tpu.cluster import Cluster
 from sherman_tpu.config import ConfigError, DSMConfig, TreeConfig
 from sherman_tpu.errors import StateError
-from sherman_tpu.hostlease import (HostFailover, HostFence,
-                                   HostLeaseCorruptError, HostLeaseTable,
-                                   OwnershipLog, StaleHostError,
-                                   count_fenced_suffix)
+from sherman_tpu.hostlease import (HostAdoptedError, HostFailover,
+                                   HostFence, HostLeaseCorruptError,
+                                   HostLeaseTable, OwnershipLog,
+                                   StaleHostError, count_fenced_suffix)
 from sherman_tpu.models import batched
 from sherman_tpu.models.btree import Tree
 from sherman_tpu.multihost import (HostDownError, HostRouter,
@@ -109,9 +109,26 @@ def test_host_lease_table_protocol(tmp_path):
     assert rec["epoch"] == 2 and rec["adopter"] == 1
     assert not tab.is_live(0, 1) and tab.is_live(0, 2)
     assert not tab.renew(0, 1), "old-epoch heartbeat refused"
-    # a restarting host re-registers into its CURRENT generation
-    assert tab.register(0) == 2
-    assert tab.epochs() == {0: 2}
+    # the adoption stamp is sticky across heartbeats at the fence epoch
+    assert tab.renew(0, 2)
+    assert tab.read(0)["adopter"] == 1, "stamp must survive renewals"
+    # a previously-adopted host must NOT re-register into the fence
+    # epoch (it would dual-write the chain the adopter is serving):
+    # typed refusal until an explicit hand-back clears the stamp
+    with pytest.raises(HostAdoptedError):
+        tab.register(0)
+    assert tab.handback(0) == 3, "hand-back opens a fresh generation"
+    assert "adopter" not in tab.read(0)
+    assert tab.handback(0) == 3, "hand-back is idempotent"
+    assert tab.register(0) == 3
+    assert not tab.renew(0, 2), "the fence epoch never passes again"
+    assert tab.epochs() == {0: 3}
+
+    # ensure_epoch: the resume path's idempotent bump
+    assert tab.ensure_epoch(0, 3) == 3, "already there: no-op"
+    assert tab.ensure_epoch(0, 5, adopter=1) == 5
+    assert tab.read(0)["epoch"] == 5 and tab.read(0)["adopter"] == 1
+    tab.handback(0)
 
     # a corrupt record is a typed refusal, never a parsed heartbeat
     tab.register(1)
@@ -154,9 +171,12 @@ def test_ownership_log_fold_and_torn_tail(tmp_path):
     assert st == {"version": 0, "overlay": {}, "pending": [],
                   "records": []}
     log.append({"version": 1, "dead": 0, "adopter": 1, "epoch": 2,
-                "state": "begin"})
+                "state": "begin",
+                "fence": ["journal-h0-x-000001.wal", 512]})
     st = log.load()
-    assert st["pending"] == [(0, 1, 2)] and st["overlay"] == {}
+    assert st["pending"] == [(0, 1, 2,
+                              ["journal-h0-x-000001.wal", 512])]
+    assert st["overlay"] == {}
     log.append({"version": 1, "dead": 0, "adopter": 1, "epoch": 2,
                 "state": "done"})
     st = log.load()
@@ -178,6 +198,18 @@ def test_ownership_log_fold_and_torn_tail(tmp_path):
     assert st["overlay"] == {0: 2} and st["version"] == 2
     open(log.path, "wb").write(good)
     assert log.load()["version"] == 2
+    # a begin frame without a fence field (no live segment) folds to a
+    # None fence in pending — the resume then has nothing to count
+    log.append({"version": 3, "dead": 1, "adopter": 0, "epoch": 4,
+                "state": "begin"})
+    assert log.load()["pending"] == [(1, 0, 4, None)]
+    log.append({"version": 3, "dead": 1, "adopter": 0, "epoch": 4,
+                "state": "done"})
+    # an explicit hand-back clears the overlay entry durably
+    log.append({"version": 4, "dead": 0, "adopter": 2, "epoch": 4,
+                "state": "handback"})
+    st = log.load()
+    assert st["overlay"] == {1: 0} and st["version"] == 4
 
 
 # ---------------------------------------------------------------------------
@@ -203,13 +235,22 @@ def test_host_chaos_grammar_and_layers():
     hc = plan.host_layer()
     assert hc is plan.host_layer(), "layer built once, clock global"
     assert any(d["kind"] == "host_freeze" for d in plan.describe())
-    # scheduled window [2, 4) on the dispatch clock, host 1 only
-    assert hc.on_dispatch(1) is None          # t=0
+    # scheduled window [2, 4) on the dispatch clock, host 1 only; the
+    # clock ticks once per DISPATCH (tick()), never once per host
+    # probed — fan-out must not age the schedule
+    assert hc.on_dispatch(1) is None          # dispatch 0: t=0
+    hc.tick()
     assert hc.on_dispatch(1) is None          # t=1
-    assert hc.on_dispatch(0) is None          # t=2: wrong host
-    assert not hc.allow_renew(1)              # t=3: in window, no tick
+    hc.tick()
+    assert hc.on_dispatch(0) is None          # t=2: wrong host...
+    d = hc.on_dispatch(1)                     # ...same tick, fan-out
+    assert d == {"down": True, "state": "freeze"}, \
+        "probing another host first must not advance the window"
+    hc.tick()
+    assert not hc.allow_renew(1)              # t=3: still in window
     d = hc.on_dispatch(1)                     # t=3: in window
     assert d == {"down": True, "state": "freeze"}
+    hc.tick()
     assert hc.on_dispatch(1) is None          # t=4: window passed
     assert hc.allow_renew(1)
     assert hc.exhausted
@@ -398,19 +439,36 @@ def test_host_failover_detect_adopt_resume(eight_devices, tmp_path):
         "an adopted host must not re-detect as dead"
     kinds = [e["kind"] for e in obs.get_recorder().events()]
     assert "host.adopt_begin" in kinds and "host.adopt_done" in kinds
-    # adopter crashed mid-adoption on the OTHER host: a begin frame
-    # with no done — resume() completes it from the journaled map
+    # adopter crashed mid-adoption on the OTHER host, in the WORST
+    # window: the begin frame is durable but the crash landed BEFORE
+    # expire() bumped the epoch — resume() must repair the bump from
+    # the journaled epoch (without it the zombie's fence would still
+    # pass and it could resurrect its lease while the adopter serves)
     tab2 = HostLeaseTable(root, 2, lease_s=60.0)
     fo2 = HostFailover(root, tab2, 2, recover_kw=fo.recover_kw)
-    epoch1_new = int(tab2.read(1)["epoch"]) + 1
+    epoch1_old = int(tab2.read(1)["epoch"])
+    epoch1_new = epoch1_old + 1
     fo2.log.append({"version": st["version"] + 1, "dead": 1,
-                    "adopter": 0, "epoch": epoch1_new, "state": "begin"})
-    tab2.expire(1, adopter=0)
-    assert fo2.log.load()["pending"] == [(1, 0, epoch1_new)]
+                    "adopter": 0, "epoch": epoch1_new, "state": "begin",
+                    "fence": None})
+    assert fo2.log.load()["pending"] == [(1, 0, epoch1_new, None)]
     done = fo2.resume()
     assert len(done) == 1 and done[0]["dead"] == 1
+    # the journaled bump was re-asserted: the dead host's old epoch is
+    # fenced — a zombie heartbeat at it is refused
+    assert int(tab2.read(1)["epoch"]) == epoch1_new
+    assert tab2.read(1)["adopter"] == 0
+    assert not tab2.renew(1, epoch1_old), \
+        "zombie resurrected its lease through the crash window"
+    # the fence rode in from the begin frame, never recomputed (a
+    # recompute would have found host 1's live segment and undercounted
+    # any zombie frames appended before the resume)
+    assert done[0]["fence"] is None
     st2 = fo2.log.load()
     assert st2["overlay"] == {0: 1, 1: 0} and st2["pending"] == []
+    # resume is idempotent toward the epoch: running ensure again is
+    # a no-op
+    assert tab2.ensure_epoch(1, epoch1_new) == epoch1_new
     # resumed context serves host 1's chain
     eng1 = done[0]["context"][-1]
     _g, f1 = eng1.search(hk[1][:24])
@@ -420,6 +478,48 @@ def test_host_failover_detect_adopt_resume(eight_devices, tmp_path):
     assert snap.get("hostfail.adoption_ms", 0) > 0
     plane0.close()
     done[0]["context"][0].close()
+
+
+def test_host_register_refused_while_adopted_and_handback(tmp_path):
+    """The restart-after-adoption dual-writer hole: a previously-
+    adopted host that restarts cleanly must not rejoin at the fence
+    epoch while the adopter serves its chain (a fence built from that
+    epoch would pass check()).  register() refuses typed; the explicit
+    hand-back clears the overlay + stamp, opens a fresh lease
+    generation, and only then does the host rejoin."""
+    root = str(tmp_path / "r")
+    tab = HostLeaseTable(root, 2, lease_s=60.0)
+    tab.register(0)
+    tab.register(1)
+    fo = HostFailover(root, tab, 2)
+    # a completed adoption of host 0 by host 1 (log + lease record)
+    fo.log.append({"version": 1, "dead": 0, "adopter": 1, "epoch": 2,
+                   "state": "begin", "fence": None})
+    tab.expire(0, adopter=1)
+    fo.log.append({"version": 1, "dead": 0, "adopter": 1, "epoch": 2,
+                   "state": "done"})
+    router = HostRouter(2)
+    router.adopt(0, 1)
+    with pytest.raises(HostAdoptedError):
+        tab.register(0)
+    new_epoch = fo.handback(0, router=router)
+    assert new_epoch == 3
+    assert router.overlay == {} and fo.log.load()["overlay"] == {}
+    assert tab.register(0) == 3
+    assert tab.renew(0, 3)
+    assert not tab.renew(0, 2), "the adopter's fence epoch is behind"
+    kinds = [e["kind"] for e in obs.get_recorder().events()]
+    assert "host.handback" in kinds
+    # nothing adopted -> typed refusal
+    with pytest.raises(StateError):
+        fo.handback(1)
+    # crash-retry half: the overlay frame landed but the hand-back
+    # died before the lease record cleared — re-running finishes from
+    # the stamp alone (idempotent both halves)
+    tab.expire(0, adopter=1)          # stamp back on, no overlay
+    assert fo.handback(0) == 5
+    assert "adopter" not in tab.read(0)
+    assert tab.register(0) == 5
 
 
 # ---------------------------------------------------------------------------
